@@ -1,0 +1,163 @@
+"""CLI argument surface — capability parity with the reference's clap structs.
+
+Reference: `Args` (cake-core/src/lib.rs:21-88), `SDArgs` (lib.rs:90-127),
+`ImageGenerationArgs` (lib.rs:145-200), `ModelType` (lib.rs:14-19).
+
+Defaults match the reference where sensible; the dtype default is **bfloat16**
+instead of f16 (cake/mod.rs:54-60) because bf16 is the native TPU matmul type.
+`ImageGenerationArgs` doubles as the REST image-request body, like the
+reference's parallel clap/serde attributes (lib.rs:145-200, api/image.rs:15-18).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field, fields, asdict
+from enum import Enum
+from typing import Optional
+
+
+class ModelType(str, Enum):
+    TEXT = "text"
+    IMAGE = "image"
+
+
+class SDVersion(str, Enum):
+    V1_5 = "v1-5"
+    V2_1 = "v2-1"
+    XL = "xl"
+    TURBO = "turbo"
+
+
+@dataclass
+class Args:
+    """Process-wide configuration (reference lib.rs:21-88)."""
+
+    model: str = ""                     # path to model directory
+    model_type: ModelType = ModelType.TEXT
+    mode: str = "master"                # master | worker (compat; TPU runs SPMD)
+    name: str = ""                      # node name within the topology
+    address: str = "127.0.0.1:10128"    # serving bind address
+    api: Optional[str] = None           # REST bind address; None = one-shot CLI
+    topology: Optional[str] = None      # topology.yml path
+    prompt: str = "Why is the sky blue?"
+    system_prompt: str = "You are a helpful AI assistant."
+    seed: int = 299792458               # reference default (lib.rs)
+    sample_len: int = 100
+    temperature: float = 1.0
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    repeat_penalty: float = 1.1
+    repeat_last_n: int = 128
+    dtype: str = "bf16"                 # f16 | bf16 | f32 (TPU default bf16)
+    cpu: bool = False
+    device_idx: int = 0
+    max_seq_len: int = 4096             # reference hard constant (config.rs:6); tunable here
+    batch_size: int = 1
+    # parallelism knobs (TPU additions; reference has PP only)
+    tp: int = 1                         # tensor-parallel degree
+    dp: int = 1                         # data-parallel degree
+    sp: int = 1                         # sequence/context-parallel degree
+
+    def validate(self) -> "Args":
+        if self.dtype not in ("f16", "bf16", "f32"):
+            raise ValueError(f"unsupported dtype '{self.dtype}'")
+        if self.mode not in ("master", "worker"):
+            raise ValueError(f"unsupported mode '{self.mode}'")
+        return self
+
+
+@dataclass
+class SDArgs:
+    """Stable-Diffusion model options (reference lib.rs:90-127)."""
+
+    sd_version: SDVersion = SDVersion.V1_5
+    sd_tokenizer: Optional[str] = None
+    sd_tokenizer_2: Optional[str] = None
+    sd_use_f16: bool = True
+    sd_width: Optional[int] = None
+    sd_height: Optional[int] = None
+    sd_sliced_attention_size: Optional[int] = None
+    sd_clip: Optional[str] = None
+    sd_clip2: Optional[str] = None
+    sd_vae: Optional[str] = None
+    sd_unet: Optional[str] = None
+    sd_flash_attention: bool = False
+
+
+@dataclass
+class ImageGenerationArgs:
+    """Per-request image generation parameters (reference lib.rs:145-200).
+
+    Serves as both CLI flags and the JSON body of POST /api/v1/image
+    (reference api/image.rs:15-18).
+    """
+
+    image_prompt: str = "A very realistic photo of a rusty robot walking on a sandy beach"
+    image_uncond_prompt: str = ""
+    sd_tracing: bool = False
+    sd_img2img: Optional[str] = None
+    sd_img2img_strength: float = 0.8
+    sd_n_steps: Optional[int] = None
+    sd_num_samples: int = 1
+    sd_bsize: int = 1
+    sd_intermediary_images: bool = False
+    sd_guidance_scale: Optional[float] = None
+    sd_seed: Optional[int] = None
+
+    @classmethod
+    def from_json(cls, body: dict) -> "ImageGenerationArgs":
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in body.items() if k in known})
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, dc_type) -> None:
+    for f in fields(dc_type):
+        name = "--" + f.name.replace("_", "-")
+        default = f.default
+        if isinstance(default, Enum):
+            parser.add_argument(name, type=str, default=default.value,
+                                dest=f.name)
+        elif isinstance(default, bool):
+            parser.add_argument(name, action="store_true", default=default,
+                                dest=f.name)
+        elif default is None:
+            parser.add_argument(name, default=None, dest=f.name)
+        else:
+            parser.add_argument(name, type=type(default), default=default,
+                                dest=f.name)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cake-tpu",
+        description="TPU-native distributed LLM + diffusion inference",
+    )
+    _add_dataclass_args(parser, Args)
+    _add_dataclass_args(parser, SDArgs)
+    _add_dataclass_args(parser, ImageGenerationArgs)
+    return parser
+
+
+def parse_args(argv=None):
+    """Parse argv into (Args, SDArgs, ImageGenerationArgs)."""
+    ns = build_parser().parse_args(argv)
+    d = vars(ns)
+
+    def pick(dc_type):
+        kwargs = {}
+        for f in fields(dc_type):
+            v = d[f.name]
+            if isinstance(f.default, Enum) and not isinstance(v, Enum):
+                v = type(f.default)(v)
+            if f.type in ("int", "Optional[int]") and isinstance(v, str):
+                v = int(v)
+            if f.type in ("float", "Optional[float]") and isinstance(v, str):
+                v = float(v)
+            kwargs[f.name] = v
+        return dc_type(**kwargs)
+
+    return pick(Args).validate(), pick(SDArgs), pick(ImageGenerationArgs)

@@ -1,0 +1,42 @@
+"""Model-level abstractions: generator protocols, Token, chat types.
+
+Reference: `Generator` / `TextGenerator` / `ImageGenerator` traits and
+`Token` (cake-core/src/models/mod.rs:14-71).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Protocol, runtime_checkable
+
+
+@dataclass
+class Token:
+    """One generated token (reference models/mod.rs:14-36)."""
+
+    id: int
+    text: str
+    is_end_of_stream: bool = False
+
+    def __str__(self) -> str:
+        return "" if self.is_end_of_stream else self.text
+
+
+@runtime_checkable
+class TextGenerator(Protocol):
+    """Reference models/mod.rs:52-64."""
+
+    def add_message(self, message) -> None: ...
+    def reset(self) -> None: ...
+    def next_token(self, index: int) -> Token: ...
+    def generated_tokens(self) -> int: ...
+
+
+@runtime_checkable
+class ImageGenerator(Protocol):
+    """Reference models/mod.rs:66-71."""
+
+    def generate_image(self, args, callback: Callable[[List[bytes]], None]) -> None: ...
+
+
+from cake_tpu.models.chat import Message, MessageRole, History  # noqa: E402,F401

@@ -1,0 +1,92 @@
+"""Chat message types and the Llama-3 prompt template.
+
+Reference: `MessageRole`/`Message` (cake-core/src/models/chat.rs:5-64) and
+`History` (cake-core/src/models/llama3/history.rs:4-47), whose rendering
+follows meta-llama's tokenizer.py ChatFormat:
+
+  <|begin_of_text|>
+  then per message:
+    <|start_header_id|>{role}<|end_header_id|>\n\n{content}<|eot_id|>
+  then an empty assistant header to cue the model's completion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Iterator, List
+
+
+class MessageRole(str, Enum):
+    SYSTEM = "system"
+    USER = "user"
+    ASSISTANT = "assistant"
+
+
+@dataclass
+class Message:
+    role: MessageRole
+    content: str
+
+    @classmethod
+    def system(cls, content: str) -> "Message":
+        return cls(MessageRole.SYSTEM, content)
+
+    @classmethod
+    def user(cls, content: str) -> "Message":
+        return cls(MessageRole.USER, content)
+
+    @classmethod
+    def assistant(cls, content: str) -> "Message":
+        return cls(MessageRole.ASSISTANT, content)
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Message":
+        # serde aliases accepted by the reference REST body (chat.rs:5-38)
+        role = obj.get("role") or obj.get("Role")
+        content = obj.get("content") or obj.get("Content") or ""
+        return cls(MessageRole(role.lower()), content)
+
+    def to_json(self) -> dict:
+        return {"role": self.role.value, "content": self.content}
+
+
+BEGIN_OF_TEXT = "<|begin_of_text|>"
+START_HEADER = "<|start_header_id|>"
+END_HEADER = "<|end_header_id|>"
+EOT = "<|eot_id|>"
+
+
+class History:
+    """Chat history -> Llama-3 prompt string (reference history.rs:8-33)."""
+
+    def __init__(self) -> None:
+        self._messages: List[Message] = []
+
+    def add_message(self, message: Message) -> None:
+        self._messages.append(message)
+
+    def clear(self) -> None:
+        self._messages.clear()
+
+    def __len__(self) -> int:
+        return len(self._messages)
+
+    def __iter__(self) -> Iterator[Message]:
+        return iter(self._messages)
+
+    @staticmethod
+    def encode_header(role: str) -> str:
+        return f"{START_HEADER}{role}{END_HEADER}\n\n"
+
+    @staticmethod
+    def encode_message(message: Message) -> str:
+        return History.encode_header(message.role.value) + message.content.strip() + EOT
+
+    def render(self) -> str:
+        """Full dialog prompt, ending with an open assistant header."""
+        out = [BEGIN_OF_TEXT]
+        for m in self._messages:
+            out.append(self.encode_message(m))
+        out.append(self.encode_header(MessageRole.ASSISTANT.value))
+        return "".join(out)

@@ -1,0 +1,5 @@
+"""Llama-3 model family: config, params, KV cache, forward fns, generator."""
+
+from cake_tpu.models.llama.config import LlamaConfig  # noqa: F401
+from cake_tpu.models.llama.cache import KVCache  # noqa: F401
+from cake_tpu.models.llama import model  # noqa: F401

@@ -1,0 +1,66 @@
+"""Fixed-shape KV cache for TPU decode.
+
+The reference grows the cache by concatenation each step and trims past
+MAX_SEQ_LEN (llama3/cache.rs:93-122 — with a latent axis bug SURVEY.md §2.2
+tells us not to replicate). Growing shapes force recompilation under XLA, so
+the TPU design preallocates `[num_layers, batch, max_seq, kv_heads, head_dim]`
+buffers and writes each step's k/v with `dynamic_update_slice`; the absolute
+write position is a traced scalar, so prefill and every decode step reuse one
+compiled program.
+
+Per-session isolation (reference `Cache::as_new`, cache.rs:125-129) is
+`KVCache.fresh()` — a zeroed cache of the same spec; `clear()` semantics
+(cache.rs:132-135) are the same operation since the buffers are dense arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer KV buffers. k/v: [L, B, S_max, KV, hd]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @classmethod
+    def create(cls, config: LlamaConfig, batch_size: int, max_seq_len: int,
+               dtype=jnp.bfloat16, num_layers: int | None = None) -> "KVCache":
+        L = num_layers if num_layers is not None else config.num_hidden_layers
+        shape = (
+            L, batch_size, max_seq_len,
+            config.num_key_value_heads, config.head_dim,
+        )
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    def fresh(self) -> "KVCache":
+        """Zeroed cache with identical spec (reference cache.rs:125-135)."""
+        return KVCache(k=jnp.zeros_like(self.k), v=jnp.zeros_like(self.v))
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def batch_size(self) -> int:
+        return self.k.shape[1]
+
+
+def update_layer_cache(k_cache, v_cache, new_k, new_v, pos):
+    """Write one layer's new k/v at absolute position `pos`.
+
+    k_cache/v_cache: [B, S_max, KV, hd]
+    new_k/new_v:     [B, S, KV, hd]
+    pos:             traced scalar start index
+    Returns the updated buffers (same shapes — jit-donatable).
+    """
+    zeros = (0, pos, 0, 0)
+    k_cache = lax.dynamic_update_slice(k_cache, new_k.astype(k_cache.dtype), zeros)
+    v_cache = lax.dynamic_update_slice(v_cache, new_v.astype(v_cache.dtype), zeros)
+    return k_cache, v_cache

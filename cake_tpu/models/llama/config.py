@@ -1,0 +1,98 @@
+"""Llama model hyperparameters, deserialised from HF `config.json`.
+
+Reference: `LlamaConfig`/`Config` (cake-core/src/models/llama3/config.rs):
+rope_theta defaults to 10k (config.rs:8-10), GQA kv-head fallback to the
+full head count (config.rs:40-42). The reference hardcodes
+MAX_SEQ_LEN = 4096 (config.rs:6); here the runtime context window is a
+separate knob (`Args.max_seq_len`) so long-context serving isn't capped by
+a constant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 8192
+    bos_token_id: int = 128000
+    eos_token_ids: Tuple[int, ...] = (128001, 128009)
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_path(cls, model_dir: str) -> "LlamaConfig":
+        """Load from `<model_dir>/config.json` (reference config.rs:30-37)."""
+        with open(os.path.join(model_dir, "config.json")) as f:
+            raw = json.load(f)
+        return cls.from_hf_dict(raw)
+
+    @classmethod
+    def from_hf_dict(cls, raw: dict) -> "LlamaConfig":
+        eos = raw.get("eos_token_id", 128001)
+        if isinstance(eos, int):
+            eos = (eos,)
+        else:
+            eos = tuple(eos)
+        return cls(
+            vocab_size=raw["vocab_size"],
+            hidden_size=raw["hidden_size"],
+            intermediate_size=raw["intermediate_size"],
+            num_hidden_layers=raw["num_hidden_layers"],
+            num_attention_heads=raw["num_attention_heads"],
+            num_key_value_heads=raw.get(
+                "num_key_value_heads", raw["num_attention_heads"]
+            ),
+            rms_norm_eps=raw.get("rms_norm_eps", 1e-5),
+            rope_theta=raw.get("rope_theta", 10000.0),
+            max_position_embeddings=raw.get("max_position_embeddings", 8192),
+            bos_token_id=raw.get("bos_token_id", 128000),
+            eos_token_ids=eos,
+            tie_word_embeddings=raw.get("tie_word_embeddings", False),
+        )
+
+    # small fixture configs for tests/benches
+    @classmethod
+    def tiny(cls, **overrides) -> "LlamaConfig":
+        base = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, rms_norm_eps=1e-5, rope_theta=10000.0,
+            max_position_embeddings=256, bos_token_id=1,
+            eos_token_ids=(2,), tie_word_embeddings=False,
+        )
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=500000.0,
+            max_position_embeddings=8192,
+        )
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+            num_hidden_layers=80, num_attention_heads=64,
+            num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=500000.0,
+            max_position_embeddings=8192,
+        )

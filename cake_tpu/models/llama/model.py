@@ -1,0 +1,149 @@
+"""Llama-3 forward functions: pure, jit-friendly, static shapes.
+
+Block semantics match the reference decoder block (transformer.rs:51-73):
+  x = x + attn(rms_norm(x))        # input_layernorm -> GQA+RoPE -> o_proj
+  x = x + mlp(rms_norm(x))         # post_attention_layernorm -> SwiGLU
+with attention accumulated in f32 (attention.rs:96-118) and RoPE from
+precomputed tables (cache.rs:23-61).
+
+The whole-model forward (reference llama.rs:72-137: embedding -> block walk
+-> final norm -> last-position slice -> lm_head -> f32 logits) is expressed
+as one `lax.scan` over the stacked block params; a contiguous sub-range of
+the stack gives a pipeline stage's forward (parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cake_tpu.models.llama.cache import KVCache, update_layer_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.attention import decode_mask, gqa_attention
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.rope import apply_rope, precompute_rope, rope_rows
+
+
+class RopeTables(NamedTuple):
+    cos: jnp.ndarray
+    sin: jnp.ndarray
+
+    @classmethod
+    def create(cls, config: LlamaConfig, max_seq_len: int) -> "RopeTables":
+        cos, sin = precompute_rope(
+            config.head_dim, max_seq_len, config.rope_theta
+        )
+        return cls(cos, sin)
+
+
+def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
+                  config: LlamaConfig):
+    """One decoder block with KV-cache update.
+
+    lp: single-layer param dict (leaves without the L axis)
+    x:  [B, S, D]; k_cache/v_cache: [B, T, KV, hd]; pos: traced scalar
+    rope_c/rope_s: [S, hd/2] rows for positions pos..pos+S
+    mask: [S, T] boolean
+    """
+    B, S, D = x.shape
+    H, KV, hd = (config.num_attention_heads, config.num_key_value_heads,
+                 config.head_dim)
+
+    h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, H, hd)
+    k = (h @ lp["wk"]).reshape(B, S, KV, hd)
+    v = (h @ lp["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, rope_c, rope_s)
+    k = apply_rope(k, rope_c, rope_s)
+    k_cache, v_cache = update_layer_cache(k_cache, v_cache, k, v, pos)
+    attn = gqa_attention(q, k_cache, v_cache, mask=mask)
+    x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+
+    h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"])
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, k_cache, v_cache
+
+
+def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
+               config: LlamaConfig) -> Tuple[jnp.ndarray, KVCache]:
+    """Scan the stacked blocks [L, ...] over the hidden state.
+
+    This is the TPU equivalent of the reference's sequential block walk with
+    contiguous-run batching (llama.rs:81-117): the scan compiles the whole
+    contiguous range into one XLA program, so "batch blocks per hop" holds
+    by construction.
+    """
+    def body(h, xs):
+        lp, kc, vc = xs
+        h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
+                                  config)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
+    return x, KVCache(k=k_new, v=v_new)
+
+
+def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
+            config: LlamaConfig, last_idx: Optional[jnp.ndarray] = None,
+            return_hidden: bool = False):
+    """Full forward: tokens [B, S] + cache @ pos -> (logits [B, V] f32, cache).
+
+    last_idx: per-batch index of the final *real* token within the window
+    (for right-padded prefill); defaults to S-1.
+    """
+    B, S = tokens.shape
+    T = cache.max_seq_len
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
+    mask = decode_mask(pos, S, T)
+    x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
+                          mask, config)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    if return_hidden:
+        return x, cache
+    if last_idx is None:
+        last = x[:, -1]
+    else:
+        last = jnp.take_along_axis(
+            x, last_idx.reshape(B, 1, 1).astype(jnp.int32), axis=1
+        )[:, 0]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def forward_logits_all(params, tokens, cache: KVCache, pos,
+                       rope: RopeTables, config: LlamaConfig):
+    """Logits at every position [B, S, V] (training / scoring path)."""
+    x, cache = forward(params, tokens, cache, pos, rope, config,
+                       return_hidden=True)
+    return (x @ params["lm_head"]).astype(jnp.float32), cache
+
+
+# -- jitted entry points -----------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params, tokens, prompt_len, cache: KVCache, rope: RopeTables,
+            config: LlamaConfig):
+    """Process a (right-padded) prompt window starting at position 0.
+
+    tokens:     [B, S_padded]
+    prompt_len: [B] true lengths; logits taken at prompt_len-1.
+    Padded slots write garbage KV beyond prompt_len, but decode masks by
+    absolute position and overwrites slot `pos` before attending it, so the
+    garbage is never observed.
+    """
+    last_idx = (prompt_len - 1).astype(jnp.int32)
+    return forward(params, tokens, cache, jnp.int32(0), rope, config,
+                   last_idx=last_idx)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(params, token, pos, cache: KVCache, rope: RopeTables,
+                config: LlamaConfig):
+    """One KV-cached decode step: token [B, 1] at absolute pos -> logits."""
+    return forward(params, token, cache, pos, rope, config)

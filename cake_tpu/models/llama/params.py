@@ -1,0 +1,201 @@
+"""Llama parameter pytree: init, HF-safetensors loading, sharding specs.
+
+Layout decision (TPU-first): all decoder-block weights are **stacked along a
+leading layer axis** `[L, ...]` so the block walk compiles as one
+`lax.scan` — one XLA while-loop instead of L unrolled block programs
+(faster compile, identical steady-state speed) — and a contiguous slice of
+the stack *is* a pipeline stage's parameter shard.
+
+Linear weights are stored `[in, out]` (x @ w), transposed from HF's
+`[out, in]` at load. On-disk format stays HF safetensors with the exact
+tensor names the reference consumes (model.layers.N.self_attn.q_proj.weight
+etc. — transformer.rs:28-49), so any reference checkpoint loads unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+def init_params(config: LlamaConfig, rng: jax.Array, dtype=jnp.bfloat16):
+    """Random-init parameter pytree (tests/benches; scale ~ 0.02)."""
+    c = config
+    L, D, F = c.num_hidden_layers, c.hidden_size, c.intermediate_size
+    H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    keys = jax.random.split(rng, 10)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dtype)
+
+    params = {
+        "embed": w(keys[0], (c.vocab_size, D), D),
+        "blocks": {
+            "attn_norm": jnp.ones((L, D), dtype),
+            "wq": w(keys[1], (L, D, H * hd), D),
+            "wk": w(keys[2], (L, D, KV * hd), D),
+            "wv": w(keys[3], (L, D, KV * hd), D),
+            "wo": w(keys[4], (L, H * hd, D), H * hd),
+            "mlp_norm": jnp.ones((L, D), dtype),
+            "w_gate": w(keys[5], (L, D, F), D),
+            "w_up": w(keys[6], (L, D, F), D),
+            "w_down": w(keys[7], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+        "lm_head": w(keys[8], (D, c.vocab_size), D),
+    }
+    if config.tie_word_embeddings:
+        params["lm_head"] = params["embed"].T
+    return params
+
+
+# -- HF name mapping ---------------------------------------------------------
+
+def hf_param_layout(config: LlamaConfig):
+    """Map our pytree leaves -> (list of HF tensor names, assembler).
+
+    Used both for loading (HF -> pytree) and by the split tool
+    (pytree -> HF names).
+    """
+    L = config.num_hidden_layers
+    layout = {
+        ("embed",): ("model.embed_tokens.weight", False),
+        ("final_norm",): ("model.norm.weight", False),
+        ("lm_head",): ("lm_head.weight", True),
+    }
+    per_layer = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    return layout, per_layer, L
+
+
+def load_params_from_hf(
+    model_dir: str,
+    config: LlamaConfig,
+    dtype=jnp.bfloat16,
+    layer_range: Optional[range] = None,
+    put: Optional[Callable[[np.ndarray, object], jax.Array]] = None,
+    shardings: Optional[dict] = None,
+):
+    """Build the parameter pytree from HF safetensors.
+
+    layer_range: only materialise these blocks (stage-local loading).
+    put:         (host_array, sharding_or_None) -> device array; defaults to
+                 jnp.asarray (single-device).
+    shardings:   optional pytree of NamedShardings matching param_specs().
+    """
+    from cake_tpu.utils.loading import load_weights
+
+    layout, per_layer, L = hf_param_layout(config)
+    layers = list(layer_range) if layer_range is not None else list(range(L))
+
+    needed = {name for (name, _t) in layout.values()}
+    for i in layers:
+        for hf_suffix, _t in per_layer.values():
+            needed.add(f"model.layers.{i}.{hf_suffix}")
+    if config.tie_word_embeddings:
+        needed.discard("lm_head.weight")
+
+    host = load_weights(model_dir, filter_fn=lambda n: n in needed)
+
+    if put is None:
+        def put(arr, sharding):
+            x = jnp.asarray(np.asarray(arr), dtype=dtype)
+            return jax.device_put(x, sharding) if sharding is not None else x
+
+    def shard_of(*path):
+        node = shardings
+        for k in path:
+            if node is None:
+                return None
+            node = node.get(k) if isinstance(node, dict) else None
+        return node
+
+    def leaf(name, transpose, sharding):
+        arr = np.asarray(host[name])
+        if transpose:
+            arr = arr.T
+        return put(arr.astype(_np_dtype(dtype)), sharding)
+
+    params: Dict = {"blocks": {}}
+    params["embed"] = leaf("model.embed_tokens.weight", False, shard_of("embed"))
+    params["final_norm"] = leaf("model.norm.weight", False, shard_of("final_norm"))
+    if config.tie_word_embeddings:
+        params["lm_head"] = params["embed"].T
+    else:
+        params["lm_head"] = leaf("lm_head.weight", True, shard_of("lm_head"))
+
+    for key, (hf_suffix, transpose) in per_layer.items():
+        stack = np.stack([
+            (np.asarray(host[f"model.layers.{i}.{hf_suffix}"]).T
+             if transpose else np.asarray(host[f"model.layers.{i}.{hf_suffix}"]))
+            for i in layers
+        ])
+        params["blocks"][key] = put(
+            stack.astype(_np_dtype(dtype)), shard_of("blocks", key)
+        )
+    return params
+
+
+def _np_dtype(jdtype):
+    import ml_dtypes
+    return {jnp.bfloat16: ml_dtypes.bfloat16,
+            jnp.float16: np.float16,
+            jnp.float32: np.float32}.get(jdtype, np.float32)
+
+
+# -- sharding ---------------------------------------------------------------
+
+def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None):
+    """PartitionSpec pytree for Megatron-style tensor parallelism.
+
+    Column-parallel: q/k/v, gate/up (output dim over tp).
+    Row-parallel:    o, down (input dim over tp).
+    Embedding + lm_head sharded over vocab; norms replicated.
+    stage_axis, if given, shards the stacked layer dim (pipeline via scan
+    is NOT done this way — see parallel/pipeline.py — but a stage axis on
+    the layer dim gives cheap weight-memory sharding for fits-in-HBM checks).
+    """
+    S = stage_axis
+    return {
+        "embed": P(tp_axis, None),
+        "blocks": {
+            "attn_norm": P(S, None),
+            "wq": P(S, None, tp_axis),
+            "wk": P(S, None, tp_axis),
+            "wv": P(S, None, tp_axis),
+            "wo": P(S, tp_axis, None),
+            "mlp_norm": P(S, None),
+            "w_gate": P(S, None, tp_axis),
+            "w_up": P(S, None, tp_axis),
+            "w_down": P(S, tp_axis, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, tp_axis),
+    }
+
+
+def cache_specs(tp_axis: str = "tp", dp_axis: str = "dp",
+                stage_axis: Optional[str] = None):
+    """KVCache PartitionSpecs: [L, B, S, KV, hd] — batch over dp, kv-heads
+    over tp."""
+    from cake_tpu.models.llama.cache import KVCache
+    return KVCache(
+        k=P(stage_axis, dp_axis, None, tp_axis, None),
+        v=P(stage_axis, dp_axis, None, tp_axis, None),
+    )

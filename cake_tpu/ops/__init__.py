@@ -1,0 +1,10 @@
+"""TPU compute ops: RoPE, RMSNorm, attention (XLA + Pallas), sampling.
+
+These replace the reference's Candle kernels (SURVEY.md §2.5): dense GEMMs
+map onto the MXU via jnp/dot_general; attention/softmax/normalisation fuse
+via XLA or run as Pallas kernels for long sequences.
+"""
+
+from cake_tpu.ops.norms import rms_norm  # noqa: F401
+from cake_tpu.ops.rope import precompute_rope, apply_rope  # noqa: F401
+from cake_tpu.ops.attention import gqa_attention  # noqa: F401

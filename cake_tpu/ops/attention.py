@@ -1,0 +1,74 @@
+"""Attention: grouped-query attention with softmax in f32.
+
+Reference semantics (llama3/attention.rs:96-130): attention is computed with
+f32 accumulation regardless of the model dtype, causal mask applied when
+seq_len > 1, and GQA via `repeat_kv`. On TPU we keep q/k/v in the compute
+dtype (bf16) and request f32 MXU accumulation via `preferred_element_type`
+— numerically equivalent to the reference's explicit upcast, without the
+extra HBM traffic. GQA is expressed with einsum over a grouped head axis so
+no materialised `repeat_kv` copy is needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def gqa_attention(q, k, v, *, mask=None, scale: float | None = None):
+    """Grouped-query attention over an arbitrary KV window.
+
+    q:    [B, S, H,  hd]   (H = num attention heads)
+    k,v:  [B, T, KV, hd]   (KV divides H; T >= S)
+    mask: broadcastable to [B, H, S, T]; additive would be wasteful —
+          boolean, True = attend.
+    Returns [B, S, H, hd] in q.dtype.
+    """
+    B, S, H, hd = q.shape
+    _, T, KV, _ = k.shape
+    G = H // KV  # query heads per kv head
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    qg = q.reshape(B, S, KV, G, hd)
+    # scores: [B, KV, G, S, T] with f32 accumulation on the MXU
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+    if mask is not None:
+        m = mask
+        if m.ndim == 4:  # [B, H, S, T] -> [B, KV, G, S, T]
+            m = m.reshape(B, KV, G, S, T)
+        elif m.ndim == 2:  # [S, T]
+            m = m[None, None, None, :, :]
+        scores = jnp.where(m, scores, jnp.float32(-1e30))
+    probs = jax_softmax_f32(scores)
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd", probs, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
+def jax_softmax_f32(scores):
+    """Numerically-stable softmax in f32 (reference attention.rs:114)."""
+    scores = scores.astype(jnp.float32)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - lax.stop_gradient(m))
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def causal_mask(seq_len: int, dtype=bool):
+    """[S, S] lower-triangular causal mask (reference cache.rs:79-90)."""
+    i = lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
+    j = lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
+    return (j <= i).astype(dtype)
+
+
+def decode_mask(pos, seq_len: int, max_seq_len: int):
+    """[S, T] mask for cached decode: query i (at absolute pos+i) may attend
+    cache slots j <= pos+i. Static shapes; `pos` may be a traced scalar."""
+    qi = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 0)
+    kj = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 1)
+    return kj <= (qi + pos)

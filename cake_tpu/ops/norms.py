@@ -1,0 +1,20 @@
+"""Normalisation ops.
+
+Reference: candle_nn RmsNorm used by each decoder block and the final norm
+(transformer.rs:35-41, llama.rs:195-199). Computed in f32 and cast back to
+the compute dtype, matching candle's rms_norm semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    """RMSNorm: x * rsqrt(mean(x^2) + eps) * weight, reduced in f32."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

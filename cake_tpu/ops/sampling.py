@@ -1,0 +1,95 @@
+"""Token sampling: argmax / temperature / top-k / top-p + repeat penalty.
+
+Reference: candle's `LogitsProcessor` configured from Args
+(llama3/llama.rs:35-48: temperature<=0 -> ArgMax, else TopKThenTopP /
+TopK / TopP / All) and `apply_repeat_penalty` over the last
+`repeat_last_n` generated tokens (llama.rs:311-320, candle semantics:
+positive logits are divided by the penalty, negative multiplied).
+
+Everything here is jit-compatible and batched: token history is a fixed
+shape [B, repeat_last_n] ring buffer (pad slots = -1), so the whole
+sample step fuses into the decode program with no host round-trip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    repeat_penalty: float = 1.1
+    repeat_last_n: int = 128
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature is None or self.temperature <= 0.0
+
+
+def apply_repeat_penalty(logits, recent_tokens, penalty: float):
+    """Penalise recently-generated tokens.
+
+    logits:        [B, V] f32
+    recent_tokens: [B, N] int32, -1 marks empty ring-buffer slots
+    """
+    if penalty == 1.0:
+        return logits
+    B, V = logits.shape
+    valid = recent_tokens >= 0
+    ids = jnp.clip(recent_tokens, 0, V - 1)
+    hit = jnp.zeros((B, V), dtype=bool)
+    batch_idx = jnp.arange(B)[:, None].repeat(recent_tokens.shape[1], axis=1)
+    hit = hit.at[batch_idx, ids].max(valid)
+    penalised = jnp.where(logits >= 0.0, logits / penalty, logits * penalty)
+    return jnp.where(hit, penalised, logits)
+
+
+def _mask_top_k(logits, k: int):
+    """Keep only the k largest logits per row."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits, p: float):
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability exceeds p (the top token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep entries where the cumulative mass *before* them is < p
+    keep_sorted = (cum - probs) < p
+    # threshold logit = smallest kept logit
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def sample_tokens(rng, logits, recent_tokens, config: SamplingConfig):
+    """Sample next token ids. logits [B, V] -> [B] int32."""
+    logits = logits.astype(jnp.float32)
+    logits = apply_repeat_penalty(logits, recent_tokens, config.repeat_penalty)
+    if config.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / config.temperature
+    if config.top_k is not None:
+        logits = _mask_top_k(logits, config.top_k)
+    if config.top_p is not None:
+        logits = _mask_top_p(logits, config.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def update_ring(recent_tokens, new_tokens, step):
+    """Push new tokens into the [B, N] ring buffer at slot step % N."""
+    N = recent_tokens.shape[1]
+    slot = jnp.mod(step, N)
+    return recent_tokens.at[:, slot].set(new_tokens)

@@ -1,0 +1,235 @@
+"""Topology: user-facing mapping of model layers onto compute resources.
+
+Capability parity with the reference's `cake-core/src/cake/topology.rs`:
+a YAML map of node-name -> {host, description, layers: [...]} where text-model
+layer lists support range expressions like ``model.layers.0-15`` which expand
+into individual layer names (reference: topology.rs:9-11, 50-76; rejects
+stop <= start, topology.rs:60-64).
+
+TPU reinterpretation: instead of `host` being a TCP address of a worker
+process, a node maps a contiguous block range onto a *pipeline stage* of a
+`jax.sharding.Mesh`. `host` is kept for config-compat (and used verbatim when
+running against a multi-host JAX runtime), but placement is derived from node
+order / explicit `stage:` keys.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+# Range expression: everything up to a non-digit, then start-stop.
+# Same grammar as the reference regex `^(.+[^\d])(\d+)-(\d+)$` (topology.rs:9-11).
+_LAYER_RANGE_PARSER = re.compile(r"^(.+\D)(\d+)-(\d+)$")
+
+
+def expand_layer_expr(expr: str) -> List[str]:
+    """Expand ``model.layers.0-15`` -> [model.layers.0, ..., model.layers.15].
+
+    Non-range expressions pass through unchanged.  Inclusive on both ends,
+    matching the reference (topology.rs:66-71).  Raises ValueError when
+    stop <= start (topology.rs:60-64).
+    """
+    m = _LAYER_RANGE_PARSER.match(expr)
+    if m is None:
+        return [expr]
+    prefix, start_s, stop_s = m.groups()
+    start, stop = int(start_s), int(stop_s)
+    if stop <= start:
+        raise ValueError(
+            f"invalid range expression '{expr}': stop must be > start"
+        )
+    return [f"{prefix}{i}" for i in range(start, stop + 1)]
+
+
+@dataclass
+class Node:
+    """One entry in the topology: a named owner of a set of layers.
+
+    Reference: `Node` (topology.rs:14-35).  On TPU a node is a pipeline
+    stage (or a named device group), not a remote process.
+    """
+
+    host: str = ""
+    description: str = ""
+    layers: List[str] = field(default_factory=list)
+    # TPU extensions (optional in YAML):
+    stage: Optional[int] = None      # explicit pipeline-stage index
+    devices: Optional[List[int]] = None  # device ids within the mesh
+
+    _expanded: Optional[List[str]] = field(default=None, repr=False)
+
+    def expanded_layers(self) -> List[str]:
+        """All concrete layer names this node owns (ranges expanded)."""
+        if self._expanded is None:
+            out: List[str] = []
+            for expr in self.layers:
+                out.extend(expand_layer_expr(expr))
+            self._expanded = out
+        return self._expanded
+
+    def owns_layer(self, full_layer_name: str) -> bool:
+        """Prefix match, used for weight selection.
+
+        Reference: `is_text_model_layer_owner` (topology.rs:25-34) — a node
+        owning `model.layers.3` owns the tensor
+        `model.layers.3.self_attn.q_proj.weight`.
+        """
+        for layer in self.expanded_layers():
+            if full_layer_name == layer or full_layer_name.startswith(layer + "."):
+                return True
+        return False
+
+    def block_indices(self, prefix: str = "model.layers.") -> List[int]:
+        """Numeric transformer-block indices owned by this node."""
+        out = []
+        for layer in self.expanded_layers():
+            if layer.startswith(prefix):
+                tail = layer[len(prefix):]
+                if tail.isdigit():
+                    out.append(int(tail))
+        return sorted(out)
+
+
+class Topology:
+    """Ordered mapping node-name -> Node.
+
+    Reference: `Topology` (topology.rs:38-105; Deref to HashMap 94-105).
+    Iteration order == YAML document order == default stage order.
+    """
+
+    def __init__(self, nodes: "Dict[str, Node]"):
+        self.nodes: Dict[str, Node] = nodes
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_path(cls, path: str) -> "Topology":
+        """Load and validate a topology.yml (reference: topology.rs:43-79)."""
+        with open(path, "r") as f:
+            raw = yaml.safe_load(f)
+        return cls.from_dict(raw or {})
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Topology":
+        nodes: Dict[str, Node] = {}
+        for name, spec in raw.items():
+            spec = spec or {}
+            node = Node(
+                host=spec.get("host", ""),
+                description=spec.get("description", ""),
+                layers=list(spec.get("layers", []) or []),
+                stage=spec.get("stage"),
+                devices=list(spec["devices"]) if spec.get("devices") else None,
+            )
+            node.expanded_layers()  # validate ranges eagerly, like from_path
+            nodes[name] = node
+        return cls(nodes)
+
+    # -- mapping interface --------------------------------------------------
+
+    def __getitem__(self, name: str) -> Node:
+        return self.nodes[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.nodes
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.nodes)
+
+    def items(self):
+        return self.nodes.items()
+
+    def keys(self):
+        return self.nodes.keys()
+
+    def values(self):
+        return self.nodes.values()
+
+    # -- queries ------------------------------------------------------------
+
+    def get_node_for_layer(self, layer_name: str) -> Optional[Tuple[str, Node]]:
+        """Exact-match lookup of the owner of a concrete layer name.
+
+        Reference: `get_node_for_layer` (topology.rs:82-91).
+        """
+        for name, node in self.nodes.items():
+            if layer_name in node.expanded_layers():
+                return name, node
+        return None
+
+    def stage_assignments(
+        self, num_layers: int, prefix: str = "model.layers."
+    ) -> List[Tuple[str, List[int]]]:
+        """Ordered (node_name, contiguous block indices) pipeline stages.
+
+        Blocks not claimed by any node are assigned to the first stage
+        (mirroring the reference master, which runs unclaimed layers locally —
+        llama.rs:205-220 falls back to local Transformer load).
+        Validates that each node's blocks are contiguous.
+        """
+        stages: List[Tuple[str, List[int]]] = []
+        claimed = set()
+        ordered = sorted(
+            self.nodes.items(),
+            key=lambda kv: (kv[1].stage if kv[1].stage is not None else 1 << 30),
+        ) if any(n.stage is not None for n in self.nodes.values()) else list(self.nodes.items())
+        for name, node in ordered:
+            blocks = [b for b in node.block_indices(prefix) if b < num_layers]
+            if not blocks:
+                continue
+            if blocks != list(range(blocks[0], blocks[-1] + 1)):
+                raise ValueError(
+                    f"node '{name}' owns non-contiguous blocks {blocks}; "
+                    "pipeline stages must own contiguous ranges"
+                )
+            overlap = claimed.intersection(blocks)
+            if overlap:
+                raise ValueError(
+                    f"blocks {sorted(overlap)} claimed by multiple nodes"
+                )
+            claimed.update(blocks)
+            stages.append((name, blocks))
+        unclaimed = [i for i in range(num_layers) if i not in claimed]
+        if unclaimed:
+            if stages and claimed:
+                # Attach leading unclaimed blocks to a synthetic master stage.
+                stages.insert(0, ("master", unclaimed))
+                if unclaimed != list(range(unclaimed[0], unclaimed[-1] + 1)):
+                    raise ValueError(
+                        f"unclaimed blocks {unclaimed} are non-contiguous"
+                    )
+            else:
+                stages = [("master", unclaimed)]
+        # order stages by first block so the pipeline walks 0..num_layers
+        stages.sort(key=lambda s: s[1][0])
+        flat = [b for _, bs in stages for b in bs]
+        if flat != list(range(num_layers)):
+            raise ValueError(
+                f"stage assignment does not cover 0..{num_layers - 1} exactly: {stages}"
+            )
+        return stages
+
+    def to_dict(self) -> dict:
+        out = {}
+        for name, node in self.nodes.items():
+            spec: dict = {
+                "host": node.host,
+                "description": node.description,
+                "layers": list(node.layers),
+            }
+            if node.stage is not None:
+                spec["stage"] = node.stage
+            if node.devices is not None:
+                spec["devices"] = list(node.devices)
+            out[name] = spec
+        return out
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)

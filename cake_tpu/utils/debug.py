@@ -1,0 +1,28 @@
+"""Debug helpers (reference: panic_on_nan, utils/mod.rs:106-112)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def panic_on_nan(x, name: str = "tensor"):
+    """Raise if any element is NaN; returns x unchanged otherwise.
+
+    The reference stringifies the tensor and greps for "NaN"
+    (utils/mod.rs:106-112); here we use a proper reduction, and
+    `jax.debug.callback`-free host check (call outside jit, or wrap with
+    `checked` below inside jit).
+    """
+    if bool(jnp.isnan(jnp.asarray(x)).any()):
+        raise FloatingPointError(f"NaN detected in {name}")
+    return x
+
+
+def checked(x, name: str = "tensor"):
+    """jit-safe NaN check via debug callback (no-op on clean tensors)."""
+    def _cb(has_nan):
+        if has_nan:
+            raise FloatingPointError(f"NaN detected in {name}")
+    jax.debug.callback(_cb, jnp.isnan(x).any())
+    return x

@@ -1,0 +1,65 @@
+"""Device selection and dtype policy.
+
+Reference: `get_inference_device` probes cuda -> metal -> cpu
+(utils/mod.rs:15-30) and the dtype parse defaults to f16 (cake/mod.rs:54-60).
+On TPU the probe order is tpu -> cpu and the default compute dtype is
+bfloat16 (the MXU-native type); f16 is honored if requested but bf16 is
+strongly preferred on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DTYPES = {
+    "f16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "f32": jnp.float32,
+}
+
+
+def resolve_dtype(name: str):
+    """Map a CLI dtype name to a jnp dtype (reference cake/mod.rs:54-60)."""
+    try:
+        return _DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported dtype '{name}' (expected one of {sorted(_DTYPES)})"
+        ) from None
+
+
+def get_inference_device(cpu: bool = False, device_idx: int = 0):
+    """Pick the inference device: TPU if present, else CPU.
+
+    Mirrors the reference's availability probe (utils/mod.rs:15-30) with
+    TPU in place of cuda/metal.
+    """
+    if cpu:
+        return jax.devices("cpu")[device_idx]
+    try:
+        tpus = jax.devices("tpu")
+        if tpus:
+            return tpus[device_idx % len(tpus)]
+    except RuntimeError:
+        pass
+    # Under the experimental axon platform, devices() may report a platform
+    # name other than "tpu"; fall back to the default backend's devices.
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return devs[device_idx % len(devs)]
+    return jax.devices("cpu")[device_idx]
+
+
+def device_kind_summary() -> str:
+    """Human-readable device inventory (WorkerInfo-style introspection).
+
+    Replaces the reference's `WorkerInfo` message fields
+    (proto/message.rs:42-58) with local JAX device/topology queries.
+    """
+    lines = []
+    for d in jax.devices():
+        lines.append(
+            f"{d.id}: {d.platform}/{d.device_kind} process={d.process_index}"
+        )
+    return "\n".join(lines)

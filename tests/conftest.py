@@ -1,0 +1,42 @@
+"""Test config: force an 8-device virtual CPU platform before jax imports.
+
+Distributed logic (pipeline stages, TP shardings, collectives) is tested on
+a host-simulated mesh per SURVEY.md §4's implication — no pod required.
+"""
+
+import os
+
+# Force-override: the session env pins JAX_PLATFORMS to the real accelerator;
+# tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Golden tests compare f32 logits against torch; XLA:CPU otherwise lowers
+# f32 matmuls to bf16-ish oneDNN paths (~1e-3 error).
+os.environ["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Something in the test environment imports jax before conftest runs, so the
+# env vars alone may be read too late — set the config directly as well
+# (safe as long as no backend has been initialised yet).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_config():
+    from cake_tpu.models.llama.config import LlamaConfig
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="session")
+def tiny_params(tiny_config):
+    import jax
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0))

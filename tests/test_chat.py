@@ -1,0 +1,36 @@
+"""Llama-3 chat template (reference history.rs:8-33, chat.rs)."""
+
+from cake_tpu.models.chat import History, Message, MessageRole
+
+
+def test_render_basic():
+    h = History()
+    h.add_message(Message.system("You are helpful."))
+    h.add_message(Message.user("Hi"))
+    rendered = h.render()
+    assert rendered == (
+        "<|begin_of_text|>"
+        "<|start_header_id|>system<|end_header_id|>\n\nYou are helpful.<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nHi<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+
+
+def test_content_is_trimmed():
+    h = History()
+    h.add_message(Message.user("  spaced  "))
+    assert "\n\nspaced<|eot_id|>" in h.render()
+
+
+def test_message_from_json_aliases():
+    m = Message.from_json({"role": "USER", "content": "x"})
+    assert m.role is MessageRole.USER
+    m2 = Message.from_json({"Role": "assistant", "Content": "y"})
+    assert m2.role is MessageRole.ASSISTANT and m2.content == "y"
+
+
+def test_clear():
+    h = History()
+    h.add_message(Message.user("a"))
+    h.clear()
+    assert len(h) == 0

@@ -1,0 +1,64 @@
+"""Sampling ops: repeat penalty, top-k/top-p filtering, greedy/categorical."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.ops.sampling import (
+    SamplingConfig, apply_repeat_penalty, sample_tokens, update_ring,
+    _mask_top_k, _mask_top_p,
+)
+
+
+def test_repeat_penalty_semantics():
+    # candle semantics: logit>=0 divided, logit<0 multiplied (llama.rs:311-320)
+    logits = jnp.asarray([[2.0, -2.0, 4.0, 1.0]])
+    recent = jnp.asarray([[0, 1, -1, -1]], dtype=jnp.int32)  # -1 = empty slot
+    out = np.asarray(apply_repeat_penalty(logits, recent, 2.0))
+    np.testing.assert_allclose(out, [[1.0, -4.0, 4.0, 1.0]])
+
+
+def test_repeat_penalty_noop_at_one():
+    logits = jnp.asarray([[2.0, -2.0]])
+    recent = jnp.asarray([[0]], dtype=jnp.int32)
+    out = np.asarray(apply_repeat_penalty(logits, recent, 1.0))
+    np.testing.assert_allclose(out, [[2.0, -2.0]])
+
+
+def test_top_k_mask():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    out = np.asarray(_mask_top_k(logits, 2))
+    assert np.isinf(out[0, 0]) and np.isinf(out[0, 3])
+    assert out[0, 1] == 5.0 and out[0, 2] == 3.0
+
+
+def test_top_p_keeps_head_of_distribution():
+    logits = jnp.asarray([[10.0, 1.0, 0.0, -5.0]])
+    out = np.asarray(_mask_top_p(logits, 0.9))
+    assert out[0, 0] == 10.0          # top token always survives
+    assert np.isinf(out[0, 3])        # tail is cut
+
+
+def test_greedy_sampling():
+    cfg = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    logits = jnp.asarray([[0.0, 3.0, 1.0]])
+    recent = jnp.full((1, 4), -1, dtype=jnp.int32)
+    tok = sample_tokens(jax.random.PRNGKey(0), logits, recent, cfg)
+    assert int(tok[0]) == 1
+
+
+def test_categorical_respects_filtering():
+    cfg = SamplingConfig(temperature=1.0, top_k=1, repeat_penalty=1.0)
+    logits = jnp.asarray([[0.0, 5.0, 1.0]])
+    recent = jnp.full((1, 4), -1, dtype=jnp.int32)
+    for seed in range(5):
+        tok = sample_tokens(jax.random.PRNGKey(seed), logits, recent, cfg)
+        assert int(tok[0]) == 1
+
+
+def test_ring_buffer():
+    ring = jnp.full((1, 3), -1, dtype=jnp.int32)
+    for step, t in enumerate([7, 8, 9, 10]):
+        ring = update_ring(ring, jnp.asarray([t], dtype=jnp.int32), step)
+    assert np.asarray(ring).tolist() == [[10, 8, 9]]

@@ -1,0 +1,78 @@
+"""Topology parsing + range expansion (reference semantics topology.rs)."""
+
+import pytest
+
+from cake_tpu.topology import Node, Topology, expand_layer_expr
+
+
+def test_expand_range():
+    assert expand_layer_expr("model.layers.0-3") == [
+        "model.layers.0", "model.layers.1", "model.layers.2", "model.layers.3",
+    ]
+
+
+def test_expand_non_range_passthrough():
+    assert expand_layer_expr("vae") == ["vae"]
+    assert expand_layer_expr("model.layers.7") == ["model.layers.7"]
+
+
+def test_expand_rejects_bad_range():
+    # stop <= start is invalid (reference topology.rs:60-64)
+    with pytest.raises(ValueError):
+        expand_layer_expr("model.layers.5-5")
+    with pytest.raises(ValueError):
+        expand_layer_expr("model.layers.9-2")
+
+
+def test_from_dict_and_lookup():
+    topo = Topology.from_dict({
+        "worker_a": {"host": "10.0.0.1:10128", "layers": ["model.layers.0-1"]},
+        "worker_b": {"host": "10.0.0.2:10128",
+                     "layers": ["model.layers.2", "model.layers.3"]},
+    })
+    assert len(topo) == 2
+    name, node = topo.get_node_for_layer("model.layers.2")
+    assert name == "worker_b"
+    assert topo.get_node_for_layer("model.layers.99") is None
+
+
+def test_owns_layer_prefix_match():
+    # is_text_model_layer_owner semantics (topology.rs:25-34)
+    node = Node(layers=["model.layers.0-1"])
+    assert node.owns_layer("model.layers.1.self_attn.q_proj.weight")
+    assert not node.owns_layer("model.layers.10.self_attn.q_proj.weight")
+    assert not node.owns_layer("model.norm.weight")
+
+
+def test_stage_assignments_even():
+    topo = Topology.from_dict({
+        "a": {"layers": ["model.layers.0-1"]},
+        "b": {"layers": ["model.layers.2-3"]},
+    })
+    assert topo.stage_assignments(4) == [("a", [0, 1]), ("b", [2, 3])]
+
+
+def test_stage_assignments_unclaimed_go_to_master():
+    topo = Topology.from_dict({
+        "b": {"layers": ["model.layers.2-3"]},
+    })
+    assert topo.stage_assignments(4) == [("master", [0, 1]), ("b", [2, 3])]
+
+
+def test_stage_assignments_rejects_overlap():
+    topo = Topology.from_dict({
+        "a": {"layers": ["model.layers.0-2"]},
+        "b": {"layers": ["model.layers.2-3"]},
+    })
+    with pytest.raises(ValueError):
+        topo.stage_assignments(4)
+
+
+def test_yaml_roundtrip(tmp_path):
+    topo = Topology.from_dict({
+        "a": {"host": "h:1", "description": "d", "layers": ["model.layers.0-1"]},
+    })
+    p = tmp_path / "topology.yml"
+    p.write_text(topo.to_yaml())
+    topo2 = Topology.from_path(str(p))
+    assert topo2["a"].expanded_layers() == ["model.layers.0", "model.layers.1"]
