@@ -1,0 +1,124 @@
+"""Benchmark: Llama-3-8B single-chip decode throughput (BASELINE.md config #1).
+
+Prints ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Method mirrors the reference's instrumentation (master.rs:93-121): steady-
+state decode tokens/s, excluding compile/warmup. The model is the real
+Llama-3-8B architecture (random bf16 weights — no checkpoint egress in this
+environment; throughput is weight-value independent). The whole
+prefill+decode loop runs on-device (`lax.scan`), so the number is chip
+throughput, not host dispatch.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). We compare
+against the chip's HBM-bandwidth roofline for bf16 8B decode (params bytes /
+bandwidth), the fundamental limit for batch-1 decode: vs_baseline =
+achieved / roofline. Falls back to smaller configs if the 8B doesn't fit.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_params_on_device(cfg, dtype=jnp.bfloat16):
+    """Random params initialised directly on-device (no 16GB host copy)."""
+    from cake_tpu.models.llama.params import init_params
+    return jax.jit(partial(init_params, cfg, dtype=dtype))(
+        jax.random.PRNGKey(0)
+    )
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def run_decode_bench(cfg, batch_size=1, prompt_len=128, gen_tokens=128,
+                     max_seq=1024):
+    from cake_tpu.models.llama.cache import KVCache
+    from cake_tpu.models.llama.generator import LlamaGenerator, ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    import numpy as np
+
+    params = build_params_on_device(cfg)
+    n_params = count_params(params)
+    log(f"params: {n_params/1e9:.2f}B ({n_params*2/2**30:.1f} GiB bf16)")
+
+    gen = LlamaGenerator(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        max_seq_len=max_seq, batch_size=batch_size,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    prompt = np.ones((batch_size, prompt_len), np.int32)
+    plen = np.full((batch_size,), prompt_len, np.int32)
+
+    t0 = time.perf_counter()
+    out = gen.generate_on_device(prompt, plen, gen_tokens)  # compile + run
+    t_compile = time.perf_counter() - t0
+    log(f"first call (compile+run): {t_compile:.1f}s")
+
+    t0 = time.perf_counter()
+    out = gen.generate_on_device(prompt, plen, gen_tokens)
+    dt = time.perf_counter() - t0
+    total = batch_size * gen_tokens
+    tok_s = total / dt
+    log(f"steady state: {total} tokens in {dt:.2f}s -> {tok_s:.2f} tok/s")
+    assert out.shape == (batch_size, gen_tokens)
+    return tok_s, n_params
+
+
+def main():
+    from cake_tpu.models.llama.config import LlamaConfig
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+
+    # HBM-bandwidth roofline for batch-1 bf16 decode (v5e ~819 GB/s)
+    HBM_GBS = 819e9
+
+    tiers = [
+        ("llama3_8b", LlamaConfig.llama3_8b(), 1, 1024),
+        ("llama3_3b-ish", LlamaConfig(
+            vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+            num_hidden_layers=28, num_attention_heads=24,
+            num_key_value_heads=8, rope_theta=500000.0), 1, 1024),
+        ("llama3_1b-ish", LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+            num_hidden_layers=16, num_attention_heads=32,
+            num_key_value_heads=8, rope_theta=500000.0), 1, 1024),
+    ]
+    for name, cfg, bs, max_seq in tiers:
+        try:
+            tok_s, n_params = run_decode_bench(cfg, batch_size=bs,
+                                               max_seq=max_seq)
+            roofline = HBM_GBS / (n_params * 2)  # tokens/s upper bound
+            print(json.dumps({
+                "metric": f"{name}_decode_tok_s_per_chip",
+                "value": round(tok_s, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(tok_s / roofline, 3),
+            }))
+            return
+        except Exception as e:  # noqa: BLE001 — fall to smaller tier on OOM
+            log(f"{name} failed: {type(e).__name__}: {e}")
+            continue
+    print(json.dumps({
+        "metric": "decode_tok_s_per_chip", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": 0.0,
+    }))
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""OpenAI-compatible REST serving.
+
+Capability parity with the reference's actix-web API (cake-core/src/cake/api/):
+  POST /api/v1/chat/completions  (api/mod.rs:38, text.rs:54-96)
+  POST /api/v1/image             (api/mod.rs:39, image.rs:25-68)
+plus upgrades called out in SURVEY.md §7.4: SSE streaming (the reference
+buffers the whole completion), a health/cluster introspection endpoint
+(WorkerInfo equivalent), and a request queue instead of silently holding a
+global write lock.
+"""
+
+from cake_tpu.api.server import ApiServer, start  # noqa: F401

@@ -1,0 +1,57 @@
+"""OpenAI chat-completions wire shapes.
+
+Matches the reference's request/response structs (api/text.rs:11-52): request
+{messages: [{role, content}]}, response a `chat.completion` object with uuid
+id, unix timestamp, single choice. Streaming responses use the standard
+`chat.completion.chunk` SSE shape (an upgrade — the reference buffers,
+api/text.rs:80-95).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional
+
+from cake_tpu.models.chat import Message
+
+
+def parse_chat_request(body: dict) -> tuple[List[Message], dict]:
+    """Extract messages + generation options from a request body."""
+    msgs = [Message.from_json(m) for m in body.get("messages", [])]
+    opts = {
+        "stream": bool(body.get("stream", False)),
+        "max_tokens": body.get("max_tokens"),
+        "temperature": body.get("temperature"),
+        "top_p": body.get("top_p"),
+    }
+    return msgs, opts
+
+
+def completion_response(text: str, model: str = "cake-tpu") -> dict:
+    return {
+        "id": str(uuid.uuid4()),
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": "stop",
+        }],
+    }
+
+
+def chunk_response(delta: str, model: str = "cake-tpu",
+                   finish: Optional[str] = None, rid: str = "") -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "delta": {} if finish else {"content": delta},
+            "finish_reason": finish,
+        }],
+    }

@@ -1,0 +1,231 @@
+"""Threaded HTTP server exposing the OpenAI-compatible API.
+
+Reference behavior (api/mod.rs, api/text.rs, api/image.rs): the master is
+shared state; the text endpoint resets chat state, appends the request
+messages, runs the full generation, returns one JSON completion; the image
+endpoint returns base64 PNGs; unknown routes 404.
+
+Differences (deliberate upgrades, SURVEY.md §7.4):
+  * `"stream": true` streams SSE `chat.completion.chunk`s token-by-token —
+    the reference computes tokens incrementally but buffers the HTTP body.
+  * Requests queue on an explicit generation lock with a `Retry-After` 503
+    once the queue is deep, instead of silently serialising on a RwLock.
+  * GET /api/v1/health and /api/v1/cluster expose device/topology
+    introspection (the reference's WorkerInfo, proto/message.rs:42-58,
+    becomes JAX device queries).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from cake_tpu.api.openai import (
+    chunk_response, completion_response, parse_chat_request,
+)
+from cake_tpu.args import ImageGenerationArgs
+
+log = logging.getLogger(__name__)
+
+MAX_WAITING = 16
+
+
+class ApiServer:
+    """Wraps a Master; one generation at a time, queued fairly."""
+
+    def __init__(self, master, model_name: str = "cake-tpu"):
+        self.master = master
+        self.model_name = model_name
+        self._gen_lock = threading.Lock()
+        self._waiting = 0
+        self._waiting_lock = threading.Lock()
+
+    # -- text ---------------------------------------------------------------
+
+    def chat(self, body: dict, send_chunk=None,
+             on_start=None) -> Optional[dict]:
+        """Run one chat completion. If send_chunk is set, stream deltas
+        through it and return None; else return the full response dict.
+        `on_start` fires after admission + the generation lock are held and
+        before any tokens — the streaming handler sends its response headers
+        there, so queue rejections still surface as a clean 503."""
+        messages, opts = parse_chat_request(body)
+        with self._admission():
+            with self._gen_lock:
+                m = self.master
+                m.reset()
+                if m.llm is not None and hasattr(m.llm, "set_sampling"):
+                    m.llm.set_sampling(temperature=opts["temperature"],
+                                       top_p=opts["top_p"])
+                for msg in messages:
+                    m.add_message(msg)
+                rid = str(uuid.uuid4())
+                if send_chunk is None:
+                    text = m.generate_text(lambda t: None,
+                                           sample_len=opts["max_tokens"])
+                    return completion_response(text, self.model_name)
+                if on_start is not None:
+                    on_start()
+                m.generate_text(
+                    lambda t: send_chunk(
+                        chunk_response(t.text, self.model_name, rid=rid)),
+                    sample_len=opts["max_tokens"],
+                )
+                send_chunk(chunk_response("", self.model_name,
+                                          finish="stop", rid=rid))
+                return None
+
+    # -- image --------------------------------------------------------------
+
+    def image(self, body: dict) -> dict:
+        import base64
+        args = ImageGenerationArgs.from_json(body)
+        images: list = []
+        with self._admission():
+            with self._gen_lock:
+                self.master.generate_image(
+                    args, lambda pngs: images.extend(pngs))
+        return {"images": [base64.b64encode(p).decode() for p in images]}
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> dict:
+        return {"status": "ok", "model": self.model_name,
+                "queue_depth": self._waiting}
+
+    def cluster(self) -> dict:
+        import jax
+        return {
+            "devices": [
+                {"id": d.id, "platform": d.platform,
+                 "kind": d.device_kind, "process": d.process_index}
+                for d in jax.devices()
+            ],
+        }
+
+    # -- admission -----------------------------------------------------------
+
+    def _admission(self):
+        server = self
+
+        class _Adm:
+            def __enter__(self):
+                with server._waiting_lock:
+                    if server._waiting >= MAX_WAITING:
+                        raise QueueFull()
+                    server._waiting += 1
+
+            def __exit__(self, *exc):
+                with server._waiting_lock:
+                    server._waiting -= 1
+        return _Adm()
+
+
+class QueueFull(Exception):
+    pass
+
+
+def make_handler(api: ApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _read_body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            if n == 0:
+                return {}
+            try:
+                return json.loads(self.rfile.read(n))
+            except json.JSONDecodeError:
+                raise ValueError("invalid JSON body")
+
+        def do_GET(self):
+            if self.path == "/api/v1/health":
+                return self._json(200, api.health())
+            if self.path == "/api/v1/cluster":
+                return self._json(200, api.cluster())
+            self._json(404, {"error": "not found"})  # api/mod.rs:19-21
+
+        def do_POST(self):
+            try:
+                body = self._read_body()
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            try:
+                if self.path == "/api/v1/chat/completions":
+                    return self._chat(body)
+                if self.path == "/api/v1/image":
+                    return self._json(200, api.image(body))
+                return self._json(404, {"error": "not found"})
+            except QueueFull:
+                if getattr(self, "_stream_started", False):
+                    return  # headers already gone; just drop the connection
+                data = json.dumps({"error": "queue full"}).encode()
+                self.send_response(503)
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except Exception as e:  # noqa: BLE001
+                log.exception("request failed")
+                if getattr(self, "_stream_started", False):
+                    return
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _chat(self, body: dict):
+            if not body.get("stream"):
+                return self._json(200, api.chat(body))
+            self._stream_started = False
+
+            def on_start():
+                # only once admission + the generation lock are held
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self._stream_started = True
+
+            def send_chunk(obj: dict):
+                payload = f"data: {json.dumps(obj)}\n\n".encode()
+                self.wfile.write(hex(len(payload))[2:].encode() + b"\r\n")
+                self.wfile.write(payload + b"\r\n")
+                self.wfile.flush()
+
+            api.chat(body, send_chunk=send_chunk, on_start=on_start)
+            done = b"data: [DONE]\n\n"
+            self.wfile.write(hex(len(done))[2:].encode() + b"\r\n")
+            self.wfile.write(done + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+    return Handler
+
+
+def start(master, address: str = "127.0.0.1:10128",
+          model_name: str = "cake-tpu", block: bool = True):
+    """Bind and serve (reference api/mod.rs:23-48)."""
+    host, port = address.rsplit(":", 1)
+    api = ApiServer(master, model_name)
+    httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
+    log.info("REST API listening on %s", address)
+    if block:
+        httpd.serve_forever()
+    else:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+    return httpd

@@ -122,8 +122,10 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, dc_type) -> None:
             parser.add_argument(name, type=str, default=default.value,
                                 dest=f.name)
         elif isinstance(default, bool):
-            parser.add_argument(name, action="store_true", default=default,
-                                dest=f.name)
+            # --flag / --no-flag so True defaults (e.g. sd_use_f16) can be
+            # disabled from the CLI
+            parser.add_argument(name, action=argparse.BooleanOptionalAction,
+                                default=default, dest=f.name)
         elif default is None:
             parser.add_argument(name, default=None, dest=f.name)
         else:

@@ -1,0 +1,61 @@
+"""cake-tpu CLI entry point.
+
+Capability parity with `cake-cli` (cake-cli/src/main.rs): parse args, build
+the context, then either serve the REST API or run a one-shot generation.
+There is no worker mode to dispatch — the reference's master/worker split
+(main.rs:28-54) collapses into one SPMD process; `--mode worker` is accepted
+and explained for compatibility.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    from cake_tpu.args import parse_args
+    from cake_tpu.master import Master
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[%(asctime)s] %(levelname)s %(name)s: %(message)s",
+    )
+    args, sd_args, img_args = parse_args(argv)
+
+    if args.mode == "worker":
+        print(
+            "cake-tpu runs the whole topology as one SPMD program over the "
+            "device mesh; there is no separate worker process. Run in "
+            "master mode on the host attached to the TPU slice.",
+            file=sys.stderr,
+        )
+        return 2
+
+    master = Master.from_args(args, sd_args)
+
+    if args.api:
+        from cake_tpu.api import start
+        start(master, address=args.api)
+        return 0
+
+    if args.model_type.value == "image":
+        count = [0]
+
+        def save(pngs):
+            for png in pngs:
+                path = f"image_{count[0]}.png"
+                with open(path, "wb") as f:
+                    f.write(png)
+                print(f"wrote {path}")
+                count[0] += 1
+
+        master.generate_image(img_args, save)
+        return 0
+
+    master.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

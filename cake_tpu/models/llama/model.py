@@ -41,17 +41,22 @@ class RopeTables(NamedTuple):
 
 
 def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
-                  config: LlamaConfig):
+                  config: LlamaConfig, tp_axis: Optional[str] = None):
     """One decoder block with KV-cache update.
 
     lp: single-layer param dict (leaves without the L axis)
     x:  [B, S, D]; k_cache/v_cache: [B, T, KV, hd]; pos: traced scalar
     rope_c/rope_s: [S, hd/2] rows for positions pos..pos+S
     mask: [S, T] boolean
+    tp_axis: when running *manually* tensor-parallel under shard_map, the
+    mesh axis name to psum partial row-parallel outputs over (Megatron: o_proj
+    and down_proj each produce partial sums). Head counts are derived from
+    the weight shapes, so the same code runs on full or head-sharded weights.
     """
     B, S, D = x.shape
-    H, KV, hd = (config.num_attention_heads, config.num_key_value_heads,
-                 config.head_dim)
+    hd = config.head_dim
+    H = lp["wq"].shape[-1] // hd      # local head count under TP
+    KV = lp["wk"].shape[-1] // hd
 
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
     q = (h @ lp["wq"]).reshape(B, S, H, hd)
@@ -61,16 +66,23 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
     k = apply_rope(k, rope_c, rope_s)
     k_cache, v_cache = update_layer_cache(k_cache, v_cache, k, v, pos)
     attn = gqa_attention(q, k_cache, v_cache, mask=mask)
-    x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+    attn_out = attn.reshape(B, S, H * hd) @ lp["wo"]
+    if tp_axis is not None:
+        attn_out = lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
     gate = jax.nn.silu(h @ lp["w_gate"])
-    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    if tp_axis is not None:
+        mlp_out = lax.psum(mlp_out, tp_axis)
+    x = x + mlp_out
     return x, k_cache, v_cache
 
 
 def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
-               config: LlamaConfig) -> Tuple[jnp.ndarray, KVCache]:
+               config: LlamaConfig,
+               tp_axis: Optional[str] = None) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks [L, ...] over the hidden state.
 
     This is the TPU equivalent of the reference's sequential block walk with
@@ -81,7 +93,7 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
     def body(h, xs):
         lp, kc, vc = xs
         h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
-                                  config)
+                                  config, tp_axis=tp_axis)
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))

@@ -1,0 +1,16 @@
+"""Distributed execution: device mesh, shardings, pipeline schedule.
+
+This package replaces the reference's entire distributed runtime
+(master/worker processes + TCP wire protocol, SURVEY.md §2.7) with SPMD
+programs over a `jax.sharding.Mesh`:
+
+  * `mesh.py`     — mesh construction from parallelism degrees / topology
+  * `sharding.py` — NamedSharding placement of params/cache (TP, DP)
+  * `pipeline.py` — microbatched pipeline parallelism via shard_map+ppermute
+                    (the TPU-native equivalent of layer-range workers;
+                    contiguous-block batching per hop holds by construction)
+  * `plan.py`     — topology.yml -> mesh/stage plan
+"""
+
+from cake_tpu.parallel.mesh import make_mesh  # noqa: F401
+from cake_tpu.parallel.plan import ParallelPlan  # noqa: F401

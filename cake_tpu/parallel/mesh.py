@@ -1,0 +1,38 @@
+"""Device mesh construction.
+
+Axis convention (order matters for ICI locality):
+  ("dp", "stage", "tp") — data parallel, pipeline stage, tensor parallel.
+`tp` is innermost so tensor-parallel collectives ride nearest-neighbour ICI
+links; `stage` transfers are point-to-point ppermutes; `dp` only reduces at
+sampling (never in the decode hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "stage", "tp")
+
+
+def make_mesh(dp: int = 1, stage: int = 1, tp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ("dp","stage","tp") mesh over the given (or all) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    need = dp * stage * tp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh dp={dp} x stage={stage} x tp={tp} = {need} devices, "
+            f"but only {len(devices)} available"
+        )
+    arr = np.array(devices[:need]).reshape(dp, stage, tp)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.array([dev]).reshape(1, 1, 1), AXES)

@@ -1,0 +1,201 @@
+"""Microbatched pipeline parallelism via shard_map + ppermute over ICI.
+
+This is the TPU-native replacement for the reference's distribution model
+(SURVEY.md §2.6-2.7): where the reference walks layer-range workers
+sequentially over TCP — a depth-1 pipeline with one request in flight
+(llama.rs:81-117) — here the stacked block parameters are sharded over a
+`stage` mesh axis, hidden states move stage-to-stage with
+`lax.ppermute` over ICI, and a GPipe-style schedule keeps every stage busy
+once `num_microbatches >= num_stages`. Setting num_microbatches=1
+reproduces the reference's depth-1 behavior exactly (useful for latency
+comparisons), and the contiguous-block-batching optimization holds by
+construction: a stage's whole block range is one fused XLA computation.
+
+Composability: the stage body optionally runs manually tensor-parallel
+(`tp` axis, Megatron psums inside the block — see
+`model.block_forward(tp_axis=...)`) and data-parallel (`dp` axis shards the
+batch; no collectives in the block math), so one shard_mapped program covers
+dp x pp x tp.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.model import RopeTables, run_blocks
+from cake_tpu.ops.attention import decode_mask
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.rope import rope_rows
+
+
+def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
+                         config: LlamaConfig, num_microbatches: int,
+                         tp_axis: Optional[str]):
+    """Per-device body (runs under shard_map; all views are local shards).
+
+    blocks: [L_local, ...] — this stage's contiguous block range
+    k, v:   [L_local, B_local, T, KV_local, hd]
+    x:      [B_local, S, D] input hidden states (replicated over stage)
+    Returns out [B_local, S, D] (valid on every stage after the final
+    broadcast) and the updated local cache.
+    """
+    nstages = lax.axis_size("stage")
+    sid = lax.axis_index("stage")
+    M = num_microbatches
+    B, S, D = x.shape
+    assert B % M == 0, f"local batch {B} not divisible by microbatches {M}"
+    mb = B // M
+
+    buf = jnp.zeros((mb, S, D), x.dtype)     # incoming hidden state
+    out = jnp.zeros_like(x)                  # final-stage outputs
+
+    def tick(t, state):
+        buf, out, k, v = state
+        my_mb = t - sid                       # microbatch this stage handles
+        active = jnp.logical_and(my_mb >= 0, my_mb < M)
+        idx = jnp.clip(my_mb, 0, M - 1) * mb
+
+        fresh = lax.dynamic_slice_in_dim(x, idx, mb, axis=0)
+        inp = jnp.where(sid == 0, fresh, buf)
+
+        k_mb = lax.dynamic_slice_in_dim(k, idx, mb, axis=1)
+        v_mb = lax.dynamic_slice_in_dim(v, idx, mb, axis=1)
+        y, cache_mb = run_blocks(
+            blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
+            config, tp_axis=tp_axis,
+        )
+        # mask side effects when this stage has no live microbatch
+        k_wr = jnp.where(active, cache_mb.k, k_mb)
+        v_wr = jnp.where(active, cache_mb.v, v_mb)
+        k = lax.dynamic_update_slice_in_dim(k, k_wr, idx, axis=1)
+        v = lax.dynamic_update_slice_in_dim(v, v_wr, idx, axis=1)
+
+        is_last = sid == nstages - 1
+        cur = lax.dynamic_slice_in_dim(out, idx, mb, axis=0)
+        out = lax.dynamic_update_slice_in_dim(
+            out, jnp.where(jnp.logical_and(active, is_last), y, cur),
+            idx, axis=0,
+        )
+        # hand this stage's result to the next stage over ICI
+        buf = lax.ppermute(
+            y, "stage", [(i, (i + 1) % nstages) for i in range(nstages)]
+        )
+        return buf, out, k, v
+
+    buf, out, k, v = lax.fori_loop(0, M + nstages - 1, tick,
+                                   (buf, out, k, v))
+    # broadcast the last stage's result to every stage (tiny: [B,S,D])
+    out = lax.psum(
+        jnp.where(sid == nstages - 1, out, jnp.zeros_like(out)), "stage"
+    )
+    return out, k, v
+
+
+def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
+                          num_microbatches: int = 1,
+                          tp: bool = False, dp: bool = False):
+    """Build a jitted pipelined forward(params, tokens, cache, pos, rope,
+    last_idx) -> (logits, cache) for the given mesh.
+
+    Sharding contract:
+      params["blocks"]: layer axis over "stage" (+ head/ffn over "tp" if tp)
+      cache:            layer over "stage", batch over "dp", kv-heads "tp"
+      embed/lm_head/final_norm: replicated (or vocab-sharded by GSPMD)
+    """
+    tp_axis = "tp" if tp else None
+
+    if tp:
+        blocks_specs = {
+            "attn_norm": P("stage", None),
+            "wq": P("stage", None, "tp"),
+            "wk": P("stage", None, "tp"),
+            "wv": P("stage", None, "tp"),
+            "wo": P("stage", "tp", None),
+            "mlp_norm": P("stage", None),
+            "w_gate": P("stage", None, "tp"),
+            "w_up": P("stage", None, "tp"),
+            "w_down": P("stage", "tp", None),
+        }
+    else:
+        blocks_specs = {kk: P("stage") for kk in
+                        ("attn_norm", "wq", "wk", "wv", "wo",
+                         "mlp_norm", "w_gate", "w_up", "w_down")}
+
+    dp_axis = "dp" if dp else None
+    cache_spec = P("stage", dp_axis, None, tp_axis, None)
+    x_spec = P(dp_axis, None, None)
+
+    stage_fn = jax.shard_map(
+        partial(_stage_pipeline_body, config=config,
+                num_microbatches=num_microbatches, tp_axis=tp_axis),
+        mesh=mesh,
+        in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
+                  P(), P(), P(), P()),
+        out_specs=(x_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnames=("cache",))
+    def pipeline_forward(params, tokens, cache: KVCache, pos,
+                         rope: RopeTables, last_idx=None):
+        B, S = tokens.shape
+        T = cache.max_seq_len
+        x = jnp.take(params["embed"], tokens, axis=0)
+        rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
+        mask = decode_mask(pos, S, T)
+        y, k, v = stage_fn(params["blocks"], cache.k, cache.v, x,
+                           pos, rope_c, rope_s, mask)
+        y = rms_norm(y, params["final_norm"], config.rms_norm_eps)
+        if last_idx is None:
+            last = y[:, -1]
+        else:
+            last = jnp.take_along_axis(
+                y, last_idx.reshape(B, 1, 1).astype(jnp.int32), axis=1
+            )[:, 0]
+        logits = (last @ params["lm_head"]).astype(jnp.float32)
+        return logits, KVCache(k, v)
+
+    return pipeline_forward
+
+
+def place_for_pipeline(params, cache: KVCache, mesh: Mesh, *,
+                       tp: bool = False, dp: bool = False):
+    """device_put params/cache with the shardings make_pipeline_forward
+    expects. The stacked layer dim maps contiguous ranges onto stages —
+    exactly the reference's topology.yml block-range assignment."""
+    tp_axis = "tp" if tp else None
+    dp_axis = "dp" if dp else None
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    blocks = params["blocks"]
+    bspec = {
+        "attn_norm": P("stage", None),
+        "wq": P("stage", None, tp_axis),
+        "wk": P("stage", None, tp_axis),
+        "wv": P("stage", None, tp_axis),
+        "wo": P("stage", tp_axis, None),
+        "mlp_norm": P("stage", None),
+        "w_gate": P("stage", None, tp_axis),
+        "w_up": P("stage", None, tp_axis),
+        "w_down": P("stage", tp_axis, None),
+    }
+    out = {
+        "embed": put(params["embed"], P(None, None)),
+        "blocks": {kk: put(blocks[kk], bspec[kk]) for kk in blocks},
+        "final_norm": put(params["final_norm"], P(None)),
+        "lm_head": put(params["lm_head"], P(None, None)),
+    }
+    cspec = P("stage", dp_axis, None, tp_axis, None)
+    cache = KVCache(k=put(cache.k, cspec), v=put(cache.v, cspec))
+    return out, cache

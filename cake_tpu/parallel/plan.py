@@ -1,0 +1,72 @@
+"""ParallelPlan: topology.yml + Args -> mesh shape and stage layout.
+
+The reference's topology maps layer ranges to worker hosts; here the same
+file maps contiguous block ranges onto pipeline stages of the mesh
+(SURVEY.md §2.7 "TPU-native equivalent"). Stage count comes from the
+topology (or explicit Args.tp/dp for pure TP/DP runs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+
+from cake_tpu.args import Args
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.parallel.mesh import make_mesh
+from cake_tpu.topology import Topology
+
+
+@dataclass
+class ParallelPlan:
+    dp: int
+    stages: int
+    tp: int
+    stage_layout: List[Tuple[str, List[int]]]  # (node name, block indices)
+
+    @classmethod
+    def from_topology(
+        cls,
+        config: LlamaConfig,
+        topology: Optional[Topology],
+        args: Optional[Args] = None,
+        num_devices: Optional[int] = None,
+    ) -> "ParallelPlan":
+        L = config.num_hidden_layers
+        dp = args.dp if args else 1
+        tp = args.tp if args else 1
+
+        if topology is None or len(topology) == 0:
+            return cls(dp=dp, stages=1, tp=tp,
+                       stage_layout=[("master", list(range(L)))])
+
+        layout = topology.stage_assignments(L)
+        sizes = {len(blocks) for _, blocks in layout}
+        if len(sizes) != 1:
+            raise ValueError(
+                "SPMD pipeline requires equal-size stages; topology gives "
+                f"ranges of sizes {sorted(len(b) for _, b in layout)}. "
+                "Rebalance topology.yml block ranges."
+            )
+        stages = len(layout)
+        n = num_devices if num_devices is not None else len(jax.devices())
+        if dp * stages * tp > n:
+            raise ValueError(
+                f"plan dp={dp} stages={stages} tp={tp} needs "
+                f"{dp * stages * tp} devices, have {n}"
+            )
+        return cls(dp=dp, stages=stages, tp=tp, stage_layout=layout)
+
+    def build_mesh(self, devices=None):
+        return make_mesh(dp=self.dp, stage=self.stages, tp=self.tp,
+                         devices=devices)
+
+    def describe(self) -> str:
+        lines = [f"mesh: dp={self.dp} x stage={self.stages} x tp={self.tp}"]
+        for name, blocks in self.stage_layout:
+            lines.append(
+                f"  stage[{name}]: blocks {blocks[0]}..{blocks[-1]}"
+            )
+        return "\n".join(lines)

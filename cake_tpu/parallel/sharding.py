@@ -1,0 +1,54 @@
+"""Parameter / cache placement on the mesh.
+
+The reference achieves location transparency through the `Forwarder` trait
+(local Transformer vs remote TCP Client, cake/mod.rs:104-146). Here the same
+job is done by `NamedSharding` annotations: the forward functions are
+location-free, and placement alone decides which chips hold which weights
+and where collectives appear.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.params import cache_specs, param_specs
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_shard(tree, mesh: Mesh, spec_tree):
+    """device_put every leaf with its PartitionSpec."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, spec_tree,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def shard_params(params, mesh: Mesh, *, tp_axis: str = "tp",
+                 stage_axis: Optional[str] = None):
+    """Place a Llama param pytree: Megatron TP (+ optional stage on layers)."""
+    specs = param_specs(tp_axis=tp_axis, stage_axis=stage_axis)
+    return tree_shard(params, mesh, specs)
+
+
+def shard_cache(cache: KVCache, mesh: Mesh, *, tp_axis: str = "tp",
+                dp_axis: str = "dp",
+                stage_axis: Optional[str] = None) -> KVCache:
+    specs = cache_specs(tp_axis=tp_axis, dp_axis=dp_axis,
+                        stage_axis=stage_axis)
+    return KVCache(
+        k=jax.device_put(cache.k, NamedSharding(mesh, specs.k)),
+        v=jax.device_put(cache.v, NamedSharding(mesh, specs.v)),
+    )
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

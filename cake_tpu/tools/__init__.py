@@ -1,0 +1,1 @@
+"""Tooling: weight splitting, cluster introspection, profiling helpers."""

@@ -1,0 +1,125 @@
+"""Offline weight splitting: per-stage safetensors bundles.
+
+Capability parity with `cake-split-model` (cake-split-model/src/main.rs):
+for each topology node, select the tensors whose names prefix-match the
+node's layers (main.rs:86-100), copy them into
+`{worker}-node/model/reduced.safetensors` with a rewritten index plus a
+single-entry topology.yml (main.rs:158-221), and round-trip-validate the
+output (main.rs:199-205).
+
+On TPU this tool matters for multi-host serving: each host pre-stages only
+its pipeline stage's weights so model load is O(params/hosts) per host.
+(For single-host meshes, `load_params_from_hf(layer_range=...)` already
+loads stage-locally without any offline step.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+from cake_tpu.topology import Node, Topology
+from cake_tpu.utils.loading import (
+    load_weight_index, load_weights, save_safetensors,
+)
+
+# Tensors every stage needs regardless of block range (embedding, final
+# norm, lm_head live on the first/last stage; we bundle them with any node
+# that doesn't claim blocks, and with the first/last stages otherwise).
+SHARED_TENSOR_PREFIXES = ("model.embed_tokens", "model.norm", "lm_head")
+
+
+def reduce_for_node(model_dir: str, node: Node,
+                    include_shared: bool = False) -> Dict[str, np.ndarray]:
+    """Select this node's tensors (reference reduce_for_worker semantics)."""
+    def want(name: str) -> bool:
+        if node.owns_layer(name):
+            return True
+        if include_shared and name.startswith(SHARED_TENSOR_PREFIXES):
+            return True
+        return False
+
+    return load_weights(model_dir, filter_fn=want)
+
+
+def split_model(model_dir: str, topology_path: str, output_dir: str) -> list:
+    """Write one `{node}-node/` bundle per topology entry.
+
+    Layout matches the reference (main.rs:158-221):
+      {output}/{node}-node/model/reduced.safetensors
+      {output}/{node}-node/model/model.safetensors.index.json
+      {output}/{node}-node/topology.yml
+      + config.json / tokenizer.json copied alongside when present.
+    """
+    topo = Topology.from_path(topology_path)
+    index = load_weight_index(model_dir)
+    written = []
+
+    for i, (name, node) in enumerate(topo.items()):
+        tensors = reduce_for_node(model_dir, node, include_shared=(i == 0))
+        if not tensors:
+            raise ValueError(f"node '{name}' matches no tensors in the index")
+        missing = [t for t in tensors if t not in index]
+        if missing:
+            raise ValueError(f"tensors not in source index: {missing[:5]}")
+
+        node_dir = os.path.join(output_dir, f"{name}-node", "model")
+        os.makedirs(node_dir, exist_ok=True)
+        st_path = os.path.join(node_dir, "reduced.safetensors")
+        tensors_np = {k: np.asarray(v) for k, v in tensors.items()}
+        save_safetensors(st_path, tensors_np)
+
+        # rewritten single-file index
+        new_index = {
+            "metadata": {"total_size": sum(
+                v.nbytes for v in tensors_np.values())},
+            "weight_map": {k: "reduced.safetensors" for k in tensors_np},
+        }
+        with open(os.path.join(node_dir, "model.safetensors.index.json"),
+                  "w") as f:
+            json.dump(new_index, f, indent=1)
+
+        # single-node topology
+        single = Topology.from_dict({name: {
+            "host": node.host, "description": node.description,
+            "layers": list(node.layers),
+        }})
+        with open(os.path.join(output_dir, f"{name}-node", "topology.yml"),
+                  "w") as f:
+            f.write(single.to_yaml())
+
+        for extra in ("config.json", "tokenizer.json"):
+            src = os.path.join(model_dir, extra)
+            if os.path.exists(src):
+                import shutil
+                shutil.copy(src, os.path.join(node_dir, extra))
+
+        # round-trip validation (reference main.rs:199-205)
+        reloaded = load_weights(node_dir)
+        if set(reloaded) != set(tensors_np):
+            raise RuntimeError(f"validation failed for node '{name}'")
+        for k in tensors_np:
+            if reloaded[k].shape != tuple(tensors_np[k].shape):
+                raise RuntimeError(f"shape mismatch for {k}")
+        written.append((name, st_path, len(tensors_np)))
+    return written
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="cake-split-model")
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--topology", required=True)
+    p.add_argument("--output", required=True)
+    a = p.parse_args(argv)
+    for name, path, n in split_model(a.model_path, a.topology, a.output):
+        print(f"{name}: {n} tensors -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

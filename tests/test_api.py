@@ -1,0 +1,114 @@
+"""REST API: chat completions (buffered + SSE), health, 404, queueing."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.api.server import start
+from cake_tpu.args import Args
+from cake_tpu.master import Master
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, params, ByteTokenizer(cfg.vocab_size),
+                         max_seq_len=256,
+                         sampling=SamplingConfig(temperature=0.0),
+                         cache_dtype=jnp.float32)
+    master = Master(Args(sample_len=5), text_generator=gen)
+    httpd = start(master, address="127.0.0.1:0", block=False)
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_chat_completion(server_url):
+    resp = _post(server_url + "/api/v1/chat/completions", {
+        "messages": [
+            {"role": "system", "content": "s"},
+            {"role": "user", "content": "hello"},
+        ],
+    })
+    obj = json.loads(resp.read())
+    assert obj["object"] == "chat.completion"
+    assert obj["choices"][0]["message"]["role"] == "assistant"
+    assert obj["choices"][0]["finish_reason"] == "stop"
+    assert "id" in obj and "created" in obj
+
+
+def test_chat_streaming_sse(server_url):
+    resp = _post(server_url + "/api/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "stream": True,
+    })
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    events = []
+    for raw in resp:
+        line = raw.decode().strip()
+        if line.startswith("data: "):
+            events.append(line[6:])
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_health_and_cluster(server_url):
+    h = json.loads(urllib.request.urlopen(
+        server_url + "/api/v1/health", timeout=10).read())
+    assert h["status"] == "ok"
+    c = json.loads(urllib.request.urlopen(
+        server_url + "/api/v1/cluster", timeout=10).read())
+    assert len(c["devices"]) == 8  # virtual CPU mesh
+
+
+def test_404_fallback(server_url):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(server_url + "/nope", timeout=10)
+    assert e.value.code == 404
+
+
+def test_bad_json_is_400(server_url):
+    req = urllib.request.Request(
+        server_url + "/api/v1/chat/completions", data=b"{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+
+
+def test_concurrent_requests_serialise(server_url):
+    """Two parallel requests both succeed (queued, not corrupted)."""
+    results = []
+
+    def go():
+        r = _post(server_url + "/api/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+        })
+        results.append(json.loads(r.read()))
+
+    ts = [threading.Thread(target=go) for _ in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(results) == 2
+    assert all(r["object"] == "chat.completion" for r in results)

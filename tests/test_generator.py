@@ -1,0 +1,112 @@
+"""Generator + Master: streaming loop, EOS, reset, on-device scan parity."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    ByteTokenizer, LlamaGenerator, bucket_length, trim_at_eos,
+)
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+
+
+@pytest.fixture(scope="module")
+def gen():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    g = LlamaGenerator(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        max_seq_len=256, sampling=SamplingConfig(temperature=0.0),
+        cache_dtype=jnp.float32,
+    )
+    return g
+
+
+def test_bucket_length():
+    assert bucket_length(5, 4096) == 32
+    assert bucket_length(33, 4096) == 64
+    assert bucket_length(5000, 4096) == 4096
+
+
+def test_streaming_generation(gen):
+    gen.reset()
+    gen.add_message(Message.system("s"))
+    gen.add_message(Message.user("hello"))
+    toks = [gen.next_token(i) for i in range(8)]
+    assert gen.generated_tokens() == 8
+    assert all(t.id >= 0 for t in toks)
+    # greedy determinism across reset
+    ids1 = [t.id for t in toks]
+    gen.reset()
+    gen.add_message(Message.system("s"))
+    gen.add_message(Message.user("hello"))
+    ids2 = [gen.next_token(i).id for i in range(8)]
+    assert ids1 == ids2
+
+
+def test_eos_detection():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    g = LlamaGenerator(cfg, params, ByteTokenizer(cfg.vocab_size),
+                       max_seq_len=256, sampling=SamplingConfig(temperature=0.0),
+                       cache_dtype=jnp.float32)
+    g.add_message(Message.user("x"))
+    for i in range(100):
+        t = g.next_token(i)
+        if t.is_end_of_stream:
+            assert t.id in cfg.eos_token_ids
+            assert t.text == ""
+            break
+
+
+def test_prompt_too_long_raises(gen):
+    gen.reset()
+    gen.add_message(Message.user("y" * 500))
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        gen.next_token(0)
+    gen.reset()
+
+
+def test_on_device_scan_matches_host_loop(gen):
+    gen.reset()
+    gen.add_message(Message.user("abc"))
+    host_ids = [gen.next_token(i).id for i in range(6)]
+
+    gen.reset()
+    gen.add_message(Message.user("abc"))
+    ids = gen._encode_prompt()
+    padded = ids + [0] * (32 - len(ids))
+    out = gen.generate_on_device(
+        np.asarray([padded], np.int32), np.asarray([len(ids)]), 6
+    )
+    assert out.shape == (1, 6)
+    assert out[0].tolist() == host_ids
+    gen.reset()
+
+
+def test_trim_at_eos():
+    ids = np.asarray([[4, 5, 2, 9], [7, 7, 7, 7]])
+    assert trim_at_eos(ids, (2,)) == [[4, 5], [7, 7, 7, 7]]
+
+
+def test_master_generate_text():
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    g = LlamaGenerator(cfg, params, ByteTokenizer(cfg.vocab_size),
+                       max_seq_len=256, sampling=SamplingConfig(temperature=0.0),
+                       cache_dtype=jnp.float32)
+    m = Master(Args(sample_len=5), text_generator=g)
+    m.add_message(Message.system("s"))
+    m.add_message(Message.user("hi"))
+    seen = []
+    text = m.generate_text(lambda t: seen.append(t))
+    assert len(seen) <= 5
+    assert m.tokens_per_s >= 0.0
+    assert isinstance(text, str)

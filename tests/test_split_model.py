@@ -1,0 +1,79 @@
+"""Weight-splitting tool (reference cake-split-model semantics)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cake_tpu.tools.split_model import split_model
+from cake_tpu.utils.loading import load_weights, save_safetensors
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    tensors = {}
+    for i in range(4):
+        for suffix in ("self_attn.q_proj.weight", "mlp.gate_proj.weight",
+                       "input_layernorm.weight"):
+            tensors[f"model.layers.{i}.{suffix}"] = np.full(
+                (4, 4), float(i), dtype=np.float32)
+    tensors["model.embed_tokens.weight"] = np.ones((8, 4), np.float32)
+    tensors["model.norm.weight"] = np.ones((4,), np.float32)
+    tensors["lm_head.weight"] = np.ones((8, 4), np.float32)
+    save_safetensors(str(d / "model.safetensors"), tensors)
+    (d / "config.json").write_text(json.dumps({"vocab_size": 8}))
+    return str(d)
+
+
+@pytest.fixture()
+def topology_path(tmp_path):
+    p = tmp_path / "topology.yml"
+    p.write_text(
+        "worker_a:\n  host: a:1\n  layers:\n    - model.layers.0-1\n"
+        "worker_b:\n  host: b:1\n  layers:\n    - model.layers.2-3\n"
+    )
+    return str(p)
+
+
+def test_split_and_validate(model_dir, topology_path, tmp_path):
+    out = str(tmp_path / "out")
+    written = split_model(model_dir, topology_path, out)
+    assert [w[0] for w in written] == ["worker_a", "worker_b"]
+
+    # worker_a gets its 2 layers x 3 tensors + shared (embed/norm/lm_head)
+    a = load_weights(os.path.join(out, "worker_a-node", "model"))
+    assert "model.layers.0.self_attn.q_proj.weight" in a
+    assert "model.layers.1.mlp.gate_proj.weight" in a
+    assert "model.embed_tokens.weight" in a
+    assert "model.layers.2.self_attn.q_proj.weight" not in a
+
+    b = load_weights(os.path.join(out, "worker_b-node", "model"))
+    assert "model.layers.2.self_attn.q_proj.weight" in b
+    assert "model.embed_tokens.weight" not in b
+    np.testing.assert_array_equal(
+        np.asarray(b["model.layers.3.input_layernorm.weight"]),
+        np.full((4, 4), 3.0, np.float32),
+    )
+
+    # per-node topology written
+    topo_file = os.path.join(out, "worker_a-node", "topology.yml")
+    assert os.path.exists(topo_file)
+    assert "worker_a" in open(topo_file).read()
+
+    # config copied alongside
+    assert os.path.exists(
+        os.path.join(out, "worker_a-node", "model", "config.json"))
+
+
+def test_split_unknown_layers_raises(model_dir, tmp_path):
+    # second node owns nothing real (the first absorbs the shared tensors)
+    p = tmp_path / "topo.yml"
+    p.write_text(
+        "w0:\n  layers:\n    - model.layers.0-1\n"
+        "w1:\n  layers:\n    - model.layers.9\n"
+    )
+    with pytest.raises(ValueError, match="matches no tensors"):
+        split_model(model_dir, str(p), str(tmp_path / "o"))
