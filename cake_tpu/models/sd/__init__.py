@@ -1,0 +1,13 @@
+"""Stable Diffusion in functional JAX: CLIP text encoder, UNet2DCondition,
+AutoencoderKL, schedulers, and the guidance/denoise driver.
+
+Capability parity with the reference's SD path (cake-core/src/models/sd/),
+which wraps candle-transformers' SD building blocks (sd.rs:141-154,
+unet.rs:72, vae.rs:78, clip.rs:91). Here each component is a pure-JAX
+module with diffusers-compatible weight naming, so the same safetensors
+checkpoints load; components are placed on devices by sharding, not by the
+reference's pack-tensors-over-TCP RPC workaround (unet.rs:81-100 — an
+artifact of single-tensor message framing that SPMD makes unnecessary).
+"""
+
+from cake_tpu.models.sd.config import SDConfig, get_sd_config  # noqa: F401

@@ -1,0 +1,103 @@
+"""CLIP text transformer (functional JAX).
+
+Capability parity with the reference's Clip wrapper over candle's
+ClipTextTransformer (sd/clip.rs:13-66). Architecture matches
+transformers' CLIPTextModel so HF checkpoints load directly: token +
+learned-position embeddings, pre-LN causal transformer layers
+(quick_gelu/gelu MLP), final LayerNorm; pooled output at each sequence's
+EOT position, with an optional text projection (SDXL encoder 2).
+Golden-tested against transformers.CLIPTextModel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.sd.config import ClipConfig
+from cake_tpu.models.sd.layers import layer_norm, linear, mha
+from cake_tpu.ops.attention import causal_mask
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTS = {"quick_gelu": quick_gelu, "gelu": jax.nn.gelu}
+
+
+def init_clip_params(cfg: ClipConfig, rng, dtype=jnp.float32):
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    ks = iter(jax.random.split(rng, 6 + L))
+
+    def w(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    def layer(key):
+        k = iter(jax.random.split(key, 6))
+        return {
+            "ln1": {"w": jnp.ones((D,), dtype), "b": jnp.zeros((D,), dtype)},
+            "q": {"w": w(next(k), (D, D)), "b": jnp.zeros((D,), dtype)},
+            "k": {"w": w(next(k), (D, D)), "b": jnp.zeros((D,), dtype)},
+            "v": {"w": w(next(k), (D, D)), "b": jnp.zeros((D,), dtype)},
+            "o": {"w": w(next(k), (D, D)), "b": jnp.zeros((D,), dtype)},
+            "ln2": {"w": jnp.ones((D,), dtype), "b": jnp.zeros((D,), dtype)},
+            "fc1": {"w": w(next(k), (D, F)), "b": jnp.zeros((F,), dtype)},
+            "fc2": {"w": w(next(k), (F, D)), "b": jnp.zeros((D,), dtype)},
+        }
+
+    params = {
+        "token_embed": w(next(ks), (cfg.vocab_size, D)),
+        "pos_embed": w(next(ks), (cfg.max_position_embeddings, D)),
+        "layers": [layer(next(ks)) for _ in range(L)],
+        "final_ln": {"w": jnp.ones((D,), dtype), "b": jnp.zeros((D,), dtype)},
+    }
+    if cfg.projection_dim:
+        params["text_projection"] = w(next(ks), (D, cfg.projection_dim))
+    return params
+
+
+def clip_encode(params, cfg: ClipConfig, input_ids,
+                output_hidden_state: int = -1):
+    """input_ids [B, S] -> (hidden [B, S, D], pooled [B, D or proj]).
+
+    output_hidden_state: -1 = after final_ln (v1.5); -2 = penultimate
+    layer's output (SD v2.x / XL "clip skip" behavior, no final_ln).
+    """
+    B, S = input_ids.shape
+    x = jnp.take(params["token_embed"], input_ids, axis=0)
+    x = x + params["pos_embed"][None, :S]
+    mask = causal_mask(S)
+    heads = cfg.num_attention_heads
+    act = _ACTS[cfg.hidden_act]
+
+    hidden_states = []
+    for lp in params["layers"]:
+        h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"])
+        q = linear(h, lp["q"]["w"], lp["q"]["b"])
+        k = linear(h, lp["k"]["w"], lp["k"]["b"])
+        v = linear(h, lp["v"]["w"], lp["v"]["b"])
+        attn = mha(q, k, v, heads, mask=mask)
+        x = x + linear(attn, lp["o"]["w"], lp["o"]["b"])
+        h = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"])
+        x = x + linear(act(linear(h, lp["fc1"]["w"], lp["fc1"]["b"])),
+                       lp["fc2"]["w"], lp["fc2"]["b"])
+        hidden_states.append(x)
+
+    final = layer_norm(x, params["final_ln"]["w"], params["final_ln"]["b"])
+    if output_hidden_state == -1:
+        out = final
+    else:
+        out = hidden_states[output_hidden_state]
+
+    # pooled: features at the EOT token (highest id position, like HF's
+    # argmax(input_ids) for standard CLIP tokenizers)
+    eot = jnp.argmax(input_ids, axis=-1)
+    pooled = jnp.take_along_axis(
+        final, eot[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    if "text_projection" in params:
+        pooled = pooled @ params["text_projection"]
+    return out, pooled

@@ -1,0 +1,98 @@
+"""Shared neural-net layers for the diffusion stack.
+
+Convs run in NHWC (TPU-native layout: channels innermost feeds the MXU's
+128-lane minor dimension); GroupNorm reduces in f32. Weight layouts follow
+torch/diffusers conventions on disk (OIHW convs, [out,in] linears) and are
+transposed at load time (params.py), the same policy as the Llama loader.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(x, w, b=None, stride: int = 1, padding: int = 1):
+    """x: [B, H, W, C_in]; w: [kh, kw, C_in, C_out] (HWIO); b: [C_out]."""
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def group_norm(x, weight, bias, num_groups: int = 32, eps: float = 1e-6):
+    """GroupNorm over channel groups; x: [B, H, W, C] (reduced in f32)."""
+    B, H, W, C = x.shape
+    xf = x.astype(jnp.float32).reshape(B, H * W, num_groups, C // num_groups)
+    mean = xf.mean(axis=(1, 3), keepdims=True)
+    var = xf.var(axis=(1, 3), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + eps)
+    xf = xf.reshape(B, H, W, C)
+    return (xf * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def linear(x, w, b=None):
+    """x @ w (+ b); w stored [in, out]."""
+    out = x @ w
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def mha(q, k, v, num_heads: int, mask=None):
+    """Multi-head attention on [B, S, D] tensors (f32 accumulation).
+
+    Used by CLIP (causal self-attn) and the UNet transformer blocks
+    (self + cross attention). Reuses the GQA kernel with KV == H.
+    """
+    from cake_tpu.ops.attention import gqa_attention
+    B, S, D = q.shape
+    T = k.shape[1]
+    hd = D // num_heads
+    qh = q.reshape(B, S, num_heads, hd)
+    kh = k.reshape(B, T, num_heads, hd)
+    vh = v.reshape(B, T, num_heads, hd)
+    out = gqa_attention(qh, kh, vh, mask=mask)
+    return out.reshape(B, S, D)
+
+
+def timestep_embedding(timesteps, dim: int, max_period: float = 10000.0,
+                       flip_sin_to_cos: bool = True, shift: float = 0.0):
+    """Sinusoidal timestep embedding [B] -> [B, dim] (diffusers semantics:
+    half dim sin, half cos; flip order for SD)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = timesteps.astype(jnp.float32)[:, None] * freqs[None, :] + shift
+    sin, cos = jnp.sin(args), jnp.cos(args)
+    emb = jnp.concatenate([cos, sin] if flip_sin_to_cos else [sin, cos],
+                          axis=-1)
+    if dim % 2 == 1:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+def nearest_upsample_2x(x):
+    """[B, H, W, C] -> [B, 2H, 2W, C] nearest-neighbour."""
+    B, H, W, C = x.shape
+    x = x[:, :, None, :, None, :]
+    x = jnp.broadcast_to(x, (B, H, 2, W, 2, C))
+    return x.reshape(B, 2 * H, 2 * W, C)

@@ -1,0 +1,72 @@
+"""SD component weight loading from HF checkpoints.
+
+CLIP loads from transformers-format safetensors (text_model.* names).
+UNet/VAE diffusers-format mapping lands with the quantised-serving work;
+until then missing weights fall back to random init in SDGenerator.load
+(this environment is zero-egress, so benches run random-init regardless —
+the mapping only matters for real deployments).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.sd.config import ClipConfig, SDConfig
+from cake_tpu.utils.loading import load_weights
+
+
+def load_clip_params(model_dir: str, cfg: ClipConfig, dtype=jnp.float32):
+    """transformers CLIPTextModel safetensors -> clip param pytree."""
+    host = load_weights(model_dir)
+
+    def t(name):  # [out,in] -> [in,out]
+        return jnp.asarray(np.asarray(host[name]).T, dtype=dtype)
+
+    def v(name):
+        return jnp.asarray(np.asarray(host[name]), dtype=dtype)
+
+    pre = "text_model."
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = f"{pre}encoder.layers.{i}."
+        layers.append({
+            "ln1": {"w": v(lp + "layer_norm1.weight"),
+                    "b": v(lp + "layer_norm1.bias")},
+            "q": {"w": t(lp + "self_attn.q_proj.weight"),
+                  "b": v(lp + "self_attn.q_proj.bias")},
+            "k": {"w": t(lp + "self_attn.k_proj.weight"),
+                  "b": v(lp + "self_attn.k_proj.bias")},
+            "v": {"w": t(lp + "self_attn.v_proj.weight"),
+                  "b": v(lp + "self_attn.v_proj.bias")},
+            "o": {"w": t(lp + "self_attn.out_proj.weight"),
+                  "b": v(lp + "self_attn.out_proj.bias")},
+            "ln2": {"w": v(lp + "layer_norm2.weight"),
+                    "b": v(lp + "layer_norm2.bias")},
+            "fc1": {"w": t(lp + "mlp.fc1.weight"),
+                    "b": v(lp + "mlp.fc1.bias")},
+            "fc2": {"w": t(lp + "mlp.fc2.weight"),
+                    "b": v(lp + "mlp.fc2.bias")},
+        })
+    params = {
+        "token_embed": v(pre + "embeddings.token_embedding.weight"),
+        "pos_embed": v(pre + "embeddings.position_embedding.weight"),
+        "layers": layers,
+        "final_ln": {"w": v(pre + "final_layer_norm.weight"),
+                     "b": v(pre + "final_layer_norm.bias")},
+    }
+    if "text_projection.weight" in host:
+        params["text_projection"] = t("text_projection.weight")
+    return params
+
+
+def load_sd_component(component: str, path: str, cfg: SDConfig, dtype):
+    if component in ("clip", "clip2"):
+        ccfg = cfg.clip if component == "clip" else cfg.clip2
+        return load_clip_params(path, ccfg, dtype)
+    raise NotImplementedError(
+        f"checkpoint loading for '{component}' is not wired up yet; "
+        "omit the weight path to run with random init"
+    )

@@ -1,0 +1,277 @@
+"""UNet2DCondition (functional JAX, NHWC).
+
+Capability parity with the reference's UNet wrapper over candle's
+UNet2DConditionModel (sd/unet.rs:13-79). Architecture follows the
+diffusers UNet2DConditionModel graph exactly (conv_in -> time embedding ->
+down blocks (ResnetBlock2D + Transformer2D cross-attn) -> mid -> up blocks
+with skip connections -> GroupNorm/SiLU/conv_out) so SD v1.5/v2.1/SDXL
+checkpoints map onto it; the SDXL added-condition path (text_embeds +
+time_ids -> add_embedding) is included.
+
+Unlike the reference, the UNet takes (latents, context, timestep) as three
+real arguments — the reference packs them into one tensor to fit its
+single-tensor RPC frame (unet.rs:81-100); SPMD needs no such workaround.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.sd.config import UNetConfig
+from cake_tpu.models.sd.layers import (
+    conv2d, group_norm, layer_norm, linear, mha, nearest_upsample_2x,
+    timestep_embedding,
+)
+
+
+# -- init --------------------------------------------------------------------
+
+def _w(rng, shape, dtype, scale=0.02):
+    return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+
+
+class _KeyGen:
+    def __init__(self, rng):
+        self.rng = rng
+
+    def __call__(self):
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+
+def _conv_p(kg, kh, kw, cin, cout, dtype):
+    return {"w": _w(kg(), (kh, kw, cin, cout), dtype),
+            "b": jnp.zeros((cout,), dtype)}
+
+
+def _lin_p(kg, cin, cout, dtype):
+    return {"w": _w(kg(), (cin, cout), dtype), "b": jnp.zeros((cout,), dtype)}
+
+
+def _norm_p(c, dtype):
+    return {"w": jnp.ones((c,), dtype), "b": jnp.zeros((c,), dtype)}
+
+
+def _resnet_p(kg, cin, cout, temb_dim, dtype):
+    p = {
+        "norm1": _norm_p(cin, dtype),
+        "conv1": _conv_p(kg, 3, 3, cin, cout, dtype),
+        "time_emb": _lin_p(kg, temb_dim, cout, dtype),
+        "norm2": _norm_p(cout, dtype),
+        "conv2": _conv_p(kg, 3, 3, cout, cout, dtype),
+    }
+    if cin != cout:
+        p["shortcut"] = _conv_p(kg, 1, 1, cin, cout, dtype)
+    return p
+
+
+def _xformer_p(kg, channels, n_layers, ctx_dim, dtype):
+    inner = 4 * channels
+    blocks = []
+    for _ in range(n_layers):
+        blocks.append({
+            "ln1": _norm_p(channels, dtype),
+            "attn1": {"q": _lin_p(kg, channels, channels, dtype),
+                      "k": _lin_p(kg, channels, channels, dtype),
+                      "v": _lin_p(kg, channels, channels, dtype),
+                      "o": _lin_p(kg, channels, channels, dtype)},
+            "ln2": _norm_p(channels, dtype),
+            "attn2": {"q": _lin_p(kg, channels, channels, dtype),
+                      "k": _lin_p(kg, ctx_dim, channels, dtype),
+                      "v": _lin_p(kg, ctx_dim, channels, dtype),
+                      "o": _lin_p(kg, channels, channels, dtype)},
+            "ln3": _norm_p(channels, dtype),
+            "geglu": _lin_p(kg, channels, 2 * inner, dtype),
+            "ff_out": _lin_p(kg, inner, channels, dtype),
+        })
+    return {
+        "norm": _norm_p(channels, dtype),
+        "proj_in": _lin_p(kg, channels, channels, dtype),
+        "blocks": blocks,
+        "proj_out": _lin_p(kg, channels, channels, dtype),
+    }
+
+
+def init_unet_params(cfg: UNetConfig, rng, dtype=jnp.float32):
+    kg = _KeyGen(rng)
+    ch = cfg.block_out_channels
+    temb_dim = ch[0] * cfg.time_embed_dim_mult
+    n_blocks = len(ch)
+
+    params = {
+        "conv_in": _conv_p(kg, 3, 3, cfg.in_channels, ch[0], dtype),
+        "time_mlp1": _lin_p(kg, ch[0], temb_dim, dtype),
+        "time_mlp2": _lin_p(kg, temb_dim, temb_dim, dtype),
+    }
+    if cfg.addition_embed_dim:
+        params["add_mlp1"] = _lin_p(kg, cfg.addition_embed_dim, temb_dim, dtype)
+        params["add_mlp2"] = _lin_p(kg, temb_dim, temb_dim, dtype)
+
+    skip_ch: List[int] = [ch[0]]
+    down = []
+    for i in range(n_blocks):
+        cin = ch[i - 1] if i > 0 else ch[0]
+        cout = ch[i]
+        block = {"resnets": [], "attns": []}
+        for j in range(cfg.layers_per_block):
+            block["resnets"].append(
+                _resnet_p(kg, cin if j == 0 else cout, cout, temb_dim, dtype))
+            if cfg.attn_blocks[i]:
+                block["attns"].append(_xformer_p(
+                    kg, cout, cfg.transformer_layers_per_block[i],
+                    cfg.cross_attention_dim, dtype))
+            skip_ch.append(cout)
+        if i < n_blocks - 1:
+            block["downsample"] = _conv_p(kg, 3, 3, cout, cout, dtype)
+            skip_ch.append(cout)
+        down.append(block)
+    params["down"] = down
+
+    c_mid = ch[-1]
+    # mid block always carries cross-attention (SD1.5's last *down* block
+    # doesn't, but its mid does, with 1 transformer layer; SDXL's mid uses
+    # its deepest transformer depth)
+    mid_layers = (cfg.transformer_layers_per_block[-1]
+                  if cfg.attn_blocks[-1] else 1)
+    params["mid"] = {
+        "resnet1": _resnet_p(kg, c_mid, c_mid, temb_dim, dtype),
+        "attn": _xformer_p(kg, c_mid, mid_layers,
+                           cfg.cross_attention_dim, dtype),
+        "resnet2": _resnet_p(kg, c_mid, c_mid, temb_dim, dtype),
+    }
+
+    up = []
+    rev = list(reversed(ch))
+    prev = ch[-1]
+    for i in range(n_blocks):
+        cout = rev[i]
+        block = {"resnets": [], "attns": []}
+        src_block = n_blocks - 1 - i
+        for j in range(cfg.layers_per_block + 1):
+            skip = skip_ch.pop()
+            block["resnets"].append(
+                _resnet_p(kg, prev + skip, cout, temb_dim, dtype))
+            prev = cout
+            if cfg.attn_blocks[src_block]:
+                block["attns"].append(_xformer_p(
+                    kg, cout, cfg.transformer_layers_per_block[src_block],
+                    cfg.cross_attention_dim, dtype))
+        if i < n_blocks - 1:
+            block["upsample"] = _conv_p(kg, 3, 3, cout, cout, dtype)
+        up.append(block)
+    params["up"] = up
+
+    params["norm_out"] = _norm_p(ch[0], dtype)
+    params["conv_out"] = _conv_p(kg, 3, 3, ch[0], cfg.out_channels, dtype)
+    return params
+
+
+# -- forward -----------------------------------------------------------------
+
+def _resnet(p, x, temb, groups):
+    h = group_norm(x, p["norm1"]["w"], p["norm1"]["b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv1"]["w"], p["conv1"]["b"])
+    t = linear(jax.nn.silu(temb), p["time_emb"]["w"], p["time_emb"]["b"])
+    h = h + t[:, None, None, :]
+    h = group_norm(h, p["norm2"]["w"], p["norm2"]["b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv2"]["w"], p["conv2"]["b"])
+    if "shortcut" in p:
+        x = conv2d(x, p["shortcut"]["w"], p["shortcut"]["b"], padding=0)
+    return x + h
+
+
+def _geglu(p, x):
+    proj = linear(x, p["w"], p["b"])
+    a, gate = jnp.split(proj, 2, axis=-1)
+    return a * jax.nn.gelu(gate)
+
+
+def _transformer(p, x, context, heads, groups):
+    """Transformer2DModel: spatial tokens attend to themselves + context."""
+    B, H, W, C = x.shape
+    residual = x
+    h = group_norm(x, p["norm"]["w"], p["norm"]["b"], groups)
+    h = h.reshape(B, H * W, C)
+    h = linear(h, p["proj_in"]["w"], p["proj_in"]["b"])
+    for bp in p["blocks"]:
+        n = layer_norm(h, bp["ln1"]["w"], bp["ln1"]["b"])
+        h = h + linear(
+            mha(linear(n, bp["attn1"]["q"]["w"]),
+                linear(n, bp["attn1"]["k"]["w"]),
+                linear(n, bp["attn1"]["v"]["w"]), heads),
+            bp["attn1"]["o"]["w"], bp["attn1"]["o"]["b"])
+        n = layer_norm(h, bp["ln2"]["w"], bp["ln2"]["b"])
+        h = h + linear(
+            mha(linear(n, bp["attn2"]["q"]["w"]),
+                linear(context, bp["attn2"]["k"]["w"]),
+                linear(context, bp["attn2"]["v"]["w"]), heads),
+            bp["attn2"]["o"]["w"], bp["attn2"]["o"]["b"])
+        n = layer_norm(h, bp["ln3"]["w"], bp["ln3"]["b"])
+        h = h + linear(_geglu(bp["geglu"], n),
+                       bp["ff_out"]["w"], bp["ff_out"]["b"])
+    h = linear(h, p["proj_out"]["w"], p["proj_out"]["b"])
+    return h.reshape(B, H, W, C) + residual
+
+
+def unet_forward(params, cfg: UNetConfig, latents, timesteps, context,
+                 added_cond: Optional[dict] = None):
+    """latents [B, H, W, C_in] (NHWC), timesteps [B], context [B, S, ctx_dim]
+    -> noise prediction [B, H, W, C_out]."""
+    ch = cfg.block_out_channels
+    groups = cfg.num_groups
+    temb = timestep_embedding(timesteps, ch[0])
+    temb = linear(jax.nn.silu(
+        linear(temb.astype(latents.dtype), params["time_mlp1"]["w"],
+               params["time_mlp1"]["b"])),
+        params["time_mlp2"]["w"], params["time_mlp2"]["b"])
+    if cfg.addition_embed_dim and added_cond is not None:
+        # SDXL: concat(pooled text_embeds, fourier(time_ids)) -> MLP -> add
+        te = added_cond["text_embeds"]
+        tids = added_cond["time_ids"]  # [B, 6]
+        tid_emb = timestep_embedding(
+            tids.reshape(-1), 256).reshape(te.shape[0], -1)
+        add = jnp.concatenate([te, tid_emb.astype(te.dtype)], axis=-1)
+        add = linear(jax.nn.silu(
+            linear(add, params["add_mlp1"]["w"], params["add_mlp1"]["b"])),
+            params["add_mlp2"]["w"], params["add_mlp2"]["b"])
+        temb = temb + add
+
+    x = conv2d(latents, params["conv_in"]["w"], params["conv_in"]["b"])
+    skips = [x]
+    n_blocks = len(ch)
+
+    for i, block in enumerate(params["down"]):
+        heads = cfg.attention_head_dim[i]
+        for j, rp in enumerate(block["resnets"]):
+            x = _resnet(rp, x, temb, groups)
+            if block["attns"]:
+                x = _transformer(block["attns"][j], x, context, heads, groups)
+            skips.append(x)
+        if "downsample" in block:
+            x = conv2d(x, block["downsample"]["w"], block["downsample"]["b"],
+                       stride=2)
+            skips.append(x)
+
+    mid_heads = cfg.attention_head_dim[-1]
+    x = _resnet(params["mid"]["resnet1"], x, temb, groups)
+    x = _transformer(params["mid"]["attn"], x, context, mid_heads, groups)
+    x = _resnet(params["mid"]["resnet2"], x, temb, groups)
+
+    for i, block in enumerate(params["up"]):
+        src_block = n_blocks - 1 - i
+        heads = cfg.attention_head_dim[src_block]
+        for j, rp in enumerate(block["resnets"]):
+            skip = skips.pop()
+            x = _resnet(rp, jnp.concatenate([x, skip], axis=-1), temb, groups)
+            if block["attns"]:
+                x = _transformer(block["attns"][j], x, context, heads, groups)
+        if "upsample" in block:
+            x = nearest_upsample_2x(x)
+            x = conv2d(x, block["upsample"]["w"], block["upsample"]["b"])
+
+    x = group_norm(x, params["norm_out"]["w"], params["norm_out"]["b"], groups)
+    x = conv2d(jax.nn.silu(x), params["conv_out"]["w"], params["conv_out"]["b"])
+    return x

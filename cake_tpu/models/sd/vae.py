@@ -1,0 +1,164 @@
+"""AutoencoderKL (functional JAX, NHWC).
+
+Capability parity with the reference's VAE wrapper over candle's
+AutoEncoderKL (sd/vae.rs:13-108): `encode` samples the posterior (img2img
+init latents), `decode` maps latents back to pixels. Architecture follows
+diffusers AutoencoderKL (encoder: downsampling ResnetBlocks + mid with one
+self-attention; decoder mirrors it), so SD checkpoints map on.
+
+The reference multiplexes encode/decode through one packed-tensor RPC with
+a direction flag (vae.rs:42-62); here they are simply two functions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.sd.config import VAEConfig
+from cake_tpu.models.sd.layers import conv2d, group_norm, mha, nearest_upsample_2x
+from cake_tpu.models.sd.unet import _KeyGen, _conv_p, _norm_p
+
+
+def _res_p(kg, cin, cout, dtype):
+    p = {
+        "norm1": _norm_p(cin, dtype),
+        "conv1": _conv_p(kg, 3, 3, cin, cout, dtype),
+        "norm2": _norm_p(cout, dtype),
+        "conv2": _conv_p(kg, 3, 3, cout, cout, dtype),
+    }
+    if cin != cout:
+        p["shortcut"] = _conv_p(kg, 1, 1, cin, cout, dtype)
+    return p
+
+
+def _attn_p(kg, c, dtype):
+    return {
+        "norm": _norm_p(c, dtype),
+        "q": _conv_p(kg, 1, 1, c, c, dtype),
+        "k": _conv_p(kg, 1, 1, c, c, dtype),
+        "v": _conv_p(kg, 1, 1, c, c, dtype),
+        "o": _conv_p(kg, 1, 1, c, c, dtype),
+    }
+
+
+def init_vae_params(cfg: VAEConfig, rng, dtype=jnp.float32):
+    kg = _KeyGen(rng)
+    ch = cfg.block_out_channels
+    n = len(ch)
+    lat = cfg.latent_channels
+
+    enc = {"conv_in": _conv_p(kg, 3, 3, cfg.in_channels, ch[0], dtype),
+           "down": []}
+    for i in range(n):
+        cin = ch[i - 1] if i > 0 else ch[0]
+        block = {"resnets": [
+            _res_p(kg, cin if j == 0 else ch[i], ch[i], dtype)
+            for j in range(cfg.layers_per_block)
+        ]}
+        if i < n - 1:
+            block["downsample"] = _conv_p(kg, 3, 3, ch[i], ch[i], dtype)
+        enc["down"].append(block)
+    enc["mid"] = {
+        "resnet1": _res_p(kg, ch[-1], ch[-1], dtype),
+        "attn": _attn_p(kg, ch[-1], dtype),
+        "resnet2": _res_p(kg, ch[-1], ch[-1], dtype),
+    }
+    enc["norm_out"] = _norm_p(ch[-1], dtype)
+    enc["conv_out"] = _conv_p(kg, 3, 3, ch[-1], 2 * lat, dtype)
+    enc["quant_conv"] = _conv_p(kg, 1, 1, 2 * lat, 2 * lat, dtype)
+
+    dec = {"post_quant_conv": _conv_p(kg, 1, 1, lat, lat, dtype),
+           "conv_in": _conv_p(kg, 3, 3, lat, ch[-1], dtype)}
+    dec["mid"] = {
+        "resnet1": _res_p(kg, ch[-1], ch[-1], dtype),
+        "attn": _attn_p(kg, ch[-1], dtype),
+        "resnet2": _res_p(kg, ch[-1], ch[-1], dtype),
+    }
+    dec["up"] = []
+    rev = list(reversed(ch))
+    for i in range(n):
+        cin = rev[i - 1] if i > 0 else rev[0]
+        block = {"resnets": [
+            _res_p(kg, cin if j == 0 else rev[i], rev[i], dtype)
+            for j in range(cfg.layers_per_block + 1)
+        ]}
+        if i < n - 1:
+            block["upsample"] = _conv_p(kg, 3, 3, rev[i], rev[i], dtype)
+        dec["up"].append(block)
+    dec["norm_out"] = _norm_p(ch[0], dtype)
+    dec["conv_out"] = _conv_p(kg, 3, 3, ch[0], cfg.in_channels, dtype)
+    return {"encoder": enc, "decoder": dec}
+
+
+def _res(p, x, groups):
+    h = group_norm(x, p["norm1"]["w"], p["norm1"]["b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv1"]["w"], p["conv1"]["b"])
+    h = group_norm(h, p["norm2"]["w"], p["norm2"]["b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv2"]["w"], p["conv2"]["b"])
+    if "shortcut" in p:
+        x = conv2d(x, p["shortcut"]["w"], p["shortcut"]["b"], padding=0)
+    return x + h
+
+
+def _self_attn(p, x, groups):
+    B, H, W, C = x.shape
+    h = group_norm(x, p["norm"]["w"], p["norm"]["b"], groups)
+    q = conv2d(h, p["q"]["w"], p["q"]["b"], padding=0).reshape(B, H * W, C)
+    k = conv2d(h, p["k"]["w"], p["k"]["b"], padding=0).reshape(B, H * W, C)
+    v = conv2d(h, p["v"]["w"], p["v"]["b"], padding=0).reshape(B, H * W, C)
+    attn = mha(q, k, v, num_heads=1).reshape(B, H, W, C)
+    return x + conv2d(attn, p["o"]["w"], p["o"]["b"], padding=0)
+
+
+def vae_encode(params, cfg: VAEConfig, images, rng=None,
+               sample: bool = True):
+    """images [B, H, W, 3] in [-1, 1] -> latents [B, H/8, W/8, C_lat],
+    scaled by scaling_factor (reference vae.rs:87-96 sample semantics)."""
+    p = params["encoder"]
+    g = cfg.num_groups
+    x = conv2d(images, p["conv_in"]["w"], p["conv_in"]["b"])
+    for block in p["down"]:
+        for rp in block["resnets"]:
+            x = _res(rp, x, g)
+        if "downsample" in block:
+            # diffusers pads (0,1,0,1) before stride-2 conv
+            x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            x = conv2d(x, block["downsample"]["w"], block["downsample"]["b"],
+                       stride=2, padding=0)
+    x = _res(p["mid"]["resnet1"], x, g)
+    x = _self_attn(p["mid"]["attn"], x, g)
+    x = _res(p["mid"]["resnet2"], x, g)
+    x = group_norm(x, p["norm_out"]["w"], p["norm_out"]["b"], g)
+    x = conv2d(jax.nn.silu(x), p["conv_out"]["w"], p["conv_out"]["b"])
+    moments = conv2d(x, p["quant_conv"]["w"], p["quant_conv"]["b"], padding=0)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    if sample:
+        if rng is None:
+            raise ValueError("sampling the VAE posterior needs an rng")
+        std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
+        mean = mean + std * jax.random.normal(rng, mean.shape, mean.dtype)
+    return mean * cfg.scaling_factor
+
+
+def vae_decode(params, cfg: VAEConfig, latents):
+    """latents (scaled) -> images [B, H, W, 3] in [-1, 1]
+    (reference vae.rs:98-108)."""
+    p = params["decoder"]
+    g = cfg.num_groups
+    x = latents / cfg.scaling_factor
+    x = conv2d(x, p["post_quant_conv"]["w"], p["post_quant_conv"]["b"],
+               padding=0)
+    x = conv2d(x, p["conv_in"]["w"], p["conv_in"]["b"])
+    x = _res(p["mid"]["resnet1"], x, g)
+    x = _self_attn(p["mid"]["attn"], x, g)
+    x = _res(p["mid"]["resnet2"], x, g)
+    for block in p["up"]:
+        for rp in block["resnets"]:
+            x = _res(rp, x, g)
+        if "upsample" in block:
+            x = nearest_upsample_2x(x)
+            x = conv2d(x, block["upsample"]["w"], block["upsample"]["b"])
+    x = group_norm(x, p["norm_out"]["w"], p["norm_out"]["b"], g)
+    x = conv2d(jax.nn.silu(x), p["conv_out"]["w"], p["conv_out"]["b"])
+    return x

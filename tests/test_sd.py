@@ -1,0 +1,197 @@
+"""Stable Diffusion stack: CLIP golden vs transformers, UNet/VAE shapes,
+scheduler behavior, end-to-end tiny txt2img."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.sd.config import tiny_sd_config, get_sd_config
+from cake_tpu.args import SDVersion
+
+
+# -- CLIP golden --------------------------------------------------------------
+
+def test_clip_matches_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from cake_tpu.models.sd.config import ClipConfig
+    from cake_tpu.models.sd.clip import clip_encode
+    from cake_tpu.models.sd.params import load_clip_params
+
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=1000, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=77, hidden_act="quick_gelu",
+    )
+    torch.manual_seed(0)
+    model = transformers.CLIPTextModel(hf_cfg).eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    cfg = ClipConfig(vocab_size=1000, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4)
+    params = load_clip_params(str(tmp_path), cfg)
+
+    ids = np.array([[49, 2, 7, 999, 3, 0, 0, 0]], dtype=np.int32)
+    with torch.no_grad():
+        ref = model(torch.tensor(ids, dtype=torch.long)).last_hidden_state
+    ours, pooled = clip_encode(params, cfg, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                               atol=2e-4, rtol=2e-3)
+    assert pooled.shape == (1, 64)
+
+
+# -- UNet / VAE shapes --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    return tiny_sd_config()
+
+
+def test_unet_shapes(tiny):
+    from cake_tpu.models.sd.unet import init_unet_params, unet_forward
+    p = init_unet_params(tiny.unet, jax.random.PRNGKey(0))
+    lat = jnp.zeros((2, 8, 8, 4))
+    ctx = jnp.zeros((2, 77, tiny.unet.cross_attention_dim))
+    out = unet_forward(p, tiny.unet, lat, jnp.asarray([10.0, 10.0]), ctx)
+    assert out.shape == (2, 8, 8, 4)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_vae_roundtrip_shapes(tiny):
+    from cake_tpu.models.sd.vae import init_vae_params, vae_decode, vae_encode
+    p = init_vae_params(tiny.vae, jax.random.PRNGKey(0))
+    img = jnp.zeros((1, 32, 32, 3))
+    lat = vae_encode(p, tiny.vae, img, rng=jax.random.PRNGKey(1))
+    assert lat.shape == (1, 16, 16, 4)  # two down blocks -> /2
+    out = vae_decode(p, tiny.vae, lat)
+    assert out.shape == (1, 32, 32, 3)
+    assert bool(jnp.isfinite(out).all())
+
+
+# -- schedulers ---------------------------------------------------------------
+
+def test_ddim_denoises_toward_x0():
+    """DDIM with a perfect eps oracle must recover x0."""
+    from cake_tpu.models.sd.scheduler import Schedule, SchedulerConfig
+    sched = Schedule.create(SchedulerConfig(), 10)
+    rng = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(rng, (1, 4, 4, 4))
+    eps = jax.random.normal(jax.random.PRNGKey(1), x0.shape)
+    lat = sched.add_noise(x0, eps, 0)
+    for i in range(10):
+        t = int(sched.timesteps[i])
+        a = sched.alphas_cumprod[t]
+        true_eps = (lat - np.sqrt(a) * x0) / np.sqrt(1 - a)
+        lat = sched.step(true_eps, i, lat)
+    np.testing.assert_allclose(np.asarray(lat), np.asarray(x0), atol=1e-3)
+
+
+def test_euler_sigma_monotone():
+    from cake_tpu.models.sd.scheduler import Schedule, SchedulerConfig
+    sched = Schedule.create(SchedulerConfig(kind="euler"), 8)
+    assert (np.diff(sched.sigmas) <= 0).all()
+    assert sched.sigmas[-1] == 0.0
+    assert sched.init_noise_sigma > 1.0
+
+
+# -- end-to-end ---------------------------------------------------------------
+
+def test_tiny_txt2img_end_to_end(tiny):
+    """Full generate_image: prompt -> PNG bytes via callback."""
+    from cake_tpu.args import ImageGenerationArgs
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.sd import SDGenerator, SimpleClipTokenizer
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    params = {
+        "clip": init_clip_params(tiny.clip, jax.random.PRNGKey(0)),
+        "unet": init_unet_params(tiny.unet, jax.random.PRNGKey(1)),
+        "vae": init_vae_params(tiny.vae, jax.random.PRNGKey(2)),
+    }
+    gen = SDGenerator(tiny, params,
+                      [SimpleClipTokenizer(tiny.clip.vocab_size)])
+    pngs = []
+    gen.generate_image(
+        ImageGenerationArgs(image_prompt="a robot", sd_n_steps=2,
+                            sd_num_samples=1, sd_seed=7),
+        lambda imgs: pngs.extend(imgs),
+    )
+    assert len(pngs) == 1
+    assert pngs[0][:8] == b"\x89PNG\r\n\x1a\n"
+    from PIL import Image
+    import io
+    img = Image.open(io.BytesIO(pngs[0]))
+    assert img.size == (64, 64)
+
+
+def test_img2img_path(tiny, tmp_path):
+    from PIL import Image
+    from cake_tpu.args import ImageGenerationArgs
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.sd import SDGenerator, SimpleClipTokenizer
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    src = tmp_path / "src.png"
+    Image.new("RGB", (64, 64), (120, 40, 200)).save(src)
+    params = {
+        "clip": init_clip_params(tiny.clip, jax.random.PRNGKey(0)),
+        "unet": init_unet_params(tiny.unet, jax.random.PRNGKey(1)),
+        "vae": init_vae_params(tiny.vae, jax.random.PRNGKey(2)),
+    }
+    gen = SDGenerator(tiny, params,
+                      [SimpleClipTokenizer(tiny.clip.vocab_size)])
+    pngs = []
+    gen.generate_image(
+        ImageGenerationArgs(image_prompt="x", sd_img2img=str(src),
+                            sd_img2img_strength=0.5, sd_n_steps=4,
+                            sd_seed=1),
+        lambda imgs: pngs.extend(imgs),
+    )
+    assert len(pngs) == 1
+
+
+def test_sdxl_config_shapes():
+    """XL preset: dual encoders, added-cond UNet on tiny latents."""
+    cfg = get_sd_config(SDVersion.XL)
+    assert cfg.clip2 is not None
+    assert cfg.unet.addition_embed_dim == 2816
+    assert cfg.unet.cross_attention_dim == 2048
+
+
+def test_img2img_zero_strength_no_crash(tiny, tmp_path):
+    """strength*steps < 1 leaves t_start == steps; must decode cleanly."""
+    from PIL import Image
+    from cake_tpu.args import ImageGenerationArgs
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.sd import SDGenerator, SimpleClipTokenizer
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    src = tmp_path / "s.png"
+    Image.new("RGB", (64, 64), (1, 2, 3)).save(src)
+    params = {
+        "clip": init_clip_params(tiny.clip, jax.random.PRNGKey(0)),
+        "unet": init_unet_params(tiny.unet, jax.random.PRNGKey(1)),
+        "vae": init_vae_params(tiny.vae, jax.random.PRNGKey(2)),
+    }
+    gen = SDGenerator(tiny, params,
+                      [SimpleClipTokenizer(tiny.clip.vocab_size)])
+    pngs = []
+    gen.generate_image(
+        ImageGenerationArgs(image_prompt="x", sd_img2img=str(src),
+                            sd_img2img_strength=0.1, sd_n_steps=4),
+        lambda imgs: pngs.extend(imgs),
+    )
+    assert len(pngs) == 1
+
+
+def test_simple_tokenizer_deterministic():
+    from cake_tpu.models.sd.sd import SimpleClipTokenizer
+    t = SimpleClipTokenizer(1000)
+    a = t.encode("a rusty robot")
+    assert a == t.encode("a rusty robot")
+    assert len(a) == 77 and a[0] == 998 and a[-1] == 999
