@@ -58,6 +58,9 @@ class Args:
     tp: int = 1                         # tensor-parallel degree
     dp: int = 1                         # data-parallel degree
     sp: int = 1                         # sequence/context-parallel degree
+    # Pallas flash attention for LLM prefill; None = auto (on when the
+    # backend is a real TPU, off on CPU where interpret mode is slow)
+    flash_attention: Optional[bool] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -126,6 +129,9 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, dc_type) -> None:
             # disabled from the CLI
             parser.add_argument(name, action=argparse.BooleanOptionalAction,
                                 default=default, dest=f.name)
+        elif default is None and f.type == "Optional[bool]":
+            parser.add_argument(name, action=argparse.BooleanOptionalAction,
+                                default=None, dest=f.name)
         elif default is None:
             parser.add_argument(name, default=None, dest=f.name)
         else:

@@ -22,6 +22,16 @@ from cake_tpu.utils.devices import get_inference_device, resolve_dtype
 log = logging.getLogger(__name__)
 
 
+def _resolve_flash(args: Args) -> bool:
+    """--flash-attention / --no-flash-attention; default on iff real TPU."""
+    if args.flash_attention is not None:
+        return args.flash_attention
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @dataclass
 class Context:
     args: Args
@@ -39,10 +49,15 @@ class Context:
 
         llama_config = None
         if args.model_type.value == "text" and args.model:
+            import dataclasses
+
             from cake_tpu.models.llama.config import LlamaConfig
             cfg_path = os.path.join(args.model, "config.json")
             if os.path.exists(cfg_path):
-                llama_config = LlamaConfig.from_path(args.model)
+                llama_config = dataclasses.replace(
+                    LlamaConfig.from_path(args.model),
+                    use_flash_attention=_resolve_flash(args),
+                )
 
         log.info("context: device=%s dtype=%s topology=%s",
                  device, args.dtype,
@@ -61,8 +76,12 @@ class Context:
         from cake_tpu.models.llama.params import load_params_from_hf
         from cake_tpu.ops.sampling import SamplingConfig
 
+        import dataclasses
+
         a = self.args
-        cfg = self.llama_config or LlamaConfig.tiny()
+        cfg = self.llama_config or dataclasses.replace(
+            LlamaConfig.tiny(), use_flash_attention=_resolve_flash(a)
+        )
         if a.model and os.path.exists(os.path.join(a.model, "tokenizer.json")):
             tokenizer = load_tokenizer(a.model)
         else:

@@ -30,6 +30,10 @@ class LlamaConfig:
     bos_token_id: int = 128000
     eos_token_ids: Tuple[int, ...] = (128001, 128009)
     tie_word_embeddings: bool = False
+    # Use the Pallas flash-attention kernel for prefill windows whose shapes
+    # tile (ops/flash_attention.py). Off by default so CPU test runs don't
+    # pay interpret-mode cost; the TPU Context enables it.
+    use_flash_attention: bool = False
 
     @property
     def head_dim(self) -> int:

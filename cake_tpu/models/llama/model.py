@@ -24,6 +24,7 @@ from jax import lax
 from cake_tpu.models.llama.cache import KVCache, update_layer_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import decode_mask, gqa_attention
+from cake_tpu.ops.flash_attention import flash_attention, flash_supported
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import apply_rope, precompute_rope, rope_rows
 
@@ -41,7 +42,8 @@ class RopeTables(NamedTuple):
 
 
 def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
-                  config: LlamaConfig, tp_axis: Optional[str] = None):
+                  config: LlamaConfig, tp_axis: Optional[str] = None,
+                  is_prefill: bool = False):
     """One decoder block with KV-cache update.
 
     lp: single-layer param dict (leaves without the L axis)
@@ -65,7 +67,15 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
     q = apply_rope(q, rope_c, rope_s)
     k = apply_rope(k, rope_c, rope_s)
     k_cache, v_cache = update_layer_cache(k_cache, v_cache, k, v, pos)
-    attn = gqa_attention(q, k_cache, v_cache, mask=mask)
+    if (is_prefill and config.use_flash_attention
+            and flash_supported(S, S, H, KV)):
+        # Prefill at pos=0 with an empty cache: attention over the fresh
+        # in-window k/v under a causal mask is exactly the cached-decode
+        # mask (kj <= pos+qi with pos=0) — run the Pallas kernel instead of
+        # materialising [S, T] scores.
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        attn = gqa_attention(q, k_cache, v_cache, mask=mask)
     attn_out = attn.reshape(B, S, H * hd) @ lp["wo"]
     if tp_axis is not None:
         attn_out = lax.psum(attn_out, tp_axis)
@@ -82,7 +92,8 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
 
 def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
                config: LlamaConfig,
-               tp_axis: Optional[str] = None) -> Tuple[jnp.ndarray, KVCache]:
+               tp_axis: Optional[str] = None,
+               is_prefill: bool = False) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks [L, ...] over the hidden state.
 
     This is the TPU equivalent of the reference's sequential block walk with
@@ -93,7 +104,8 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
     def body(h, xs):
         lp, kc, vc = xs
         h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
-                                  config, tp_axis=tp_axis)
+                                  config, tp_axis=tp_axis,
+                                  is_prefill=is_prefill)
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
@@ -102,7 +114,7 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
 
 def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
             config: LlamaConfig, last_idx: Optional[jnp.ndarray] = None,
-            return_hidden: bool = False):
+            return_hidden: bool = False, is_prefill: bool = False):
     """Full forward: tokens [B, S] + cache @ pos -> (logits [B, V] f32, cache).
 
     last_idx: per-batch index of the final *real* token within the window
@@ -114,7 +126,7 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
     mask = decode_mask(pos, S, T)
     x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
-                          mask, config)
+                          mask, config, is_prefill=is_prefill)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     if return_hidden:
         return x, cache
@@ -151,7 +163,7 @@ def prefill(params, tokens, prompt_len, cache: KVCache, rope: RopeTables,
     """
     last_idx = (prompt_len - 1).astype(jnp.int32)
     return forward(params, tokens, cache, jnp.int32(0), rope, config,
-                   last_idx=last_idx)
+                   last_idx=last_idx, is_prefill=True)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))

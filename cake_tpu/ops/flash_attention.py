@@ -1,0 +1,169 @@
+"""Pallas TPU flash attention (causal, GQA-aware) for the prefill path.
+
+The reference computes attention as naive matmul→softmax→matmul in f32
+(llama3/attention.rs:96-118), materialising the full [S, T] score matrix in
+memory. On TPU that matrix is pure HBM traffic; the flash formulation keeps
+one [block_q, block_k] tile in VMEM and carries online-softmax statistics
+(m, l) across key blocks, so the kernel is MXU-bound instead of
+bandwidth-bound for long sequences.
+
+Layout: grid (batch, q_head, q_block, k_block); the k_block axis is the
+innermost (sequential on TPU), carrying f32 accumulators in VMEM scratch.
+GQA is handled in the k/v index maps (query head h reads kv head h // G) —
+no repeat_kv materialisation. Causal blocks above the diagonal are skipped
+with `pl.when` (upper-triangular tiles cost ~0).
+
+CPU tests run the same kernel with interpret=True (tests/test_flash.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, block_q: int, block_k: int, causal: bool):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def compute():
+        q = q_ref[0, 0]                      # [block_q, hd]
+        k = k_ref[0, 0]                      # [block_k, hd]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                            # [block_q, block_k]
+        if causal:
+            qi = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kj = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kj <= qi, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)      # rescale of old accumulator
+        p = jnp.exp(s - m_new)               # [block_q, block_k]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # k_start/q_start are traced (grid ids), so gate at runtime
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)      # fully-masked row guard
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    """q [B,H,S,hd], k/v [B,KV,T,hd] -> [B,H,S,hd]."""
+    B, H, S, hd = q.shape
+    _, KV, T, _ = k.shape
+    G = H // KV
+    nq = S // block_q
+    nk = T // block_k
+
+    grid = (B, H, nq, nk)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda b, h, i, j: (b, h // G, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        # only the innermost (k) axis carries scratch state; the rest can be
+        # scheduled across megacore
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, *, scale: float | None = None,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Flash attention over [B, S, H, hd] q and [B, T, KV, hd] k/v.
+
+    Falls back to None-signalling (caller uses the einsum path) is NOT done
+    here — callers should check `flash_supported(...)` first. Shapes must
+    tile: S % block_q == 0, T % block_k == 0.
+    """
+    B, S, H, hd = q.shape
+    _, T, KV, _ = k.shape
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qt = jnp.swapaxes(q, 1, 2)        # [B, H, S, hd]
+    kt = jnp.swapaxes(k, 1, 2)        # [B, KV, T, hd]
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_bhsd(qt, kt, vt, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_supported(S: int, T: int, H: int, KV: int,
+                    block_q: int = 128, block_k: int = 128) -> bool:
+    """Static shape check for the flash path (prefill-style, S == T).
+
+    Beyond divisibility, the clamped blocks must be Mosaic-tileable: the
+    second-minor dim of a bf16 tile is 16, so unaligned blocks (e.g. S=100
+    -> block_q=100) compile only in interpret mode and must fall back to
+    the einsum path on hardware.
+    """
+    bq = min(block_q, S)
+    bk = min(block_k, T)
+    return (S > 1 and S % bq == 0 and T % bk == 0 and H % KV == 0
+            and bq % 16 == 0 and bk % 16 == 0)

@@ -39,7 +39,7 @@ from cake_tpu.ops.rope import rope_rows
 
 def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
                          config: LlamaConfig, num_microbatches: int,
-                         tp_axis: Optional[str]):
+                         tp_axis: Optional[str], is_prefill: bool = False):
     """Per-device body (runs under shard_map; all views are local shards).
 
     blocks: [L_local, ...] — this stage's contiguous block range
@@ -71,7 +71,7 @@ def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
         v_mb = lax.dynamic_slice_in_dim(v, idx, mb, axis=1)
         y, cache_mb = run_blocks(
             blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
-            config, tp_axis=tp_axis,
+            config, tp_axis=tp_axis, is_prefill=is_prefill,
         )
         # mask side effects when this stage has no live microbatch
         k_wr = jnp.where(active, cache_mb.k, k_mb)
@@ -134,26 +134,32 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
     cache_spec = P("stage", dp_axis, None, tp_axis, None)
     x_spec = P(dp_axis, None, None)
 
-    stage_fn = jax.shard_map(
-        partial(_stage_pipeline_body, config=config,
-                num_microbatches=num_microbatches, tp_axis=tp_axis),
-        mesh=mesh,
-        in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
-                  P(), P(), P(), P()),
-        out_specs=(x_spec, cache_spec, cache_spec),
-        check_vma=False,
-    )
+    def make_stage_fn(is_prefill: bool):
+        return jax.shard_map(
+            partial(_stage_pipeline_body, config=config,
+                    num_microbatches=num_microbatches, tp_axis=tp_axis,
+                    is_prefill=is_prefill),
+            mesh=mesh,
+            in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
+                      P(), P(), P(), P()),
+            out_specs=(x_spec, cache_spec, cache_spec),
+            check_vma=False,
+        )
 
-    @partial(jax.jit, donate_argnames=("cache",))
+    stage_fns = {False: make_stage_fn(False), True: make_stage_fn(True)}
+
+    @partial(jax.jit, donate_argnames=("cache",),
+             static_argnames=("is_prefill",))
     def pipeline_forward(params, tokens, cache: KVCache, pos,
-                         rope: RopeTables, last_idx=None):
+                         rope: RopeTables, last_idx=None,
+                         is_prefill: bool = False):
         B, S = tokens.shape
         T = cache.max_seq_len
         x = jnp.take(params["embed"], tokens, axis=0)
         rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
         mask = decode_mask(pos, S, T)
-        y, k, v = stage_fn(params["blocks"], cache.k, cache.v, x,
-                           pos, rope_c, rope_s, mask)
+        y, k, v = stage_fns[is_prefill](params["blocks"], cache.k, cache.v,
+                                        x, pos, rope_c, rope_s, mask)
         y = rms_norm(y, params["final_norm"], config.rms_norm_eps)
         if last_idx is None:
             last = y[:, -1]
