@@ -41,15 +41,13 @@ class RopeTables(NamedTuple):
         return cls(cos, sin)
 
 
-def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
-                  config: LlamaConfig, tp_axis: Optional[str] = None,
-                  is_prefill: bool = False):
-    """One decoder block with KV-cache update.
+def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
+                   tp_axis: Optional[str] = None):
+    """Decoder-block math with a pluggable attention:
+    rms → qkv proj → attn_fn(q, k, v) → o_proj → residual → rms → SwiGLU →
+    residual (reference transformer.rs:51-73). attn_fn returns
+    (attn [B,S,H,hd], extras) — extras carry e.g. updated caches.
 
-    lp: single-layer param dict (leaves without the L axis)
-    x:  [B, S, D]; k_cache/v_cache: [B, T, KV, hd]; pos: traced scalar
-    rope_c/rope_s: [S, hd/2] rows for positions pos..pos+S
-    mask: [S, T] boolean
     tp_axis: when running *manually* tensor-parallel under shard_map, the
     mesh axis name to psum partial row-parallel outputs over (Megatron: o_proj
     and down_proj each produce partial sums). Head counts are derived from
@@ -64,18 +62,7 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
     q = (h @ lp["wq"]).reshape(B, S, H, hd)
     k = (h @ lp["wk"]).reshape(B, S, KV, hd)
     v = (h @ lp["wv"]).reshape(B, S, KV, hd)
-    q = apply_rope(q, rope_c, rope_s)
-    k = apply_rope(k, rope_c, rope_s)
-    k_cache, v_cache = update_layer_cache(k_cache, v_cache, k, v, pos)
-    if (is_prefill and config.use_flash_attention
-            and flash_supported(S, S, H, KV)):
-        # Prefill at pos=0 with an empty cache: attention over the fresh
-        # in-window k/v under a causal mask is exactly the cached-decode
-        # mask (kj <= pos+qi with pos=0) — run the Pallas kernel instead of
-        # materialising [S, T] scores.
-        attn = flash_attention(q, k, v, causal=True)
-    else:
-        attn = gqa_attention(q, k_cache, v_cache, mask=mask)
+    attn, extras = attn_fn(q, k, v)
     attn_out = attn.reshape(B, S, H * hd) @ lp["wo"]
     if tp_axis is not None:
         attn_out = lax.psum(attn_out, tp_axis)
@@ -87,6 +74,39 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
     if tp_axis is not None:
         mlp_out = lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
+    return x, extras
+
+
+def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
+                  config: LlamaConfig, tp_axis: Optional[str] = None,
+                  is_prefill: bool = False):
+    """One decoder block with KV-cache update.
+
+    lp: single-layer param dict (leaves without the L axis)
+    x:  [B, S, D]; k_cache/v_cache: [B, T, KV, hd]; pos: traced scalar
+    rope_c/rope_s: [S, hd/2] rows for positions pos..pos+S
+    mask: [S, T] boolean
+    """
+    S = x.shape[1]
+
+    def attn_fn(q, k, v):
+        H, KV = q.shape[2], k.shape[2]
+        q = apply_rope(q, rope_c, rope_s)
+        k = apply_rope(k, rope_c, rope_s)
+        kc, vc = update_layer_cache(k_cache, v_cache, k, v, pos)
+        if (is_prefill and config.use_flash_attention
+                and flash_supported(S, S, H, KV)):
+            # Prefill at pos=0 with an empty cache: attention over the fresh
+            # in-window k/v under a causal mask is exactly the cached-decode
+            # mask (kj <= pos+qi with pos=0) — run the Pallas kernel instead
+            # of materialising [S, T] scores.
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            attn = gqa_attention(q, kc, vc, mask=mask)
+        return attn, (kc, vc)
+
+    x, (k_cache, v_cache) = block_skeleton(lp, x, config, attn_fn,
+                                           tp_axis=tp_axis)
     return x, k_cache, v_cache
 
 

@@ -1,0 +1,320 @@
+"""Sequence/context parallelism: ring attention over an "sp" mesh axis.
+
+The reference has no long-context story at all — a hard MAX_SEQ_LEN = 4096
+(llama3/config.rs:6) and the whole sequence resident on whichever device
+owns a layer (SURVEY.md §5 "Long-context"). Here long context is first
+class: the token sequence is sharded over the `sp` mesh axis, each device
+computes attention for its query chunk while KV chunks rotate around the
+ring over ICI (`lax.ppermute`), accumulated with online softmax — so the
+context length a model can serve scales with the number of chips, and the
+per-hop transfer (one KV chunk) overlaps with the chunk's attention
+compute.
+
+Decode after a context-parallel prefill keeps the prefilled KV sharded
+where it was computed and gives every device a small replicated "tail"
+cache for newly generated tokens: a decode step computes partial attention
+(m, l, o) against the local context shard, merges the per-shard statistics
+with a logsumexp reduction over `sp` (two psums), and adds the tail — no
+resharding of the long context, ever.
+
+All functions here are *per-device* bodies meant to run under
+`jax.shard_map`; `make_sp_forward` wraps the whole Llama forward.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.model import RopeTables, block_skeleton
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.rope import apply_rope
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, *, scale):
+    """[B,Sq,KV,G,hd] x [B,Sk,KV,hd] -> f32 [B,KV,G,Sq,Sk]."""
+    return jnp.einsum("bskgd,btkd->bkgst", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", *, causal: bool = True,
+                   scale: float | None = None):
+    """Ring attention for one device's query chunk (runs under shard_map).
+
+    q:   [B, Sl, H, hd] local query chunk (global rows idx*Sl..)
+    k,v: [B, Sl, KV, hd] local key/value chunk
+    Rotates k/v around the `axis_name` ring sp times; each step computes the
+    partial attention of the local queries against the visiting chunk and
+    folds it into online-softmax state. Masking uses *global* positions, so
+    the result equals full causal attention over the gathered sequence.
+    """
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Sl, H, hd = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    qg = q.reshape(B, Sl, KV, G, hd)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    m0 = jnp.full((B, KV, G, Sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, Sl, 1), jnp.float32)
+    acc0 = jnp.zeros((B, KV, G, Sl, hd), jnp.float32)
+
+    def fold(t, m, l, acc, k_cur, v_cur):
+        src = (idx - t) % sp                 # chunk id currently held
+        s = _chunk_scores(qg, k_cur, scale=scale)
+        if causal:
+            qi = idx * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
+            kj = src * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+            mask = (kj <= qi)[None, None, None]
+            s = jnp.where(mask, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # exp(NEG_INF - NEG_INF) would be 1 for fully-masked rows; zero the
+        # probabilities explicitly instead
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bkgst,btkd->bkgsd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    def body(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = fold(t, m, l, acc, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, acc, k_nxt, v_nxt
+
+    # sp-1 rotated hops, then fold the final visiting chunk without paying
+    # for a rotation whose result would be discarded
+    m, l, acc, k_last, v_last = lax.fori_loop(
+        0, sp - 1, body, (m0, l0, acc0, k, v))
+    m, l, acc = fold(sp - 1, m, l, acc, k_last, v_last)
+    l = jnp.where(l == 0.0, 1.0, l)
+    # [B, KV, G, Sl, hd] -> [B, Sl, KV, G, hd] -> [B, Sl, H, hd]
+    out = jnp.transpose(acc / l, (0, 3, 1, 2, 4)).reshape(B, Sl, H, hd)
+    return out.astype(q.dtype)
+
+
+def partial_attention_stats(q, k, v, valid, *, scale: float | None = None):
+    """Partial attention of q against a local KV shard, returning
+    unnormalised online-softmax stats for cross-shard merging.
+
+    q: [B, S, H, hd]; k, v: [B, T, KV, hd]; valid: bool [B, 1, 1, S, T]
+    (or broadcastable) marking which local slots may be attended.
+    Returns (m, l, o): [B,KV,G,S,1], [B,KV,G,S,1], [B,KV,G,S,hd] f32.
+    """
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    qg = q.reshape(B, S, KV, G, hd)
+    s = _chunk_scores(qg, k, scale=scale)
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bkgst,btkd->bkgsd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return m, l, o
+
+
+def merge_attention_stats(stats_list):
+    """Merge per-shard (m, l, o) stats (already psum'd or local list)."""
+    ms = jnp.stack([m for m, _, _ in stats_list])
+    m_g = jnp.max(ms, axis=0)
+    l_g = 0.0
+    o_g = 0.0
+    for m, l, o in stats_list:
+        scale = jnp.exp(m - m_g)
+        l_g = l_g + scale * l
+        o_g = o_g + scale * o
+    l_g = jnp.where(l_g == 0.0, 1.0, l_g)
+    return o_g / l_g
+
+
+def sp_merged_attention(q, ctx_k, ctx_v, tail_k, tail_v, ctx_valid,
+                        tail_valid, axis_name: str = "sp"):
+    """Decode attention over (sharded context) + (replicated tail).
+
+    Runs under shard_map. Computes local partial stats against this
+    device's context shard, reduces (m, l, o) across `sp` with a
+    numerically-stable logsumexp merge (pmax + two psums), folds in the
+    replicated tail stats, and normalises.
+
+    q: [B, S, H, hd] (replicated); ctx_k/v: [B, Tl, KV, hd] local shard;
+    tail_k/v: [B, Ttail, KV, hd] replicated.
+    Returns [B, S, H, hd] in q.dtype (replicated).
+    """
+    B, S, H, hd = q.shape
+
+    m_c, l_c, o_c = partial_attention_stats(q, ctx_k, ctx_v, ctx_valid)
+    # stable cross-device merge of the context shards
+    m_g = lax.pmax(m_c, axis_name)
+    scale = jnp.exp(m_c - m_g)
+    l_cg = lax.psum(scale * l_c, axis_name)
+    o_cg = lax.psum(scale * o_c, axis_name)
+
+    m_t, l_t, o_t = partial_attention_stats(q, tail_k, tail_v, tail_valid)
+    out = merge_attention_stats([(m_g, l_cg, o_cg), (m_t, l_t, o_t)])
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, H, hd).astype(
+        q.dtype)
+
+
+# -- whole-model sequence-parallel forward -----------------------------------
+
+
+class SPCache(NamedTuple):
+    """Long-context KV cache: prefilled context sharded over sp, decode tail
+    replicated. ctx_*: [L, B, S_ctx, KV, hd] (seq axis sharded over "sp");
+    tail_*: [L, B, T_tail, KV, hd] (replicated)."""
+    ctx_k: jnp.ndarray
+    ctx_v: jnp.ndarray
+    tail_k: jnp.ndarray
+    tail_v: jnp.ndarray
+
+
+
+def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
+                    tail_len: int):
+    """Build (sp_prefill, sp_decode) jitted over the mesh's "sp" axis.
+
+    sp_prefill(params, tokens [B, ctx_len], plen [B], rope)
+        -> (logits [B, V] f32, SPCache)   # tokens right-padded to ctx_len;
+                                          # allocates the cache itself
+    sp_decode(params, token [B, 1], pos scalar, plen [B], cache, rope)
+        -> (logits, SPCache)              # pos in [ctx_len, ctx_len+tail);
+                                          # cache is donated
+    """
+    sp_size = mesh.shape["sp"]
+    assert ctx_len % sp_size == 0, (ctx_len, sp_size)
+    Sl = ctx_len // sp_size
+
+    def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
+                     cos, sin):
+        idx = lax.axis_index("sp")
+        B = tokens.shape[0]
+        x = jnp.take(embed, tokens, axis=0)                 # [B, Sl, D]
+        rope_c = lax.dynamic_slice_in_dim(cos, idx * Sl, Sl, axis=0)
+        rope_s = lax.dynamic_slice_in_dim(sin, idx * Sl, Sl, axis=0)
+
+        def layer(h, lp):
+            def attn_fn(q, k, v):
+                q = apply_rope(q, rope_c, rope_s)
+                k = apply_rope(k, rope_c, rope_s)
+                return ring_attention(q, k, v, "sp", causal=True), (k, v)
+            h, (k, v) = block_skeleton(lp, h, config, attn_fn)
+            return h, (k, v)
+
+        x, (ks, vs) = lax.scan(layer, x, blocks)
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+
+        # select the hidden state at plen-1 (it lives on one sp shard)
+        last = (plen - 1).astype(jnp.int32)                 # [B] global idx
+        local = jnp.clip(last - idx * Sl, 0, Sl - 1)
+        val = jnp.take_along_axis(
+            x, local.reshape(B, 1, 1), axis=1)[:, 0]        # [B, D]
+        mine = ((last >= idx * Sl) & (last < (idx + 1) * Sl))
+        val = jnp.where(mine[:, None], val, 0.0)
+        val = lax.psum(val, "sp")
+        logits = (val @ lm_head).astype(jnp.float32)
+        return logits, ks, vs
+
+    def decode_body(blocks, embed, final_norm, lm_head, token, pos, plen,
+                    ctx_k, ctx_v, tail_k, tail_v, cos, sin):
+        idx = lax.axis_index("sp")
+        B = token.shape[0]
+        x = jnp.take(embed, token, axis=0)                  # [B, 1, D]
+        rope_c = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+        rope_s = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+        t_slot = pos - ctx_len                               # tail write slot
+
+        # validity masks (shared across layers)
+        slot_g = idx * Sl + jnp.arange(Sl)                   # global ctx slots
+        ctx_valid = (slot_g[None] < plen[:, None])           # [B, Tl]
+        ctx_valid = ctx_valid[:, None, None, None, :]        # [B,1,1,1,Tl]
+        tail_valid = (jnp.arange(tail_k.shape[2])[None] <= t_slot)
+        tail_valid = jnp.broadcast_to(
+            tail_valid, (B, tail_k.shape[2]))[:, None, None, None, :]
+
+        def layer(h, xs):
+            lp, ck, cv, tk, tv = xs
+
+            def attn_fn(q, k, v):
+                q = apply_rope(q, rope_c, rope_s)
+                k = apply_rope(k, rope_c, rope_s)
+                tk2 = lax.dynamic_update_slice_in_dim(tk, k, t_slot, axis=1)
+                tv2 = lax.dynamic_update_slice_in_dim(tv, v, t_slot, axis=1)
+                out = sp_merged_attention(q, ck, cv, tk2, tv2,
+                                          ctx_valid, tail_valid, "sp")
+                return out, (tk2, tv2)
+
+            h, (tk2, tv2) = block_skeleton(lp, h, config, attn_fn)
+            return h, (tk2, tv2)
+
+        x, (tk_new, tv_new) = lax.scan(
+            layer, x, (blocks, ctx_k, ctx_v, tail_k, tail_v))
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+        logits = (x[:, -1] @ lm_head).astype(jnp.float32)
+        return logits, tk_new, tv_new
+
+    ctx_spec = P(None, None, "sp", None, None)
+    rep = P()
+    blocks_spec = {kk: P() for kk in
+                   ("attn_norm", "wq", "wk", "wv", "wo",
+                    "mlp_norm", "w_gate", "w_up", "w_down")}
+
+    prefill_sm = jax.shard_map(
+        prefill_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep, rep, rep),
+        out_specs=(rep, ctx_spec, ctx_spec),
+        check_vma=False,
+    )
+    decode_sm = jax.shard_map(
+        decode_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
+                  ctx_spec, ctx_spec, rep, rep, rep, rep),
+        out_specs=(rep, rep, rep),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def sp_prefill(params, tokens, plen, rope: RopeTables):
+        logits, ks, vs = prefill_sm(
+            params["blocks"], params["embed"], params["final_norm"],
+            params["lm_head"], tokens, plen, rope.cos, rope.sin)
+        B = tokens.shape[0]
+        KV, hd = config.num_key_value_heads, config.head_dim
+        tail = jnp.zeros(
+            (config.num_hidden_layers, B, tail_len, KV, hd), ks.dtype)
+        tail = lax.with_sharding_constraint(tail, NamedSharding(mesh, P()))
+        return logits, SPCache(ks, vs, tail, tail)
+
+    @partial(jax.jit, donate_argnames=("cache",))
+    def sp_decode(params, token, pos, plen, cache: SPCache,
+                  rope: RopeTables):
+        logits, tk, tv = decode_sm(
+            params["blocks"], params["embed"], params["final_norm"],
+            params["lm_head"], token, pos, plen,
+            cache.ctx_k, cache.ctx_v, cache.tail_k, cache.tail_v,
+            rope.cos, rope.sin)
+        return logits, SPCache(cache.ctx_k, cache.ctx_v, tk, tv)
+
+    return sp_prefill, sp_decode
