@@ -131,6 +131,8 @@ def load_weights(
                 defaults to returning the host array untouched so the caller
                 controls dtype casting + sharding.
     """
+    from cake_tpu.native.safetensors import read_file
+
     weight_map = load_weight_index(model_dir)
     by_file: Dict[str, List[str]] = {}
     for name, fname in weight_map.items():
@@ -139,7 +141,10 @@ def load_weights(
         by_file.setdefault(fname, []).append(name)
     out: Dict[str, object] = {}
     for fname, names in by_file.items():
-        tensors = _st_load_file(os.path.join(model_dir, fname), names)
+        # native mmap reader (madvise-prefetched zero-copy views) when the
+        # C++ library built; numpy memmap otherwise. Views keep their
+        # mapping alive through the array base chain in both cases.
+        tensors, _handle = read_file(os.path.join(model_dir, fname), names)
         for name, arr in tensors.items():
             out[name] = to_device(name, arr) if to_device else arr
     return out
