@@ -35,11 +35,17 @@ MAX_WAITING = 16
 
 
 class ApiServer:
-    """Wraps a Master; one generation at a time, queued fairly."""
+    """Wraps a Master. With an engine, chat requests batch continuously —
+    N requests decode together in one batched program; without one, they
+    serialise on a generation lock (still an upgrade over the reference's
+    silent RwLock, api/text.rs:67)."""
 
-    def __init__(self, master, model_name: str = "cake-tpu"):
+    def __init__(self, master, model_name: str = "cake-tpu", engine=None):
         self.master = master
         self.model_name = model_name
+        self.engine = engine
+        if engine is not None:
+            engine.start()
         self._gen_lock = threading.Lock()
         self._waiting = 0
         self._waiting_lock = threading.Lock()
@@ -50,9 +56,11 @@ class ApiServer:
              on_start=None) -> Optional[dict]:
         """Run one chat completion. If send_chunk is set, stream deltas
         through it and return None; else return the full response dict.
-        `on_start` fires after admission + the generation lock are held and
-        before any tokens — the streaming handler sends its response headers
-        there, so queue rejections still surface as a clean 503."""
+        `on_start` fires after admission and before any tokens — the
+        streaming handler sends its response headers there, so queue
+        rejections still surface as a clean 503."""
+        if self.engine is not None:
+            return self._chat_engine(body, send_chunk, on_start)
         messages, opts = parse_chat_request(body)
         with self._admission():
             with self._gen_lock:
@@ -79,6 +87,57 @@ class ApiServer:
                                           finish="stop", rid=rid))
                 return None
 
+    def _chat_engine(self, body: dict, send_chunk=None,
+                     on_start=None) -> Optional[dict]:
+        """Continuous-batching path: no lock — the engine interleaves this
+        request's decode steps with every other in-flight request."""
+        from cake_tpu.serve.engine import QueueFullError
+        messages, opts = parse_chat_request(body)
+        kw = dict(
+            max_new_tokens=opts["max_tokens"] or self.master.args.sample_len,
+            temperature=opts["temperature"],
+            top_p=opts["top_p"],
+        )
+        if send_chunk is None:
+            try:
+                h = self.engine.chat(messages, **kw)
+            except QueueFullError:
+                raise QueueFull()
+            h.wait()
+            return completion_response(h.text(), self.model_name)
+
+        rid = str(uuid.uuid4())
+        # Deltas are queued by the engine thread and written here on the
+        # handler thread: a slow client must never block the engine loop
+        # (that would stall every other in-flight request).
+        import queue as _queue
+        deltas: _queue.Queue = _queue.Queue()
+
+        def stream(delta: str, final: bool):
+            deltas.put((delta, final))
+
+        try:
+            h = self.engine.chat(messages, stream=stream, **kw)
+        except QueueFullError:
+            raise QueueFull()
+        if on_start is not None:
+            on_start()
+        while True:
+            try:
+                delta, final = deltas.get(timeout=0.5)
+            except _queue.Empty:
+                if h._req.done.is_set() and deltas.empty():
+                    break  # request ended without a final delta (error path)
+                continue
+            if delta:
+                send_chunk(chunk_response(delta, self.model_name, rid=rid))
+            if final:
+                break
+        h.text()  # raises if the engine failed the request
+        send_chunk(chunk_response("", self.model_name,
+                                  finish="stop", rid=rid))
+        return None
+
     # -- image --------------------------------------------------------------
 
     def image(self, body: dict) -> dict:
@@ -94,8 +153,19 @@ class ApiServer:
     # -- introspection -------------------------------------------------------
 
     def health(self) -> dict:
-        return {"status": "ok", "model": self.model_name,
-                "queue_depth": self._waiting}
+        out = {"status": "ok", "model": self.model_name,
+               "queue_depth": self._waiting}
+        if self.engine is not None:
+            st = self.engine.stats
+            out.update(
+                queue_depth=self.engine.queue_depth,
+                active_requests=self.engine.active,
+                decode_slots=self.engine.max_slots,
+                requests_completed=st.requests_completed,
+                tokens_generated=st.tokens_generated,
+                decode_tokens_per_s=round(st.decode_tokens_per_s, 2),
+            )
+        return out
 
     def cluster(self) -> dict:
         import jax
@@ -217,10 +287,14 @@ def make_handler(api: ApiServer):
 
 
 def start(master, address: str = "127.0.0.1:10128",
-          model_name: str = "cake-tpu", block: bool = True):
-    """Bind and serve (reference api/mod.rs:23-48)."""
+          model_name: str = "cake-tpu", block: bool = True, engine=None):
+    """Bind and serve (reference api/mod.rs:23-48). When the master holds a
+    text model, a continuous-batching engine is built automatically so
+    concurrent chat requests share the decode loop."""
     host, port = address.rsplit(":", 1)
-    api = ApiServer(master, model_name)
+    if engine is None and master.llm is not None:
+        engine = master.make_engine()
+    api = ApiServer(master, model_name, engine=engine)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
     if block:

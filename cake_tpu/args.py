@@ -54,6 +54,7 @@ class Args:
     device_idx: int = 0
     max_seq_len: int = 4096             # reference hard constant (config.rs:6); tunable here
     batch_size: int = 1
+    max_slots: int = 8                  # continuous-batching decode slots (API serving)
     # parallelism knobs (TPU additions; reference has PP only)
     tp: int = 1                         # tensor-parallel degree
     dp: int = 1                         # data-parallel degree

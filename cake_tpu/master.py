@@ -46,6 +46,24 @@ class Master:
             return cls(args, image_generator=ctx.load_image_model())
         return cls(args, text_generator=ctx.load_text_model())
 
+    def make_engine(self, max_slots: Optional[int] = None):
+        """Build a continuous-batching engine sharing the loaded LLM's
+        params (no weight copy; the engine allocates its own batched KV
+        cache). Used by the REST server so N requests decode together
+        instead of serialising on a lock like the reference (api/text.rs:67).
+        """
+        if self.llm is None:
+            raise RuntimeError("no text generator loaded")
+        from cake_tpu.serve import InferenceEngine
+        g = self.llm
+        return InferenceEngine(
+            g.config, g.params, g.tokenizer,
+            max_slots=max_slots or getattr(self.args, "max_slots", 8),
+            max_seq_len=g.max_seq_len,
+            sampling=g.sampling,
+            seed=self.args.seed,
+        )
+
     # -- text ----------------------------------------------------------------
 
     def reset(self) -> None:

@@ -52,6 +52,27 @@ class KVCache(NamedTuple):
         return self.k.shape[1]
 
 
+def update_layer_cache_per_row(k_cache, v_cache, new_k, new_v, pos, active):
+    """Write one new k/v per row at that row's own position (ragged decode).
+
+    k_cache/v_cache: [B, S_max, KV, hd]
+    new_k/new_v:     [B, 1, KV, hd] (single decode token per row)
+    pos:             [B] absolute positions (one per row)
+    active:          [B] bool; inactive rows keep their existing cache line
+                     (their pos may be stale — a retired slot must not
+                     corrupt state a future prefill won't overwrite).
+    """
+    b = jnp.arange(k_cache.shape[0])
+    sel = active[:, None, None]
+    old_k = k_cache[b, pos]
+    old_v = v_cache[b, pos]
+    k_cache = k_cache.at[b, pos].set(
+        jnp.where(sel, new_k[:, 0].astype(k_cache.dtype), old_k))
+    v_cache = v_cache.at[b, pos].set(
+        jnp.where(sel, new_v[:, 0].astype(v_cache.dtype), old_v))
+    return k_cache, v_cache
+
+
 def update_layer_cache(k_cache, v_cache, new_k, new_v, pos):
     """Write one layer's new k/v at absolute position `pos`.
 

@@ -79,6 +79,26 @@ def load_tokenizer(model_dir: str):
     return Tokenizer.from_file(path)
 
 
+def encode_text(tokenizer, text: str) -> List[int]:
+    """Tokenize, normalising HF `Encoding.ids` vs plain-list tokenizers."""
+    enc = tokenizer.encode(text)
+    return list(enc.ids if hasattr(enc, "ids") else enc)
+
+
+def incremental_decode(tokenizer, ids: List[int],
+                       pending: str) -> Tuple[str, str]:
+    """Streaming detokenization step: (new_text, updated_pending).
+
+    Text is held back (empty delta) while the tail decodes to an incomplete
+    UTF-8 sequence (the replacement char), so multi-token characters stream
+    whole."""
+    full = tokenizer.decode(ids)
+    new = full[len(pending):]
+    if new.endswith("�"):
+        return "", pending
+    return new, full
+
+
 class LlamaGenerator:
     """TextGenerator implementation (reference models/mod.rs:52-64)."""
 
@@ -169,14 +189,12 @@ class LlamaGenerator:
     # -- internals -----------------------------------------------------------
 
     def _encode_prompt(self) -> List[int]:
-        prompt = self.history.render()
-        enc = self.tokenizer.encode(prompt)
-        ids = enc.ids if hasattr(enc, "ids") else enc
+        ids = encode_text(self.tokenizer, self.history.render())
         if len(ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(ids)} exceeds max_seq_len {self.max_seq_len}"
             )
-        return list(ids)
+        return ids
 
     def _prefill_prompt(self):
         ids = self._encode_prompt()
@@ -193,12 +211,8 @@ class LlamaGenerator:
 
     def _decode_incremental(self) -> str:
         """Return newly-finalized text for the freshly appended token."""
-        full = self.tokenizer.decode(self.tokens)
-        new = full[len(self._pending_text):]
-        # hold back text while the tail is an incomplete UTF-8 replacement
-        if new.endswith("�"):
-            return ""
-        self._pending_text = full
+        new, self._pending_text = incremental_decode(
+            self.tokenizer, self.tokens, self._pending_text)
         return new
 
     # -- fully on-device generation (throughput path) ------------------------

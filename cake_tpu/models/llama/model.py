@@ -21,12 +21,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cake_tpu.models.llama.cache import KVCache, update_layer_cache
+from cake_tpu.models.llama.cache import (
+    KVCache, update_layer_cache, update_layer_cache_per_row,
+)
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.ops.attention import decode_mask, gqa_attention
+from cake_tpu.ops.attention import (
+    decode_mask, decode_mask_per_row, gqa_attention,
+)
 from cake_tpu.ops.flash_attention import flash_attention, flash_supported
 from cake_tpu.ops.norms import rms_norm
-from cake_tpu.ops.rope import apply_rope, precompute_rope, rope_rows
+from cake_tpu.ops.rope import (
+    apply_rope, precompute_rope, rope_rows, rope_rows_per_row,
+)
 
 
 class RopeTables(NamedTuple):
@@ -191,3 +197,70 @@ def decode_step(params, token, pos, cache: KVCache, rope: RopeTables,
                 config: LlamaConfig):
     """One KV-cached decode step: token [B, 1] at absolute pos -> logits."""
     return forward(params, token, cache, pos, rope, config)
+
+
+# -- ragged (per-row position) entry points for continuous batching ----------
+
+
+def forward_ragged(params, tokens, cache: KVCache, pos, active,
+                   rope: RopeTables, config: LlamaConfig):
+    """Single-token decode where every batch row sits at its own position.
+
+    tokens: [B, 1]; pos: [B] absolute positions; active: [B] bool —
+    inactive rows (free slots between requests) compute garbage but leave
+    their cache lines untouched. Returns (logits [B, V] f32, cache).
+    """
+    B = tokens.shape[0]
+    T = cache.max_seq_len
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
+    mask = decode_mask_per_row(pos, T)
+
+    def body(h, xs):
+        lp, kc, vc = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            kc2, vc2 = update_layer_cache_per_row(kc, vc, k, v, pos, active)
+            return gqa_attention(q, kc2, vc2, mask=mask), (kc2, vc2)
+
+        h, (kc, vc) = block_skeleton(lp, h, config, attn_fn)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
+                       rope: RopeTables, config: LlamaConfig):
+    """Jitted ragged decode step (compiles once per batch size)."""
+    return forward_ragged(params, tokens, cache, pos, active, rope, config)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_slot(params, tokens, prompt_len, slot, cache: KVCache,
+                 rope: RopeTables, config: LlamaConfig):
+    """Prefill ONE request into batch slot `slot` of a shared cache.
+
+    tokens: [1, S_padded]; prompt_len: [1]; slot: traced scalar. The slot's
+    cache lines are sliced out, prefilled from position 0, and written back —
+    other slots' state is untouched, so requests can be admitted while their
+    neighbors are mid-decode (continuous batching). Compiles once per prefill
+    bucket length.
+    """
+    sub = KVCache(
+        k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+        v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+    )
+    last_idx = (prompt_len - 1).astype(jnp.int32)
+    logits, sub = forward(params, tokens, sub, jnp.int32(0), rope, config,
+                          last_idx=last_idx, is_prefill=True)
+    cache = KVCache(
+        k=lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
+        v=lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
+    )
+    return logits, cache

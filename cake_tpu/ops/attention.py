@@ -40,6 +40,8 @@ def gqa_attention(q, k, v, *, mask=None, scale: float | None = None):
         m = mask
         if m.ndim == 4:  # [B, H, S, T] -> [B, KV, G, S, T]
             m = m.reshape(B, KV, G, S, T)
+        elif m.ndim == 3:  # [B, S, T] per-row (ragged decode)
+            m = m[:, None, None, :, :]
         elif m.ndim == 2:  # [S, T]
             m = m[None, None, None, :, :]
         scores = jnp.where(m, scores, jnp.float32(-1e30))
@@ -72,3 +74,10 @@ def decode_mask(pos, seq_len: int, max_seq_len: int):
     qi = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 0)
     kj = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 1)
     return kj <= (qi + pos)
+
+
+def decode_mask_per_row(pos, max_seq_len: int):
+    """[B, 1, T] mask for ragged single-token decode: row b (whose query sits
+    at absolute position pos[b]) may attend cache slots j <= pos[b]."""
+    kj = lax.broadcasted_iota(jnp.int32, (pos.shape[0], 1, max_seq_len), 2)
+    return kj <= pos[:, None, None]

@@ -35,17 +35,33 @@ def rope_rows(cos, sin, pos, seq_len: int):
     return c, s
 
 
+def rope_rows_per_row(cos, sin, pos):
+    """Gather one table row per batch element (ragged decode).
+
+    pos: [B] absolute positions -> (cos, sin) of shape [B, 1, head_dim//2],
+    ready for `apply_rope` in per-row mode.
+    """
+    c = jnp.take(cos, pos, axis=0)[:, None, :]
+    s = jnp.take(sin, pos, axis=0)[:, None, :]
+    return c, s
+
+
 def apply_rope(x, cos, sin):
     """Rotate-half RoPE.
 
     x:        [batch, seq, heads, head_dim]
-    cos/sin:  [seq, head_dim//2]
+    cos/sin:  [seq, head_dim//2] shared across the batch, or
+              [batch, seq, head_dim//2] per-row (ragged decode).
     """
     half = x.shape[-1] // 2
     x1 = x[..., :half]
     x2 = x[..., half:]
-    c = cos[None, :, None, :].astype(jnp.float32)
-    s = sin[None, :, None, :].astype(jnp.float32)
+    if cos.ndim == 2:
+        c = cos[None, :, None, :].astype(jnp.float32)
+        s = sin[None, :, None, :].astype(jnp.float32)
+    else:
+        c = cos[:, :, None, :].astype(jnp.float32)
+        s = sin[:, :, None, :].astype(jnp.float32)
     x1f = x1.astype(jnp.float32)
     x2f = x2.astype(jnp.float32)
     out = jnp.concatenate([x1f * c - x2f * s, x2f * c + x1f * s], axis=-1)

@@ -93,3 +93,67 @@ def update_ring(recent_tokens, new_tokens, step):
     N = recent_tokens.shape[1]
     slot = jnp.mod(step, N)
     return recent_tokens.at[:, slot].set(new_tokens)
+
+
+def update_ring_per_row(recent_tokens, new_tokens, steps):
+    """Per-row ring push: row b writes at slot steps[b] % N (ragged decode)."""
+    N = recent_tokens.shape[1]
+    b = jnp.arange(recent_tokens.shape[0])
+    return recent_tokens.at[b, jnp.mod(steps, N)].set(new_tokens)
+
+
+def _apply_repeat_penalty_per_row(logits, recent_tokens, penalty):
+    """Like `apply_repeat_penalty` but penalty is a [B] traced vector."""
+    B, V = logits.shape
+    valid = recent_tokens >= 0
+    ids = jnp.clip(recent_tokens, 0, V - 1)
+    hit = jnp.zeros((B, V), dtype=bool)
+    batch_idx = jnp.arange(B)[:, None].repeat(recent_tokens.shape[1], axis=1)
+    hit = hit.at[batch_idx, ids].max(valid)
+    pen = penalty[:, None]
+    penalised = jnp.where(logits >= 0.0, logits / pen, logits * pen)
+    return jnp.where(hit, penalised, logits)
+
+
+@partial(jax.jit, static_argnames=("top_k",))
+def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
+                         repeat_penalty, top_k: Optional[int] = None):
+    """Batched sampling with PER-ROW options (continuous batching: each slot
+    carries its own request's temperature/top_p/repeat_penalty).
+
+    keys:            [B] PRNG keys (one per slot — a row's stream is
+                     independent of which other requests share the batch)
+    logits:          [B, V]
+    recent_tokens:   [B, N] ring buffers (-1 = empty)
+    temperature:     [B] f32; <= 0 means greedy for that row
+    top_p:           [B] f32; >= 1 disables nucleus filtering for that row
+    repeat_penalty:  [B] f32; 1.0 disables
+    top_k:           static engine-wide k (the REST API exposes only
+                     temperature/top_p per request, matching the reference's
+                     global Args.top_k)
+    Returns [B] int32.
+    """
+    logits = logits.astype(jnp.float32)
+    logits = _apply_repeat_penalty_per_row(logits, recent_tokens,
+                                           repeat_penalty)
+    greedy = temperature <= 0.0
+    argmax_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_t = jnp.where(greedy, 1.0, temperature)[:, None]
+    scaled = logits / safe_t
+    if top_k is not None:
+        scaled = _mask_top_k(scaled, top_k)
+    # per-row nucleus filtering; p>=1 keeps everything
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+    keep_sorted = keep_sorted.at[..., 0].set(True)  # top token always survives
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sampled = jax.vmap(
+        lambda k, lg: jax.random.categorical(k, lg)
+    )(keys, filtered).astype(jnp.int32)
+    return jnp.where(greedy, argmax_ids, sampled)

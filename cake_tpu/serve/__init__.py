@@ -1,0 +1,3 @@
+from cake_tpu.serve.engine import EngineStats, InferenceEngine, RequestHandle
+
+__all__ = ["InferenceEngine", "RequestHandle", "EngineStats"]

@@ -1,0 +1,415 @@
+"""Continuous-batching inference engine.
+
+The reference serves one request at a time — the REST handler write-locks
+the whole Master for the duration of a generation (api/text.rs:67,
+SURVEY.md §3.3). This engine replaces that with slot-based continuous
+batching on top of the native scheduler (cake_tpu/native/scheduler.py):
+
+  * a fixed pool of B decode slots shares ONE batched KV cache
+    [L, B, T, KV, hd] — static shapes, so the decode step is a single
+    cached XLA program regardless of which requests occupy which slots;
+  * new requests are admitted *between decode steps*: `prefill_slot`
+    fills exactly one slot's cache lines (dynamic_slice / update along the
+    batch axis) while neighboring slots keep decoding next iteration;
+  * every slot carries its own position, PRNG key, repeat-penalty ring and
+    sampling options, so the batched step is "ragged": per-row RoPE rows,
+    per-row causal masks, per-row temperature/top_p
+    (model.forward_ragged, ops/sampling.sample_tokens_ragged);
+  * tokens stream to per-request callbacks from the engine thread; EOS /
+    max-token retirement frees the slot for the next queued request.
+
+A row's output depends only on its own prompt, options and PRNG key — not
+on which other requests happen to share the batch (verified by
+tests/test_engine.py against the sequential generator).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.chat import History, Message
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    bucket_length, encode_text, incremental_decode,
+)
+from cake_tpu.models.llama.model import (
+    RopeTables, decode_step_ragged, prefill_slot,
+)
+from cake_tpu.native.scheduler import make_scheduler
+from cake_tpu.ops.sampling import (
+    SamplingConfig, sample_tokens_ragged, update_ring_per_row,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt_ids: List[int]
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    repeat_penalty: float
+    stream: Optional[Callable[[str, bool], None]]
+    out_tokens: List[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[Exception] = None
+    slot: int = -1
+    submit_t: float = 0.0
+    first_token_t: float = 0.0
+    finish_t: float = 0.0
+    _pending_text: str = ""
+
+
+class RequestHandle:
+    """Caller-side view of a submitted request."""
+
+    def __init__(self, req: _Request, tokenizer, eos_ids):
+        self._req = req
+        self._tokenizer = tokenizer
+        self._eos_ids = eos_ids
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._req.done.wait(timeout)
+
+    @property
+    def token_ids(self) -> List[int]:
+        ids = self._req.out_tokens
+        return [t for t in ids if t not in self._eos_ids]
+
+    def text(self) -> str:
+        if self._req.error is not None:
+            raise self._req.error
+        return self._tokenizer.decode(self.token_ids)
+
+    @property
+    def ttft(self) -> float:
+        """Seconds from submit to first token (includes queueing)."""
+        r = self._req
+        return (r.first_token_t - r.submit_t) if r.first_token_t else 0.0
+
+    @property
+    def tokens_per_s(self) -> float:
+        r = self._req
+        n = len(r.out_tokens)
+        dt = (r.finish_t or time.perf_counter()) - (r.first_token_t or 0)
+        return (n - 1) / dt if n > 1 and dt > 0 else 0.0
+
+
+@dataclass
+class EngineStats:
+    """Aggregate throughput counters (reference worker.rs:254-283 analog)."""
+
+    steps: int = 0
+    tokens_generated: int = 0
+    requests_completed: int = 0
+    decode_time_s: float = 0.0
+    prefill_time_s: float = 0.0
+    errors: int = 0
+    last_error: str = ""
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        return (self.tokens_generated / self.decode_time_s
+                if self.decode_time_s > 0 else 0.0)
+
+
+class InferenceEngine:
+    """Slot-based continuous batching over one shared batched KV cache."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        tokenizer,
+        *,
+        max_slots: int = 8,
+        max_seq_len: int = 4096,
+        max_queue: int = 1024,
+        sampling: Optional[SamplingConfig] = None,
+        seed: int = 299792458,
+        cache_dtype=jnp.bfloat16,
+    ):
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.defaults = sampling or SamplingConfig()
+        self.rope = RopeTables.create(config, max_seq_len)
+        self.cache = KVCache.create(config, max_slots, max_seq_len,
+                                    dtype=cache_dtype)
+        self.scheduler = make_scheduler(max_slots, max_queue)
+        self.stats = EngineStats()
+
+        B = max_slots
+        self._pos = np.zeros(B, np.int64)            # next write position
+        self._last_tok = np.zeros(B, np.int64)
+        self._steps = np.zeros(B, np.int64)          # generated count per slot
+        self._temp = np.full(B, self.defaults.temperature or 0.0, np.float32)
+        self._top_p = np.ones(B, np.float32)
+        self._penalty = np.full(B, self.defaults.repeat_penalty, np.float32)
+        self._ring = jnp.full((B, self.defaults.repeat_last_n), -1, jnp.int32)
+        root = jax.random.PRNGKey(seed)
+        self._keys = jax.random.split(root, B)       # [B] keys
+        self._slot_req: List[Optional[_Request]] = [None] * B
+
+        self._next_rid = 1
+        self._rid_lock = threading.Lock()
+        self._requests = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="cake-engine")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        *,
+        max_new_tokens: int = 100,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        repeat_penalty: Optional[float] = None,
+        stream: Optional[Callable[[str, bool], None]] = None,
+    ) -> RequestHandle:
+        """Queue one generation. stream(text_delta, is_final) is called from
+        the engine thread as tokens finalize; the handle's wait()/text()
+        gives the blocking interface."""
+        ids = list(prompt_ids)
+        if not ids:
+            raise ValueError("empty prompt")
+        if len(ids) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        max_new = min(max_new_tokens, self.max_seq_len - len(ids))
+        with self._rid_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        d = self.defaults
+        eff_temp = temperature if temperature is not None else d.temperature
+        eff_top_p = top_p if top_p is not None else d.top_p
+        req = _Request(
+            rid=rid, prompt_ids=ids, max_new_tokens=max_new,
+            temperature=eff_temp if eff_temp is not None else 0.0,
+            top_p=eff_top_p if eff_top_p is not None else 1.0,
+            repeat_penalty=(d.repeat_penalty if repeat_penalty is None
+                            else repeat_penalty),
+            stream=stream, submit_t=time.perf_counter(),
+        )
+        # register BEFORE scheduler.submit: the engine thread may plan the
+        # rid immediately, and _do_prefill treats an unknown rid as cancelled
+        self._requests[rid] = req
+        if not self.scheduler.submit(rid, len(ids), max_new):
+            self._requests.pop(rid, None)
+            raise QueueFullError("engine queue full")
+        self._wake.set()
+        return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
+
+    def chat(self, messages: Sequence[Message], **kw) -> RequestHandle:
+        """Render a chat history through the Llama-3 template and submit."""
+        hist = History()
+        for m in messages:
+            hist.add_message(m)
+        return self.submit(encode_text(self.tokenizer, hist.render()), **kw)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth
+
+    @property
+    def active(self) -> int:
+        return self.scheduler.active
+
+    # -- engine loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            prefill_plan, decode_plan = self.scheduler.plan()
+            if not prefill_plan and not decode_plan:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                for rid, slot in prefill_plan:
+                    self._do_prefill(rid, slot)
+                if decode_plan:
+                    self._do_decode(decode_plan)
+            except Exception as e:  # noqa: BLE001
+                log.exception("engine iteration failed")
+                self._fail_all(e)
+                # the jitted steps donate the cache buffer; after a failed
+                # call it may already be deleted — rebuild so the engine
+                # survives (transient OOM/XLA error must not brick serving)
+                self.cache = KVCache.create(
+                    self.config, self.max_slots, self.max_seq_len,
+                    dtype=self.cache.k.dtype)
+                self._pos[:] = 0
+                self._last_tok[:] = 0
+                self._steps[:] = 0
+                self.stats.errors += 1
+                self.stats.last_error = f"{type(e).__name__}: {e}"
+
+    def _do_prefill(self, rid: int, slot: int) -> None:
+        req = self._requests.get(rid)
+        if req is None:  # cancelled between plan and here
+            self.scheduler.cancel(rid)
+            return
+        t0 = time.perf_counter()
+        req.slot = slot
+        self._slot_req[slot] = req
+        ids = req.prompt_ids
+        bucket = bucket_length(len(ids), self.max_seq_len)
+        padded = ids + [0] * (bucket - len(ids))
+        toks = jnp.asarray([padded], jnp.int32)
+        plen = jnp.asarray([len(ids)], jnp.int32)
+        logits, self.cache = prefill_slot(
+            self.params, toks, plen, jnp.int32(slot), self.cache,
+            self.rope, self.config,
+        )
+        # configure the slot
+        self._pos[slot] = len(ids)
+        self._steps[slot] = 0
+        self._temp[slot] = req.temperature
+        self._top_p[slot] = req.top_p
+        self._penalty[slot] = req.repeat_penalty
+        self._ring = self._ring.at[slot].set(-1)
+        # sample the first token with the slot's own key/options
+        first = self._sample_rows(
+            jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
+            rows=[slot])
+        self.stats.prefill_time_s += time.perf_counter() - t0
+        self._emit(req, int(first[slot]))
+
+    def _do_decode(self, decode_plan) -> None:
+        t0 = time.perf_counter()
+        B = self.max_slots
+        active = np.zeros(B, bool)
+        for _, slot in decode_plan:
+            active[slot] = True
+        toks = jnp.asarray(self._last_tok[:, None], jnp.int32)
+        pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                          jnp.int32)
+        logits, self.cache = decode_step_ragged(
+            self.params, toks, pos, jnp.asarray(active), self.cache,
+            self.rope, self.config,
+        )
+        nxt = self._sample_rows(logits, rows=[s for _, s in decode_plan])
+        self._pos += active  # only active rows advanced
+        self.stats.steps += 1
+        self.stats.decode_time_s += time.perf_counter() - t0
+        for rid, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is None or req.rid != rid:
+                continue
+            self._emit(req, int(nxt[slot]))
+
+    def _sample_rows(self, logits, rows: List[int]):
+        """Sample all B rows in one jitted call; advance keys/ring only for
+        `rows` (so an inactive slot's PRNG stream is untouched)."""
+        B = self.max_slots
+        row_mask = np.zeros(B, bool)
+        for r in rows:
+            row_mask[r] = True
+        mask_dev = jnp.asarray(row_mask)
+        keys, subkeys = _split_keys(self._keys)
+        nxt = sample_tokens_ragged(
+            subkeys, logits, self._ring,
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._penalty), top_k=self.defaults.top_k,
+        )
+        # only selected rows consume randomness / update their ring
+        self._keys = jnp.where(mask_dev[:, None], keys, self._keys)
+        steps = jnp.asarray(self._steps, jnp.int32)
+        new_ring = update_ring_per_row(self._ring, nxt, steps)
+        self._ring = jnp.where(mask_dev[:, None], new_ring, self._ring)
+        nxt_host = np.asarray(nxt)
+        for r in rows:
+            self._steps[r] += 1
+            self._last_tok[r] = nxt_host[r]
+        return nxt_host
+
+    # -- token plumbing -------------------------------------------------------
+
+    def _emit(self, req: _Request, token_id: int) -> None:
+        now = time.perf_counter()
+        if not req.out_tokens:
+            req.first_token_t = now
+        req.out_tokens.append(token_id)
+        self.stats.tokens_generated += 1
+        eos = token_id in self.config.eos_token_ids
+        hit_cap = (self._pos[req.slot] + 1 >= self.max_seq_len)
+        finished = self.scheduler.report(req.rid, 1, eos or hit_cap)
+        if req.stream is not None:
+            delta = "" if eos else self._incremental_text(req)
+            if delta or finished:
+                try:
+                    req.stream(delta, finished)
+                except Exception:  # noqa: BLE001
+                    log.exception("stream callback failed rid=%d", req.rid)
+        if finished:
+            req.finish_t = now
+            self._slot_req[req.slot] = None
+            self._requests.pop(req.rid, None)
+            self.stats.requests_completed += 1
+            req.done.set()
+
+    def _incremental_text(self, req: _Request) -> str:
+        ids = [t for t in req.out_tokens
+               if t not in self.config.eos_token_ids]
+        new, req._pending_text = incremental_decode(
+            self.tokenizer, ids, req._pending_text)
+        return new
+
+    def _fail_all(self, err: Exception) -> None:
+        for rid, req in list(self._requests.items()):
+            req.error = err
+            self.scheduler.cancel(rid)
+            if req.slot >= 0:
+                self._slot_req[req.slot] = None
+            self._requests.pop(rid, None)
+            req.done.set()
+
+
+class QueueFullError(Exception):
+    pass
+
+
+@jax.jit
+def _split_keys(keys):
+    """Split a [B]-vector of PRNG keys into (next_keys, subkeys)."""
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return split[:, 0], split[:, 1]

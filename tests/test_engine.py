@@ -1,0 +1,216 @@
+"""Continuous-batching engine: ragged decode correctness + serving behavior.
+
+The load-bearing property: a request's output is identical whether it runs
+alone through the sequential generator or concurrently with arbitrary other
+requests through the engine (per-row positions, masks, RoPE and sampling
+state must be fully isolated per slot)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve.engine import InferenceEngine, QueueFullError
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tok = ByteTokenizer(cfg.vocab_size)
+    return cfg, params, tok
+
+
+def sequential_ids(setup, prompt: str, n: int):
+    cfg, params, tok = setup
+    g = LlamaGenerator(cfg, params, tok, max_seq_len=256,
+                       sampling=SamplingConfig(temperature=0.0),
+                       cache_dtype=jnp.float32)
+    g.add_message(Message.user(prompt))
+    out = []
+    for i in range(n):
+        t = g.next_token(i)
+        if t.is_end_of_stream:
+            break
+        out.append(t.id)
+    return out
+
+
+def make_engine(setup, max_slots=4, **kw):
+    cfg, params, tok = setup
+    kw.setdefault("sampling", SamplingConfig(temperature=0.0))
+    return InferenceEngine(cfg, params, tok, max_slots=max_slots,
+                           max_seq_len=256, cache_dtype=jnp.float32, **kw)
+
+
+def test_concurrent_matches_sequential(setup):
+    """Three different-length prompts in flight together must each produce
+    exactly what the sequential generator produces for them alone."""
+    prompts = ["hello world", "a", "the quick brown fox jumps"]
+    want = {p: sequential_ids(setup, p, 12) for p in prompts}
+
+    with make_engine(setup, max_slots=4) as eng:
+        handles = {}
+        for p in prompts:
+            handles[p] = eng.chat([Message.user(p)], max_new_tokens=12)
+        for p, h in handles.items():
+            assert h.wait(120), f"timeout waiting for {p!r}"
+            got = h._req.out_tokens
+            got = [t for t in got if t not in setup[0].eos_token_ids]
+            assert got == want[p], f"mismatch for {p!r}"
+
+
+def test_more_requests_than_slots(setup):
+    """Requests beyond the slot count queue and retire correctly."""
+    with make_engine(setup, max_slots=2) as eng:
+        hs = [eng.submit([5 + i, 6, 7], max_new_tokens=5) for i in range(6)]
+        for h in hs:
+            assert h.wait(120)
+            assert 1 <= len(h._req.out_tokens) <= 5
+        assert eng.stats.requests_completed == 6
+        assert eng.active == 0
+        assert eng.queue_depth == 0
+
+
+def test_streaming_callbacks(setup):
+    got = []
+    done = threading.Event()
+
+    def stream(delta, final):
+        got.append((delta, final))
+        if final:
+            done.set()
+
+    with make_engine(setup) as eng:
+        h = eng.submit([10, 11, 12], max_new_tokens=6, stream=stream)
+        assert h.wait(120)
+        assert done.wait(10)
+    assert got[-1][1] is True
+    text = "".join(d for d, _ in got)
+    assert text == h.text()
+
+
+def test_late_join_does_not_disturb_running_request(setup):
+    """A request admitted mid-decode of another must not change either's
+    output (prefill touches only its own slot's cache lines)."""
+    a, b = "first request 123", "second"
+    want_a = sequential_ids(setup, a, 16)
+    want_b = sequential_ids(setup, b, 16)
+
+    with make_engine(setup, max_slots=2) as eng:
+        ha = eng.chat([Message.user(a)], max_new_tokens=16)
+        # let A get a few decode steps in before B joins
+        deadline = time.time() + 60
+        while len(ha._req.out_tokens) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        hb = eng.chat([Message.user(b)], max_new_tokens=16)
+        assert ha.wait(120) and hb.wait(120)
+        eos = setup[0].eos_token_ids
+        assert [t for t in ha._req.out_tokens if t not in eos] == want_a
+        assert [t for t in hb._req.out_tokens if t not in eos] == want_b
+
+
+def test_per_request_sampling_options(setup):
+    """Greedy and sampled requests coexist; greedy rows stay deterministic."""
+    with make_engine(setup, max_slots=3) as eng:
+        hg = eng.submit([20, 21, 22], max_new_tokens=8, temperature=0.0)
+        hs = eng.submit([20, 21, 22], max_new_tokens=8, temperature=1.5,
+                        top_p=0.9)
+        assert hg.wait(120) and hs.wait(120)
+        want = sequential_ids(setup, "", 8)  # not comparable; just check shape
+        assert len(hg._req.out_tokens) >= 1
+        assert len(hs._req.out_tokens) >= 1
+    # the greedy request must reproduce exactly on a fresh engine
+    with make_engine(setup, max_slots=3) as eng:
+        hg2 = eng.submit([20, 21, 22], max_new_tokens=8, temperature=0.0)
+        assert hg2.wait(120)
+    assert hg._req.out_tokens == hg2._req.out_tokens
+
+
+def test_queue_full(setup):
+    eng = make_engine(setup, max_slots=1, max_queue=2)
+    # not started: plan() never runs, so submissions pile up in the queue
+    # (slot admission happens between engine iterations, not at submit)
+    eng.submit([1, 2], max_new_tokens=4)
+    eng.submit([1, 2], max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        eng.submit([1, 2], max_new_tokens=4)
+    eng.stop()
+
+
+def test_max_tokens_cap_and_metrics(setup):
+    with make_engine(setup) as eng:
+        h = eng.submit([3, 4, 5], max_new_tokens=4)
+        assert h.wait(120)
+        assert len(h._req.out_tokens) <= 4
+        assert h.ttft > 0
+        assert eng.stats.tokens_generated >= 1
+        assert eng.stats.decode_tokens_per_s >= 0
+
+
+def test_engine_api_server_integration(setup):
+    """End-to-end over HTTP: concurrent streaming + non-streaming chats."""
+    import json
+    import http.client
+    from cake_tpu.api.server import start as api_start
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+
+    cfg, params, tok = setup
+    g = LlamaGenerator(cfg, params, tok, max_seq_len=256,
+                       sampling=SamplingConfig(temperature=0.0),
+                       cache_dtype=jnp.float32)
+    master = Master(Args(sample_len=8, max_slots=4), text_generator=g)
+    engine = make_engine(setup, max_slots=4)
+    httpd = api_start(master, address="127.0.0.1:0", block=False,
+                      engine=engine)
+    port = httpd.server_address[1]
+    try:
+        results = {}
+
+        def post(name, body):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+            c.request("POST", "/api/v1/chat/completions", json.dumps(body),
+                      {"Content-Type": "application/json"})
+            r = c.getresponse()
+            results[name] = (r.status, r.read())
+            c.close()
+
+        threads = [
+            threading.Thread(target=post, args=(i, {
+                "messages": [{"role": "user", "content": f"hi {i}"}],
+                "max_tokens": 6, "stream": i % 2 == 0,
+            })) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert len(results) == 4
+        for i, (status, body) in results.items():
+            assert status == 200
+            if i % 2 == 0:
+                assert b"data:" in body and b"[DONE]" in body
+            else:
+                obj = json.loads(body)
+                assert obj["object"] == "chat.completion"
+
+        # health reflects engine counters
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request("GET", "/api/v1/health")
+        h = json.loads(c.getresponse().read())
+        assert h["requests_completed"] >= 2
+        assert "decode_tokens_per_s" in h
+        c.close()
+    finally:
+        httpd.shutdown()
+        engine.stop()
