@@ -62,6 +62,9 @@ class Args:
     # Pallas flash attention for LLM prefill; None = auto (on when the
     # backend is a real TPU, off on CPU where interpret mode is slow)
     flash_attention: Optional[bool] = None
+    # profile generation to this directory (jax.profiler; view in
+    # TensorBoard or ui.perfetto.dev) — LLM-path analog of --sd-tracing
+    tracing: Optional[str] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):

@@ -53,7 +53,9 @@ def main(argv=None) -> int:
         master.generate_image(img_args, save)
         return 0
 
-    master.run()
+    from cake_tpu.utils.profiling import trace
+    with trace(args.tracing):
+        master.run()
     return 0
 
 

@@ -102,12 +102,15 @@ class Context:
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,
             repeat_penalty=a.repeat_penalty, repeat_last_n=a.repeat_last_n,
         )
-        return LlamaGenerator(
+        gen = LlamaGenerator(
             cfg, params, tokenizer,
             max_seq_len=min(a.max_seq_len, cfg.max_position_embeddings),
             batch_size=a.batch_size, sampling=sampling, seed=a.seed,
             cache_dtype=self.dtype,
         )
+        from cake_tpu.utils.profiling import log_memory
+        log_memory("model loaded")  # reference llama.rs:233-236
+        return gen
 
     def load_image_model(self):
         from cake_tpu.models.sd.sd import SDGenerator

@@ -227,6 +227,14 @@ class SDGenerator:
 
     def generate_image(self, args: ImageGenerationArgs,
                        callback: Callable[[List[bytes]], None]) -> None:
+        # --sd-tracing equivalent (reference sd.rs:350-356): profile the
+        # whole generation to a Perfetto/TensorBoard trace directory.
+        from cake_tpu.utils.profiling import trace
+        with trace("sd-trace" if args.sd_tracing else None):
+            self._generate_image(args, callback)
+
+    def _generate_image(self, args: ImageGenerationArgs,
+                        callback: Callable[[List[bytes]], None]) -> None:
         cfg = self.config
         steps = args.sd_n_steps or cfg.default_steps
         guidance = (args.sd_guidance_scale

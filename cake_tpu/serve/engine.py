@@ -151,6 +151,8 @@ class InferenceEngine:
                                     dtype=cache_dtype)
         self.scheduler = make_scheduler(max_slots, max_queue)
         self.stats = EngineStats()
+        from cake_tpu.utils.profiling import StepStats
+        self._step_stats = StepStats(name="engine", window=100)
 
         B = max_slots
         self._pos = np.zeros(B, np.int64)            # next write position
@@ -175,6 +177,8 @@ class InferenceEngine:
 
     def start(self) -> "InferenceEngine":
         if self._thread is None:
+            from cake_tpu.utils.profiling import log_memory
+            log_memory("engine start")
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name="cake-engine")
             self._thread.start()
@@ -331,6 +335,7 @@ class InferenceEngine:
         self._pos += active  # only active rows advanced
         self.stats.steps += 1
         self.stats.decode_time_s += time.perf_counter() - t0
+        self._step_stats.step(bytes_out=len(decode_plan))
         for rid, slot in decode_plan:
             req = self._slot_req[slot]
             if req is None or req.rid != rid:

@@ -1,0 +1,140 @@
+"""Tracing / profiling / memory observability.
+
+Reference surface (SURVEY.md §5):
+  * `--sd-tracing` installs a Chrome-trace subscriber writing
+    `trace-*.json` (sd/sd.rs:350-356) — here `trace(dir)` wraps
+    `jax.profiler.trace`, producing a TensorBoard/Perfetto profile of
+    both host Python and on-device XLA execution (strictly more detail
+    than the reference's host-side spans), plus `annotate(name)` for
+    custom spans (`jax.profiler.TraceAnnotation`).
+  * worker ops/s + read/write throughput logged every 5 ops
+    (worker.rs:19, 254-283) — here `StepStats`, a windowed counter the
+    engine/drivers call per step.
+  * memory reporting at context creation / model load / inference start
+    (cake/mod.rs:65-71, memory-stats + human_bytes) — here
+    `log_memory(tag)` over `Device.memory_stats()` (real HBM numbers on
+    TPU, not host RSS).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+NUM_OPS_TO_STATS = 5  # reference worker.rs:19
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """Profile everything inside the block to `log_dir` (None = no-op).
+
+    View with TensorBoard's profile plugin or upload the generated
+    `*.trace.json.gz` (perfetto trace) to ui.perfetto.dev — the TPU-era
+    equivalent of the reference's chrome://tracing JSON.
+    """
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir, create_perfetto_trace=True):
+        log.info("profiling to %s", log_dir)
+        yield
+    log.info("profile written to %s", log_dir)
+
+
+def annotate(name: str):
+    """Named span visible in the profile (host + device timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def human_bytes(n: float) -> str:
+    """1536 -> '1.5 KiB' (reference human_bytes crate semantics)."""
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def device_memory_stats() -> List[Dict[str, object]]:
+    """Per-device memory usage. Empty fields on backends without stats."""
+    out = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — CPU backend has no stats
+            pass
+        out.append({
+            "device": f"{d.platform}:{d.id}",
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        })
+    return out
+
+
+def log_memory(tag: str) -> None:
+    """Log per-device memory at a lifecycle point (cake/mod.rs:65-71)."""
+    for s in device_memory_stats():
+        used, peak, limit = (s["bytes_in_use"], s["peak_bytes_in_use"],
+                             s["bytes_limit"])
+        if used is None:
+            log.info("[%s] %s: memory stats unavailable", tag, s["device"])
+        else:
+            log.info(
+                "[%s] %s: %s in use (peak %s / limit %s)", tag, s["device"],
+                human_bytes(used), human_bytes(peak or 0),
+                human_bytes(limit or 0),
+            )
+
+
+@dataclass
+class StepStats:
+    """Windowed per-step throughput counters (worker.rs:254-283 analog).
+
+    Call `step(bytes_in, bytes_out)` once per op; every `window` ops the
+    moving-window ops/s + throughput is logged and returned.
+    """
+
+    name: str = "engine"
+    window: int = NUM_OPS_TO_STATS
+    ops: int = 0
+    total_bytes_in: int = 0
+    total_bytes_out: int = 0
+    _win_start: float = field(default_factory=time.perf_counter)
+    _win_bytes_in: int = 0
+    _win_bytes_out: int = 0
+    last_ops_per_s: float = 0.0
+
+    def step(self, bytes_in: int = 0, bytes_out: int = 0) -> Optional[dict]:
+        self.ops += 1
+        self.total_bytes_in += bytes_in
+        self.total_bytes_out += bytes_out
+        self._win_bytes_in += bytes_in
+        self._win_bytes_out += bytes_out
+        if self.ops % self.window:
+            return None
+        now = time.perf_counter()
+        dt = max(now - self._win_start, 1e-9)
+        snap = {
+            "ops_per_s": self.window / dt,
+            "read_bytes_per_s": self._win_bytes_in / dt,
+            "write_bytes_per_s": self._win_bytes_out / dt,
+        }
+        self.last_ops_per_s = snap["ops_per_s"]
+        log.info(
+            "%s: %.1f ops/s | read %s/s | write %s/s", self.name,
+            snap["ops_per_s"], human_bytes(snap["read_bytes_per_s"]),
+            human_bytes(snap["write_bytes_per_s"]),
+        )
+        self._win_start = now
+        self._win_bytes_in = 0
+        self._win_bytes_out = 0
+        return snap

@@ -1,0 +1,75 @@
+"""Profiling subsystem: trace capture, step stats, memory reporting."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.utils.profiling import (
+    StepStats, annotate, device_memory_stats, human_bytes, log_memory, trace,
+)
+
+
+def test_human_bytes():
+    assert human_bytes(512) == "512 B"
+    assert human_bytes(1536) == "1.5 KiB"
+    assert human_bytes(3 * 1024 ** 3) == "3.0 GiB"
+
+
+def test_trace_noop_when_disabled():
+    with trace(None):
+        pass
+    with trace(""):
+        pass
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace(d):
+        with annotate("test-span"):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    found = []
+    for root, _dirs, files in os.walk(d):
+        found.extend(files)
+    assert found, "profiler produced no output files"
+
+
+def test_step_stats_window():
+    st = StepStats(name="t", window=5)
+    snaps = [st.step(bytes_in=10, bytes_out=20) for _ in range(12)]
+    real = [s for s in snaps if s is not None]
+    assert len(real) == 2  # at ops 5 and 10
+    assert st.ops == 12
+    assert st.total_bytes_in == 120
+    assert st.total_bytes_out == 240
+    assert real[0]["ops_per_s"] > 0
+    assert st.last_ops_per_s > 0
+
+
+def test_memory_stats_shape():
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.local_devices())
+    for s in stats:
+        assert "device" in s and "bytes_in_use" in s
+    log_memory("test")  # must not raise on CPU
+
+
+def test_sd_tracing_flag_wires(tmp_path, monkeypatch):
+    """--sd-tracing routes generation through the profiler context."""
+    import cake_tpu.models.sd.sd as sd_mod
+    from cake_tpu.args import ImageGenerationArgs
+
+    calls = []
+
+    class FakeSD(sd_mod.SDGenerator):
+        def __init__(self):  # bypass heavy init
+            pass
+
+        def _generate_image(self, args, callback):
+            calls.append("ran")
+
+    monkeypatch.chdir(tmp_path)
+    FakeSD().generate_image(
+        ImageGenerationArgs(sd_tracing=True), lambda p: None)
+    assert calls == ["ran"]
+    assert os.path.isdir(tmp_path / "sd-trace")
