@@ -169,13 +169,14 @@ class ApiServer:
 
     def cluster(self) -> dict:
         import jax
-        return {
-            "devices": [
-                {"id": d.id, "platform": d.platform,
-                 "kind": d.device_kind, "process": d.process_index}
-                for d in jax.devices()
-            ],
-        }
+        from cake_tpu.parallel.distributed import cluster_info
+        out = cluster_info()
+        out["devices"] = [
+            {"id": d.id, "platform": d.platform,
+             "kind": d.device_kind, "process": d.process_index}
+            for d in jax.devices()
+        ]
+        return out
 
     # -- admission -----------------------------------------------------------
 

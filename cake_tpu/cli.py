@@ -32,11 +32,23 @@ def main(argv=None) -> int:
         )
         return 2
 
+    # multi-host: every host runs this same program (SPMD); coordinates
+    # auto-detected on TPU pods or taken from CAKE_* env vars
+    from cake_tpu.parallel.distributed import initialize, is_coordinator
+    initialize()
+
     master = Master.from_args(args, sd_args)
 
     if args.api:
         from cake_tpu.api import start
-        start(master, address=args.api)
+        if is_coordinator():
+            start(master, address=args.api)
+        else:
+            # non-coordinator hosts participate in the SPMD computations
+            # driven by the coordinator's engine; they idle here
+            import time as _time
+            while True:
+                _time.sleep(3600)
         return 0
 
     if args.model_type.value == "image":

@@ -14,3 +14,6 @@ programs over a `jax.sharding.Mesh`:
 
 from cake_tpu.parallel.mesh import make_mesh  # noqa: F401
 from cake_tpu.parallel.plan import ParallelPlan  # noqa: F401
+from cake_tpu.parallel.distributed import (  # noqa: F401
+    cluster_info, initialize, is_coordinator, make_multihost_mesh,
+)

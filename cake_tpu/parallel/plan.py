@@ -59,9 +59,13 @@ class ParallelPlan:
             )
         return cls(dp=dp, stages=stages, tp=tp, stage_layout=layout)
 
-    def build_mesh(self, devices=None):
-        return make_mesh(dp=self.dp, stage=self.stages, tp=self.tp,
-                         devices=devices)
+    def build_mesh(self, devices=None, dcn_axis: str = "dp"):
+        """Build the mesh; on multi-slice topologies the `dcn_axis` is laid
+        out so only that axis crosses the inter-slice (DCN) boundary."""
+        from cake_tpu.parallel.distributed import make_multihost_mesh
+        return make_multihost_mesh(dp=self.dp, stage=self.stages,
+                                   tp=self.tp, dcn_axis=dcn_axis,
+                                   devices=devices)
 
     def describe(self) -> str:
         lines = [f"mesh: dp={self.dp} x stage={self.stages} x tp={self.tp}"]
