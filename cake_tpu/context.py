@@ -51,11 +51,11 @@ class Context:
         if args.model_type.value == "text" and args.model:
             import dataclasses
 
-            from cake_tpu.models.llama.config import LlamaConfig
+            from cake_tpu.models.llama.config import load_config
             cfg_path = os.path.join(args.model, "config.json")
             if os.path.exists(cfg_path):
                 llama_config = dataclasses.replace(
-                    LlamaConfig.from_path(args.model),
+                    load_config(args.model),
                     use_flash_attention=_resolve_flash(args),
                 )
 
@@ -73,7 +73,6 @@ class Context:
         from cake_tpu.models.llama.generator import (
             ByteTokenizer, LlamaGenerator, load_tokenizer,
         )
-        from cake_tpu.models.llama.params import load_params_from_hf
         from cake_tpu.ops.sampling import SamplingConfig
 
         import dataclasses
@@ -87,16 +86,8 @@ class Context:
         else:
             tokenizer = ByteTokenizer(cfg.vocab_size)
 
-        if a.model and os.path.exists(
-            os.path.join(a.model, "model.safetensors")
-        ) or a.model and os.path.exists(
-            os.path.join(a.model, "model.safetensors.index.json")
-        ):
-            params = load_params_from_hf(a.model, cfg, dtype=self.dtype)
-        else:
-            from cake_tpu.models.llama.params import init_params
-            log.warning("no weights at %r; using random init", a.model)
-            params = init_params(cfg, jax.random.PRNGKey(0), dtype=self.dtype)
+        from cake_tpu.models import load_text_params
+        params = load_text_params(cfg, a.model, self.dtype)
 
         sampling = SamplingConfig(
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,

@@ -40,3 +40,37 @@ class ImageGenerator(Protocol):
 
 
 from cake_tpu.models.chat import Message, MessageRole, History  # noqa: E402,F401
+
+
+def load_text_params(config, model_dir: Optional[str], dtype, rng=None):
+    """Parameter pytree for any text-model family, keyed by the config.
+
+    HF safetensors when present under model_dir, else random init (with a
+    warning). Family dispatch (dense Llama vs MoE) lives here, next to
+    load_config's model_type dispatch, so app layers never branch on it.
+    """
+    import logging
+    import os
+
+    import jax
+
+    is_moe = bool(getattr(config, "num_local_experts", 0))
+    has_weights = model_dir and (
+        os.path.exists(os.path.join(model_dir, "model.safetensors"))
+        or os.path.exists(
+            os.path.join(model_dir, "model.safetensors.index.json"))
+    )
+    if is_moe:
+        from cake_tpu.models.moe.params import (
+            init_params, load_params_from_hf,
+        )
+    else:
+        from cake_tpu.models.llama.params import (
+            init_params, load_params_from_hf,
+        )
+    if has_weights:
+        return load_params_from_hf(model_dir, config, dtype=dtype)
+    logging.getLogger(__name__).warning(
+        "no weights at %r; using random init", model_dir)
+    return init_params(config, rng if rng is not None
+                       else jax.random.PRNGKey(0), dtype=dtype)

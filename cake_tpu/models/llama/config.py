@@ -16,6 +16,18 @@ from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 
+def load_config(model_dir: str) -> "LlamaConfig":
+    """Load `<model_dir>/config.json`, dispatching on `model_type`:
+    "mixtral" -> MoEConfig (sparse experts), anything else -> LlamaConfig.
+    The single entry point every config.json consumer should use."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    if raw.get("model_type") == "mixtral":
+        from cake_tpu.models.moe import MoEConfig
+        return MoEConfig.from_hf_dict(raw)
+    return LlamaConfig.from_hf_dict(raw)
+
+
 @dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
@@ -41,10 +53,16 @@ class LlamaConfig:
 
     @classmethod
     def from_path(cls, model_dir: str) -> "LlamaConfig":
-        """Load from `<model_dir>/config.json` (reference config.rs:30-37)."""
+        """Load from `<model_dir>/config.json` (reference config.rs:30-37),
+        dispatching on model_type — a Mixtral checkpoint yields MoEConfig.
+        Called on a subclass, that subclass is guaranteed (so e.g.
+        MoEConfig.from_path on a checkpoint without model_type still reads
+        the expert fields)."""
+        cfg = load_config(model_dir)
+        if isinstance(cfg, cls):
+            return cfg
         with open(os.path.join(model_dir, "config.json")) as f:
-            raw = json.load(f)
-        return cls.from_hf_dict(raw)
+            return cls.from_hf_dict(json.load(f))
 
     @classmethod
     def from_hf_dict(cls, raw: dict) -> "LlamaConfig":
@@ -70,7 +88,6 @@ class LlamaConfig:
             tie_word_embeddings=raw.get("tie_word_embeddings", False),
         )
 
-    # small fixture configs for tests/benches
     @classmethod
     def tiny(cls, **overrides) -> "LlamaConfig":
         base = dict(

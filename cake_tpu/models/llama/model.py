@@ -48,16 +48,23 @@ class RopeTables(NamedTuple):
 
 
 def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
-                   tp_axis: Optional[str] = None):
+                   tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None):
     """Decoder-block math with a pluggable attention:
-    rms → qkv proj → attn_fn(q, k, v) → o_proj → residual → rms → SwiGLU →
+    rms → qkv proj → attn_fn(q, k, v) → o_proj → residual → rms → FFN →
     residual (reference transformer.rs:51-73). attn_fn returns
     (attn [B,S,H,hd], extras) — extras carry e.g. updated caches.
+
+    The FFN is dense SwiGLU (mlp.rs:15-18), or — when the layer params carry
+    a `router` leaf (models/moe) — a sparse mixture-of-experts; every
+    caller (scan, pipeline, ragged decode) works for both since blocks are
+    just pytrees.
 
     tp_axis: when running *manually* tensor-parallel under shard_map, the
     mesh axis name to psum partial row-parallel outputs over (Megatron: o_proj
     and down_proj each produce partial sums). Head counts are derived from
     the weight shapes, so the same code runs on full or head-sharded weights.
+    ep_axis: shard_map expert-parallel axis for the MoE path (ops/moe.py).
     """
     B, S, D = x.shape
     hd = config.head_dim
@@ -75,8 +82,15 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"])
-    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    if "router" in lp:
+        from cake_tpu.ops.moe import moe_mlp
+        # AttributeError here means MoE params were paired with a dense
+        # LlamaConfig — a real mismatch that must not default silently.
+        mlp_out = moe_mlp(h=h, lp=lp, ep_axis=ep_axis,
+                          num_experts_per_tok=config.num_experts_per_tok)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"])
+        mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
     if tp_axis is not None:
         mlp_out = lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
@@ -85,6 +99,7 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
 
 def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
                   config: LlamaConfig, tp_axis: Optional[str] = None,
+                  ep_axis: Optional[str] = None,
                   is_prefill: bool = False):
     """One decoder block with KV-cache update.
 
@@ -112,13 +127,14 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
         return attn, (kc, vc)
 
     x, (k_cache, v_cache) = block_skeleton(lp, x, config, attn_fn,
-                                           tp_axis=tp_axis)
+                                           tp_axis=tp_axis, ep_axis=ep_axis)
     return x, k_cache, v_cache
 
 
 def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
                config: LlamaConfig,
                tp_axis: Optional[str] = None,
+               ep_axis: Optional[str] = None,
                is_prefill: bool = False) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks [L, ...] over the hidden state.
 
@@ -130,7 +146,7 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
     def body(h, xs):
         lp, kc, vc = xs
         h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
-                                  config, tp_axis=tp_axis,
+                                  config, tp_axis=tp_axis, ep_axis=ep_axis,
                                   is_prefill=is_prefill)
         return h, (kc, vc)
 

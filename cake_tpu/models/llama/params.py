@@ -161,6 +161,49 @@ def _np_dtype(jdtype):
 
 # -- sharding ---------------------------------------------------------------
 
+def block_param_keys(config=None, *, moe: Optional[bool] = None) -> tuple:
+    """Stacked-block leaf names for a config's family (dense vs MoE)."""
+    if moe is None:
+        moe = bool(getattr(config, "num_local_experts", 0))
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
+    keys += (["router", "we_gate", "we_up", "we_down"] if moe
+             else ["w_gate", "w_up", "w_down"])
+    return tuple(keys)
+
+
+def block_specs(keys, stage_axis: Optional[str] = None,
+                tp_axis: Optional[str] = None,
+                ep_axis: Optional[str] = None):
+    """PartitionSpecs for a set of stacked-block leaves, dense or MoE.
+
+    Derives the spec dict from the actual pytree keys so every consumer
+    (pipeline shard_map in_specs, placement, fits-in-HBM checks) handles
+    both families without hardcoding a leaf list.
+    """
+    S, T, E = stage_axis, tp_axis, ep_axis
+    table = {
+        "attn_norm": P(S, None),
+        "wq": P(S, None, T),
+        "wk": P(S, None, T),
+        "wv": P(S, None, T),
+        "wo": P(S, T, None),
+        "mlp_norm": P(S, None),
+        "w_gate": P(S, None, T),
+        "w_up": P(S, None, T),
+        "w_down": P(S, T, None),
+        # MoE leaves (models/moe): router replicated, experts over ep,
+        # ffn dim over tp
+        "router": P(S, None, None),
+        "we_gate": P(S, E, None, T),
+        "we_up": P(S, E, None, T),
+        "we_down": P(S, E, T, None),
+    }
+    unknown = set(keys) - set(table)
+    if unknown:
+        raise KeyError(f"no PartitionSpec rule for block leaves {unknown}")
+    return {k: table[k] for k in keys}
+
+
 def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None):
     """PartitionSpec pytree for Megatron-style tensor parallelism.
 
@@ -171,20 +214,10 @@ def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None):
     is NOT done this way — see parallel/pipeline.py — but a stage axis on
     the layer dim gives cheap weight-memory sharding for fits-in-HBM checks).
     """
-    S = stage_axis
     return {
         "embed": P(tp_axis, None),
-        "blocks": {
-            "attn_norm": P(S, None),
-            "wq": P(S, None, tp_axis),
-            "wk": P(S, None, tp_axis),
-            "wv": P(S, None, tp_axis),
-            "wo": P(S, tp_axis, None),
-            "mlp_norm": P(S, None),
-            "w_gate": P(S, None, tp_axis),
-            "w_up": P(S, None, tp_axis),
-            "w_down": P(S, tp_axis, None),
-        },
+        "blocks": block_specs(block_param_keys(moe=False),
+                              stage_axis=stage_axis, tp_axis=tp_axis),
         "final_norm": P(None),
         "lm_head": P(None, tp_axis),
     }

@@ -1,0 +1,15 @@
+"""Sparse mixture-of-experts (Mixtral-style) model family.
+
+Capability extension beyond the reference, which is dense-only
+(`mlp.rs:7-11`; SURVEY.md §2.6 records expert parallelism as absent). The
+family reuses the whole Llama stack — attention, KV cache, RoPE,
+generator, serving engine, pipeline — because a block is just a pytree of
+leaves: MoE blocks carry `router`/`we_*` leaves and `block_skeleton`
+dispatches on their presence (models/llama/model.py). Expert math lives in
+ops/moe.py; EP sharding specs in params.py here.
+"""
+
+from cake_tpu.models.moe.config import MoEConfig
+from cake_tpu.models.moe.params import init_params, param_specs
+
+__all__ = ["MoEConfig", "init_params", "param_specs"]

@@ -1,0 +1,79 @@
+"""Sparse mixture-of-experts FFN (Mixtral-style) for decoder blocks.
+
+The reference is dense-only (`mlp.rs:7-11` — SURVEY.md §2.6 lists expert
+parallelism as absent); this is a capability extension. Design is
+TPU-first:
+
+  * Routing is `lax.top_k` over router logits with softmax renormalised
+    over the selected experts (Mixtral semantics), producing a dense
+    [tokens, E] combine matrix — static shapes, no sorting/scatter, so the
+    whole thing jits and scans.
+  * Expert computation is batched einsum over the (possibly EP-sharded)
+    expert axis: every expert runs on every token and the combine matrix
+    zeroes the non-selected ones. For inference-sized token counts this
+    keeps the MXU busy with one big contraction instead of ragged gathers;
+    XLA shards the expert axis when the weights carry an `ep`
+    PartitionSpec.
+  * Under `shard_map` (the manual pipeline path), pass `ep_axis`: each
+    shard holds an [E/ep, ...] slice of the expert weights, computes its
+    local experts against its slice of the combine matrix, and `psum`s the
+    partial outputs over the axis — token dispatch rides ICI as a single
+    reduction instead of an all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def route_top_k(x, router_w, k: int):
+    """Top-k routing combine matrix.
+
+    x:        [N, D] tokens
+    router_w: [D, E] router weights
+    returns   [N, E] float32: softmax weight for each selected expert,
+              zero elsewhere. Softmax is over the top-k logits only
+              (Mixtral renormalisation).
+    """
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [N, E]
+    E = logits.shape[-1]
+    top_vals, top_idx = lax.top_k(logits, k)                       # [N, k]
+    weights = jax.nn.softmax(top_vals, axis=-1)                    # [N, k]
+    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)         # [N, k, E]
+    return jnp.einsum("nk,nke->ne", weights, onehot)
+
+
+def moe_mlp(lp, h, num_experts_per_tok: int,
+            ep_axis: Optional[str] = None):
+    """Sparse SwiGLU FFN over experts.
+
+    lp leaves: router [D, E]; we_gate/we_up [E_local, D, F];
+    we_down [E_local, F, D]. E_local == E except under shard_map EP, where
+    each shard holds its contiguous slice and `ep_axis` names the mesh axis.
+    Returns the *unreduced-over-tp* output: when F is additionally
+    Megatron-sharded the caller (block_skeleton) psums over tp, exactly as
+    for the dense path — EP and TP reductions compose.
+    """
+    B, S, D = h.shape
+    x = h.reshape(B * S, D)
+    combine = route_top_k(x, lp["router"], num_experts_per_tok)    # [N, E]
+
+    e_local = lp["we_gate"].shape[0]
+    if ep_axis is not None:
+        offset = lax.axis_index(ep_axis) * e_local
+        combine = lax.dynamic_slice_in_dim(combine, offset, e_local, axis=1)
+
+    # [N, E_local, F]: all (local) experts on all tokens; combine masks.
+    gate = jnp.einsum("nd,edf->nef", x, lp["we_gate"])
+    up = jnp.einsum("nd,edf->nef", x, lp["we_up"])
+    act = jax.nn.silu(gate) * up
+    per_expert = jnp.einsum("nef,efd->ned", act, lp["we_down"])    # [N, E, D]
+    out = jnp.einsum("ned,ne->nd", per_expert,
+                     combine.astype(per_expert.dtype))
+    if ep_axis is not None:
+        out = lax.psum(out, ep_axis)
+    return out.reshape(B, S, D).astype(h.dtype)

@@ -277,9 +277,8 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
 
     ctx_spec = P(None, None, "sp", None, None)
     rep = P()
-    blocks_spec = {kk: P() for kk in
-                   ("attn_norm", "wq", "wk", "wv", "wo",
-                    "mlp_norm", "w_gate", "w_up", "w_down")}
+    from cake_tpu.models.llama.params import block_param_keys
+    blocks_spec = {kk: P() for kk in block_param_keys(config)}
 
     prefill_sm = jax.shard_map(
         prefill_body, mesh=mesh,

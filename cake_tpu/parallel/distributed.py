@@ -70,7 +70,25 @@ def initialize(coordinator: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    multi_worker = (
+        bool(env.get("MEGASCALE_COORDINATOR_ADDRESS"))
+        or len([h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                if h.strip()]) > 1
+    )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except ValueError:
+        if kwargs or multi_worker:
+            # Explicit config or genuine multi-worker signals must fail
+            # fast — silently downgrading one worker to single-process
+            # would hang its peers in their first collective.
+            raise
+        # Single-worker pod-ish env (e.g. a TPU VM image or tunnel exports
+        # TPU_WORKER_HOSTNAMES with one entry) and auto-detection found no
+        # coordinator: this is a single-process run.
+        log.warning("distributed auto-init found no coordinator; "
+                    "running single-process")
+        return False
     log.info("distributed: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
              jax.local_device_count(), jax.device_count())

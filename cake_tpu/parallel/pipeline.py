@@ -111,24 +111,10 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
       cache:            layer over "stage", batch over "dp", kv-heads "tp"
       embed/lm_head/final_norm: replicated (or vocab-sharded by GSPMD)
     """
+    from cake_tpu.models.llama.params import block_param_keys, block_specs
     tp_axis = "tp" if tp else None
-
-    if tp:
-        blocks_specs = {
-            "attn_norm": P("stage", None),
-            "wq": P("stage", None, "tp"),
-            "wk": P("stage", None, "tp"),
-            "wv": P("stage", None, "tp"),
-            "wo": P("stage", "tp", None),
-            "mlp_norm": P("stage", None),
-            "w_gate": P("stage", None, "tp"),
-            "w_up": P("stage", None, "tp"),
-            "w_down": P("stage", "tp", None),
-        }
-    else:
-        blocks_specs = {kk: P("stage") for kk in
-                        ("attn_norm", "wq", "wk", "wv", "wo",
-                         "mlp_norm", "w_gate", "w_up", "w_down")}
+    blocks_specs = block_specs(block_param_keys(config),
+                               stage_axis="stage", tp_axis=tp_axis)
 
     dp_axis = "dp" if dp else None
     cache_spec = P("stage", dp_axis, None, tp_axis, None)
@@ -184,18 +170,9 @@ def place_for_pipeline(params, cache: KVCache, mesh: Mesh, *,
     def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
+    from cake_tpu.models.llama.params import block_specs
     blocks = params["blocks"]
-    bspec = {
-        "attn_norm": P("stage", None),
-        "wq": P("stage", None, tp_axis),
-        "wk": P("stage", None, tp_axis),
-        "wv": P("stage", None, tp_axis),
-        "wo": P("stage", tp_axis, None),
-        "mlp_norm": P("stage", None),
-        "w_gate": P("stage", None, tp_axis),
-        "w_up": P("stage", None, tp_axis),
-        "w_down": P("stage", tp_axis, None),
-    }
+    bspec = block_specs(blocks.keys(), stage_axis="stage", tp_axis=tp_axis)
     out = {
         "embed": put(params["embed"], P(None, None)),
         "blocks": {kk: put(blocks[kk], bspec[kk]) for kk in blocks},

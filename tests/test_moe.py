@@ -1,0 +1,225 @@
+"""MoE family: routing math, expert-parallel equivalence, generation.
+
+Expert parallelism is tested on the virtual CPU mesh both ways it ships:
+XLA-SPMD (jit + NamedSharding on the expert axis) and manual shard_map
+with psum (the pipeline path), each checked against the unsharded result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.model import RopeTables, decode_step, prefill
+from cake_tpu.models.moe import MoEConfig, init_params, param_specs
+from cake_tpu.ops.moe import moe_mlp, route_top_k
+
+CFG = MoEConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_route_top_k_selects_and_normalises():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(5, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 6)), jnp.float32)
+    combine = np.asarray(route_top_k(x, w, k=2))
+    logits = np.asarray(x) @ np.asarray(w)
+    for n in range(5):
+        nz = np.flatnonzero(combine[n])
+        assert len(nz) == 2
+        assert set(nz) == set(np.argsort(logits[n])[-2:])
+        assert combine[n].sum() == pytest.approx(1.0, abs=1e-6)
+        # heavier weight on the higher logit
+        hi, lo = np.argsort(logits[n])[-1], np.argsort(logits[n])[-2]
+        assert combine[n, hi] >= combine[n, lo]
+
+
+def test_moe_mlp_matches_per_token_loop(params):
+    lp = jax.tree.map(lambda x: x[0], params["blocks"])
+    rng = np.random.default_rng(1)
+    h = jnp.asarray(rng.normal(size=(2, 3, CFG.hidden_size)), jnp.float32)
+    out = np.asarray(moe_mlp(lp, h, CFG.num_experts_per_tok))
+
+    router = np.asarray(lp["router"])
+    wg, wu, wd = (np.asarray(lp[k]) for k in ("we_gate", "we_up", "we_down"))
+    x = np.asarray(h).reshape(-1, CFG.hidden_size)
+    expect = np.zeros_like(x)
+    for n, tok in enumerate(x):
+        logits = tok @ router
+        top = np.argsort(logits)[-CFG.num_experts_per_tok:]
+        w = np.exp(logits[top] - logits[top].max())
+        w /= w.sum()
+        for wi, e in zip(w, top):
+            act = (tok @ wg[e]) / (1 + np.exp(-(tok @ wg[e]))) * (tok @ wu[e])
+            expect[n] += wi * (act @ wd[e])
+    np.testing.assert_allclose(
+        out.reshape(-1, CFG.hidden_size), expect, rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_decode_runs(params):
+    cache = KVCache.create(CFG, 1, 32, dtype=jnp.float32)
+    rope = RopeTables.create(CFG, 32)
+    toks = jnp.ones((1, 8), jnp.int32)
+    logits, cache = prefill(params, toks, jnp.array([8]), cache, rope, CFG)
+    assert logits.shape == (1, CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    logits2, _ = decode_step(params, jnp.ones((1, 1), jnp.int32),
+                             jnp.int32(8), cache, rope, CFG)
+    assert np.isfinite(np.asarray(logits2)).all()
+
+
+def test_ep_sharded_forward_matches_single_device(params):
+    """jit + NamedSharding on the expert axis == unsharded logits."""
+    cache = KVCache.create(CFG, 2, 32, dtype=jnp.float32)
+    rope = RopeTables.create(CFG, 32)
+    toks = jnp.arange(16, dtype=jnp.int32).reshape(2, 8) % CFG.vocab_size
+    plen = jnp.array([8, 8])
+    ref, _ = prefill(params, toks, plen, cache, rope, CFG)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("ep",))
+    specs = param_specs(tp_axis=None, ep_axis="ep")
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    cache_s = jax.device_put(
+        KVCache.create(CFG, 2, 32, dtype=jnp.float32),
+        NamedSharding(mesh, P()))
+    with mesh:
+        got, _ = prefill(sharded, toks, plen, cache_s, rope, CFG)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ep_shard_map_matches_unsharded(params):
+    """Manual shard_map EP (local expert slice + psum) == full moe_mlp."""
+    from jax import shard_map
+
+    lp = jax.tree.map(lambda x: x[0], params["blocks"])
+    rng = np.random.default_rng(2)
+    h = jnp.asarray(rng.normal(size=(1, 4, CFG.hidden_size)), jnp.float32)
+    ref = np.asarray(moe_mlp(lp, h, CFG.num_experts_per_tok))
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("ep",))
+    lp_specs = {k: P() for k in lp}
+    for k in ("we_gate", "we_up", "we_down"):
+        lp_specs[k] = P("ep")
+
+    def f(lp_local, h_local):
+        return moe_mlp(lp_local, h_local, CFG.num_experts_per_tok,
+                       ep_axis="ep")
+
+    got = shard_map(f, mesh=mesh,
+                    in_specs=(lp_specs, P()), out_specs=P())(lp, h)
+    np.testing.assert_allclose(ref, np.asarray(got), rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_with_moe_blocks_matches_single(params):
+    """MoE blocks through the shard_map pipeline == single-device logits."""
+    from cake_tpu.models.llama.model import forward
+    from cake_tpu.parallel.mesh import make_mesh
+    from cake_tpu.parallel.pipeline import (
+        make_pipeline_forward, place_for_pipeline,
+    )
+
+    rope = RopeTables.create(CFG, 32)
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(4, 8) % CFG.vocab_size
+    ref, _ = forward(params, tokens, KVCache.create(CFG, 4, 32,
+                                                    dtype=jnp.float32),
+                     jnp.int32(0), rope, CFG)
+
+    mesh = make_mesh(dp=1, stage=2, tp=1, devices=jax.devices()[:2])
+    pf = make_pipeline_forward(mesh, CFG, num_microbatches=2)
+    p, cache = place_for_pipeline(
+        params, KVCache.create(CFG, 4, 32, dtype=jnp.float32), mesh)
+    logits, _ = pf(p, tokens, cache, jnp.int32(0), rope)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sp_forward_with_moe_blocks_matches_single(params):
+    """MoE blocks through the sequence-parallel ring path == single-chip."""
+    from cake_tpu.parallel.context_parallel import make_sp_forward
+
+    ctx_len, tail_len = 32, 8
+    rope = RopeTables.create(CFG, ctx_len + tail_len)
+    B = 2
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, ctx_len), 0,
+                                CFG.vocab_size)
+    plen = jnp.full((B,), ctx_len, jnp.int32)
+    ref, _ = prefill(
+        params, tokens, plen,
+        KVCache.create(CFG, B, ctx_len + tail_len, dtype=jnp.float32),
+        rope, CFG)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+    sp_prefill, _ = make_sp_forward(mesh, CFG, ctx_len, tail_len)
+    got, _ = sp_prefill(params, tokens, plen, rope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_generator_with_moe_model(params):
+    from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    f32 = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+    gen = LlamaGenerator(
+        CFG, f32, ByteTokenizer(CFG.vocab_size), max_seq_len=256,
+        batch_size=1,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    from cake_tpu.models.chat import Message
+    gen.add_message(Message.user("hi"))
+    toks = [gen.next_token(i) for i in range(4)]
+    assert all(t.id >= 0 for t in toks)
+
+
+def test_load_params_from_hf_mixtral_layout(tmp_path):
+    """Synthetic Mixtral-layout safetensors round-trips into the pytree."""
+    from cake_tpu.models.moe.params import load_params_from_hf
+    from cake_tpu.utils.loading import save_safetensors
+
+    c = MoEConfig.tiny(num_hidden_layers=1, num_local_experts=2)
+    rng = np.random.default_rng(3)
+    D, F, E = c.hidden_size, c.intermediate_size, c.num_local_experts
+    hd, H, KV = c.head_dim, c.num_attention_heads, c.num_key_value_heads
+
+    tensors = {
+        "model.embed_tokens.weight": rng.normal(size=(c.vocab_size, D)),
+        "model.norm.weight": rng.normal(size=(D,)),
+        "lm_head.weight": rng.normal(size=(c.vocab_size, D)),
+    }
+    pre = "model.layers.0"
+    tensors.update({
+        f"{pre}.input_layernorm.weight": rng.normal(size=(D,)),
+        f"{pre}.post_attention_layernorm.weight": rng.normal(size=(D,)),
+        f"{pre}.self_attn.q_proj.weight": rng.normal(size=(H * hd, D)),
+        f"{pre}.self_attn.k_proj.weight": rng.normal(size=(KV * hd, D)),
+        f"{pre}.self_attn.v_proj.weight": rng.normal(size=(KV * hd, D)),
+        f"{pre}.self_attn.o_proj.weight": rng.normal(size=(D, H * hd)),
+        f"{pre}.block_sparse_moe.gate.weight": rng.normal(size=(E, D)),
+    })
+    for e in range(E):
+        base = f"{pre}.block_sparse_moe.experts.{e}"
+        tensors[f"{base}.w1.weight"] = rng.normal(size=(F, D))
+        tensors[f"{base}.w2.weight"] = rng.normal(size=(D, F))
+        tensors[f"{base}.w3.weight"] = rng.normal(size=(F, D))
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+
+    params = load_params_from_hf(str(tmp_path), c, dtype=jnp.float32)
+    assert params["blocks"]["router"].shape == (1, D, E)
+    assert params["blocks"]["we_gate"].shape == (1, E, D, F)
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["we_down"][0, 1]),
+        tensors[f"{pre}.block_sparse_moe.experts.1.w2.weight"].T)
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["router"][0]),
+        tensors[f"{pre}.block_sparse_moe.gate.weight"].T)
