@@ -50,16 +50,12 @@ def load_text_params(config, model_dir: Optional[str], dtype, rng=None):
     load_config's model_type dispatch, so app layers never branch on it.
     """
     import logging
-    import os
 
     import jax
 
-    is_moe = bool(getattr(config, "num_local_experts", 0))
-    has_weights = model_dir and (
-        os.path.exists(os.path.join(model_dir, "model.safetensors"))
-        or os.path.exists(
-            os.path.join(model_dir, "model.safetensors.index.json"))
-    )
+    from cake_tpu.utils.loading import has_weights
+
+    is_moe = config.is_moe
     if is_moe:
         from cake_tpu.models.moe.params import (
             init_params, load_params_from_hf,
@@ -68,7 +64,7 @@ def load_text_params(config, model_dir: Optional[str], dtype, rng=None):
         from cake_tpu.models.llama.params import (
             init_params, load_params_from_hf,
         )
-    if has_weights:
+    if has_weights(model_dir):
         return load_params_from_hf(model_dir, config, dtype=dtype)
     logging.getLogger(__name__).warning(
         "no weights at %r; using random init", model_dir)

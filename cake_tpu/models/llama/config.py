@@ -16,16 +16,24 @@ from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 
-def load_config(model_dir: str) -> "LlamaConfig":
-    """Load `<model_dir>/config.json`, dispatching on `model_type`:
-    "mixtral" -> MoEConfig (sparse experts), anything else -> LlamaConfig.
-    The single entry point every config.json consumer should use."""
+def _read_config(model_dir: str) -> dict:
     with open(os.path.join(model_dir, "config.json")) as f:
-        raw = json.load(f)
+        return json.load(f)
+
+
+def load_config_dict(raw: dict) -> "LlamaConfig":
+    """Dispatch a parsed config.json on `model_type`: "mixtral" ->
+    MoEConfig (sparse experts), anything else -> LlamaConfig."""
     if raw.get("model_type") == "mixtral":
         from cake_tpu.models.moe import MoEConfig
         return MoEConfig.from_hf_dict(raw)
     return LlamaConfig.from_hf_dict(raw)
+
+
+def load_config(model_dir: str) -> "LlamaConfig":
+    """Load `<model_dir>/config.json` with model_type dispatch — the single
+    entry point every config.json consumer should use."""
+    return load_config_dict(_read_config(model_dir))
 
 
 @dataclass(frozen=True)
@@ -51,6 +59,12 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def is_moe(self) -> bool:
+        """Single source of truth for family dispatch (MoEConfig carries
+        num_local_experts; dense configs don't)."""
+        return bool(getattr(self, "num_local_experts", 0))
+
     @classmethod
     def from_path(cls, model_dir: str) -> "LlamaConfig":
         """Load from `<model_dir>/config.json` (reference config.rs:30-37),
@@ -58,11 +72,9 @@ class LlamaConfig:
         Called on a subclass, that subclass is guaranteed (so e.g.
         MoEConfig.from_path on a checkpoint without model_type still reads
         the expert fields)."""
-        cfg = load_config(model_dir)
-        if isinstance(cfg, cls):
-            return cfg
-        with open(os.path.join(model_dir, "config.json")) as f:
-            return cls.from_hf_dict(json.load(f))
+        raw = _read_config(model_dir)
+        cfg = load_config_dict(raw)
+        return cfg if isinstance(cfg, cls) else cls.from_hf_dict(raw)
 
     @classmethod
     def from_hf_dict(cls, raw: dict) -> "LlamaConfig":

@@ -164,7 +164,7 @@ def _np_dtype(jdtype):
 def block_param_keys(config=None, *, moe: Optional[bool] = None) -> tuple:
     """Stacked-block leaf names for a config's family (dense vs MoE)."""
     if moe is None:
-        moe = bool(getattr(config, "num_local_experts", 0))
+        moe = bool(config is not None and config.is_moe)
     keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
     keys += (["router", "we_gate", "we_up", "we_down"] if moe
              else ["w_gate", "w_up", "w_down"])

@@ -1,0 +1,126 @@
+"""Embeddable C-ABI bindings — the TPU analog of `cake-ios`.
+
+The reference exports `start_worker(name, model_path, topology_path,
+model_type)` to Swift apps through uniffi (cake-ios/src/lib.rs:20-87,
+consumed by the iOS worker app, ContentView.swift:50). Here the same
+capability — host a cake node inside a non-Python application — is a
+C-ABI shared library (`csrc/embed.cpp`) that embeds CPython and calls the
+Python entry points in this module:
+
+  cake_tpu_version(out_buf, cap)             -> package version string
+  cake_tpu_generate(model_dir, prompt, n,
+                    out_buf, cap)            -> one-shot text generation
+  cake_tpu_start_worker(name, model_path,
+                        topology_path,
+                        model_type, address) -> blocking serve loop
+                                                (reference signature
+                                                 + bind address)
+
+String-returning calls follow the snprintf convention: 0 on success, a
+positive required-capacity value when the buffer is too small (truncated
+at a UTF-8 boundary), negative on failure (see cake_tpu_last_error).
+
+`build_embed_library()` compiles it on demand with the system g++ and
+`python3-config --embed` flags; any C/C++/Swift host can then dlopen it.
+This module also holds the Python-side implementations the C shims call,
+keeping the C layer to argument marshalling only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import sysconfig
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "csrc")
+_BUILD = os.path.join(_HERE, "_build")
+_SOURCE = "embed.cpp"
+
+
+def build_embed_library() -> str:
+    """Compile libcake_embed (idempotent, hash-keyed). Returns the .so path."""
+    os.makedirs(_BUILD, exist_ok=True)
+    src = os.path.join(_CSRC, _SOURCE)
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD, f"libcake_embed_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    tmp = f"{so_path}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", "-o", tmp, src,
+        f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ver}",
+        "-lpthread", "-ldl",
+    ]
+    log.info("building embed library: %s", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"embed library build failed (is the python dev package "
+            f"installed?):\n{e.stderr}"
+        ) from e
+    os.replace(tmp, so_path)
+    return so_path
+
+
+# -- Python-side implementations called from the C shims ---------------------
+
+def version() -> str:
+    import cake_tpu
+    return cake_tpu.__version__
+
+
+_masters: dict = {}
+
+
+def generate(model_dir: str, prompt: str, sample_len: int = 16) -> str:
+    """One-shot generation for embedded hosts; returns the generated text.
+
+    The Master (weights + compiled programs) is cached per model_dir so
+    repeat calls pay token cost only — the embedded analog of the
+    reference's persistent worker process."""
+    from cake_tpu.args import parse_args
+    from cake_tpu.master import Master
+    from cake_tpu.models.chat import Message
+
+    args, sd_args, _ = parse_args([
+        "--model", model_dir, "--prompt", prompt,
+        "--sample-len", str(sample_len),
+    ])
+    master = _masters.get(model_dir)
+    if master is None:
+        master = _masters[model_dir] = Master.from_args(args, sd_args)
+    else:
+        master.reset()
+    master.add_message(Message.system(args.system_prompt))
+    master.add_message(Message.user(prompt))
+    return master.generate_text(lambda t: None, sample_len=sample_len)
+
+
+def start_worker(name: str, model_path: str, topology_path: str,
+                 model_type: str = "text",
+                 address: str = "127.0.0.1:10128") -> int:
+    """Blocking node loop — signature parity with the reference's uniffi
+    export (cake-ios/src/lib.rs:20-28), plus an explicit bind address (the
+    reference hardcodes 0.0.0.0:10128; embedding hosts must be able to pick
+    the interface/port). On TPU every node runs the same SPMD program, so
+    this serves the API (coordinator) or joins the computation
+    (non-coordinator) until killed."""
+    from cake_tpu.cli import main
+
+    argv = ["--name", name, "--model", model_path,
+            "--model-type", model_type, "--api", address]
+    if topology_path:
+        argv += ["--topology", topology_path]
+    return main(argv)

@@ -26,6 +26,14 @@ import numpy as np
 INDEX_FILE = "model.safetensors.index.json"
 SINGLE_FILE = "model.safetensors"
 
+
+def has_weights(model_dir: Optional[str]) -> bool:
+    """True when model_dir holds weights in a layout load_weights reads."""
+    return bool(model_dir) and (
+        os.path.exists(os.path.join(model_dir, SINGLE_FILE))
+        or os.path.exists(os.path.join(model_dir, INDEX_FILE))
+    )
+
 # safetensors dtype string -> numpy dtype for raw-buffer interpretation.
 # bf16 is viewed through ml_dtypes (ships with jax).
 import ml_dtypes  # noqa: E402

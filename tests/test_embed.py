@@ -1,0 +1,132 @@
+"""C-ABI embed library (cake-ios analog): build, dlopen, drive from C.
+
+Two integration levels:
+  * in-process: ctypes-load the .so inside this interpreter and round-trip
+    version + one-shot generation through the C ABI,
+  * true embedded host: compile a small C main() that links the library,
+    runs in a fresh process with no Python on the stack, and generates
+    text — the reference's "start a node from a Swift app" scenario
+    (cake-ios/src/lib.rs:20-87).
+"""
+
+import ctypes
+import json
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def lib_path():
+    from cake_tpu.native.embed import build_embed_library
+    return build_embed_library()
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tiny_model")
+    cfg = {
+        "model_type": "llama", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "rms_norm_eps": 1e-5, "rope_theta": 10000.0,
+        "max_position_embeddings": 256, "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+    with open(d / "config.json", "w") as f:
+        json.dump(cfg, f)
+    return str(d)
+
+
+def _load(lib_path):
+    lib = ctypes.CDLL(lib_path)
+    for fn in ("cake_tpu_version", "cake_tpu_generate",
+               "cake_tpu_last_error"):
+        getattr(lib, fn).restype = ctypes.c_long
+    return lib
+
+
+def test_version_roundtrip_in_process(lib_path):
+    import cake_tpu
+
+    lib = _load(lib_path)
+    buf = ctypes.create_string_buffer(64)
+    rc = lib.cake_tpu_version(buf, ctypes.c_long(64))
+    assert rc == 0
+    assert buf.value.decode() == cake_tpu.__version__
+
+    # snprintf convention: too-small buffer -> required capacity, not 0
+    small = ctypes.create_string_buffer(3)
+    rc = lib.cake_tpu_version(small, ctypes.c_long(3))
+    assert rc == len(cake_tpu.__version__) + 1
+    assert len(small.value) < 3
+
+
+def test_generate_in_process(lib_path, tiny_model_dir):
+    lib = _load(lib_path)
+    buf = ctypes.create_string_buffer(4096)
+    rc = lib.cake_tpu_generate(
+        tiny_model_dir.encode(), b"hi", ctypes.c_int(3),
+        buf, ctypes.c_long(4096))
+    if rc != 0:
+        err = ctypes.create_string_buffer(1024)
+        lib.cake_tpu_last_error(err, ctypes.c_long(1024))
+        pytest.fail(f"cake_tpu_generate rc={rc}: {err.value.decode()}")
+    # random weights -> arbitrary (possibly empty-after-EOS) text; the
+    # contract is rc==0 and a NUL-terminated utf-8 payload
+    buf.value.decode()
+
+
+C_HOST = r"""
+#include <stdio.h>
+long cake_tpu_version(char *buf, long cap);
+long cake_tpu_generate(const char *model_dir, const char *prompt,
+                       int sample_len, char *buf, long cap);
+long cake_tpu_last_error(char *buf, long cap);
+
+int main(int argc, char **argv) {
+  char ver[64], out[4096], err[1024];
+  if (cake_tpu_version(ver, sizeof ver) != 0) { printf("FAIL version\n"); return 1; }
+  printf("version=%s\n", ver);
+  if (cake_tpu_generate(argv[1], "hello", 2, out, sizeof out) != 0) {
+    cake_tpu_last_error(err, sizeof err);
+    printf("FAIL generate: %s\n", err);
+    return 2;
+  }
+  printf("generated-ok\n");
+  return 0;
+}
+"""
+
+
+def test_c_host_embeds_and_generates(lib_path, tiny_model_dir, tmp_path):
+    """Fresh C process (no Python on the stack) drives generation."""
+    src = tmp_path / "host.c"
+    src.write_text(C_HOST)
+    exe = tmp_path / "host"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", "-o", str(exe), str(src), lib_path,
+         f"-Wl,-rpath,{os.path.dirname(lib_path)}",
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    site = sysconfig.get_path("purelib")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, site] + [p for p in sys.path if p.endswith("site-packages")])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([str(exe), tiny_model_dir], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "version=" in proc.stdout
+    assert "generated-ok" in proc.stdout

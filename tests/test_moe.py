@@ -120,6 +120,17 @@ def test_ep_shard_map_matches_unsharded(params):
     np.testing.assert_allclose(ref, np.asarray(got), rtol=1e-4, atol=1e-4)
 
 
+def test_shard_params_places_moe_pytree(params):
+    """shard_params derives specs from the block leaves (dense or MoE)."""
+    from cake_tpu.parallel.mesh import make_mesh
+    from cake_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(dp=1, stage=1, tp=2, devices=jax.devices()[:2])
+    placed = shard_params(params, mesh)
+    assert placed["blocks"]["we_gate"].shape == \
+        params["blocks"]["we_gate"].shape
+
+
 def test_pipeline_with_moe_blocks_matches_single(params):
     """MoE blocks through the shard_map pipeline == single-device logits."""
     from cake_tpu.models.llama.model import forward
