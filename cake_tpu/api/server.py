@@ -288,19 +288,68 @@ def make_handler(api: ApiServer):
 
 
 def start(master, address: str = "127.0.0.1:10128",
-          model_name: str = "cake-tpu", block: bool = True, engine=None):
+          model_name: str = "cake-tpu", block: bool = True, engine=None,
+          checkpoint_path: str | None = None):
     """Bind and serve (reference api/mod.rs:23-48). When the master holds a
     text model, a continuous-batching engine is built automatically so
-    concurrent chat requests share the decode loop."""
+    concurrent chat requests share the decode loop.
+
+    checkpoint_path: restore any in-flight requests recorded by a previous
+    shutdown, and snapshot unfinished requests on SIGTERM/serve_forever
+    exit (serve/checkpoint.py)."""
     host, port = address.rsplit(":", 1)
     if engine is None and master.llm is not None:
         engine = master.make_engine()
     api = ApiServer(master, model_name, engine=engine)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
-    if block:
-        httpd.serve_forever()
+
+    if checkpoint_path and engine is not None:
+        import os
+
+        from cake_tpu.serve import checkpoint as ckpt
+
+        if os.path.exists(checkpoint_path):
+            handles, _ = ckpt.restore(engine, checkpoint_path, strict=False)
+            log.info("restored %d in-flight request(s) from %s",
+                     len(handles), checkpoint_path)
+
+        done = threading.Event()
+
+        def save_and_exit(*_sig):
+            if done.is_set():
+                return
+            done.set()
+            # order matters: stop the engine FIRST (post-stop submits from
+            # handler threads raise instead of racing the snapshot), then
+            # snapshot, then tear down HTTP. shutdown() must run on a
+            # helper thread — called from the serve_forever thread (the
+            # block=True signal path) it deadlocks.
+            engine.stop()
+            ckpt.save(engine, checkpoint_path)
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+        try:
+            import signal
+
+            signal.signal(signal.SIGTERM, save_and_exit)
+        except ValueError:
+            pass  # not the main thread; caller owns signal handling
     else:
-        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        save_and_exit = None
+
+    def serve():
+        try:
+            httpd.serve_forever()
+        finally:
+            # snapshot on EVERY exit path (SIGINT, external shutdown()),
+            # not just SIGTERM
+            if save_and_exit is not None:
+                save_and_exit()
+
+    if block:
+        serve()
+    else:
+        t = threading.Thread(target=serve, daemon=True)
         t.start()
     return httpd

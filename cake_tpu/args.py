@@ -65,6 +65,10 @@ class Args:
     # profile generation to this directory (jax.profiler; view in
     # TensorBoard or ui.perfetto.dev) — LLM-path analog of --sd-tracing
     tracing: Optional[str] = None
+    # engine checkpoint file: restore in-flight requests on startup, save
+    # on shutdown (serve/checkpoint.py; the reference has no runtime
+    # checkpointing, SURVEY.md §5)
+    checkpoint: Optional[str] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):

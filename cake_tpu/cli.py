@@ -42,7 +42,8 @@ def main(argv=None) -> int:
     if args.api:
         from cake_tpu.api import start
         if is_coordinator():
-            start(master, address=args.api)
+            start(master, address=args.api,
+                  checkpoint_path=args.checkpoint)
         else:
             # non-coordinator hosts participate in the SPMD computations
             # driven by the coordinator's engine; they idle here

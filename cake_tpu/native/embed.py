@@ -33,6 +33,7 @@ import logging
 import os
 import subprocess
 import sysconfig
+import threading
 
 log = logging.getLogger(__name__)
 
@@ -82,6 +83,7 @@ def version() -> str:
 
 
 _masters: dict = {}
+_masters_lock = threading.Lock()
 
 
 def generate(model_dir: str, prompt: str, sample_len: int = 16) -> str:
@@ -89,7 +91,10 @@ def generate(model_dir: str, prompt: str, sample_len: int = 16) -> str:
 
     The Master (weights + compiled programs) is cached per model_dir so
     repeat calls pay token cost only — the embedded analog of the
-    reference's persistent worker process."""
+    reference's persistent worker process. Serialised under a lock: a
+    Master holds mutable chat/decode state, and multithreaded C hosts are
+    an expected caller (jax releases the GIL mid-generation, so two
+    unsynchronised calls would interleave resets)."""
     from cake_tpu.args import parse_args
     from cake_tpu.master import Master
     from cake_tpu.models.chat import Message
@@ -98,14 +103,15 @@ def generate(model_dir: str, prompt: str, sample_len: int = 16) -> str:
         "--model", model_dir, "--prompt", prompt,
         "--sample-len", str(sample_len),
     ])
-    master = _masters.get(model_dir)
-    if master is None:
-        master = _masters[model_dir] = Master.from_args(args, sd_args)
-    else:
-        master.reset()
-    master.add_message(Message.system(args.system_prompt))
-    master.add_message(Message.user(prompt))
-    return master.generate_text(lambda t: None, sample_len=sample_len)
+    with _masters_lock:
+        master = _masters.get(model_dir)
+        if master is None:
+            master = _masters[model_dir] = Master.from_args(args, sd_args)
+        else:
+            master.reset()
+        master.add_message(Message.system(args.system_prompt))
+        master.add_message(Message.user(prompt))
+        return master.generate_text(lambda t: None, sample_len=sample_len)
 
 
 def start_worker(name: str, model_path: str, topology_path: str,

@@ -1,0 +1,246 @@
+"""Failure detection: device probes, heartbeats, progress watchdog.
+
+The reference has **none** (SURVEY.md §5): a worker crash mid-generation
+bubbles an error and kills the request, with no heartbeat, retry, or
+detection. This module provides the three detection layers a long-running
+TPU serving deployment needs:
+
+  * `probe_devices(timeout_s)` — runs a tiny computation on every local
+    device in a watchdog thread; a hung accelerator/tunnel (which blocks
+    forever rather than raising) is reported as wedged instead of hanging
+    the caller.
+  * `HeartbeatMonitor` / `HeartbeatSender` — coordinator-side liveness
+    tracking of worker hosts over plain TCP (JAX's control plane has no
+    user-visible liveness API; a stale heartbeat is the signal to alert or
+    restart before a collective deadlocks on the dead host).
+  * `Watchdog` — generic progress monitor: polls a counter (e.g.
+    `engine.stats.steps`) and fires a callback when it stops advancing.
+
+All components are dependency-free and run in daemon threads; tests drive
+them on localhost/CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+# -- device probe ------------------------------------------------------------
+
+@dataclass
+class DeviceProbe:
+    device: str
+    ok: bool
+    latency_s: float
+    error: Optional[str] = None
+
+
+def probe_devices(timeout_s: float = 30.0, devices=None) -> List[DeviceProbe]:
+    """Health-check local devices with a wall-clock timeout each.
+
+    A tiny computation is dispatched from a worker thread; if it neither
+    completes nor raises within timeout_s the device is reported wedged
+    (ok=False, error='timeout') — unlike a bare jnp op, this never hangs
+    the caller on a dead accelerator or tunnel.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    devices = list(devices) if devices is not None else jax.local_devices()
+    out: List[DeviceProbe] = []
+    for dev in devices:
+        result: Dict = {}
+
+        def work(dev=dev, result=result):
+            try:
+                t0 = time.perf_counter()
+                x = jax.device_put(jnp.arange(8, dtype=jnp.float32), dev)
+                float((x * 2).sum())  # block until the device answers
+                result["latency"] = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — report, don't raise
+                result["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            out.append(DeviceProbe(str(dev), False, timeout_s,
+                                   error="timeout"))
+        elif "error" in result:
+            out.append(DeviceProbe(str(dev), False, 0.0, result["error"]))
+        else:
+            out.append(DeviceProbe(str(dev), True, result["latency"]))
+    return out
+
+
+# -- heartbeats --------------------------------------------------------------
+
+class HeartbeatMonitor:
+    """Coordinator-side liveness tracker.
+
+    Workers connect over TCP and send `name\\n` lines periodically; the
+    monitor records last-seen times. `stale(threshold_s)` lists workers
+    whose heartbeat lapsed; `on_failure`, if set, fires once per worker
+    when it first goes stale (checked by a background sweeper).
+    """
+
+    def __init__(self, address: str = "127.0.0.1:0",
+                 on_failure: Optional[Callable[[str], None]] = None,
+                 stale_after_s: float = 10.0, sweep_interval_s: float = 1.0):
+        host, port = address.rsplit(":", 1)
+        self.last_seen: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._failed: set = set()
+        self._on_failure = on_failure
+        self._stale_after = stale_after_s
+        monitor = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    name = line.decode("utf-8", "replace").strip()
+                    if name:
+                        monitor.beat(name)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, int(port)), Handler)
+        self.address = "%s:%d" % self._server.server_address[:2]
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="cake-heartbeat-server")
+        self._serve_thread.start()
+        self._stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep, args=(sweep_interval_s,), daemon=True,
+            name="cake-heartbeat-sweeper")
+        self._sweeper.start()
+
+    def beat(self, name: str) -> None:
+        with self._lock:
+            self.last_seen[name] = time.monotonic()
+            self._failed.discard(name)
+
+    def stale(self, threshold_s: Optional[float] = None) -> List[str]:
+        thr = threshold_s if threshold_s is not None else self._stale_after
+        now = time.monotonic()
+        with self._lock:
+            return [n for n, t in self.last_seen.items() if now - t > thr]
+
+    def _sweep(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            for name in self.stale():
+                with self._lock:
+                    first = name not in self._failed
+                    self._failed.add(name)
+                if first:
+                    log.warning("heartbeat lost: %s", name)
+                    if self._on_failure is not None:
+                        try:
+                            self._on_failure(name)
+                        except Exception:  # noqa: BLE001
+                            log.exception("on_failure callback failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class HeartbeatSender:
+    """Worker-side pinger: connects to the monitor and sends `name\\n`
+    every interval_s from a daemon thread until close()."""
+
+    def __init__(self, address: str, name: str, interval_s: float = 2.0):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._name = name
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"cake-heartbeat-{name}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        sock = None
+        while not self._stop.is_set():
+            try:
+                if sock is None:
+                    sock = socket.create_connection(self._addr, timeout=5.0)
+                sock.sendall(f"{self._name}\n".encode())
+            except OSError:
+                if sock is not None:
+                    sock.close()
+                    sock = None
+            self._stop.wait(self._interval)
+        if sock is not None:
+            sock.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+# -- progress watchdog -------------------------------------------------------
+
+class Watchdog:
+    """Fires on_stall when a monotonically-advancing counter stops moving.
+
+    counter: zero-arg callable (e.g. `lambda: engine.stats.steps`).
+    Armed only while the counter has advanced at least once since start /
+    the last stall (an idle engine with an empty queue is not a stall:
+    pass `active` to gate, e.g. `lambda: engine.active > 0`).
+    """
+
+    def __init__(self, counter: Callable[[], int], stall_after_s: float,
+                 on_stall: Callable[[], None],
+                 active: Optional[Callable[[], bool]] = None,
+                 poll_interval_s: float = 0.5):
+        self._counter = counter
+        self._active = active or (lambda: True)
+        self._stall_after = stall_after_s
+        self._on_stall = on_stall
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(poll_interval_s,), daemon=True,
+            name="cake-watchdog")
+        self._thread.start()
+
+    def _run(self, poll: float) -> None:
+        last_value = self._counter()
+        last_change = time.monotonic()
+        armed = False  # arm on the first advance: a never-started counter
+        fired = False  # (idle engine) is not a stall
+        while not self._stop.wait(poll):
+            cur = self._counter()
+            now = time.monotonic()
+            if cur != last_value:
+                last_value, last_change, fired = cur, now, False
+                armed = True
+                continue
+            if (armed and not fired and self._active()
+                    and now - last_change > self._stall_after):
+                fired = True
+                log.warning("watchdog: no progress for %.1fs",
+                            now - last_change)
+                try:
+                    self._on_stall()
+                except Exception:  # noqa: BLE001
+                    log.exception("on_stall callback failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

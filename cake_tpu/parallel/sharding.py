@@ -16,7 +16,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from cake_tpu.models.llama.cache import KVCache
-from cake_tpu.models.llama.params import cache_specs
+from cake_tpu.models.llama.params import block_specs, cache_specs
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:
@@ -38,14 +38,12 @@ def shard_params(params, mesh: Mesh, *, tp_axis: str = "tp",
     """Place a text-model param pytree: Megatron TP (+ optional stage on
     layers, + expert axis for MoE families). Specs derive from the actual
     block leaves, so dense and MoE pytrees both place correctly."""
-    from cake_tpu.models.llama.params import block_specs
-    from jax.sharding import PartitionSpec as PS
     specs = {
-        "embed": PS(tp_axis, None),
+        "embed": P(tp_axis, None),
         "blocks": block_specs(params["blocks"].keys(), stage_axis=stage_axis,
                               tp_axis=tp_axis, ep_axis=ep_axis),
-        "final_norm": PS(None),
-        "lm_head": PS(None, tp_axis),
+        "final_norm": P(None),
+        "lm_head": P(None, tp_axis),
     }
     return tree_shard(params, mesh, specs)
 

@@ -1,0 +1,132 @@
+"""Runtime checkpoint/resume for the serving engine.
+
+The reference has **no** runtime checkpointing (SURVEY.md §5: chat state is
+in-memory, a crashed process loses every in-flight generation). This module
+adds it for the continuous-batching engine:
+
+  * `snapshot(engine)` captures every queued / in-flight / finished request
+    as a JSON-serializable record: prompt ids, tokens generated so far,
+    remaining budget, per-request sampling params, plus an engine
+    compatibility fingerprint.
+  * `save(engine, path)` / `load(path)` persist the snapshot.
+  * `resume(engine, snap)` resubmits unfinished requests with
+    prompt = original prompt + tokens generated so far — the KV cache is
+    rebuilt by re-prefilling the transcript, the standard recovery design
+    for serving systems: no device-buffer dump to go stale, works across
+    restarts, topology changes, and host counts.
+
+Determinism: greedy (temperature=0) continuations produce exactly the
+tokens the uninterrupted run would have produced. Stochastic requests
+resume with a fresh RNG key, and the repeat-penalty ring restarts empty at
+the resume boundary (the same state a fresh request with that transcript
+would have).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+SNAPSHOT_VERSION = 1
+
+
+def _fingerprint(engine) -> Dict:
+    c = engine.config
+    return {
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "max_seq_len": engine.max_seq_len,
+    }
+
+
+def snapshot(engine) -> Dict:
+    """Capture engine request state. Call with the engine stopped (or at
+    least quiesced): the engine thread mutates request state per step."""
+    requests: List[Dict] = []
+    for rid, req in sorted(dict(engine._requests).items()):
+        finished = req.done.is_set()
+        requests.append({
+            "rid": rid,
+            "prompt_ids": list(req.prompt_ids),
+            "out_tokens": list(req.out_tokens),
+            "remaining": max(0, req.max_new_tokens - len(req.out_tokens)),
+            "temperature": req.temperature,
+            "top_p": req.top_p,
+            "repeat_penalty": req.repeat_penalty,
+            "finished": finished,
+            "error": str(req.error) if req.error else None,
+        })
+    return {
+        "version": SNAPSHOT_VERSION,
+        "engine": _fingerprint(engine),
+        "requests": requests,
+    }
+
+
+def save(engine, path: str) -> Dict:
+    """Snapshot the engine and write it to `path` (atomic replace)."""
+    snap = snapshot(engine)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, path)
+    log.info("checkpoint: %d request(s) -> %s", len(snap["requests"]), path)
+    return snap
+
+
+def load(path: str) -> Dict:
+    with open(path) as f:
+        snap = json.load(f)
+    if snap.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"unsupported snapshot version {snap.get('version')!r}")
+    return snap
+
+
+def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
+    """Resubmit unfinished snapshot requests into `engine` (started).
+
+    Returns (handles, finished_records): one RequestHandle per resumed
+    request, in snapshot order, plus the records of requests that had
+    already finished (their transcripts survive the restart).
+    strict: fingerprint mismatch raises instead of warning.
+    """
+    fp, want = _fingerprint(engine), snap.get("engine", {})
+    if fp != want:
+        msg = f"snapshot fingerprint {want} != engine {fp}"
+        if strict:
+            raise ValueError(msg)
+        log.warning("%s (resuming anyway)", msg)
+
+    handles, finished = [], []
+    for rec in snap["requests"]:
+        if rec["finished"] or rec["remaining"] <= 0 or rec["error"]:
+            finished.append(rec)
+            continue
+        try:
+            handles.append(engine.submit(
+                rec["prompt_ids"] + rec["out_tokens"],
+                max_new_tokens=rec["remaining"],
+                temperature=rec["temperature"],
+                top_p=rec["top_p"],
+                repeat_penalty=rec["repeat_penalty"],
+            ))
+        except Exception as e:  # noqa: BLE001 — one bad record must not
+            # crash-loop server startup (queue full, shrunk max_seq_len, …)
+            log.warning("resume: dropping request rid=%s: %s",
+                        rec.get("rid"), e)
+            rec = dict(rec, error=f"resume failed: {e}")
+            finished.append(rec)
+    log.info("resume: %d request(s) resubmitted, %d already finished",
+             len(handles), len(finished))
+    return handles, finished
+
+
+def restore(engine, path: str, strict: bool = True) -> Tuple[List, List[Dict]]:
+    """load + resume in one call."""
+    return resume(engine, load(path), strict=strict)

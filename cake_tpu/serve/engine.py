@@ -212,6 +212,10 @@ class InferenceEngine:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; the handle's wait()/text()
         gives the blocking interface."""
+        if self._stop.is_set():
+            # post-stop submits (e.g. an HTTP handler racing shutdown) must
+            # not mutate state under a checkpoint snapshot
+            raise RuntimeError("engine stopped")
         ids = list(prompt_ids)
         if not ids:
             raise ValueError("empty prompt")
