@@ -88,6 +88,12 @@ class Context:
 
         from cake_tpu.models import load_text_params
         params = load_text_params(cfg, a.model, self.dtype)
+        if a.quant == "int8":
+            from cake_tpu.ops.quant import quantize_params
+            # donate: frees each bf16 buffer as its int8 copy materialises,
+            # so an 8B model quantizes without 1.5x peak HBM
+            params = jax.jit(quantize_params, donate_argnums=0)(params)
+            log.info("weights quantized to int8 (weight-only, per-channel)")
 
         sampling = SamplingConfig(
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,

@@ -30,6 +30,7 @@ from cake_tpu.ops.attention import (
 )
 from cake_tpu.ops.flash_attention import flash_attention, flash_supported
 from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.quant import qmatmul
 from cake_tpu.ops.rope import (
     apply_rope, precompute_rope, rope_rows, rope_rows_per_row,
 )
@@ -72,11 +73,11 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
     KV = lp["wk"].shape[-1] // hd
 
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, H, hd)
-    k = (h @ lp["wk"]).reshape(B, S, KV, hd)
-    v = (h @ lp["wv"]).reshape(B, S, KV, hd)
+    q = qmatmul(h, lp["wq"]).reshape(B, S, H, hd)
+    k = qmatmul(h, lp["wk"]).reshape(B, S, KV, hd)
+    v = qmatmul(h, lp["wv"]).reshape(B, S, KV, hd)
     attn, extras = attn_fn(q, k, v)
-    attn_out = attn.reshape(B, S, H * hd) @ lp["wo"]
+    attn_out = qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
     if tp_axis is not None:
         attn_out = lax.psum(attn_out, tp_axis)
     x = x + attn_out
@@ -89,8 +90,8 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
         mlp_out = moe_mlp(h=h, lp=lp, ep_axis=ep_axis,
                           num_experts_per_tok=config.num_experts_per_tok)
     else:
-        gate = jax.nn.silu(h @ lp["w_gate"])
-        mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(qmatmul(h, lp["w_gate"]))
+        mlp_out = qmatmul(gate * qmatmul(h, lp["w_up"]), lp["w_down"])
     if tp_axis is not None:
         mlp_out = lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
@@ -178,7 +179,7 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
         last = jnp.take_along_axis(
             x, last_idx.reshape(B, 1, 1).astype(jnp.int32), axis=1
         )[:, 0]
-    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 
@@ -187,7 +188,7 @@ def forward_logits_all(params, tokens, cache: KVCache, pos,
     """Logits at every position [B, S, V] (training / scoring path)."""
     x, cache = forward(params, tokens, cache, pos, rope, config,
                        return_hidden=True)
-    return (x @ params["lm_head"]).astype(jnp.float32), cache
+    return qmatmul(x, params["lm_head"]).astype(jnp.float32), cache
 
 
 # -- jitted entry points -----------------------------------------------------
@@ -246,7 +247,7 @@ def forward_ragged(params, tokens, cache: KVCache, pos, active,
 
     x, (k_new, v_new) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
-    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    logits = qmatmul(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(k=k_new, v=v_new)
 
 

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cake_tpu.ops.quant import qeinsum
+
 
 def route_top_k(x, router_w, k: int):
     """Top-k routing combine matrix.
@@ -68,10 +70,10 @@ def moe_mlp(lp, h, num_experts_per_tok: int,
         combine = lax.dynamic_slice_in_dim(combine, offset, e_local, axis=1)
 
     # [N, E_local, F]: all (local) experts on all tokens; combine masks.
-    gate = jnp.einsum("nd,edf->nef", x, lp["we_gate"])
-    up = jnp.einsum("nd,edf->nef", x, lp["we_up"])
+    gate = qeinsum("nd,edf->nef", x, lp["we_gate"])
+    up = qeinsum("nd,edf->nef", x, lp["we_up"])
     act = jax.nn.silu(gate) * up
-    per_expert = jnp.einsum("nef,efd->ned", act, lp["we_down"])    # [N, E, D]
+    per_expert = qeinsum("nef,efd->ned", act, lp["we_down"])       # [N, E, D]
     out = jnp.einsum("ned,ne->nd", per_expert,
                      combine.astype(per_expert.dtype))
     if ep_axis is not None:

@@ -1,0 +1,97 @@
+"""Weight-only int8 quantization for decode bandwidth.
+
+Batch-1 decode is HBM-bandwidth-bound: every step streams the full weight
+set once (SURVEY.md §6 / BASELINE.md roofline). Storing linear weights as
+int8 with per-output-channel scales halves that traffic — the dequantize
+happens in registers on the way into the bf16 MXU matmul, so throughput
+approaches 2x the bf16 roofline while activations/accumulation stay bf16
+(weight-only: no activation quantization, accuracy loss is per-channel
+rounding only). The reference has no quantization support at all (f16 is
+its smallest dtype, cake/mod.rs:54-60).
+
+`QTensor` is a pytree (NamedTuple), so quantized params flow through
+`lax.scan` over stacked layers, jit, and donation unchanged; `qmatmul` /
+`qeinsum` dispatch on leaf type so the same model code runs full-precision
+and quantized weights.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Union
+
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weights + per-output-channel scales.
+
+    q:     int8, original weight shape
+    scale: f32, original shape with the contracted (input) dims removed
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+Weight = Union[jnp.ndarray, QTensor]
+
+
+def quantize(w: jnp.ndarray, contract_dims: Sequence[int]) -> QTensor:
+    """Symmetric per-channel int8: scale = max|w| / 127 over contract_dims."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(contract_dims), keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=jnp.squeeze(scale, axis=tuple(contract_dims)))
+
+
+def qmatmul(x: jnp.ndarray, w: Weight) -> jnp.ndarray:
+    """x @ w for a raw array or QTensor ([in, out], contract dim -2)."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def qeinsum(spec: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
+    """einsum(spec, x, w) with QTensor support.
+
+    The QTensor's scale must broadcast against the einsum output's trailing
+    dims (true for the layouts quantize_params produces: contracted dims
+    removed, remaining dims in output order)."""
+    if isinstance(w, QTensor):
+        out = jnp.einsum(spec, x, w.q.astype(x.dtype))
+        return out * w.scale.astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
+# Per-leaf contracted dims for the stacked [L, ...] block layout
+# (models/llama/params.py, models/moe/params.py): matmul weights contract
+# their input dim; expert weights contract D (we_gate/we_up) or F (we_down).
+_BLOCK_CONTRACT = {
+    "wq": (1,), "wk": (1,), "wv": (1,), "wo": (1,),
+    "w_gate": (1,), "w_up": (1,), "w_down": (1,),
+    "we_gate": (2,), "we_up": (2,), "we_down": (2,),
+}
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every linear weight in a text-model pytree to int8.
+
+    Embedding, norms, and the (tiny) MoE router stay full precision; the
+    lm_head and all block matmul weights become QTensors.
+    """
+    out = dict(params)
+    out["blocks"] = {
+        k: (quantize(v, _BLOCK_CONTRACT[k]) if k in _BLOCK_CONTRACT else v)
+        for k, v in params["blocks"].items()
+    }
+    out["lm_head"] = quantize(params["lm_head"], (0,))
+    return out
