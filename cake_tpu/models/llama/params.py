@@ -56,6 +56,57 @@ def init_params(config: LlamaConfig, rng: jax.Array, dtype=jnp.bfloat16):
     return params
 
 
+def init_params_quantized(config: LlamaConfig, rng: jax.Array,
+                          dtype=jnp.bfloat16):
+    """Random int8-quantized params built directly on device.
+
+    Produces the same pytree structure as ``quantize_params(init_params(...))``
+    without ever materialising the full-precision tree — a bf16 8B tree is
+    ~15 GiB, i.e. most of a v5e's HBM, so the quantize-after-init path is
+    dead on arrival there. Benchmarks are weight-value independent
+    (bench.py), so random int8 + constant scales are as good as quantized
+    real weights.
+    """
+    from cake_tpu.ops.quant import _BLOCK_CONTRACT, QTensor
+
+    c = config
+    L, D, F = c.num_hidden_layers, c.hidden_size, c.intermediate_size
+    H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    keys = jax.random.split(rng, 12)
+    kit = iter(keys)
+
+    def qleaf(shape, contract_dims, fan_in):
+        q = jax.random.randint(next(kit), shape, -127, 128, dtype=jnp.int8)
+        scale_shape = tuple(s for i, s in enumerate(shape)
+                            if i not in contract_dims)
+        # scale chosen so dequantized weights have the init std ~1/sqrt(fan_in)
+        scale = jnp.full(scale_shape, 1.0 / (127.0 * np.sqrt(fan_in)),
+                         jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(kit), shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dtype)
+
+    blocks = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "wq": qleaf((L, D, H * hd), _BLOCK_CONTRACT["wq"], D),
+        "wk": qleaf((L, D, KV * hd), _BLOCK_CONTRACT["wk"], D),
+        "wv": qleaf((L, D, KV * hd), _BLOCK_CONTRACT["wv"], D),
+        "wo": qleaf((L, H * hd, D), _BLOCK_CONTRACT["wo"], H * hd),
+        "mlp_norm": jnp.ones((L, D), dtype),
+        "w_gate": qleaf((L, D, F), _BLOCK_CONTRACT["w_gate"], D),
+        "w_up": qleaf((L, D, F), _BLOCK_CONTRACT["w_up"], D),
+        "w_down": qleaf((L, F, D), _BLOCK_CONTRACT["w_down"], F),
+    }
+    return {
+        "embed": w((c.vocab_size, D), D),
+        "blocks": blocks,
+        "final_norm": jnp.ones((D,), dtype),
+        "lm_head": qleaf((D, c.vocab_size), (0,), D),
+    }
+
+
 # -- HF name mapping ---------------------------------------------------------
 
 def hf_param_layout(config: LlamaConfig):

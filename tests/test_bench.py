@@ -1,0 +1,38 @@
+"""Bench plumbing smoke tests (CPU-runnable tiers).
+
+The real tiers need a TPU; these validate the subprocess orchestration,
+tier-mode entry, direct-int8 init, and the JSON contract the driver parses
+({"metric", "value", "unit", "vs_baseline"}).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _run_tier(name: str) -> dict:
+    env = dict(os.environ, CAKE_BENCH_TIER=name, JAX_PLATFORMS="cpu")
+    # skip the axon TPU-claim hook: these are CPU smoke runs
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
+    return json.loads(line)
+
+
+@pytest.mark.parametrize("tier", ["tiny", "tiny_int8"])
+def test_smoke_tier_json_contract(tier):
+    result = _run_tier(tier)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in result
+    assert result["value"] > 0
+    assert result["unit"] == "tokens/s"
+    assert tier in result["metric"]
