@@ -59,6 +59,8 @@ class Args:
     tp: int = 1                         # tensor-parallel degree
     dp: int = 1                         # data-parallel degree
     sp: int = 1                         # sequence/context-parallel degree
+    microbatches: int = 1               # GPipe microbatches per pipeline step
+                                        # (1 = reference depth-1 behavior)
     # Pallas flash attention for LLM prefill; None = auto (on when the
     # backend is a real TPU, off on CPU where interpret mode is slow)
     flash_attention: Optional[bool] = None
@@ -80,6 +82,10 @@ class Args:
             raise ValueError(f"unsupported quant '{self.quant}'")
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
+        for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
+                     "max_slots"):
+            if getattr(self, knob) < 1:
+                raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self
 
 

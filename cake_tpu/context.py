@@ -68,7 +68,11 @@ class Context:
     # -- model loading -------------------------------------------------------
 
     def load_text_model(self):
-        """Build a LlamaGenerator, sharded per topology when one is given."""
+        """Build a LlamaGenerator; with a multi-stage topology (or tp/dp > 1)
+        the params/cache are placed on a ("dp","stage","tp") mesh per the
+        ParallelPlan and the generator drives the pipelined forward — the
+        reference's topology-driven serving (topology.rs:43-91 feeding
+        llama.rs:203-220), as one SPMD program instead of TCP hops."""
         from cake_tpu.models.llama.config import LlamaConfig
         from cake_tpu.models.llama.generator import (
             ByteTokenizer, LlamaGenerator, load_tokenizer,
@@ -99,11 +103,49 @@ class Context:
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,
             repeat_penalty=a.repeat_penalty, repeat_last_n=a.repeat_last_n,
         )
+        max_seq = min(a.max_seq_len, cfg.max_position_embeddings)
+
+        from cake_tpu.parallel.plan import ParallelPlan
+        plan = ParallelPlan.from_topology(cfg, self.topology, args=a)
+        kwargs = {}
+        if plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
+            from cake_tpu.parallel.pipeline import (
+                make_pipeline_forward, place_for_pipeline,
+            )
+            if a.batch_size % plan.dp != 0:
+                raise ValueError(
+                    f"--batch-size {a.batch_size} must be divisible by "
+                    f"--dp {plan.dp}")
+            if (a.batch_size // plan.dp) % a.microbatches != 0:
+                raise ValueError(
+                    f"per-replica batch {a.batch_size // plan.dp} must be "
+                    f"divisible by --microbatches {a.microbatches} "
+                    "(GPipe slices the batch into microbatches)")
+            mesh = plan.build_mesh()
+            tp, dp = plan.tp > 1, plan.dp > 1
+            from cake_tpu.parallel.sharding import create_sharded_cache
+            cache = create_sharded_cache(
+                cfg, a.batch_size, max_seq, mesh,
+                tp_axis="tp" if tp else None,
+                dp_axis="dp" if dp else None,
+                stage_axis="stage", dtype=self.dtype,
+            )
+            params, cache = place_for_pipeline(params, cache, mesh,
+                                               tp=tp, dp=dp)
+            fwd = make_pipeline_forward(
+                mesh, cfg,
+                num_microbatches=a.microbatches,
+                tp=tp, dp=dp, params=params,
+            )
+            kwargs = dict(forward_fn=fwd, cache=cache,
+                          parallel=(plan, mesh))
+            log.info("topology-sharded serving:\n%s", plan.describe())
+
         gen = LlamaGenerator(
             cfg, params, tokenizer,
-            max_seq_len=min(a.max_seq_len, cfg.max_position_embeddings),
+            max_seq_len=max_seq,
             batch_size=a.batch_size, sampling=sampling, seed=a.seed,
-            cache_dtype=self.dtype,
+            cache_dtype=self.dtype, **kwargs,
         )
         from cake_tpu.utils.profiling import log_memory
         log_memory("model loaded")  # reference llama.rs:233-236

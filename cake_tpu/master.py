@@ -56,12 +56,38 @@ class Master:
             raise RuntimeError("no text generator loaded")
         from cake_tpu.serve import InferenceEngine
         g = self.llm
+        slots = max_slots or getattr(self.args, "max_slots", 8)
+        kwargs = {}
+        if getattr(g, "parallel", None) is not None:
+            # topology-sharded model: the engine's steps run the same
+            # pipelined SPMD program, with its batched cache placed to match
+            from cake_tpu.parallel.pipeline import make_engine_step_fns
+            from cake_tpu.parallel.sharding import create_sharded_cache
+            plan, mesh = g.parallel
+            tp = plan.tp > 1
+            microbatches = self.args.microbatches
+            if slots % microbatches != 0:
+                raise ValueError(
+                    f"--max-slots {slots} must be divisible by "
+                    f"--microbatches {microbatches}")
+            cache = create_sharded_cache(
+                g.config, slots, g.max_seq_len, mesh,
+                tp_axis="tp" if tp else None, dp_axis=None,
+                stage_axis="stage", dtype=g.cache.k.dtype,
+            )
+            kwargs = dict(
+                step_fns=make_engine_step_fns(
+                    mesh, g.config, num_microbatches=microbatches,
+                    tp=tp, params=g.params),
+                cache=cache,
+            )
         return InferenceEngine(
             g.config, g.params, g.tokenizer,
-            max_slots=max_slots or getattr(self.args, "max_slots", 8),
+            max_slots=slots,
             max_seq_len=g.max_seq_len,
             sampling=g.sampling,
             seed=self.args.seed,
+            **kwargs,
         )
 
     # -- text ----------------------------------------------------------------

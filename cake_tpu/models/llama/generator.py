@@ -115,6 +115,9 @@ class LlamaGenerator:
         sampling: Optional[SamplingConfig] = None,
         seed: int = 299792458,
         cache_dtype=jnp.bfloat16,
+        forward_fn=None,
+        cache: Optional[KVCache] = None,
+        parallel=None,
     ):
         self.config = config
         self.params = params
@@ -123,8 +126,17 @@ class LlamaGenerator:
         self.batch_size = batch_size
         self.sampling = sampling or SamplingConfig()
         self.rope = RopeTables.create(config, max_seq_len)
-        self.cache = KVCache.create(config, batch_size, max_seq_len,
-                                    dtype=cache_dtype)
+        # forward_fn: optional replacement for the single-device jitted
+        # steps — e.g. parallel.pipeline.make_pipeline_forward's output when
+        # a topology shards the model. Signature:
+        #   forward_fn(params, tokens, cache, pos, rope,
+        #              last_idx=None, is_prefill=False) -> (logits, cache)
+        self._forward_fn = forward_fn
+        # parallel: opaque (plan, mesh) context carried for consumers that
+        # need to build matching-sharded state (Master.make_engine).
+        self.parallel = parallel
+        self.cache = cache if cache is not None else KVCache.create(
+            config, batch_size, max_seq_len, dtype=cache_dtype)
         self.history = History()
         self.rng = jax.random.PRNGKey(seed)
         self._reset_session()
@@ -170,10 +182,16 @@ class LlamaGenerator:
             logits = self._prefill_prompt()
         else:
             tok = jnp.full((self.batch_size, 1), self.tokens[-1], jnp.int32)
-            logits, self.cache = decode_step(
-                self.params, tok, jnp.int32(self.index_pos), self.cache,
-                self.rope, self.config,
-            )
+            if self._forward_fn is None:
+                logits, self.cache = decode_step(
+                    self.params, tok, jnp.int32(self.index_pos), self.cache,
+                    self.rope, self.config,
+                )
+            else:
+                logits, self.cache = self._forward_fn(
+                    self.params, tok, self.cache, jnp.int32(self.index_pos),
+                    self.rope,
+                )
             self.index_pos += 1
 
         self.rng, sub = jax.random.split(self.rng)
@@ -203,9 +221,15 @@ class LlamaGenerator:
         padded = ids + [0] * (bucket - len(ids))
         toks = jnp.asarray([padded] * self.batch_size, dtype=jnp.int32)
         plen = jnp.full((self.batch_size,), len(ids), dtype=jnp.int32)
-        logits, self.cache = prefill(
-            self.params, toks, plen, self.cache, self.rope, self.config
-        )
+        if self._forward_fn is None:
+            logits, self.cache = prefill(
+                self.params, toks, plen, self.cache, self.rope, self.config
+            )
+        else:
+            logits, self.cache = self._forward_fn(
+                self.params, toks, self.cache, jnp.int32(0), self.rope,
+                last_idx=(plen - 1).astype(jnp.int32), is_prefill=True,
+            )
         self.index_pos = len(ids)
         return logits
 
@@ -239,11 +263,44 @@ class LlamaGenerator:
         plen = jnp.asarray(plen_arr)
         cache = self.cache.fresh()
         self.rng, sub = jax.random.split(self.rng)
+        if self._forward_fn is not None:
+            return self._generate_hostloop(toks, plen, cache, sub,
+                                           num_tokens)
         out, _ = _generate_scan(
             self.params, toks, plen, cache, self.rope, self.config,
             self.sampling, sub, num_tokens,
         )
         return np.asarray(out)
+
+    def _generate_hostloop(self, toks, plen, cache, rng,
+                           num_tokens: int) -> np.ndarray:
+        """Host-stepped generation over a custom forward (pipeline path).
+
+        The pipelined forward is already one compiled program per step with
+        a donated cache; stepping it from the host matches the reference's
+        master decode loop (master.rs:96-108) while every step stays a
+        single XLA computation over the whole mesh.
+        """
+        B = toks.shape[0]
+        fwd = self._forward_fn
+        logits, cache = fwd(self.params, toks, cache, jnp.int32(0),
+                            self.rope, last_idx=(plen - 1).astype(jnp.int32),
+                            is_prefill=True)
+        ring = jnp.full((B, self.sampling.repeat_last_n), -1, jnp.int32)
+        outs = []
+        tok = None
+        pos = int(np.max(np.asarray(plen)))
+        for step in range(num_tokens):
+            rng, sub = jax.random.split(rng)
+            tok = sample_tokens(sub, logits, ring, self.sampling)
+            ring = update_ring(ring, tok, step)
+            outs.append(np.asarray(tok))
+            if step + 1 == num_tokens:
+                break
+            logits, cache = fwd(self.params, tok[:, None], cache,
+                                jnp.int32(pos), self.rope)
+            pos += 1
+        return np.stack(outs, axis=1).astype(np.int32)
 
 
 @partial(jax.jit,

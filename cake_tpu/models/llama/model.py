@@ -219,20 +219,19 @@ def decode_step(params, token, pos, cache: KVCache, rope: RopeTables,
 # -- ragged (per-row position) entry points for continuous batching ----------
 
 
-def forward_ragged(params, tokens, cache: KVCache, pos, active,
-                   rope: RopeTables, config: LlamaConfig):
-    """Single-token decode where every batch row sits at its own position.
+def run_blocks_ragged(blocks, x, cache: KVCache, pos, active,
+                      rope_c, rope_s, mask, config: LlamaConfig,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None
+                      ) -> Tuple[jnp.ndarray, KVCache]:
+    """Scan the stacked blocks for per-row-position single-token decode.
 
-    tokens: [B, 1]; pos: [B] absolute positions; active: [B] bool —
-    inactive rows (free slots between requests) compute garbage but leave
-    their cache lines untouched. Returns (logits [B, V] f32, cache).
+    x: [B, 1, D]; pos/active: [B]; rope_c/rope_s: [B, 1, hd/2] per-row
+    rows; mask: [B, 1, T]. Inactive rows compute garbage but leave their
+    cache lines untouched. Shared by the single-device ragged decode and
+    the pipelined engine step (parallel/pipeline.py), where the blocks/cache
+    views are stage-local shards.
     """
-    B = tokens.shape[0]
-    T = cache.max_seq_len
-    x = jnp.take(params["embed"], tokens, axis=0)
-    rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
-    mask = decode_mask_per_row(pos, T)
-
     def body(h, xs):
         lp, kc, vc = xs
 
@@ -242,13 +241,49 @@ def forward_ragged(params, tokens, cache: KVCache, pos, active,
             kc2, vc2 = update_layer_cache_per_row(kc, vc, k, v, pos, active)
             return gqa_attention(q, kc2, vc2, mask=mask), (kc2, vc2)
 
-        h, (kc, vc) = block_skeleton(lp, h, config, attn_fn)
+        h, (kc, vc) = block_skeleton(lp, h, config, attn_fn,
+                                     tp_axis=tp_axis, ep_axis=ep_axis)
         return h, (kc, vc)
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
+    return x, KVCache(k=k_new, v=v_new)
+
+
+def ragged_decode(params, tokens, pos, active, cache: KVCache,
+                  rope: RopeTables, config: LlamaConfig, blocks_runner):
+    """Shared frame for per-row-position single-token decode: embedding →
+    per-row rope rows/masks → blocks_runner → final norm → logits.
+
+    blocks_runner(blocks, x, cache, pos, active, rope_c, rope_s, mask)
+    -> (y, cache) walks the decoder blocks — single-device scan here,
+    shard_mapped pipeline in parallel/pipeline.make_engine_step_fns — so
+    the ragged-decode frame exists exactly once.
+    """
+    T = cache.max_seq_len
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
+    mask = decode_mask_per_row(pos, T)
+    x, cache = blocks_runner(params["blocks"], x, cache, pos, active,
+                             rope_c, rope_s, mask)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     logits = qmatmul(x[:, -1], params["lm_head"]).astype(jnp.float32)
-    return logits, KVCache(k=k_new, v=v_new)
+    return logits, cache
+
+
+def forward_ragged(params, tokens, cache: KVCache, pos, active,
+                   rope: RopeTables, config: LlamaConfig):
+    """Single-token decode where every batch row sits at its own position.
+
+    tokens: [B, 1]; pos: [B] absolute positions; active: [B] bool —
+    inactive rows (free slots between requests) compute garbage but leave
+    their cache lines untouched. Returns (logits [B, V] f32, cache).
+    """
+    def runner(blocks, x, cache, pos, active, rope_c, rope_s, mask):
+        return run_blocks_ragged(blocks, x, cache, pos, active,
+                                 rope_c, rope_s, mask, config)
+
+    return ragged_decode(params, tokens, pos, active, cache, rope, config,
+                         runner)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -258,26 +293,36 @@ def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
     return forward_ragged(params, tokens, cache, pos, active, rope, config)
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill_slot(params, tokens, prompt_len, slot, cache: KVCache,
-                 rope: RopeTables, config: LlamaConfig):
+def slot_prefill(params, tokens, prompt_len, slot, cache: KVCache,
+                 forward_fn):
     """Prefill ONE request into batch slot `slot` of a shared cache.
 
     tokens: [1, S_padded]; prompt_len: [1]; slot: traced scalar. The slot's
-    cache lines are sliced out, prefilled from position 0, and written back —
-    other slots' state is untouched, so requests can be admitted while their
-    neighbors are mid-decode (continuous batching). Compiles once per prefill
-    bucket length.
+    cache lines are sliced out, prefilled from position 0 via
+    forward_fn(params, tokens, sub_cache, pos0, last_idx) -> (logits, sub),
+    and written back — other slots' state is untouched, so requests can be
+    admitted while their neighbors are mid-decode (continuous batching).
+    Shared by the single-device and pipelined engine prefills.
     """
     sub = KVCache(
         k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
         v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
     )
     last_idx = (prompt_len - 1).astype(jnp.int32)
-    logits, sub = forward(params, tokens, sub, jnp.int32(0), rope, config,
-                          last_idx=last_idx, is_prefill=True)
+    logits, sub = forward_fn(params, tokens, sub, jnp.int32(0), last_idx)
     cache = KVCache(
         k=lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
         v=lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
     )
     return logits, cache
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_slot(params, tokens, prompt_len, slot, cache: KVCache,
+                 rope: RopeTables, config: LlamaConfig):
+    """Jitted single-device slot prefill (compiles once per bucket length)."""
+    def fwd(p, t, sub, pos, last_idx):
+        return forward(p, t, sub, pos, rope, config,
+                       last_idx=last_idx, is_prefill=True)
+
+    return slot_prefill(params, tokens, prompt_len, slot, cache, fwd)

@@ -82,6 +82,56 @@ _BLOCK_CONTRACT = {
 }
 
 
+def expand_spec(spec, contract_dims: Sequence[int], ndim: int) -> "QTensor":
+    """(q_spec, scale_spec) for a quantized weight from its logical spec.
+
+    q keeps the full-precision weight's PartitionSpec unchanged (same
+    shape); the scale drops the contracted dims, so its spec keeps only the
+    surviving entries. Sharding a *contracted* dim therefore shards q only:
+    each shard still holds complete input columns for its output channels,
+    so per-channel dequantize stays local — no scale communication.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(spec) + [None] * (ndim - len(spec))
+    scale_entries = [e for i, e in enumerate(entries)
+                     if i not in tuple(contract_dims)]
+    return QTensor(q=P(*entries), scale=P(*scale_entries))
+
+
+def contract_dims_for_path(path) -> Sequence[int]:
+    """Contracted dims of a quantized leaf, keyed by its pytree path.
+
+    Stacked block leaves use _BLOCK_CONTRACT by name; the lm_head contracts
+    its input dim 0 (see quantize_params).
+    """
+    for entry in reversed(tuple(path)):
+        name = getattr(entry, "key", None)
+        if name in _BLOCK_CONTRACT:
+            return _BLOCK_CONTRACT[name]
+        if name == "lm_head":
+            return (0,)
+    raise KeyError(
+        f"no contract-dim rule for quantized leaf at path {path!r}")
+
+
+def expand_specs_for_quant(params, spec_tree):
+    """Return spec_tree with QTensor(q_spec, scale_spec) nodes wherever
+    `params` holds a QTensor, so the two trees match structurally for
+    tree.map / shard_map in_specs / pjit shardings."""
+    import jax
+
+    def f(path, x, s):
+        if isinstance(x, QTensor):
+            return expand_spec(s, contract_dims_for_path(path), x.q.ndim)
+        return s
+
+    return jax.tree_util.tree_map_with_path(
+        f, params, spec_tree,
+        is_leaf=lambda x: x is None or isinstance(x, QTensor),
+    )
+
+
 def quantize_params(params: dict) -> dict:
     """Quantize every linear weight in a text-model pytree to int8.
 

@@ -31,22 +31,24 @@ from jax.sharding import PartitionSpec as P
 
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.models.llama.model import RopeTables, run_blocks
+from cake_tpu.models.llama.model import (
+    RopeTables, run_blocks, run_blocks_ragged,
+)
 from cake_tpu.ops.attention import decode_mask
 from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.quant import expand_specs_for_quant, qmatmul
 from cake_tpu.ops.rope import rope_rows
 
 
-def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
-                         config: LlamaConfig, num_microbatches: int,
-                         tp_axis: Optional[str], is_prefill: bool = False):
-    """Per-device body (runs under shard_map; all views are local shards).
+def _gpipe_stage_loop(k, v, x, run_microbatch, *, num_microbatches: int):
+    """Shared GPipe tick schedule (runs under shard_map, per-device views).
 
-    blocks: [L_local, ...] — this stage's contiguous block range
-    k, v:   [L_local, B_local, T, KV_local, hd]
-    x:      [B_local, S, D] input hidden states (replicated over stage)
-    Returns out [B_local, S, D] (valid on every stage after the final
-    broadcast) and the updated local cache.
+    k, v: [L_local, B, T, KV_local, hd]; x: [B, S, D] (replicated over
+    stage). `run_microbatch(inp, k_mb, v_mb, idx, mb)` runs this stage's
+    blocks on one microbatch and returns (y, k_mb_new, v_mb_new); callers
+    close over whatever per-row state they need and slice it with
+    (idx, mb). Returns (out, k, v) with out valid on every stage after the
+    final broadcast.
     """
     nstages = lax.axis_size("stage")
     sid = lax.axis_index("stage")
@@ -61,7 +63,7 @@ def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
     def tick(t, state):
         buf, out, k, v = state
         my_mb = t - sid                       # microbatch this stage handles
-        active = jnp.logical_and(my_mb >= 0, my_mb < M)
+        live = jnp.logical_and(my_mb >= 0, my_mb < M)  # pipeline bubble?
         idx = jnp.clip(my_mb, 0, M - 1) * mb
 
         fresh = lax.dynamic_slice_in_dim(x, idx, mb, axis=0)
@@ -69,20 +71,17 @@ def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
 
         k_mb = lax.dynamic_slice_in_dim(k, idx, mb, axis=1)
         v_mb = lax.dynamic_slice_in_dim(v, idx, mb, axis=1)
-        y, cache_mb = run_blocks(
-            blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
-            config, tp_axis=tp_axis, is_prefill=is_prefill,
-        )
+        y, k_new, v_new = run_microbatch(inp, k_mb, v_mb, idx, mb)
         # mask side effects when this stage has no live microbatch
-        k_wr = jnp.where(active, cache_mb.k, k_mb)
-        v_wr = jnp.where(active, cache_mb.v, v_mb)
+        k_wr = jnp.where(live, k_new, k_mb)
+        v_wr = jnp.where(live, v_new, v_mb)
         k = lax.dynamic_update_slice_in_dim(k, k_wr, idx, axis=1)
         v = lax.dynamic_update_slice_in_dim(v, v_wr, idx, axis=1)
 
         is_last = sid == nstages - 1
         cur = lax.dynamic_slice_in_dim(out, idx, mb, axis=0)
         out = lax.dynamic_update_slice_in_dim(
-            out, jnp.where(jnp.logical_and(active, is_last), y, cur),
+            out, jnp.where(jnp.logical_and(live, is_last), y, cur),
             idx, axis=0,
         )
         # hand this stage's result to the next stage over ICI
@@ -100,21 +99,53 @@ def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
     return out, k, v
 
 
+def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
+                         config: LlamaConfig, num_microbatches: int,
+                         tp_axis: Optional[str], is_prefill: bool = False):
+    """Per-device body for uniform-position forward (prefill / batch
+    decode): pos, rope rows and mask are shared across the batch.
+    """
+    def run_microbatch(inp, k_mb, v_mb, idx, mb):
+        y, cache_mb = run_blocks(
+            blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
+            config, tp_axis=tp_axis, is_prefill=is_prefill,
+        )
+        return y, cache_mb.k, cache_mb.v
+
+    return _gpipe_stage_loop(k, v, x, run_microbatch,
+                             num_microbatches=num_microbatches)
+
+
+def _blocks_in_specs(config: LlamaConfig, tp_axis, params=None):
+    """shard_map in_specs for the stacked block params; QTensor leaves get
+    their (q, scale) spec pair expanded when an example params tree is
+    given (required for --quant int8 under any topology)."""
+    from cake_tpu.models.llama.params import block_param_keys, block_specs
+    specs = block_specs(block_param_keys(config),
+                        stage_axis="stage", tp_axis=tp_axis)
+    if params is not None:
+        specs = {k: specs[k] for k in params["blocks"]}
+        specs = expand_specs_for_quant({"blocks": params["blocks"]},
+                                       {"blocks": specs})["blocks"]
+    return specs
+
+
 def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
                           num_microbatches: int = 1,
-                          tp: bool = False, dp: bool = False):
+                          tp: bool = False, dp: bool = False,
+                          params=None):
     """Build a jitted pipelined forward(params, tokens, cache, pos, rope,
-    last_idx) -> (logits, cache) for the given mesh.
+    last_idx, is_prefill) -> (logits, cache) for the given mesh.
 
     Sharding contract:
       params["blocks"]: layer axis over "stage" (+ head/ffn over "tp" if tp)
       cache:            layer over "stage", batch over "dp", kv-heads "tp"
       embed/lm_head/final_norm: replicated (or vocab-sharded by GSPMD)
+    params: optional example pytree — pass when weights are int8-quantized
+    so the QTensor leaves get matching in_specs.
     """
-    from cake_tpu.models.llama.params import block_param_keys, block_specs
     tp_axis = "tp" if tp else None
-    blocks_specs = block_specs(block_param_keys(config),
-                               stage_axis="stage", tp_axis=tp_axis)
+    blocks_specs = _blocks_in_specs(config, tp_axis, params)
 
     dp_axis = "dp" if dp else None
     cache_spec = P("stage", dp_axis, None, tp_axis, None)
@@ -134,11 +165,8 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
 
     stage_fns = {False: make_stage_fn(False), True: make_stage_fn(True)}
 
-    @partial(jax.jit, donate_argnames=("cache",),
-             static_argnames=("is_prefill",))
-    def pipeline_forward(params, tokens, cache: KVCache, pos,
-                         rope: RopeTables, last_idx=None,
-                         is_prefill: bool = False):
+    def forward_body(params, tokens, cache: KVCache, pos, rope: RopeTables,
+                     last_idx=None, is_prefill: bool = False):
         B, S = tokens.shape
         T = cache.max_seq_len
         x = jnp.take(params["embed"], tokens, axis=0)
@@ -153,32 +181,122 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
             last = jnp.take_along_axis(
                 y, last_idx.reshape(B, 1, 1).astype(jnp.int32), axis=1
             )[:, 0]
-        logits = (last @ params["lm_head"]).astype(jnp.float32)
+        logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
         return logits, KVCache(k, v)
 
+    jitted = jax.jit(forward_body, donate_argnames=("cache",),
+                     static_argnames=("is_prefill",))
+
+    def pipeline_forward(*args, **kwargs):
+        return jitted(*args, **kwargs)
+
+    pipeline_forward.body = forward_body  # un-jitted, for embedding callers
     return pipeline_forward
+
+
+# -- ragged (continuous-batching) pipeline ------------------------------------
+
+
+def _stage_pipeline_body_ragged(blocks, k, v, x, pos, active,
+                                rope_c, rope_s, mask, *,
+                                config: LlamaConfig, num_microbatches: int,
+                                tp_axis: Optional[str]):
+    """Per-device GPipe body for per-row-position single-token decode:
+    every per-row quantity (pos, active, rope rows, mask) is sliced per
+    microbatch and the stage runs `run_blocks_ragged`. x: [B, 1, D].
+    """
+    def run_microbatch(inp, k_mb, v_mb, idx, mb):
+        sl = partial(lax.dynamic_slice_in_dim, start_index=idx,
+                     slice_size=mb, axis=0)
+        y, cache_mb = run_blocks_ragged(
+            blocks, inp, KVCache(k_mb, v_mb), sl(pos), sl(active),
+            sl(rope_c), sl(rope_s), sl(mask), config, tp_axis=tp_axis,
+        )
+        return y, cache_mb.k, cache_mb.v
+
+    return _gpipe_stage_loop(k, v, x, run_microbatch,
+                             num_microbatches=num_microbatches)
+
+
+def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
+                         num_microbatches: int = 1, tp: bool = False,
+                         params=None):
+    """Pipelined replacements for the engine's two jitted steps.
+
+    Returns (prefill_slot_fn, decode_ragged_fn) with the exact call
+    signatures of model.prefill_slot / model.decode_step_ragged, so
+    serve/engine.py runs continuous batching over a topology-sharded
+    model unchanged. The batch (slot) axis is NOT dp-sharded — slots are
+    admitted one at a time and sliced dynamically, which must stay local.
+    """
+    tp_axis = "tp" if tp else None
+    blocks_specs = _blocks_in_specs(config, tp_axis, params)
+    cache_spec = P("stage", None, None, tp_axis, None)
+    x_spec = P(None, None, None)
+
+    from cake_tpu.models.llama.model import ragged_decode, slot_prefill
+
+    fwd = make_pipeline_forward(mesh, config, num_microbatches=1, tp=tp,
+                                dp=False, params=params)
+    model_config = config
+
+    ragged_stage = jax.shard_map(
+        partial(_stage_pipeline_body_ragged, config=config,
+                num_microbatches=num_microbatches, tp_axis=tp_axis),
+        mesh=mesh,
+        in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
+                  P(), P(), P(), P(), P()),
+        out_specs=(x_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnames=("cache",),
+             static_argnames=("config",))
+    def prefill_slot_fn(params, tokens, prompt_len, slot, cache: KVCache,
+                        rope: RopeTables, config=None):
+        def pipelined(p, t, sub, pos, last_idx):
+            return fwd.body(p, t, sub, pos, rope,
+                            last_idx=last_idx, is_prefill=True)
+
+        return slot_prefill(params, tokens, prompt_len, slot, cache,
+                            pipelined)
+
+    @partial(jax.jit, donate_argnames=("cache",),
+             static_argnames=("config",))
+    def decode_ragged_fn(params, tokens, pos, active, cache: KVCache,
+                         rope: RopeTables, config=None):
+        def runner(blocks, x, cache, pos, active, rope_c, rope_s, mask):
+            y, k, v = ragged_stage(blocks, cache.k, cache.v, x,
+                                   pos, active, rope_c, rope_s, mask)
+            return y, KVCache(k, v)
+
+        return ragged_decode(params, tokens, pos, active, cache, rope,
+                             model_config, runner)
+
+    return prefill_slot_fn, decode_ragged_fn
 
 
 def place_for_pipeline(params, cache: KVCache, mesh: Mesh, *,
                        tp: bool = False, dp: bool = False):
     """device_put params/cache with the shardings make_pipeline_forward
     expects. The stacked layer dim maps contiguous ranges onto stages —
-    exactly the reference's topology.yml block-range assignment."""
+    exactly the reference's topology.yml block-range assignment.
+    QTensor leaves place via their expanded (q, scale) specs."""
+    from cake_tpu.models.llama.params import block_specs
+    from cake_tpu.parallel.sharding import tree_shard
     tp_axis = "tp" if tp else None
     dp_axis = "dp" if dp else None
 
-    def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    from cake_tpu.models.llama.params import block_specs
     blocks = params["blocks"]
-    bspec = block_specs(blocks.keys(), stage_axis="stage", tp_axis=tp_axis)
-    out = {
-        "embed": put(params["embed"], P(None, None)),
-        "blocks": {kk: put(blocks[kk], bspec[kk]) for kk in blocks},
-        "final_norm": put(params["final_norm"], P(None)),
-        "lm_head": put(params["lm_head"], P(None, None)),
+    specs = {
+        "embed": P(None, None),
+        "blocks": block_specs(blocks.keys(), stage_axis="stage",
+                              tp_axis=tp_axis),
+        "final_norm": P(None),
+        "lm_head": P(None, None),
     }
-    cspec = P("stage", dp_axis, None, tp_axis, None)
-    cache = KVCache(k=put(cache.k, cspec), v=put(cache.v, cspec))
+    out = tree_shard(params, mesh, specs)
+    from cake_tpu.parallel.sharding import shard_cache
+    cache = shard_cache(cache, mesh, tp_axis=tp_axis, dp_axis=dp_axis,
+                        stage_axis="stage")
     return out, cache

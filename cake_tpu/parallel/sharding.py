@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.params import block_specs, cache_specs
+from cake_tpu.ops.quant import expand_specs_for_quant
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:
@@ -24,7 +25,12 @@ def named(mesh: Mesh, spec: P) -> NamedSharding:
 
 
 def tree_shard(tree, mesh: Mesh, spec_tree):
-    """device_put every leaf with its PartitionSpec."""
+    """device_put every leaf with its PartitionSpec.
+
+    QTensor leaves (int8 q + reduced-rank scale) first get their spec
+    expanded from the logical weight spec (ops/quant.expand_specs_for_quant),
+    so `--quant int8` composes with every placement path."""
+    spec_tree = expand_specs_for_quant(tree, spec_tree)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         tree, spec_tree,
@@ -61,3 +67,28 @@ def shard_cache(cache: KVCache, mesh: Mesh, *, tp_axis: str = "tp",
 
 def replicate(x, mesh: Mesh):
     return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def create_sharded_cache(config, batch_size: int, max_seq_len: int,
+                         mesh: Mesh, *, tp_axis: Optional[str] = None,
+                         dp_axis: Optional[str] = None,
+                         stage_axis: Optional[str] = "stage",
+                         dtype=None) -> KVCache:
+    """Allocate a KV cache directly in its sharded layout.
+
+    `KVCache.create` + `shard_cache` would first materialise the full zeros
+    buffer on the default device — for 8B-class models that transient can
+    exceed a chip whose budget was sized for the *sharded* slice. jit with
+    out_shardings allocates each shard in place instead.
+    """
+    import jax.numpy as jnp
+    dtype = dtype if dtype is not None else jnp.bfloat16
+    specs = cache_specs(tp_axis=tp_axis, dp_axis=dp_axis,
+                        stage_axis=stage_axis)
+    shardings = KVCache(k=NamedSharding(mesh, specs.k),
+                        v=NamedSharding(mesh, specs.v))
+    make = jax.jit(
+        lambda: KVCache.create(config, batch_size, max_seq_len, dtype=dtype),
+        out_shardings=shardings,
+    )
+    return make()

