@@ -28,8 +28,14 @@ SINGLE_FILE = "model.safetensors"
 
 
 def has_weights(model_dir: Optional[str]) -> bool:
-    """True when model_dir holds weights in a layout load_weights reads."""
-    return bool(model_dir) and (
+    """True when model_dir holds weights in a layout load_weights reads
+    (indexed/single-file directory, or a direct .safetensors path — the
+    shape diffusers per-component checkpoints ship in)."""
+    if not model_dir:
+        return False
+    if os.path.isfile(model_dir) and model_dir.endswith(".safetensors"):
+        return True
+    return (
         os.path.exists(os.path.join(model_dir, SINGLE_FILE))
         or os.path.exists(os.path.join(model_dir, INDEX_FILE))
     )
@@ -60,6 +66,9 @@ def load_weight_index(model_dir: str) -> Dict[str, str]:
     Reads `model.safetensors.index.json` weight_map; falls back to mapping
     every tensor of a single `model.safetensors` (utils/mod.rs:42-82).
     """
+    if os.path.isfile(model_dir):  # direct .safetensors file
+        return {name: os.path.basename(model_dir)
+                for name in _st_tensor_names(model_dir)}
     index_path = os.path.join(model_dir, INDEX_FILE)
     if os.path.exists(index_path):
         with open(index_path) as f:
@@ -142,6 +151,8 @@ def load_weights(
     from cake_tpu.native.safetensors import read_file
 
     weight_map = load_weight_index(model_dir)
+    base_dir = (os.path.dirname(model_dir) if os.path.isfile(model_dir)
+                else model_dir)
     by_file: Dict[str, List[str]] = {}
     for name, fname in weight_map.items():
         if filter_fn is not None and not filter_fn(name):
@@ -152,7 +163,7 @@ def load_weights(
         # native mmap reader (madvise-prefetched zero-copy views) when the
         # C++ library built; numpy memmap otherwise. Views keep their
         # mapping alive through the array base chain in both cases.
-        tensors, _handle = read_file(os.path.join(model_dir, fname), names)
+        tensors, _handle = read_file(os.path.join(base_dir, fname), names)
         for name, arr in tensors.items():
             out[name] = to_device(name, arr) if to_device else arr
     return out

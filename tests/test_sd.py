@@ -195,3 +195,99 @@ def test_simple_tokenizer_deterministic():
     a = t.encode("a rusty robot")
     assert a == t.encode("a rusty robot")
     assert len(a) == 77 and a[0] == 998 and a[-1] == 999
+
+
+# -- UNet / VAE checkpoint round trip -----------------------------------------
+
+def test_unet_safetensors_roundtrip(tiny, tmp_path):
+    """save random-init -> diffusers names -> load -> identical outputs
+    (round-2 verdict gap #4: real-weight loading for every SD component,
+    reference sd/sd.rs:141-302, unet.rs:66-79)."""
+    from cake_tpu.models.sd.params import load_sd_component, save_sd_component
+    from cake_tpu.models.sd.unet import init_unet_params, unet_forward
+
+    p = init_unet_params(tiny.unet, jax.random.PRNGKey(3))
+    f = str(tmp_path / "unet.safetensors")
+    save_sd_component("unet", p, tiny, f)
+    p2 = load_sd_component("unet", f, tiny, jnp.float32)
+
+    lat = jax.random.normal(jax.random.PRNGKey(4), (1, 8, 8, 4))
+    ctx = jax.random.normal(jax.random.PRNGKey(5),
+                            (1, 77, tiny.unet.cross_attention_dim))
+    t = jnp.asarray([7.0])
+    a = unet_forward(p, tiny.unet, lat, t, ctx)
+    b = unet_forward(p2, tiny.unet, lat, t, ctx)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unet_sdxl_roundtrip(tmp_path):
+    """SDXL-shaped UNet (added-cond embedding) maps add_embedding.* too."""
+    from cake_tpu.models.sd.config import SDConfig, UNetConfig
+    from cake_tpu.models.sd.params import load_sd_component, save_sd_component
+    from cake_tpu.models.sd.unet import init_unet_params, unet_forward
+
+    ucfg = UNetConfig(
+        cross_attention_dim=64, block_out_channels=(32, 64),
+        layers_per_block=1, attn_blocks=(True, False),
+        transformer_layers_per_block=(1, 0), attention_head_dim=(4, 4),
+        num_groups=8, addition_embed_dim=32 + 6 * 256)
+    cfg = SDConfig(unet=ucfg)
+    p = init_unet_params(ucfg, jax.random.PRNGKey(6))
+    f = str(tmp_path / "unet_xl.safetensors")
+    save_sd_component("unet", p, cfg, f)
+    p2 = load_sd_component("unet", f, cfg, jnp.float32)
+
+    lat = jax.random.normal(jax.random.PRNGKey(7), (1, 8, 8, 4))
+    ctx = jax.random.normal(jax.random.PRNGKey(8), (1, 77, 64))
+    added = {"text_embeds": jax.random.normal(jax.random.PRNGKey(9), (1, 32)),
+             "time_ids": jnp.ones((1, 6))}
+    a = unet_forward(p, ucfg, lat, jnp.asarray([7.0]), ctx, added_cond=added)
+    b = unet_forward(p2, ucfg, lat, jnp.asarray([7.0]), ctx, added_cond=added)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_vae_safetensors_roundtrip(tiny, tmp_path):
+    from cake_tpu.models.sd.params import load_sd_component, save_sd_component
+    from cake_tpu.models.sd.vae import init_vae_params, vae_decode, vae_encode
+
+    p = init_vae_params(tiny.vae, jax.random.PRNGKey(10))
+    f = str(tmp_path / "vae.safetensors")
+    save_sd_component("vae", p, tiny, f)
+    p2 = load_sd_component("vae", f, tiny, jnp.float32)
+
+    img = jax.random.normal(jax.random.PRNGKey(11), (1, 32, 32, 3))
+    a = vae_encode(p, tiny.vae, img, sample=False)
+    b = vae_encode(p2, tiny.vae, img, sample=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = vae_decode(p, tiny.vae, a)
+    d = vae_decode(p2, tiny.vae, b)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+def test_vae_legacy_attention_names(tiny, tmp_path):
+    """Old diffusers checkpoints name the VAE mid attention
+    query/key/value/proj_attn instead of to_q/.../to_out.0."""
+    from cake_tpu.models.sd.params import (
+        export_sd_component, load_sd_component,
+    )
+    from cake_tpu.models.sd.vae import init_vae_params, vae_decode
+    from cake_tpu.utils.loading import save_safetensors
+
+    p = init_vae_params(tiny.vae, jax.random.PRNGKey(12))
+    tensors = export_sd_component("vae", p, tiny)
+    legacy = {}
+    for name, arr in tensors.items():
+        for new, old in (("to_q", "query"), ("to_k", "key"),
+                         ("to_v", "value"), ("to_out.0", "proj_attn")):
+            marker = f"attentions.0.{new}."
+            if marker in name:
+                name = name.replace(f"{new}.", f"{old}.")
+                break
+        legacy[name] = arr
+    f = str(tmp_path / "vae_legacy.safetensors")
+    save_safetensors(f, legacy)
+    p2 = load_sd_component("vae", f, tiny, jnp.float32)
+    lat = jax.random.normal(jax.random.PRNGKey(13), (1, 16, 16, 4))
+    np.testing.assert_array_equal(
+        np.asarray(vae_decode(p, tiny.vae, lat)),
+        np.asarray(vae_decode(p2, tiny.vae, lat)))
