@@ -310,9 +310,22 @@ def start(master, address: str = "127.0.0.1:10128",
         from cake_tpu.serve import checkpoint as ckpt
 
         if os.path.exists(checkpoint_path):
-            handles, _ = ckpt.restore(engine, checkpoint_path, strict=False)
-            log.info("restored %d in-flight request(s) from %s",
-                     len(handles), checkpoint_path)
+            try:
+                handles, _ = ckpt.restore(engine, checkpoint_path,
+                                          strict=False)
+                log.info("restored %d in-flight request(s) from %s",
+                         len(handles), checkpoint_path)
+            except Exception as e:  # noqa: BLE001
+                # an unreadable/old-version/incompatible snapshot must not
+                # crash-loop server startup; sideline it so the evidence
+                # survives and the next save starts clean
+                bad = f"{checkpoint_path}.invalid"
+                try:
+                    os.replace(checkpoint_path, bad)
+                except OSError:
+                    bad = checkpoint_path
+                log.warning("checkpoint restore failed (%s); moved to %s "
+                            "and starting with an empty engine", e, bad)
 
         done = threading.Event()
 
@@ -332,7 +345,20 @@ def start(master, address: str = "127.0.0.1:10128",
         try:
             import signal
 
-            signal.signal(signal.SIGTERM, save_and_exit)
+            prev_handler = signal.getsignal(signal.SIGTERM)
+
+            def on_sigterm(signum, frame):
+                save_and_exit()
+                # chain whatever handler was installed before us (an
+                # application-level cleanup, jax.distributed teardown, …)
+                # instead of silently clobbering it
+                if callable(prev_handler):
+                    prev_handler(signum, frame)
+                elif prev_handler == signal.SIG_DFL:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    signal.raise_signal(signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, on_sigterm)
         except ValueError:
             pass  # not the main thread; caller owns signal handling
     else:

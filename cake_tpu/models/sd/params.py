@@ -310,8 +310,27 @@ def _walk(tree, path):
     return node
 
 
-def _set(tree, path, value) -> None:
-    _walk(tree, path[:-1])[path[-1]] = value
+def _assign(root, path, value) -> None:
+    """Set tree[path] = value, growing dicts/lists along the way (int path
+    entries create lists, str entries create dicts)."""
+    node = root
+    for p, nxt in zip(path, path[1:]):
+        empty = [] if isinstance(nxt, int) else {}
+        if isinstance(p, int):
+            while len(node) <= p:
+                node.append(None)
+            if node[p] is None:
+                node[p] = empty
+        elif p not in node:
+            node[p] = empty
+        node = node[p]
+    last = path[-1]
+    if isinstance(last, int):
+        while len(node) <= last:
+            node.append(None)
+        node[last] = value
+    else:
+        node[last] = value
 
 
 def _component_entries(component: str, cfg: SDConfig) -> List[Entry]:
@@ -332,17 +351,40 @@ def load_vae_params(model_dir: str, cfg: VAEConfig, dtype=jnp.float32):
 
 
 def _load_tabular(component: str, model_dir: str, cfg: SDConfig, dtype):
+    """Build the param pytree straight from the entry table — no throwaway
+    random init (a real SD1.5 UNet is ~860M params; generating then
+    discarding that would double peak memory for nothing). Structure is
+    validated against the init function under eval_shape (free: traced,
+    never computed), so table drift fails loudly here instead of as a
+    KeyError mid-forward."""
+    from functools import partial
+
     import jax
 
     host = load_weights(model_dir)
+    params: Dict = {}
+    for path, name, kind in _component_entries(component, cfg):
+        _assign(params, path, _from_hf(host, name, kind, dtype))
+    if component == "unet":
+        # attention-free blocks still carry an empty attns list in the
+        # init structure (unet_forward branches on `block["attns"]`)
+        for side in ("down", "up"):
+            for block in params[side]:
+                block.setdefault("attns", [])
+
     if component == "unet":
         from cake_tpu.models.sd.unet import init_unet_params
-        params = init_unet_params(cfg.unet, jax.random.PRNGKey(0), dtype)
+        init = partial(init_unet_params, cfg.unet, jax.random.PRNGKey(0),
+                       dtype)
     else:
         from cake_tpu.models.sd.vae import init_vae_params
-        params = init_vae_params(cfg.vae, jax.random.PRNGKey(0), dtype)
-    for path, name, kind in _component_entries(component, cfg):
-        _set(params, path, _from_hf(host, name, kind, dtype))
+        init = partial(init_vae_params, cfg.vae, jax.random.PRNGKey(0),
+                       dtype)
+    expect = jax.eval_shape(init)
+    if jax.tree.structure(params) != jax.tree.structure(expect):
+        raise ValueError(
+            f"{component} checkpoint mapping does not match the model "
+            f"structure for this config (entry-table drift?)")
     return params
 
 

@@ -94,7 +94,8 @@ class HeartbeatMonitor:
 
     def __init__(self, address: str = "127.0.0.1:0",
                  on_failure: Optional[Callable[[str], None]] = None,
-                 stale_after_s: float = 10.0, sweep_interval_s: float = 1.0):
+                 stale_after_s: float = 10.0, sweep_interval_s: float = 1.0,
+                 expected: Optional[List[str]] = None):
         host, port = address.rsplit(":", 1)
         self.last_seen: Dict[str, float] = {}
         self._lock = threading.Lock()
@@ -128,6 +129,20 @@ class HeartbeatMonitor:
             target=self._sweep, args=(sweep_interval_s,), daemon=True,
             name="cake-heartbeat-sweeper")
         self._sweeper.start()
+
+        if expected:
+            self.expect(*expected)
+
+    def expect(self, *names: str) -> None:
+        """Register workers that MUST beat. Registration starts the stale
+        clock, so a worker that dies before its first heartbeat is reported
+        after stale_after_s instead of staying invisible (a monitor that
+        only tracks seen workers cannot detect a never-started one —
+        precisely the failure the subsystem exists for)."""
+        now = time.monotonic()
+        with self._lock:
+            for name in names:
+                self.last_seen.setdefault(name, now)
 
     def beat(self, name: str) -> None:
         with self._lock:

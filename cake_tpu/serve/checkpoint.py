@@ -16,14 +16,16 @@ adds it for the continuous-batching engine:
     restarts, topology changes, and host counts.
 
 Determinism: greedy (temperature=0) continuations produce exactly the
-tokens the uninterrupted run would have produced. Stochastic requests
-resume with a fresh RNG key, and the repeat-penalty ring restarts empty at
-the resume boundary (the same state a fresh request with that transcript
-would have).
+tokens the uninterrupted run would have produced — including with
+repeat_penalty != 1.0: `resume` passes each request's generated tokens as
+`prime_penalty_tokens`, so the engine reconstructs the penalty ring at the
+resume boundary instead of restarting it empty. Stochastic requests resume
+with a fresh RNG key (their continuation is a different but valid sample).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -31,16 +33,41 @@ from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
-SNAPSHOT_VERSION = 1
+SNAPSHOT_VERSION = 2
+
+
+def _params_digest(params) -> str:
+    """Cheap but weight-sensitive model identity: sha256 over small
+    deterministic samples of the first/last leaves. Shape-only
+    fingerprints would let a snapshot resume into a *different* model
+    with identical dims and replay token ids against the wrong weights."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    leaves = jax.tree.leaves(params)
+    for leaf in leaves[:4] + leaves[-4:]:
+        arr = np.asarray(jax.device_get(leaf)).reshape(-1)[:256]
+        h.update(str(arr.shape).encode())
+        h.update(arr.astype(np.float32, copy=False).tobytes())
+    return h.hexdigest()[:16]
 
 
 def _fingerprint(engine) -> Dict:
+    import dataclasses
     c = engine.config
+    cfg = (dataclasses.asdict(c) if dataclasses.is_dataclass(c)
+           else {"repr": repr(c)})
+    # JSON round-trip normalisation (tuples -> lists) so a saved+loaded
+    # fingerprint compares equal to a freshly computed one
+    cfg = json.loads(json.dumps(cfg))
     return {
-        "vocab_size": c.vocab_size,
-        "hidden_size": c.hidden_size,
-        "num_hidden_layers": c.num_hidden_layers,
+        "config": cfg,
         "max_seq_len": engine.max_seq_len,
+        # ring width shapes penalty reconstruction; a mismatch silently
+        # changes the penalty window, so it is part of compatibility
+        "repeat_last_n": engine.defaults.repeat_last_n,
+        "params": _params_digest(engine.params),
     }
 
 
@@ -54,6 +81,10 @@ def snapshot(engine) -> Dict:
             "rid": rid,
             "prompt_ids": list(req.prompt_ids),
             "out_tokens": list(req.out_tokens),
+            # full generated-token history incl. pre-resume generations, so
+            # a request interrupted twice still reconstructs the penalty
+            # ring over its whole transcript, not just the latest leg
+            "penalty_context": list(req.prime_tokens) + list(req.out_tokens),
             "remaining": max(0, req.max_new_tokens - len(req.out_tokens)),
             "temperature": req.temperature,
             "top_p": req.top_p,
@@ -115,6 +146,8 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
                 temperature=rec["temperature"],
                 top_p=rec["top_p"],
                 repeat_penalty=rec["repeat_penalty"],
+                prime_penalty_tokens=rec.get("penalty_context",
+                                             rec["out_tokens"]),
             ))
         except Exception as e:  # noqa: BLE001 — one bad record must not
             # crash-loop server startup (queue full, shrunk max_seq_len, …)

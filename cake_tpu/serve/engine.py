@@ -61,6 +61,9 @@ class _Request:
     top_p: float
     repeat_penalty: float
     stream: Optional[Callable[[str, bool], None]]
+    # previously-generated tokens whose penalty state must be reconstructed
+    # (checkpoint resume): seeds the slot's repeat-penalty ring
+    prime_tokens: List[int] = field(default_factory=list)
     out_tokens: List[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
@@ -223,6 +226,7 @@ class InferenceEngine:
         top_p: Optional[float] = None,
         repeat_penalty: Optional[float] = None,
         stream: Optional[Callable[[str, bool], None]] = None,
+        prime_penalty_tokens: Optional[Sequence[int]] = None,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; the handle's wait()/text()
@@ -252,6 +256,7 @@ class InferenceEngine:
             repeat_penalty=(d.repeat_penalty if repeat_penalty is None
                             else repeat_penalty),
             stream=stream, submit_t=time.perf_counter(),
+            prime_tokens=list(prime_penalty_tokens or ()),
         )
         # register BEFORE scheduler.submit: the engine thread may plan the
         # rid immediately, and _do_prefill treats an unknown rid as cancelled
@@ -336,6 +341,18 @@ class InferenceEngine:
         self._top_p[slot] = req.top_p
         self._penalty[slot] = req.repeat_penalty
         self._ring = self._ring.at[slot].set(-1)
+        if req.prime_tokens:
+            # checkpoint resume: reconstruct the repeat-penalty ring exactly
+            # as the uninterrupted run would have it — each prior token at
+            # its true step index, and the step counter continuing from
+            # there, so subsequent writes land where they always would.
+            N = self._ring.shape[1]
+            row = np.full(N, -1, np.int32)
+            start = max(0, len(req.prime_tokens) - N)
+            for i, t in enumerate(req.prime_tokens[start:], start=start):
+                row[i % N] = t
+            self._ring = self._ring.at[slot].set(jnp.asarray(row))
+            self._steps[slot] = len(req.prime_tokens)
         # sample the first token with the slot's own key/options
         first = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),

@@ -106,24 +106,22 @@ def test_checkpoint_fingerprint_mismatch_raises(params, tmp_path):
 def test_server_restores_checkpoint_on_start(params, tmp_path):
     """api.start(checkpoint_path=...) resumes a previous shutdown's
     in-flight requests into the fresh engine."""
-    import json
-
     from cake_tpu.api.server import start
     from cake_tpu.args import Args
     from cake_tpu.master import Master
+    from cake_tpu.serve import checkpoint
 
+    # produce a genuine interrupted-run snapshot (v2 fingerprints include a
+    # params digest, so hand-written records can't fake one)
+    eng0 = _engine(params).start()
+    h0 = eng0.submit(PROMPT, max_new_tokens=6)
+    deadline = time.time() + 60
+    while len(h0.token_ids) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    eng0.stop()
+    assert 0 < len(h0.token_ids) < 6
     path = tmp_path / "server.ckpt"
-    path.write_text(json.dumps({
-        "version": 1,
-        "engine": {"vocab_size": CFG.vocab_size,
-                   "hidden_size": CFG.hidden_size,
-                   "num_hidden_layers": CFG.num_hidden_layers,
-                   "max_seq_len": 128},
-        "requests": [{"rid": 7, "prompt_ids": PROMPT, "out_tokens": [3],
-                      "remaining": 3, "temperature": 0.0, "top_p": 1.0,
-                      "repeat_penalty": 1.0, "finished": False,
-                      "error": None}],
-    }))
+    checkpoint.save(eng0, str(path))
 
     engine = _engine(params)
     from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
@@ -203,3 +201,174 @@ def test_watchdog_fires_on_stall_and_rearms():
         assert len(stalls) == 2
     finally:
         wd.close()
+
+
+# -- round-3 regression tests (round-1 advisor findings) ----------------------
+
+def test_checkpoint_fingerprint_detects_different_weights(params, tmp_path):
+    """Shape-only fingerprints let a snapshot resume into any model with
+    identical dims; the digest must reject different weights."""
+    from cake_tpu.serve import checkpoint
+
+    with _engine(params).start() as eng:
+        h = eng.submit(PROMPT, max_new_tokens=4)
+        assert h.wait(60)
+    path = str(tmp_path / "fp.ckpt")
+    checkpoint.save(eng, path)
+
+    other = init_params(CFG, jax.random.PRNGKey(99), dtype=jnp.float32)
+    eng2 = _engine(other).start()
+    try:
+        with pytest.raises(ValueError, match="fingerprint"):
+            checkpoint.restore(eng2, path, strict=True)
+    finally:
+        eng2.stop()
+
+
+def test_resume_primes_repeat_penalty_ring(params, tmp_path):
+    """Greedy + repeat_penalty: interrupted-and-resumed transcript must
+    equal the uninterrupted one (the ring is reconstructed, not emptied)."""
+    from cake_tpu.serve import checkpoint
+
+    sampling = SamplingConfig(temperature=0.0, repeat_penalty=1.3,
+                              repeat_last_n=8)
+
+    def mk():
+        from cake_tpu.models.llama.generator import ByteTokenizer
+        from cake_tpu.serve.engine import InferenceEngine
+        return InferenceEngine(
+            CFG, params, ByteTokenizer(CFG.vocab_size), max_slots=2,
+            max_seq_len=128, sampling=sampling)
+
+    with mk().start() as eng:
+        h = eng.submit(PROMPT, max_new_tokens=N_TOK, repeat_penalty=1.3)
+        assert h.wait(60)
+        want = h.token_ids
+
+    eng1 = mk().start()
+    h1 = eng1.submit(PROMPT, max_new_tokens=N_TOK, repeat_penalty=1.3)
+    deadline = time.time() + 60
+    while len(h1.token_ids) < 5 and time.time() < deadline:
+        time.sleep(0.01)
+    eng1.stop()
+    assert 0 < len(h1.token_ids) < N_TOK
+    path = str(tmp_path / "ring.ckpt")
+    checkpoint.save(eng1, path)
+
+    eng2 = mk().start()
+    try:
+        handles, _ = checkpoint.restore(eng2, path)
+        assert len(handles) == 1
+        assert handles[0].wait(60)
+        got = h1.token_ids + handles[0].token_ids
+        assert got == want, (got, want)
+    finally:
+        eng2.stop()
+
+
+def test_heartbeat_detects_never_started_worker():
+    """A worker registered as expected but never beating must be reported
+    (health.py roster gap: last_seen-only iteration misses it)."""
+    from cake_tpu.parallel.health import HeartbeatMonitor, HeartbeatSender
+
+    failures = []
+    mon = HeartbeatMonitor(on_failure=failures.append,
+                           stale_after_s=0.4, sweep_interval_s=0.1,
+                           expected=["alive", "neverstarted"])
+    try:
+        s = HeartbeatSender(mon.address, "alive", interval_s=0.1)
+        deadline = time.time() + 10
+        while "neverstarted" not in failures and time.time() < deadline:
+            time.sleep(0.05)
+        assert "neverstarted" in failures
+        assert "alive" not in failures
+        s.close()
+    finally:
+        mon.close()
+
+
+def test_sigterm_handler_chains_previous(params, tmp_path, monkeypatch):
+    """start()'s SIGTERM hook must invoke the previously-installed handler
+    instead of clobbering it (api/server.py round-1 finding)."""
+    import signal
+
+    from cake_tpu.api.server import start
+    from cake_tpu.master import Master
+    from cake_tpu.args import Args
+
+    calls = []
+    prev = lambda signum, frame: calls.append("prev")  # noqa: E731
+    old = signal.signal(signal.SIGTERM, prev)
+    try:
+        from cake_tpu.models.llama.generator import (
+            ByteTokenizer, LlamaGenerator,
+        )
+        gen = LlamaGenerator(
+            CFG, params, ByteTokenizer(CFG.vocab_size), max_seq_len=128,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0))
+        master = Master(Args(), text_generator=gen)
+        path = str(tmp_path / "sig.ckpt")
+        httpd = start(master, address="127.0.0.1:0", block=False,
+                      checkpoint_path=path)
+        handler = signal.getsignal(signal.SIGTERM)
+        assert handler is not prev, "hook not installed"
+        handler(signal.SIGTERM, None)  # simulate delivery
+        assert calls == ["prev"], "previous handler was not chained"
+        assert np.asarray([1]).size  # keep np import used
+        httpd.shutdown()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+def test_double_interrupt_preserves_penalty_window(params, tmp_path):
+    """A request interrupted and resumed TWICE still reconstructs the
+    penalty ring over its whole transcript (snapshot records
+    penalty_context = prime + out, not just the latest leg)."""
+    from cake_tpu.serve import checkpoint
+
+    sampling = SamplingConfig(temperature=0.0, repeat_penalty=1.3,
+                              repeat_last_n=8)
+
+    def mk():
+        from cake_tpu.models.llama.generator import ByteTokenizer
+        from cake_tpu.serve.engine import InferenceEngine
+        return InferenceEngine(
+            CFG, params, ByteTokenizer(CFG.vocab_size), max_slots=2,
+            max_seq_len=128, sampling=sampling)
+
+    with mk().start() as eng:
+        h = eng.submit(PROMPT, max_new_tokens=N_TOK, repeat_penalty=1.3)
+        assert h.wait(60)
+        want = h.token_ids
+
+    def interrupt_after(eng, handle, n):
+        deadline = time.time() + 60
+        while len(handle.token_ids) < n and time.time() < deadline:
+            time.sleep(0.01)
+        eng.stop()
+        assert len(handle.token_ids) >= n
+
+    transcript = []
+    eng1 = mk().start()
+    h1 = eng1.submit(PROMPT, max_new_tokens=N_TOK, repeat_penalty=1.3)
+    interrupt_after(eng1, h1, 4)
+    transcript += h1.token_ids
+    p1 = str(tmp_path / "leg1.ckpt")
+    checkpoint.save(eng1, p1)
+
+    eng2 = mk().start()
+    h2s, _ = checkpoint.restore(eng2, p1)
+    interrupt_after(eng2, h2s[0], 2)
+    transcript += h2s[0].token_ids
+    p2 = str(tmp_path / "leg2.ckpt")
+    checkpoint.save(eng2, p2)
+
+    eng3 = mk().start()
+    try:
+        h3s, _ = checkpoint.restore(eng3, p2)
+        if h3s:  # leg 2 may already have finished the budget
+            assert h3s[0].wait(60)
+            transcript += h3s[0].token_ids
+    finally:
+        eng3.stop()
+    assert transcript == want, (transcript, want)
