@@ -311,8 +311,11 @@ def start(master, address: str = "127.0.0.1:10128",
 
         if os.path.exists(checkpoint_path):
             try:
+                # strict: a fingerprint mismatch (e.g. different weights
+                # with identical shapes) must NOT silently replay tokens —
+                # the except below sidelines the snapshot instead
                 handles, _ = ckpt.restore(engine, checkpoint_path,
-                                          strict=False)
+                                          strict=True)
                 log.info("restored %d in-flight request(s) from %s",
                          len(handles), checkpoint_path)
             except Exception as e:  # noqa: BLE001

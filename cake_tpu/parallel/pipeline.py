@@ -276,25 +276,29 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     return prefill_slot_fn, decode_ragged_fn
 
 
-def place_for_pipeline(params, cache: KVCache, mesh: Mesh, *,
-                       tp: bool = False, dp: bool = False):
-    """device_put params/cache with the shardings make_pipeline_forward
-    expects. The stacked layer dim maps contiguous ranges onto stages —
-    exactly the reference's topology.yml block-range assignment.
-    QTensor leaves place via their expanded (q, scale) specs."""
+def pipeline_param_specs(blocks_keys, tp_axis: Optional[str] = None):
+    """The param PartitionSpec tree make_pipeline_forward expects: stacked
+    layer dim over "stage" (the reference's topology.yml block-range
+    assignment), heads/ffn over tp; embed/lm_head/norms replicated."""
     from cake_tpu.models.llama.params import block_specs
-    from cake_tpu.parallel.sharding import tree_shard
-    tp_axis = "tp" if tp else None
-    dp_axis = "dp" if dp else None
-
-    blocks = params["blocks"]
-    specs = {
+    return {
         "embed": P(None, None),
-        "blocks": block_specs(blocks.keys(), stage_axis="stage",
+        "blocks": block_specs(blocks_keys, stage_axis="stage",
                               tp_axis=tp_axis),
         "final_norm": P(None),
         "lm_head": P(None, None),
     }
+
+
+def place_for_pipeline(params, cache: KVCache, mesh: Mesh, *,
+                       tp: bool = False, dp: bool = False):
+    """device_put params/cache with the shardings make_pipeline_forward
+    expects. QTensor leaves place via their expanded (q, scale) specs."""
+    from cake_tpu.parallel.sharding import tree_shard
+    tp_axis = "tp" if tp else None
+    dp_axis = "dp" if dp else None
+
+    specs = pipeline_param_specs(params["blocks"].keys(), tp_axis)
     out = tree_shard(params, mesh, specs)
     from cake_tpu.parallel.sharding import shard_cache
     cache = shard_cache(cache, mesh, tp_axis=tp_axis, dp_axis=dp_axis,

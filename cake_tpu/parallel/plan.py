@@ -74,3 +74,76 @@ class ParallelPlan:
                 f"  stage[{name}]: blocks {blocks[0]}..{blocks[-1]}"
             )
         return "\n".join(lines)
+
+
+# chip HBM budgets (bytes) for fits-in-memory validation
+HBM_BUDGET = {
+    "v5e": 16 * 2**30,
+    "v5p": 95 * 2**30,
+    "v4": 32 * 2**30,
+    "v6e": 32 * 2**30,
+}
+
+
+def placement_memory(config, *, dp: int = 1, stages: int = 1, tp: int = 1,
+                     batch_size: int = 1, max_seq_len: int = 4096,
+                     dtype=None, quant: bool = False) -> dict:
+    """Per-device HBM estimate for a pipeline placement — without
+    materializing anything (shapes via jax.eval_shape).
+
+    Uses the exact PartitionSpecs place_for_pipeline applies, so the
+    estimate can't drift from the real placement. This is the
+    plan-validation path for configs too big for the chips at hand
+    (BASELINE config #3: Llama-3-70B over a v5p pod) — the reference has
+    no equivalent; it discovers misfits by OOM at load time.
+    """
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama.params import (
+        cache_specs, init_params, init_params_quantized,
+    )
+    from cake_tpu.ops.quant import expand_specs_for_quant
+    from cake_tpu.parallel.pipeline import pipeline_param_specs
+
+    dtype = dtype if dtype is not None else jnp.bfloat16
+    init = init_params_quantized if quant else init_params
+    shapes = jax.eval_shape(
+        lambda: init(config, jax.random.PRNGKey(0), dtype=dtype))
+
+    axis_size = {"dp": dp, "stage": stages, "tp": tp, None: 1}
+    tp_axis = "tp" if tp > 1 else None
+    specs = pipeline_param_specs(shapes["blocks"].keys(), tp_axis)
+    specs = expand_specs_for_quant(shapes, specs)
+
+    def per_device(leaf, spec):
+        n = 1
+        for entry in spec:
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                n *= axis_size[ax]
+        return leaf.size * leaf.dtype.itemsize / n
+
+    leaves = jax.tree.leaves(
+        jax.tree.map(per_device, shapes, specs, is_leaf=lambda x: x is None))
+    params_bytes = sum(leaves)
+
+    cspec = cache_specs(tp_axis=tp_axis or "tp",
+                        dp_axis="dp" if dp > 1 else None,
+                        stage_axis="stage").k
+    L = config.num_hidden_layers
+    KV, hd = config.num_key_value_heads, config.head_dim
+    cache_elems = L * batch_size * max_seq_len * KV * hd
+    div = 1
+    for entry in cspec:
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            div *= axis_size.get(ax, 1)
+    cache_bytes = 2 * cache_elems * 2 / div  # k+v, bf16
+
+    total = params_bytes + cache_bytes
+    return {
+        "dp": dp, "stages": stages, "tp": tp,
+        "devices": dp * stages * tp,
+        "params_bytes_per_device": int(params_bytes),
+        "cache_bytes_per_device": int(cache_bytes),
+        "total_bytes_per_device": int(total),
+        "total_gib_per_device": round(total / 2**30, 2),
+    }

@@ -47,9 +47,12 @@ def _params_digest(params) -> str:
     h = hashlib.sha256()
     leaves = jax.tree.leaves(params)
     for leaf in leaves[:4] + leaves[-4:]:
-        arr = np.asarray(jax.device_get(leaf)).reshape(-1)[:256]
-        h.update(str(arr.shape).encode())
-        h.update(arr.astype(np.float32, copy=False).tobytes())
+        # slice ON DEVICE before the host transfer: device_get of a whole
+        # multi-GiB leaf on the SIGTERM save path could overrun the kill
+        # grace period
+        h.update(str(tuple(leaf.shape)).encode())
+        sample = np.asarray(leaf.reshape(-1)[:256])
+        h.update(sample.astype(np.float32, copy=False).tobytes())
     return h.hexdigest()[:16]
 
 
@@ -136,10 +139,12 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
 
     handles, finished = [], []
     for rec in snap["requests"]:
-        if rec["finished"] or rec["remaining"] <= 0 or rec["error"]:
-            finished.append(rec)
-            continue
         try:
+            # field reads stay inside the try: one malformed record must
+            # not abort the loop after earlier requests were resubmitted
+            if rec["finished"] or rec["remaining"] <= 0 or rec["error"]:
+                finished.append(rec)
+                continue
             handles.append(engine.submit(
                 rec["prompt_ids"] + rec["out_tokens"],
                 max_new_tokens=rec["remaining"],

@@ -36,3 +36,11 @@ def test_smoke_tier_json_contract(tier):
     assert result["value"] > 0
     assert result["unit"] == "tokens/s"
     assert tier in result["metric"]
+
+
+def test_engine_smoke_tier_reports_ttft():
+    result = _run_tier("engine_tiny")
+    assert result["value"] > 0
+    assert result["ttft_p50_ms"] > 0
+    assert result["engine_decode_tok_s"] > 0
+    assert result["engine_streams"] == 2

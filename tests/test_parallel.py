@@ -129,3 +129,35 @@ def test_pipeline_decode_consistency(setup):
     l2, _ = pf(p, nxt, c1, jnp.int32(8), rope)
     np.testing.assert_allclose(np.asarray(l2), np.asarray(ref_l2),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_placement_memory_70b_fits_v5p():
+    """BASELINE config #3 at the placement level: Llama-3-70B over
+    stage=8 x tp=2 must fit a v5p chip's HBM, estimated from the real
+    PartitionSpecs without materializing weights."""
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.parallel.plan import HBM_BUDGET, placement_memory
+
+    cfg = LlamaConfig.llama3_70b()
+    rep = placement_memory(cfg, stages=8, tp=2, batch_size=8,
+                           max_seq_len=4096)
+    assert rep["devices"] == 16
+    # ~141 GB params bf16 / 16 ways for blocks + ~4 GB replicated embed+head
+    assert 6 * 2**30 < rep["params_bytes_per_device"] < 16 * 2**30
+    assert rep["total_bytes_per_device"] < HBM_BUDGET["v5p"]
+
+    # single chip must NOT fit 70B bf16 — sanity that the estimate is real
+    rep1 = placement_memory(cfg, stages=1, tp=1, batch_size=8,
+                            max_seq_len=4096)
+    assert rep1["total_bytes_per_device"] > HBM_BUDGET["v5p"]
+
+
+def test_placement_memory_quant_halves_block_bytes():
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.parallel.plan import placement_memory
+
+    cfg = LlamaConfig.llama3_8b()
+    bf16 = placement_memory(cfg, stages=2, batch_size=1, max_seq_len=1024)
+    int8 = placement_memory(cfg, stages=2, batch_size=1, max_seq_len=1024,
+                            quant=True)
+    assert int8["params_bytes_per_device"] < 0.62 * bf16["params_bytes_per_device"]
