@@ -61,6 +61,10 @@ class Args:
     sp: int = 1                         # sequence/context-parallel degree
     microbatches: int = 1               # GPipe microbatches per pipeline step
                                         # (1 = reference depth-1 behavior)
+    # prefill prompts in fixed windows of N tokens (one compiled program
+    # for every prompt length; cache-aware flash attention per chunk);
+    # None = whole-prompt prefill with bucketed shapes
+    prefill_chunk: Optional[int] = None
     # Pallas flash attention for LLM prefill; None = auto (on when the
     # backend is a real TPU, off on CPU where interpret mode is slow)
     flash_attention: Optional[bool] = None

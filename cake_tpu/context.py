@@ -145,7 +145,8 @@ class Context:
             cfg, params, tokenizer,
             max_seq_len=max_seq,
             batch_size=a.batch_size, sampling=sampling, seed=a.seed,
-            cache_dtype=self.dtype, **kwargs,
+            cache_dtype=self.dtype, prefill_chunk=a.prefill_chunk,
+            **kwargs,
         )
         from cake_tpu.utils.profiling import log_memory
         log_memory("model loaded")  # reference llama.rs:233-236

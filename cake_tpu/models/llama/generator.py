@@ -118,6 +118,7 @@ class LlamaGenerator:
         forward_fn=None,
         cache: Optional[KVCache] = None,
         parallel=None,
+        prefill_chunk: Optional[int] = None,
     ):
         self.config = config
         self.params = params
@@ -135,6 +136,11 @@ class LlamaGenerator:
         # parallel: opaque (plan, mesh) context carried for consumers that
         # need to build matching-sharded state (Master.make_engine).
         self.parallel = parallel
+        # prefill_chunk: process prompts in fixed windows of this many
+        # tokens (one compiled program for ALL prompt lengths and chunk
+        # positions, bounded activation memory); None = whole-prompt
+        # prefill with bucketed shapes.
+        self.prefill_chunk = prefill_chunk
         self.cache = cache if cache is not None else KVCache.create(
             config, batch_size, max_seq_len, dtype=cache_dtype)
         self.history = History()
@@ -217,6 +223,11 @@ class LlamaGenerator:
     def _prefill_prompt(self):
         ids = self._encode_prompt()
         self._prompt_len = len(ids)
+        C = self.prefill_chunk
+        if C and len(ids) > C and self._forward_fn is None:
+            logits = self._prefill_chunked(ids, C)
+            self.index_pos = len(ids)
+            return logits
         bucket = bucket_length(len(ids), self.max_seq_len)
         padded = ids + [0] * (bucket - len(ids))
         toks = jnp.asarray([padded] * self.batch_size, dtype=jnp.int32)
@@ -231,6 +242,26 @@ class LlamaGenerator:
                 last_idx=(plen - 1).astype(jnp.int32), is_prefill=True,
             )
         self.index_pos = len(ids)
+        return logits
+
+    def _prefill_chunked(self, ids: List[int], C: int):
+        """Walk the prompt in fixed windows of C tokens: every chunk (and
+        every future prompt) hits ONE compiled program, and attention per
+        chunk runs against the growing cache (cache-aware flash kernel on
+        TPU) instead of over a monolithic [S, S] window."""
+        from cake_tpu.models.llama.model import prefill_chunk
+        B = self.batch_size
+        logits = None
+        for start in range(0, len(ids), C):
+            window = ids[start:start + C]
+            n_real = len(window)
+            window = window + [0] * (C - n_real)
+            toks = jnp.asarray([window] * B, dtype=jnp.int32)
+            last_idx = jnp.full((B,), n_real - 1, dtype=jnp.int32)
+            logits, self.cache = prefill_chunk(
+                self.params, toks, jnp.int32(start), last_idx, self.cache,
+                self.rope, self.config,
+            )
         return logits
 
     def _decode_incremental(self) -> str:

@@ -14,6 +14,7 @@ the stack gives a pipeline stage's forward (parallel/pipeline.py).
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -28,12 +29,17 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import (
     decode_mask, decode_mask_per_row, gqa_attention,
 )
-from cake_tpu.ops.flash_attention import flash_attention, flash_supported
+from cake_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_cached, flash_supported,
+)
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.quant import qmatmul
 from cake_tpu.ops.rope import (
     apply_rope, precompute_rope, rope_rows, rope_rows_per_row,
 )
+
+
+log = logging.getLogger(__name__)
 
 
 class RopeTables(NamedTuple):
@@ -101,29 +107,43 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
 def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
                   config: LlamaConfig, tp_axis: Optional[str] = None,
                   ep_axis: Optional[str] = None,
-                  is_prefill: bool = False):
+                  is_prefill: bool = False, chunked: bool = False):
     """One decoder block with KV-cache update.
 
     lp: single-layer param dict (leaves without the L axis)
     x:  [B, S, D]; k_cache/v_cache: [B, T, KV, hd]; pos: traced scalar
     rope_c/rope_s: [S, hd/2] rows for positions pos..pos+S
     mask: [S, T] boolean
+    chunked: static — this prefill window continues an existing cache
+    (pos may be > 0), so flash must use the cache-aware kernel; fresh
+    whole-prompt prefill (pos == 0 by contract) uses the cheaper
+    S-window kernel that never touches the cache tail.
     """
     S = x.shape[1]
 
     def attn_fn(q, k, v):
         H, KV = q.shape[2], k.shape[2]
+        T = k_cache.shape[1]
         q = apply_rope(q, rope_c, rope_s)
         k = apply_rope(k, rope_c, rope_s)
         kc, vc = update_layer_cache(k_cache, v_cache, k, v, pos)
-        if (is_prefill and config.use_flash_attention
-                and flash_supported(S, S, H, KV)):
-            # Prefill at pos=0 with an empty cache: attention over the fresh
-            # in-window k/v under a causal mask is exactly the cached-decode
-            # mask (kj <= pos+qi with pos=0) — run the Pallas kernel instead
-            # of materialising [S, T] scores.
+        use_flash = is_prefill and config.use_flash_attention
+        if use_flash and not chunked and flash_supported(S, S, H, KV):
+            # Fresh prompt at pos=0 with an empty cache: causal attention
+            # over the in-window k/v IS the cached-decode mask, so the
+            # kernel reads only the S fresh keys — no cache traffic.
             attn = flash_attention(q, k, v, causal=True)
+        elif use_flash and chunked and flash_supported(S, T, H, KV):
+            # Continued prefill at pos>0: the cache-aware kernel attends
+            # the cache under kj <= pos+qi; key blocks past the frontier
+            # neither compute nor DMA (index-map clamp).
+            attn = flash_attention_cached(q, kc, vc, pos)
         else:
+            if use_flash:
+                log.warning(
+                    "flash attention requested but unsupported for "
+                    "S=%d T=%d H=%d KV=%d (non-tileable shapes) — "
+                    "falling back to the einsum path", S, T, H, KV)
             attn = gqa_attention(q, kc, vc, mask=mask)
         return attn, (kc, vc)
 
@@ -136,7 +156,8 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
                config: LlamaConfig,
                tp_axis: Optional[str] = None,
                ep_axis: Optional[str] = None,
-               is_prefill: bool = False) -> Tuple[jnp.ndarray, KVCache]:
+               is_prefill: bool = False,
+               chunked: bool = False) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks [L, ...] over the hidden state.
 
     This is the TPU equivalent of the reference's sequential block walk with
@@ -148,7 +169,7 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
         lp, kc, vc = xs
         h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
                                   config, tp_axis=tp_axis, ep_axis=ep_axis,
-                                  is_prefill=is_prefill)
+                                  is_prefill=is_prefill, chunked=chunked)
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
@@ -157,7 +178,8 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
 
 def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
             config: LlamaConfig, last_idx: Optional[jnp.ndarray] = None,
-            return_hidden: bool = False, is_prefill: bool = False):
+            return_hidden: bool = False, is_prefill: bool = False,
+            chunked: bool = False):
     """Full forward: tokens [B, S] + cache @ pos -> (logits [B, V] f32, cache).
 
     last_idx: per-batch index of the final *real* token within the window
@@ -169,7 +191,8 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
     mask = decode_mask(pos, S, T)
     x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
-                          mask, config, is_prefill=is_prefill)
+                          mask, config, is_prefill=is_prefill,
+                          chunked=chunked)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     if return_hidden:
         return x, cache
@@ -214,6 +237,18 @@ def decode_step(params, token, pos, cache: KVCache, rope: RopeTables,
                 config: LlamaConfig):
     """One KV-cached decode step: token [B, 1] at absolute pos -> logits."""
     return forward(params, token, cache, pos, rope, config)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_chunk(params, tokens, pos, last_idx, cache: KVCache,
+                  rope: RopeTables, config: LlamaConfig):
+    """Prefill ONE fixed-size window at absolute position `pos` (chunked
+    prefill for long prompts). pos is traced, so every chunk of a prompt —
+    and every prompt — reuses one compiled program per chunk shape. With
+    flash enabled, attention runs the cache-aware Pallas kernel
+    (ops/flash_attention.flash_attention_cached)."""
+    return forward(params, tokens, cache, pos, rope, config,
+                   last_idx=last_idx, is_prefill=True, chunked=True)
 
 
 # -- ragged (per-row position) entry points for continuous batching ----------

@@ -28,6 +28,49 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                         scale: float, mask, v_valid=None):
+    """One (q_block, k_block) tile of the online-softmax recurrence.
+
+    mask: boolean [block_q, block_k] (True = attend) or None. Shared by
+    the fresh-window and cache-aware kernels.
+    v_valid: boolean [block_k, 1] or None — zero out v rows beyond the
+    cache frontier before the p @ v matmul: a masked score contributes
+    p = 0, but 0 * non-finite garbage is NaN, so garbage must never reach
+    the dot.
+    """
+    q = q_ref[0, 0]                      # [block_q, hd]
+    k = k_ref[0, 0]                      # [block_k, hd]
+    v = v_ref[0, 0]
+    if v_valid is not None:
+        v = jnp.where(v_valid, v, 0.0)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                            # [block_q, block_k]
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]                # [block_q, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)      # rescale of old accumulator
+    p = jnp.exp(s - m_new)               # [block_q, block_k]
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _finish_block(o_ref, acc_ref, l_ref):
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)      # fully-masked row guard
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                   scale: float, block_q: int, block_k: int, causal: bool):
     iq = pl.program_id(2)
@@ -44,32 +87,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     k_start = ik * block_k
 
     def compute():
-        q = q_ref[0, 0]                      # [block_q, hd]
-        k = k_ref[0, 0]                      # [block_k, hd]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                            # [block_q, block_k]
+        mask = None
         if causal:
             qi = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kj = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kj <= qi, s, NEG_INF)
-
-        m_prev = m_ref[:, :1]                # [block_q, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)      # rescale of old accumulator
-        p = jnp.exp(s - m_new)               # [block_q, block_k]
-        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            mask = kj <= qi
+        _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                             scale=scale, mask=mask)
 
     if causal:
         # k_start/q_start are traced (grid ids), so gate at runtime
@@ -81,9 +107,51 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ik == nk - 1)
     def _finish():
-        l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)      # fully-masked row guard
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        _finish_block(o_ref, acc_ref, l_ref)
+
+
+def _flash_kernel_cached(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *,
+                         scale: float, block_q: int, block_k: int,
+                         seq_len: int):
+    """Cache-aware variant: queries sit at absolute positions
+    pos..pos+seq_len-1 and attend the whole KV cache [T], masked to
+    kj <= pos + qi (chunked/continued prefill; pos is a prefetched
+    scalar, so one compiled kernel serves every chunk position)."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    pos = pos_ref[0]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    # skip key blocks entirely above this query block's last position
+    # (their DMAs are also elided — the k/v index maps clamp to the same
+    # limit, so Pallas re-reads the resident block instead of fetching)
+    @pl.when(k_start <= pos + q_start + block_q - 1)
+    def _():
+        qi = pos + q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kj = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # cache slots at/after the write frontier pos+seq_len may hold
+        # stale or non-finite garbage in the boundary block
+        col_valid = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < pos + seq_len
+        _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                             scale=scale, mask=kj <= qi,
+                             v_valid=col_valid)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        _finish_block(o_ref, acc_ref, l_ref)
 
 
 def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
@@ -154,9 +222,92 @@ def flash_attention(q, k, v, *, scale: float | None = None,
     return jnp.swapaxes(out, 1, 2)
 
 
+def _flash_bhsd_cached(pos, q, k, v, *, scale, block_q, block_k, interpret):
+    """q [B,H,S,hd] at absolute offset pos; k/v [B,KV,T,hd] full cache."""
+    B, H, S, hd = q.shape
+    _, KV, T, _ = k.shape
+    G = H // KV
+    grid = (B, H, S // block_q, T // block_k)
+    kernel = functools.partial(
+        _flash_kernel_cached, scale=scale, block_q=block_q, block_k=block_k,
+        seq_len=S,
+    )
+
+    def kv_index(b, h, i, j, pos_ref):
+        # clamp skipped k-blocks (beyond this q-block's causal limit) to
+        # the limit block: Pallas elides the DMA when the index repeats,
+        # so a pos=0 whole-cache call reads only the live prefix, not all
+        # T slots
+        limit = jax.lax.div(pos_ref[0] + i * block_q + block_q - 1,
+                            jnp.int32(block_k))
+        return (b, h // G, jnp.minimum(j, limit), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd),
+                         lambda b, h, i, j, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda b, h, i, j, *_: (b, h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k, v)
+
+
+def flash_attention_cached(q, k_cache, v_cache, pos, *,
+                           scale: float | None = None, block_q: int = 128,
+                           block_k: int = 128,
+                           interpret: bool | None = None):
+    """Flash attention for a query window at absolute position `pos`
+    against the full KV cache (chunked/continued prefill, pos > 0).
+
+    q:              [B, S, H, hd] — the chunk's queries (RoPE applied)
+    k_cache/v_cache:[B, T, KV, hd] — entries < pos+S written (the chunk's
+                    own k/v included); later slots may be garbage, they
+                    are causally masked.
+    pos:            traced scalar — one compiled kernel serves every chunk.
+    Equivalent to gqa_attention(q, kc, vc, mask=decode_mask(pos, S, T)).
+    """
+    B, S, H, hd = q.shape
+    _, T, KV, _ = k_cache.shape
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k_cache, 1, 2)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    out = _flash_bhsd_cached(pos, qt, kt, vt, scale=scale,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def flash_supported(S: int, T: int, H: int, KV: int,
                     block_q: int = 128, block_k: int = 128) -> bool:
-    """Static shape check for the flash path (prefill-style, S == T).
+    """Static shape check for the flash path (S = query window, T = KV
+    length — equal for fresh-prompt prefill, T > S for the cache-aware
+    chunked-prefill kernel).
 
     Beyond divisibility, the clamped blocks must be Mosaic-tileable: the
     second-minor dim of a bf16 tile is 16, so unaligned blocks (e.g. S=100

@@ -33,7 +33,10 @@ from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
-SNAPSHOT_VERSION = 2
+# The fingerprint's digest definition is part of the version contract: a
+# digest-format change MUST bump this, or old snapshots would present as
+# weights mismatches instead of an explicit version error.
+SNAPSHOT_VERSION = 3
 
 
 def _params_digest(params) -> str:

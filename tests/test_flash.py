@@ -90,3 +90,104 @@ def test_flash_supported_gate():
     assert not flash_supported(1, 1024, 8, 4)       # decode step
     assert not flash_supported(100, 100, 8, 4)      # 100 not Mosaic-tileable
     assert not flash_supported(130, 130, 8, 4, block_q=128)
+
+
+# -- cache-aware kernel (chunked / continued prefill, pos > 0) ----------------
+
+@pytest.mark.parametrize("pos", [0, 32, 96, 17, 50])
+def test_flash_cached_matches_gqa(pos):
+    """flash_attention_cached == gqa_attention with the decode mask, for a
+    query window at any absolute position against the full cache."""
+    from cake_tpu.ops.attention import decode_mask
+    from cake_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, T, H, KV, hd = 2, 32, 160, 8, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    kc = _rand(ks[1], (B, T, KV, hd))
+    vc = _rand(ks[2], (B, T, KV, hd))
+    # slots >= pos+S are garbage in real use; fill with NaN to prove the
+    # kernel never reads them through the mask
+    garbage = jnp.full((B, T, KV, hd), jnp.nan, jnp.float32)
+    valid = jnp.arange(T)[None, :, None, None] < (pos + S)
+    kc = jnp.where(valid, kc, garbage)
+    vc = jnp.where(valid, vc, garbage)
+
+    ref = gqa_attention(q, jnp.where(valid, kc, 0.0),
+                        jnp.where(valid, vc, 0.0),
+                        mask=decode_mask(jnp.int32(pos), S, T))
+    got = flash_attention_cached(q, kc, vc, jnp.int32(pos),
+                                 block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_cached_traced_pos_single_compile():
+    """pos is a traced scalar: one jitted program serves every position."""
+    from cake_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, T, H, KV, hd = 1, 16, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    kc = _rand(ks[1], (B, T, KV, hd))
+    vc = _rand(ks[2], (B, T, KV, hd))
+
+    calls = jax.jit(lambda p: flash_attention_cached(
+        q, kc, vc, p, block_q=16, block_k=16, interpret=True))
+    a = calls(jnp.int32(0))
+    b = calls(jnp.int32(48))
+    assert np.isfinite(np.asarray(a)).all()
+    assert np.isfinite(np.asarray(b)).all()
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_chunked_prefill_matches_whole_prompt():
+    """Generator-level chunked prefill (prefill_chunk=N) produces the same
+    continuation as whole-prompt prefill."""
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+    from cake_tpu.models.llama.params import init_params
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def run(chunk):
+        gen = LlamaGenerator(
+            cfg, params, ByteTokenizer(cfg.vocab_size), max_seq_len=256,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefill_chunk=chunk, cache_dtype=jnp.float32)
+        from cake_tpu.models.chat import Message
+        gen.add_message(Message.user("the quick brown fox jumps over"))
+        return [gen.next_token(i).id for i in range(6)]
+
+    assert run(None) == run(64) == run(48)
+
+
+def test_chunked_prefill_with_flash_matches():
+    """Chunked prefill THROUGH the cache-aware flash kernel (interpret on
+    CPU) equals the einsum path."""
+    import dataclasses
+
+    from cake_tpu.models.llama.cache import KVCache
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.model import RopeTables, prefill_chunk
+    from cake_tpu.models.llama.params import init_params
+
+    base = LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=2)
+    params = init_params(base, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rope = RopeTables.create(base, 128)
+    ids = list(range(3, 67))  # 64 tokens, two 32-token chunks
+
+    outs = {}
+    for flash in (False, True):
+        cfg = dataclasses.replace(base, use_flash_attention=flash)
+        cache = KVCache.create(cfg, 1, 128, dtype=jnp.float32)
+        for start in range(0, 64, 32):
+            toks = jnp.asarray([ids[start:start + 32]], jnp.int32)
+            logits, cache = prefill_chunk(
+                params, toks, jnp.int32(start),
+                jnp.full((1,), 31, jnp.int32), cache, rope, cfg)
+        outs[flash] = np.asarray(logits)
+    np.testing.assert_allclose(outs[True], outs[False],
+                               atol=2e-4, rtol=2e-4)
