@@ -140,6 +140,14 @@ class LlamaGenerator:
         # tokens (one compiled program for ALL prompt lengths and chunk
         # positions, bounded activation memory); None = whole-prompt
         # prefill with bucketed shapes.
+        if prefill_chunk is not None and (
+                prefill_chunk < 1 or max_seq_len % prefill_chunk != 0):
+            # a padded final window [start, start+C) must stay inside the
+            # cache: dynamic_update_slice CLAMPS an out-of-range start and
+            # would silently overwrite earlier live entries
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be >= 1 and divide "
+                f"max_seq_len {max_seq_len}")
         self.prefill_chunk = prefill_chunk
         self.cache = cache if cache is not None else KVCache.create(
             config, batch_size, max_seq_len, dtype=cache_dtype)

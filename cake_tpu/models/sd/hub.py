@@ -1,0 +1,127 @@
+"""HF-hub asset resolution for SD components.
+
+Capability parity with the reference's `ModelFile::get`
+(cake-core/src/models/sd/sd.rs:29-102) and the per-version repo/file
+mapping (lib.rs:202-268): an explicit --sd-* path always wins; otherwise
+the asset is resolved from the local HF cache, and — when the environment
+permits network access — downloaded from the hub.
+
+Resolution order:
+  1. explicit file path (returned verbatim, like the reference's
+     `Some(filename)` arm),
+  2. local HF cache hit (huggingface_hub.try_to_load_from_cache),
+  3. hub download (hf_hub_download), unless offline mode is requested via
+     HF_HUB_OFFLINE/CAKE_HUB_OFFLINE or allow_download=False.
+A miss raises FileNotFoundError with the (repo, file) it wanted, so
+zero-egress environments get an actionable message instead of a stack of
+network errors.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# version -> base diffusers repo (reference lib.rs:212-219). The reference
+# pins runwayml/stable-diffusion-v1-5, which was removed from the hub in
+# 2024 — the maintained mirror is used for downloads, with the legacy name
+# kept as a cache alias so pre-existing local caches still resolve.
+_REPOS = {
+    "v1-5": "stable-diffusion-v1-5/stable-diffusion-v1-5",
+    "v2-1": "stabilityai/stable-diffusion-2-1",
+    "xl": "stabilityai/stable-diffusion-xl-base-1.0",
+    "turbo": "stabilityai/sdxl-turbo",
+}
+_REPO_CACHE_ALIASES = {
+    "stable-diffusion-v1-5/stable-diffusion-v1-5": (
+        "runwayml/stable-diffusion-v1-5",),
+}
+
+# tokenizer repos (reference sd.rs:41-54)
+_TOKENIZER_REPOS = {
+    "v1-5": "openai/clip-vit-base-patch32",
+    "v2-1": "openai/clip-vit-base-patch32",
+    "xl": "openai/clip-vit-large-patch14",
+    "turbo": "openai/clip-vit-large-patch14",
+}
+_TOKENIZER2_REPO = "laion/CLIP-ViT-bigG-14-laion2B-39B-b160k"
+
+# the fp16 SDXL VAE is numerically broken upstream; the reference (and
+# diffusers) substitute the community fix (sd.rs:60-75)
+_SDXL_VAE_FP16_FIX = ("madebyollin/sdxl-vae-fp16-fix",
+                      "diffusion_pytorch_model.safetensors")
+
+
+def _component_repo_file(component: str, version: str, use_f16: bool):
+    v = getattr(version, "value", version)  # SDVersion enum or str
+    if v not in _REPOS:
+        raise ValueError(f"unknown SD version '{v}'")
+    suffix = ".fp16.safetensors" if use_f16 else ".safetensors"
+    if component == "tokenizer":
+        return _TOKENIZER_REPOS[v], "tokenizer.json"
+    if component == "tokenizer_2":
+        return _TOKENIZER2_REPO, "tokenizer.json"
+    if component == "clip":
+        return _REPOS[v], f"text_encoder/model{suffix}"
+    if component == "clip2":
+        return _REPOS[v], f"text_encoder_2/model{suffix}"
+    if component == "unet":
+        return _REPOS[v], f"unet/diffusion_pytorch_model{suffix}"
+    if component == "vae":
+        if v in ("xl", "turbo") and use_f16:
+            return _SDXL_VAE_FP16_FIX
+        return _REPOS[v], f"vae/diffusion_pytorch_model{suffix}"
+    raise ValueError(f"unknown SD component '{component}'")
+
+
+def _offline() -> bool:
+    return (os.environ.get("HF_HUB_OFFLINE", "") not in ("", "0")
+            or os.environ.get("CAKE_HUB_OFFLINE", "") not in ("", "0"))
+
+
+def resolve_sd_asset(component: str, version, *,
+                     filename: Optional[str] = None, use_f16: bool = True,
+                     cache_dir: Optional[str] = None,
+                     allow_download: Optional[bool] = None) -> str:
+    """Path to a component's weights/tokenizer file (see module docstring).
+
+    component: tokenizer | tokenizer_2 | clip | clip2 | unet | vae
+    """
+    if filename:
+        return filename
+    repo, path = _component_repo_file(component, version, use_f16)
+    if allow_download is None:
+        allow_download = not _offline()
+
+    try:
+        from huggingface_hub import hf_hub_download, try_to_load_from_cache
+    except ImportError as e:
+        raise FileNotFoundError(
+            f"SD {component} needs {repo}/{path}, but huggingface_hub is "
+            f"unavailable ({e}); pass an explicit --sd-{component} path"
+        ) from None
+
+    for candidate in (repo, *_REPO_CACHE_ALIASES.get(repo, ())):
+        cached = try_to_load_from_cache(candidate, path, cache_dir=cache_dir)
+        if isinstance(cached, str) and os.path.exists(cached):
+            log.info("sd: %s resolved from HF cache: %s", component, cached)
+            return cached
+
+    if allow_download:
+        try:
+            got = hf_hub_download(repo, path, cache_dir=cache_dir)
+            log.info("sd: %s downloaded from hub: %s", component, got)
+            return got
+        except Exception as e:  # noqa: BLE001 — normalize network failures
+            raise FileNotFoundError(
+                f"SD {component}: {repo}/{path} not in the local HF cache "
+                f"and the hub download failed ({type(e).__name__}: {e}); "
+                f"pre-populate the cache or pass an explicit path"
+            ) from None
+    raise FileNotFoundError(
+        f"SD {component}: {repo}/{path} not in the local HF cache and "
+        "downloads are disabled (HF_HUB_OFFLINE/CAKE_HUB_OFFLINE); "
+        "pre-populate the cache or pass an explicit path")

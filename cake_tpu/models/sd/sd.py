@@ -105,12 +105,38 @@ class SDGenerator:
         k1, k2, k3, k4 = jax.random.split(rng, 4)
 
         import os
+
+        from cake_tpu.models.sd.hub import resolve_sd_asset
+
+        def resolve(component, explicit):
+            """explicit path > HF cache > hub download (sd.rs:29-102);
+            None when nothing resolves (caller falls back to random init).
+            An explicit path that does NOT exist is a hard error — a typo'd
+            --sd-* flag must not silently produce a random-weight model."""
+            if explicit:
+                if os.path.exists(explicit):
+                    return explicit
+                raise FileNotFoundError(
+                    f"--sd-{component.replace('_', '-')} path does not "
+                    f"exist: {explicit}")
+            try:
+                return resolve_sd_asset(component, sd_args.sd_version,
+                                        use_f16=sd_args.sd_use_f16)
+            except FileNotFoundError as e:
+                log.warning("sd: %s", e)
+                return None
+
         def maybe_load(component, path, init_fn):
-            if path and os.path.exists(path):
+            path = resolve(component, path)
+            if path:
                 from cake_tpu.models.sd.params import load_sd_component
                 return load_sd_component(component, path, cfg, dtype)
             log.warning("sd: no weights for %s; using random init", component)
             return init_fn()
+
+        def tokenizer_for(component, explicit):
+            path = resolve(component, explicit)
+            return HFClipTokenizer(path) if path else SimpleClipTokenizer()
 
         params = {
             "clip": maybe_load("clip", sd_args.sd_clip,
@@ -120,14 +146,12 @@ class SDGenerator:
             "vae": maybe_load("vae", sd_args.sd_vae,
                               lambda: init_vae_params(cfg.vae, k3, dtype)),
         }
-        toks = [HFClipTokenizer(sd_args.sd_tokenizer)
-                if sd_args.sd_tokenizer else SimpleClipTokenizer()]
+        toks = [tokenizer_for("tokenizer", sd_args.sd_tokenizer)]
         if cfg.clip2 is not None:
             params["clip2"] = maybe_load(
                 "clip2", sd_args.sd_clip2,
                 lambda: init_clip_params(cfg.clip2, k4, dtype))
-            toks.append(HFClipTokenizer(sd_args.sd_tokenizer_2)
-                        if sd_args.sd_tokenizer_2 else SimpleClipTokenizer())
+            toks.append(tokenizer_for("tokenizer_2", sd_args.sd_tokenizer_2))
 
         gen = cls(cfg, params, toks, dtype)
         if ctx.topology is not None:

@@ -1,0 +1,99 @@
+/* embed_host — a complete C host application for the cake-tpu embed
+ * library, mirroring the reference's iOS worker app shell
+ * (cake-ios-worker-app/Cake Worker/ContentView.swift:10-62): the user
+ * points it at a base directory holding `model/` and `topology.yml`,
+ * picks a model type, and the app runs a cake node inside its own
+ * process. Where the SwiftUI app calls the uniffi-exported
+ * startWorker(name:modelPath:topologyPath:modelType:), this calls the
+ * C ABI's cake_tpu_start_worker — same contract, any language that can
+ * speak C (Swift included: declare the three externs below in a
+ * bridging header and the Swift body is a direct transliteration).
+ *
+ * Modes:
+ *   embed_host <base_dir>                          # run a node (blocks)
+ *   embed_host <base_dir> --type image             # image-model node
+ *   embed_host <base_dir> --prompt "..." [--n N]   # one-shot generation
+ *
+ * Build: `make` here (uses the library built by cake_tpu.native), or see
+ * tests/test_embed.py for the exact compile line the CI uses.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+long cake_tpu_version(char *buf, long cap);
+long cake_tpu_generate(const char *model_dir, const char *prompt,
+                       int sample_len, char *buf, long cap);
+int cake_tpu_start_worker(const char *name, const char *model_path,
+                          const char *topology_path, const char *model_type,
+                          const char *address);
+long cake_tpu_last_error(char *buf, long cap);
+
+static void print_last_error(const char *what) {
+  char err[2048];
+  err[0] = '\0';
+  cake_tpu_last_error(err, (long)sizeof err);
+  fprintf(stderr, "embed_host: %s failed: %s\n", what, err);
+}
+
+int main(int argc, char **argv) {
+  const char *base = NULL, *prompt = NULL, *type = "text";
+  int sample_len = 16;
+  for (int i = 1; i < argc; i++) {
+    if (strcmp(argv[i], "--prompt") == 0 && i + 1 < argc) {
+      prompt = argv[++i];
+    } else if (strcmp(argv[i], "--type") == 0 && i + 1 < argc) {
+      type = argv[++i];
+    } else if (strcmp(argv[i], "--n") == 0 && i + 1 < argc) {
+      sample_len = atoi(argv[++i]);
+    } else if (base == NULL) {
+      base = argv[i];
+    } else {
+      fprintf(stderr, "usage: %s <base_dir> [--type text|image] "
+                      "[--prompt P [--n N]]\n", argv[0]);
+      return 64;
+    }
+  }
+  if (base == NULL) {
+    fprintf(stderr, "usage: %s <base_dir> [--type text|image] "
+                    "[--prompt P [--n N]]\n", argv[0]);
+    return 64;
+  }
+
+  char ver[64];
+  if (cake_tpu_version(ver, (long)sizeof ver) != 0) {
+    print_last_error("version");
+    return 1;
+  }
+  printf("cake-tpu embed host, library v%s\n", ver);
+
+  /* The reference app resolves <picked folder>/model and
+   * <picked folder>/topology.yml (ContentView.swift:40-42). */
+  char model_path[4096], topology_path[4096];
+  snprintf(model_path, sizeof model_path, "%s/model", base);
+  snprintf(topology_path, sizeof topology_path, "%s/topology.yml", base);
+
+  if (prompt != NULL) {
+    char out[65536];
+    printf("[%s] generating %d tokens...\n", model_path, sample_len);
+    if (cake_tpu_generate(model_path, prompt, sample_len, out,
+                          (long)sizeof out) != 0) {
+      print_last_error("generate");
+      return 2;
+    }
+    printf("%s\n", out);
+    printf("embed_host: done\n");
+    return 0;
+  }
+
+  printf("starting %s-model node (model=%s topology=%s)...\n",
+         type, model_path, topology_path);
+  /* Blocks for the life of the node, like the app's startWorker call. */
+  if (cake_tpu_start_worker("embed-host", model_path, topology_path, type,
+                            NULL) != 0) {
+    print_last_error("start_worker");
+    return 3;
+  }
+  return 0;
+}

@@ -9,6 +9,9 @@ import os
 # Force-override: the session env pins JAX_PLATFORMS to the real accelerator;
 # tests always run on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# hermetic: never attempt HF-hub downloads from tests (zero-egress CI
+# would stall through network retries); cache hits still resolve
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
 # Golden tests compare f32 logits against torch; XLA:CPU otherwise lowers
 # f32 matmuls to bf16-ish oneDNN paths (~1e-3 error).
 os.environ["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"

@@ -130,3 +130,36 @@ def test_c_host_embeds_and_generates(lib_path, tiny_model_dir, tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "version=" in proc.stdout
     assert "generated-ok" in proc.stdout
+
+
+def test_example_host_app(lib_path, tiny_model_dir, tmp_path):
+    """examples/embed_host builds with its Makefile and generates from a
+    fresh process — the shipped analog of the reference's worker app
+    shell (cake-ios-worker-app/Cake Worker/ContentView.swift:10-62)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    example = os.path.join(repo, "examples", "embed_host")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    build = subprocess.run(["make", "-B"], cwd=example, env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stdout + build.stderr
+    exe = os.path.join(example, "embed_host")
+
+    # base dir layout the app expects: <base>/model + <base>/topology.yml
+    base = tmp_path / "node"
+    base.mkdir()
+    shutil.copytree(tiny_model_dir, base / "model")
+    (base / "topology.yml").write_text(
+        "host0:\n  host: 127.0.0.1:10128\n  description: all\n"
+        "  layers:\n    - model.layers.0-1\n")
+
+    site = sysconfig.get_path("purelib")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, site] + [p for p in sys.path if p.endswith("site-packages")])
+    proc = subprocess.run(
+        [exe, str(base), "--prompt", "hello", "--n", "2"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "embed_host: done" in proc.stdout

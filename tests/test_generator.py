@@ -110,3 +110,15 @@ def test_master_generate_text():
     assert len(seen) <= 5
     assert m.tokens_per_s >= 0.0
     assert isinstance(text, str)
+
+
+def test_prefill_chunk_must_divide_max_seq(tiny_config, tiny_params):
+    """A padded final chunk window must stay inside the cache —
+    dynamic_update_slice clamps out-of-range starts and would silently
+    corrupt live entries, so the constraint is enforced at construction."""
+    from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        LlamaGenerator(tiny_config, tiny_params,
+                       ByteTokenizer(tiny_config.vocab_size),
+                       max_seq_len=250, prefill_chunk=64)

@@ -291,3 +291,44 @@ def test_vae_legacy_attention_names(tiny, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(vae_decode(p, tiny.vae, lat)),
         np.asarray(vae_decode(p2, tiny.vae, lat)))
+
+
+# -- HF-hub asset resolution (reference ModelFile::get, sd.rs:29-102) --------
+
+def test_hub_repo_file_mapping():
+    from cake_tpu.models.sd.hub import _component_repo_file
+
+    repo, f = _component_repo_file("unet", "v1-5", use_f16=False)
+    assert repo == "stable-diffusion-v1-5/stable-diffusion-v1-5"
+    assert f == "unet/diffusion_pytorch_model.safetensors"
+    _, f16 = _component_repo_file("clip", "v2-1", use_f16=True)
+    assert f16 == "text_encoder/model.fp16.safetensors"
+    # SDXL fp16 VAE substitutes the community fix (sd.rs:60-75)
+    repo, f = _component_repo_file("vae", "xl", use_f16=True)
+    assert repo == "madebyollin/sdxl-vae-fp16-fix"
+    repo, _ = _component_repo_file("tokenizer", "v1-5", use_f16=False)
+    assert repo == "openai/clip-vit-base-patch32"
+    repo, _ = _component_repo_file("tokenizer_2", "xl", use_f16=True)
+    assert repo == "laion/CLIP-ViT-bigG-14-laion2B-39B-b160k"
+
+
+def test_hub_resolve_explicit_path_wins(tmp_path):
+    from cake_tpu.models.sd.hub import resolve_sd_asset
+
+    f = tmp_path / "x.safetensors"
+    f.write_text("")
+    assert resolve_sd_asset("unet", "v1-5", filename=str(f)) == str(f)
+
+
+def test_hub_resolve_offline_miss_is_actionable(monkeypatch, tmp_path):
+    from cake_tpu.models.sd.hub import resolve_sd_asset
+
+    monkeypatch.setenv("CAKE_HUB_OFFLINE", "1")
+    with pytest.raises(FileNotFoundError) as ei:
+        # cache_dir pinned to an empty dir: a developer machine's real HF
+        # cache must not satisfy the lookup and mask the offline error
+        resolve_sd_asset("unet", "v1-5", use_f16=False,
+                         cache_dir=str(tmp_path))
+    msg = str(ei.value)
+    assert "stable-diffusion-v1-5" in msg
+    assert "unet/diffusion_pytorch_model.safetensors" in msg
