@@ -172,6 +172,37 @@ class SDGenerator:
             self.params[name] = jax.device_put(self.params[name], dev)
             log.info("sd: %s -> %s (node %s)", name, dev, node_name)
 
+    def _component_device(self, name):
+        params = self.params.get(name)
+        if params is None:
+            return None
+        leaf = jax.tree.leaves(params)[0]
+        devs = leaf.devices() if hasattr(leaf, "devices") else None
+        if devs and len(devs) == 1:
+            return next(iter(devs))
+        if devs and len(devs) > 1:
+            # a multi-device (sharded) component needs a sharding-aware
+            # transfer of activations; silently skipping would resurface
+            # jit's incompatible-devices error with no hint why
+            raise NotImplementedError(
+                f"SD component '{name}' is sharded over {len(devs)} "
+                "devices; per-component placement currently supports one "
+                "device per component (use device_put / place_components)")
+        return None
+
+    def _to_component(self, name, tree):
+        """Move activations to the device hosting component `name` — the
+        explicit stage-boundary transfer that replaces the reference's
+        TCP tensor send to each worker (sd.rs:198-302). Without it, jit
+        rejects arguments committed to different devices (it will not
+        guess which placement was intended)."""
+        dev = self._component_device(name)
+        if dev is None:
+            return tree
+        return jax.tree.map(
+            lambda x: (jax.device_put(x, dev)
+                       if hasattr(x, "shape") else x), tree)
+
     # -- text embeddings ------------------------------------------------------
 
     def text_embeddings(self, prompt: str, uncond_prompt: str,
@@ -183,8 +214,12 @@ class SDGenerator:
 
         def encode_with(tok, clip_params, clip_cfg, text, skip):
             ids = jnp.asarray([tok.encode(text)], dtype=jnp.int32)
-            return clip_encode(clip_params, clip_cfg, ids,
-                               output_hidden_state=skip)
+            out = clip_encode(clip_params, clip_cfg, ids,
+                              output_hidden_state=skip)
+            # hand the embeddings to the UNet's device right away: the two
+            # encoders may live on different devices, and the concat below
+            # (like every later consumer) needs co-located operands
+            return self._to_component("unet", out)
 
         # Clip-skip (-2, no final_ln) applies to the XL encoders only.
         # v2.1's ViT-H config ships pre-truncated to 23 layers — diffusers
@@ -290,9 +325,9 @@ class SDGenerator:
         if args.sd_img2img:
             image = _image_preprocess(args.sd_img2img, cfg.height, cfg.width)
             rng, sub = jax.random.split(rng)
-            init_latent = vae_encode(
+            init_latent = self._to_component("unet", vae_encode(
                 self.params["vae"], cfg.vae,
-                jnp.asarray(image, self.dtype)[None], rng=sub)
+                jnp.asarray(image, self.dtype)[None], rng=sub))
             t_start = max(steps - int(args.sd_img2img_strength * steps), 0)
 
         for sample_idx in range(args.sd_num_samples):
@@ -328,7 +363,8 @@ class SDGenerator:
     def _decode_to_pngs(self, latents) -> List[bytes]:
         """VAE decode -> u8 RGB -> PNG bytes (reference split_images,
         sd.rs:535-565)."""
-        imgs = vae_decode(self.params["vae"], self.config.vae, latents)
+        imgs = vae_decode(self.params["vae"], self.config.vae,
+                          self._to_component("vae", latents))
         imgs = np.asarray(((jnp.clip(imgs, -1, 1) + 1.0) * 127.5)
                           .astype(jnp.uint8))
         out = []
