@@ -210,6 +210,9 @@ def run_engine_tier(name: str, model: str, quant: bool, max_seq: int,
         cfg, params, ByteTokenizer(cfg.vocab_size), max_slots=slots,
         max_seq_len=max_seq,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # 8 tokens per host round-trip once all streams are admitted —
+        # the dispatch-amortized serving configuration
+        decode_scan_steps=8,
     )
     prompt = list(range(3, 3 + prompt_len))
     with engine:

@@ -65,6 +65,10 @@ class Args:
     # for every prompt length; cache-aware flash attention per chunk);
     # None = whole-prompt prefill with bucketed shapes
     prefill_chunk: Optional[int] = None
+    # engine: when no request is queued, decode N tokens per host
+    # round-trip as one on-device scan (amortizes dispatch latency);
+    # 1 = step-by-step
+    decode_scan: int = 1
     # Pallas flash attention for LLM prefill; None = auto (on when the
     # backend is a real TPU, off on CPU where interpret mode is slow)
     flash_attention: Optional[bool] = None
@@ -87,7 +91,7 @@ class Args:
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
-                     "max_slots"):
+                     "max_slots", "decode_scan"):
             if getattr(self, knob) < 1:
                 raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self

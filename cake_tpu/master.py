@@ -87,6 +87,7 @@ class Master:
             max_seq_len=g.max_seq_len,
             sampling=g.sampling,
             seed=self.args.seed,
+            decode_scan_steps=self.args.decode_scan,
             **kwargs,
         )
 

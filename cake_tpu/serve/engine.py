@@ -29,6 +29,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -144,6 +145,7 @@ class InferenceEngine:
         cache_dtype=jnp.bfloat16,
         step_fns=None,
         cache: Optional[KVCache] = None,
+        decode_scan_steps: int = 1,
     ):
         self.config = config
         self.params = params
@@ -160,6 +162,19 @@ class InferenceEngine:
         self._prefill_slot, self._decode_step = (
             step_fns if step_fns is not None
             else (prefill_slot, decode_step_ragged))
+        # decode_scan_steps > 1: when no request is waiting, run K decode
+        # steps as ONE on-device lax.scan per host round-trip — host/tunnel
+        # dispatch latency amortizes across K tokens. Only for the built-in
+        # single-device steps (a custom pipelined step fn owns its own
+        # jit/donation and cannot be re-scanned here).
+        if decode_scan_steps < 1:
+            raise ValueError("decode_scan_steps must be >= 1")
+        if decode_scan_steps > 1 and step_fns is not None:
+            log.warning(
+                "decode_scan_steps=%d ignored: custom (pipelined) step fns "
+                "own their jit/donation and run step-by-step",
+                decode_scan_steps)
+        self._decode_scan = decode_scan_steps if step_fns is None else 1
         self.cache = cache if cache is not None else KVCache.create(
             config, max_slots, max_seq_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
@@ -295,7 +310,11 @@ class InferenceEngine:
                 for rid, slot in prefill_plan:
                     self._do_prefill(rid, slot)
                 if decode_plan:
-                    self._do_decode(decode_plan)
+                    n = self._scan_steps_for(decode_plan)
+                    if n > 1:
+                        self._do_decode_scan(decode_plan, n)
+                    else:
+                        self._do_decode(decode_plan)
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
                 self._fail_all(e)
@@ -384,25 +403,81 @@ class InferenceEngine:
                 continue
             self._emit(req, int(nxt[slot]))
 
+    def _scan_steps_for(self, decode_plan) -> int:
+        """Fixed scan length when multi-step decode is safe right now:
+        nobody queued (a waiting request must not see its admission
+        delayed by a whole scan), every active row has >= K tokens of
+        budget left (no overshoot past max_new_tokens), and K more cache
+        writes fit every row's window."""
+        n = self._decode_scan
+        if n <= 1 or self.scheduler.queue_depth > 0:
+            return 1
+        for _, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is None:
+                return 1
+            if req.max_new_tokens - len(req.out_tokens) < n:
+                return 1
+            if self._pos[slot] + n >= self.max_seq_len:
+                return 1
+        return n
+
+    def _do_decode_scan(self, decode_plan, n: int) -> None:
+        """n ragged decode steps + sampling as one compiled program."""
+        t0 = time.perf_counter()
+        B = self.max_slots
+        active = np.zeros(B, bool)
+        for _, slot in decode_plan:
+            active[slot] = True
+        toks, self.cache, self._keys, self._ring = _decode_scan(
+            self.params,
+            jnp.asarray(self._last_tok, jnp.int32),
+            jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                        jnp.int32),
+            jnp.asarray(active), self.cache, self.rope, self.config,
+            self._keys, self._ring,
+            jnp.asarray(self._steps, jnp.int32),
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._penalty),
+            num_steps=n, top_k=self.defaults.top_k,
+        )
+        toks_host = np.asarray(toks)                 # [B, n]
+        self.stats.steps += n
+        self.stats.decode_time_s += time.perf_counter() - t0
+        self._step_stats.step(bytes_out=len(decode_plan) * n)
+        for rid, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is None or req.rid != rid:
+                continue
+            pos0 = int(self._pos[slot])
+            self._steps[slot] += n
+            self._last_tok[slot] = toks_host[slot, -1]
+            for j in range(n):
+                # per-token position so _emit's cap check sees the value a
+                # single-step loop would have had
+                self._pos[slot] = pos0 + j + 1
+                self._emit(req, int(toks_host[slot, j]))
+                if req.done.is_set():
+                    # EOS/budget mid-scan: later tokens are overshoot; the
+                    # slot's cache garbage is overwritten by the next
+                    # prefill into this slot
+                    break
+            else:
+                self._pos[slot] = pos0 + n
+
     def _sample_rows(self, logits, rows: List[int]):
-        """Sample all B rows in one jitted call; advance keys/ring only for
-        `rows` (so an inactive slot's PRNG stream is untouched)."""
+        """Sample all B rows; advance keys/ring only for `rows` (so an
+        inactive slot's PRNG stream is untouched)."""
         B = self.max_slots
         row_mask = np.zeros(B, bool)
         for r in rows:
             row_mask[r] = True
-        mask_dev = jnp.asarray(row_mask)
-        keys, subkeys = _split_keys(self._keys)
-        nxt = sample_tokens_ragged(
-            subkeys, logits, self._ring,
+        nxt, self._keys, self._ring = _masked_sample(
+            jnp.asarray(row_mask), self._keys, logits, self._ring,
+            jnp.asarray(self._steps, jnp.int32),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._penalty), top_k=self.defaults.top_k,
         )
-        # only selected rows consume randomness / update their ring
-        self._keys = jnp.where(mask_dev[:, None], keys, self._keys)
-        steps = jnp.asarray(self._steps, jnp.int32)
-        new_ring = update_ring_per_row(self._ring, nxt, steps)
-        self._ring = jnp.where(mask_dev[:, None], new_ring, self._ring)
         nxt_host = np.asarray(nxt)
         for r in rows:
             self._steps[r] += 1
@@ -460,3 +535,51 @@ def _split_keys(keys):
     """Split a [B]-vector of PRNG keys into (next_keys, subkeys)."""
     split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
     return split[:, 0], split[:, 1]
+
+
+def _masked_sample(active_mask, keys, logits, ring, steps, temp, top_p,
+                   penalty, *, top_k):
+    """ONE per-row sample with masked state advance — the single source of
+    the engine's sampling semantics: rows outside active_mask keep their
+    PRNG key and ring untouched. Used eagerly by _sample_rows and traced
+    inside _decode_scan, so the two decode paths cannot drift.
+    Returns (next_tokens [B], keys, ring)."""
+    new_keys, sub = _split_keys(keys)
+    nxt = sample_tokens_ragged(sub, logits, ring, temp, top_p, penalty,
+                               top_k=top_k)
+    keys = jnp.where(active_mask[:, None], new_keys, keys)
+    ring = jnp.where(active_mask[:, None],
+                     update_ring_per_row(ring, nxt, steps), ring)
+    return nxt, keys, ring
+
+
+@partial(jax.jit, static_argnames=("config", "num_steps", "top_k"),
+         donate_argnames=("cache",))
+def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
+                 config, keys, ring, steps, temp, top_p, penalty,
+                 num_steps: int, top_k):
+    """num_steps ragged decode+sample steps as ONE compiled program.
+
+    Same per-row semantics as the single-step path (_do_decode +
+    _sample_rows — both go through _masked_sample): inactive rows touch
+    neither their cache lines nor their PRNG/ring state. Returns
+    ([B, num_steps] tokens, cache, keys, ring); the host mirrors
+    (_pos/_steps/_last_tok) are advanced by the caller.
+    """
+    from cake_tpu.models.llama.model import forward_ragged
+
+    def body(carry, _):
+        tok, pos, cache, keys, ring, steps = carry
+        logits, cache = forward_ragged(params, tok[:, None], cache, pos,
+                                       active, rope, config)
+        nxt, keys, ring = _masked_sample(active, keys, logits, ring, steps,
+                                         temp, top_p, penalty, top_k=top_k)
+        tok = jnp.where(active, nxt, tok)
+        pos = pos + active
+        steps = steps + active
+        return (tok, pos, cache, keys, ring, steps), nxt
+
+    (tok, pos, cache, keys, ring, steps), toks = jax.lax.scan(
+        body, (last_tok, pos, cache, keys, ring, steps), None,
+        length=num_steps)
+    return toks.T, cache, keys, ring  # toks: [B, num_steps]

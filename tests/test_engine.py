@@ -214,3 +214,45 @@ def test_engine_api_server_integration(setup):
     finally:
         httpd.shutdown()
         engine.stop()
+
+
+# -- multi-step scanned decode ------------------------------------------------
+
+def test_decode_scan_matches_sequential(setup):
+    """decode_scan_steps=4: batched scanned decode must reproduce the
+    sequential generator's greedy outputs exactly — including requests
+    whose EOS lands mid-scan."""
+    prompts = ["hello world", "a", "the quick brown fox jumps"]
+    want = {p: sequential_ids(setup, p, 12) for p in prompts}
+
+    with make_engine(setup, max_slots=4, decode_scan_steps=4) as eng:
+        handles = {p: eng.chat([Message.user(p)], max_new_tokens=12)
+                   for p in prompts}
+        for p, h in handles.items():
+            assert h.wait(120), f"timeout waiting for {p!r}"
+            assert h.token_ids == want[p], f"mismatch for {p!r}"
+
+
+def test_decode_scan_respects_budget(setup):
+    """Remaining budget below the scan length must still stop exactly at
+    max_new_tokens (the engine falls back to single steps near the end)."""
+    want = sequential_ids(setup, "hello world", 6)
+    with make_engine(setup, max_slots=2, decode_scan_steps=4) as eng:
+        h = eng.chat([Message.user("hello world")], max_new_tokens=6)
+        assert h.wait(120)
+    assert len(h._req.out_tokens) <= 6
+    assert h.token_ids == want
+
+
+def test_decode_scan_with_stochastic_rows(setup):
+    """Per-row sampling state stays isolated under scanned decode: a
+    temperature>0 row and a greedy row share scans, and the greedy row
+    still matches the sequential transcript."""
+    want = sequential_ids(setup, "hello world", 10)
+    with make_engine(setup, max_slots=2, decode_scan_steps=2) as eng:
+        hot = eng.chat([Message.user("something else")],
+                       max_new_tokens=10, temperature=0.9)
+        cold = eng.chat([Message.user("hello world")], max_new_tokens=10,
+                        temperature=0.0)
+        assert hot.wait(120) and cold.wait(120)
+    assert cold.token_ids == want
