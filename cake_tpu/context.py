@@ -108,7 +108,45 @@ class Context:
         from cake_tpu.parallel.plan import ParallelPlan
         plan = ParallelPlan.from_topology(cfg, self.topology, args=a)
         kwargs = {}
-        if plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
+        if a.sp > 1:
+            # sequence/context parallelism: ring-attention prefill +
+            # merged-stats decode over an ("sp",) mesh — the long-context
+            # serving mode (prompt sharded over chips)
+            if plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
+                raise ValueError(
+                    "--sp does not compose with --tp/--dp/topology stages "
+                    "in this release; run sp on its own mesh")
+            import numpy as np
+            from jax.sharding import Mesh
+
+            from cake_tpu.parallel.context_parallel import SPGeneratorForward
+            devices = jax.devices()
+            if a.sp > len(devices):
+                raise ValueError(
+                    f"--sp {a.sp} needs {a.sp} devices, have {len(devices)}")
+            # split the window: context (sharded) + decode tail (replicated);
+            # the tail MUST hold sample_len generated tokens — a too-small
+            # tail would clamp cache writes over live entries
+            tail = max(a.sample_len, 16)
+            ctx_len = ((max_seq - tail) // a.sp) * a.sp
+            if ctx_len <= 0:
+                raise ValueError(
+                    f"--max-seq-len {max_seq} leaves no context window for "
+                    f"sp={a.sp} after a {tail}-token decode tail; raise "
+                    "--max-seq-len or lower --sample-len")
+            mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
+            fwd = SPGeneratorForward(mesh, cfg, ctx_len, max_seq - ctx_len)
+            # placeholder cache: the SP prefill allocates its own sharded
+            # SPCache; the generator's default dense [L,B,max_seq,...]
+            # buffer would be dead weight at exactly the context lengths
+            # SP exists for
+            from cake_tpu.models.llama.cache import KVCache
+            kwargs = dict(forward_fn=fwd,
+                          cache=KVCache.create(cfg, a.batch_size, 1,
+                                               dtype=self.dtype))
+            log.info("sp serving: ring prefill over sp=%d, ctx=%d tail=%d",
+                     a.sp, ctx_len, max_seq - ctx_len)
+        elif plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
             from cake_tpu.parallel.pipeline import (
                 make_pipeline_forward, place_for_pipeline,
             )

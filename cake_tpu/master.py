@@ -56,6 +56,14 @@ class Master:
             raise RuntimeError("no text generator loaded")
         from cake_tpu.serve import InferenceEngine
         g = self.llm
+        if getattr(g, "_forward_fn", None) is not None and g.parallel is None:
+            # a custom forward without a (plan, mesh) — e.g. the --sp
+            # adapter — has no engine-step contract; silently serving a
+            # dense engine would drop the sharding the user asked for
+            raise ValueError(
+                "continuous-batching/API serving is not available for this "
+                "serving mode (--sp is a one-shot/generator mode); drop "
+                "--api or use a stage/tp topology instead")
         slots = max_slots or getattr(self.args, "max_slots", 8)
         kwargs = {}
         if getattr(g, "parallel", None) is not None:

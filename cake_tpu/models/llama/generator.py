@@ -192,6 +192,13 @@ class LlamaGenerator:
 
     def next_token(self, index: int) -> Token:
         """Generate one token; index==0 triggers prompt prefill."""
+        limit = getattr(self._forward_fn, "max_decode_tokens", None)
+        if limit is not None and index >= limit:
+            # e.g. the SP adapter's replicated decode tail is full; writing
+            # past it would clamp over live cache entries
+            raise ValueError(
+                f"decode budget exhausted: this serving mode holds at most "
+                f"{limit} generated tokens per session")
         if index == 0:
             logits = self._prefill_prompt()
         else:
@@ -222,9 +229,16 @@ class LlamaGenerator:
 
     def _encode_prompt(self) -> List[int]:
         ids = encode_text(self.tokenizer, self.history.render())
-        if len(ids) >= self.max_seq_len:
+        # a custom forward may impose its own (inclusive) prompt bound —
+        # e.g. the SP adapter's context window; dense decode needs one
+        # free slot past the prompt
+        limit = getattr(self._forward_fn, "max_prompt_len", None)
+        if limit is None:
+            limit = self.max_seq_len - 1
+        if len(ids) > limit:
             raise ValueError(
-                f"prompt length {len(ids)} exceeds max_seq_len {self.max_seq_len}"
+                f"prompt length {len(ids)} exceeds limit {limit} "
+                f"(max_seq_len {self.max_seq_len})"
             )
         return ids
 
@@ -298,13 +312,25 @@ class LlamaGenerator:
                 "generate_on_device requires uniform prompt_len; "
                 f"got {plen_arr.tolist()}"
             )
+        plimit = getattr(self._forward_fn, "max_prompt_len", None)
+        if plimit is not None and int(plen_arr[0]) > plimit:
+            # e.g. the SP adapter's context window: a longer prompt would
+            # silently truncate and zero the last-position hidden state
+            raise ValueError(
+                f"prompt length {int(plen_arr[0])} exceeds this serving "
+                f"mode's prompt limit {plimit}")
         toks = jnp.asarray(prompt_ids, dtype=jnp.int32)
         plen = jnp.asarray(plen_arr)
-        cache = self.cache.fresh()
         self.rng, sub = jax.random.split(self.rng)
         if self._forward_fn is not None:
+            # a forward that allocates its own cache at prefill (SP) never
+            # reads the one we pass — skip the full-size fresh() copy
+            cache = (self.cache
+                     if getattr(self._forward_fn, "allocates_cache", False)
+                     else self.cache.fresh())
             return self._generate_hostloop(toks, plen, cache, sub,
                                            num_tokens)
+        cache = self.cache.fresh()
         out, _ = _generate_scan(
             self.params, toks, plen, cache, self.rope, self.config,
             self.sampling, sub, num_tokens,
@@ -322,6 +348,11 @@ class LlamaGenerator:
         """
         B = toks.shape[0]
         fwd = self._forward_fn
+        limit = getattr(fwd, "max_decode_tokens", None)
+        if limit is not None and num_tokens > limit:
+            raise ValueError(
+                f"num_tokens {num_tokens} exceeds this serving mode's "
+                f"decode budget of {limit} tokens per session")
         logits, cache = fwd(self.params, toks, cache, jnp.int32(0),
                             self.rope, last_idx=(plen - 1).astype(jnp.int32),
                             is_prefill=True)

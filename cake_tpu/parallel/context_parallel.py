@@ -190,6 +190,11 @@ class SPCache(NamedTuple):
     tail_k: jnp.ndarray
     tail_v: jnp.ndarray
 
+    def fresh(self) -> "SPCache":
+        """Zeroed cache with identical spec/sharding (the generator's
+        session-reset contract, models/llama/cache.KVCache.fresh)."""
+        return SPCache(*(jnp.zeros_like(x) for x in self))
+
 
 
 def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
@@ -301,10 +306,16 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             params["lm_head"], tokens, plen, rope.cos, rope.sin)
         B = tokens.shape[0]
         KV, hd = config.num_key_value_heads, config.head_dim
-        tail = jnp.zeros(
-            (config.num_hidden_layers, B, tail_len, KV, hd), ks.dtype)
-        tail = lax.with_sharding_constraint(tail, NamedSharding(mesh, P()))
-        return logits, SPCache(ks, vs, tail, tail)
+        # two separate allocations: aliased tail_k/tail_v would make the
+        # first donated sp_decode try to donate one buffer twice (JAX
+        # falls back to a copy, defeating the donation)
+        shape = (config.num_hidden_layers, B, tail_len, KV, hd)
+        rep = NamedSharding(mesh, P())
+        tail_k = lax.with_sharding_constraint(
+            jnp.zeros(shape, ks.dtype), rep)
+        tail_v = lax.with_sharding_constraint(
+            jnp.zeros(shape, ks.dtype), rep)
+        return logits, SPCache(ks, vs, tail_k, tail_v)
 
     @partial(jax.jit, donate_argnames=("cache",))
     def sp_decode(params, token, pos, plen, cache: SPCache,
@@ -317,3 +328,72 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         return logits, SPCache(cache.ctx_k, cache.ctx_v, tk, tv)
 
     return sp_prefill, sp_decode
+
+
+class SPSessionCache(NamedTuple):
+    """SPCache + the session's prompt lengths: carrying plen IN the cache
+    keeps the adapter stateless, so a scratch-cache generation
+    (generate_on_device) cannot clobber a live interactive session's
+    decode positions."""
+    sp: SPCache
+    plen: jnp.ndarray
+
+    def fresh(self) -> "SPSessionCache":
+        return SPSessionCache(self.sp.fresh(), jnp.zeros_like(self.plen))
+
+
+class SPGeneratorForward:
+    """forward_fn adapter: (sp_prefill, sp_decode) under the generator's
+    pluggable-forward contract, making `--sp N` a serving mode instead of
+    a library-only capability (cli --sp N --max-seq-len ...).
+
+    Window layout: the prompt is right-padded into the sp-sharded context
+    window [0, ctx_len); generated tokens live in the replicated tail at
+    window positions ctx_len+k. With a full prompt (len == ctx_len — the
+    long-context case this mode exists for) positions coincide with the
+    dense path exactly; shorter prompts carry a positional gap between
+    prompt and generation (documented SP-mode semantics, masked
+    correctly either way).
+    """
+
+    def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
+                 tail_len: int):
+        if ctx_len % mesh.shape["sp"] != 0:
+            raise ValueError(
+                f"sp context window {ctx_len} must divide over sp="
+                f"{mesh.shape['sp']}")
+        self.ctx_len = ctx_len
+        self.tail_len = tail_len
+        # bounds the generator enforces: inclusive prompt length at encode
+        # time, and the number of decode steps the replicated tail holds
+        # (past it, dynamic_update_slice would clamp over live entries)
+        self.max_prompt_len = ctx_len
+        self.max_decode_tokens = tail_len
+        # the prefill allocates its own SPCache and ignores the passed-in
+        # cache (generator skips its fresh() copy accordingly)
+        self.allocates_cache = True
+        self._prefill, self._decode = make_sp_forward(
+            mesh, config, ctx_len, tail_len)
+
+    def __call__(self, params, tokens, cache, pos, rope,
+                 last_idx=None, is_prefill: bool = False):
+        if is_prefill:
+            B, S = tokens.shape
+            if S >= self.ctx_len:
+                # bucket padding may exceed the window; real tokens cannot
+                # (max_prompt_len) — trim pad, keep the window
+                toks = tokens[:, : self.ctx_len]
+            else:
+                toks = jnp.pad(tokens, ((0, 0), (0, self.ctx_len - S)))
+            plen = ((last_idx + 1).astype(jnp.int32)
+                    if last_idx is not None
+                    else jnp.full((B,), S, jnp.int32))
+            logits, spc = self._prefill(params, toks, plen, rope)
+            return logits, SPSessionCache(spc, plen)
+        # generator positions count from the prompt end; SP decode slots
+        # count from the context window end
+        k = pos - jnp.max(cache.plen)
+        logits, spc = self._decode(params, tokens,
+                                   jnp.int32(self.ctx_len) + k, cache.plen,
+                                   cache.sp, rope)
+        return logits, SPSessionCache(spc, cache.plen)

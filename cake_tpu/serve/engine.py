@@ -562,24 +562,30 @@ def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
 
     Same per-row semantics as the single-step path (_do_decode +
     _sample_rows — both go through _masked_sample): inactive rows touch
-    neither their cache lines nor their PRNG/ring state. Returns
-    ([B, num_steps] tokens, cache, keys, ring); the host mirrors
+    neither their cache lines nor their PRNG/ring state, and a row that
+    emits EOS mid-scan freezes for the remaining steps — in single-step
+    mode the scheduler frees the slot immediately, so without freezing
+    the slot's PRNG/ring stream would diverge between the two modes.
+    Returns ([B, num_steps] tokens, cache, keys, ring); the host mirrors
     (_pos/_steps/_last_tok) are advanced by the caller.
     """
     from cake_tpu.models.llama.model import forward_ragged
 
-    def body(carry, _):
-        tok, pos, cache, keys, ring, steps = carry
-        logits, cache = forward_ragged(params, tok[:, None], cache, pos,
-                                       active, rope, config)
-        nxt, keys, ring = _masked_sample(active, keys, logits, ring, steps,
-                                         temp, top_p, penalty, top_k=top_k)
-        tok = jnp.where(active, nxt, tok)
-        pos = pos + active
-        steps = steps + active
-        return (tok, pos, cache, keys, ring, steps), nxt
+    eos_ids = jnp.asarray(config.eos_token_ids, jnp.int32)
 
-    (tok, pos, cache, keys, ring, steps), toks = jax.lax.scan(
-        body, (last_tok, pos, cache, keys, ring, steps), None,
+    def body(carry, _):
+        tok, pos, cache, keys, ring, steps, live = carry
+        logits, cache = forward_ragged(params, tok[:, None], cache, pos,
+                                       live, rope, config)
+        nxt, keys, ring = _masked_sample(live, keys, logits, ring, steps,
+                                         temp, top_p, penalty, top_k=top_k)
+        tok = jnp.where(live, nxt, tok)
+        pos = pos + live
+        steps = steps + live
+        live = live & ~jnp.isin(nxt, eos_ids)
+        return (tok, pos, cache, keys, ring, steps, live), nxt
+
+    (tok, pos, cache, keys, ring, steps, live), toks = jax.lax.scan(
+        body, (last_tok, pos, cache, keys, ring, steps, active), None,
         length=num_steps)
     return toks.T, cache, keys, ring  # toks: [B, num_steps]

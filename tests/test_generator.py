@@ -67,7 +67,7 @@ def test_eos_detection():
 def test_prompt_too_long_raises(gen):
     gen.reset()
     gen.add_message(Message.user("y" * 500))
-    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+    with pytest.raises(ValueError, match="exceeds limit"):
         gen.next_token(0)
     gen.reset()
 
