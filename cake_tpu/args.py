@@ -50,6 +50,9 @@ class Args:
     repeat_penalty: float = 1.1
     repeat_last_n: int = 128
     dtype: str = "bf16"                 # f16 | bf16 | f32 (TPU default bf16)
+    # KV-cache storage dtype; fp8 halves KV HBM traffic/footprint (values
+    # upcast into the attention matmul on read). None = same as dtype.
+    kv_dtype: Optional[str] = None      # + f8_e4m3 | f8_e5m2
     cpu: bool = False
     device_idx: int = 0
     max_seq_len: int = 4096             # reference hard constant (config.rs:6); tunable here
@@ -88,6 +91,10 @@ class Args:
             raise ValueError(f"unsupported dtype '{self.dtype}'")
         if self.quant not in ("none", "int8"):
             raise ValueError(f"unsupported quant '{self.quant}'")
+        if self.kv_dtype is not None:
+            # single source of truth for storage dtypes
+            from cake_tpu.utils.devices import resolve_kv_dtype
+            resolve_kv_dtype(self.kv_dtype)
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

@@ -104,6 +104,9 @@ class Context:
             repeat_penalty=a.repeat_penalty, repeat_last_n=a.repeat_last_n,
         )
         max_seq = min(a.max_seq_len, cfg.max_position_embeddings)
+        from cake_tpu.utils.devices import resolve_kv_dtype
+        kv_dtype = (resolve_kv_dtype(a.kv_dtype) if a.kv_dtype
+                    else self.dtype)
 
         from cake_tpu.parallel.plan import ParallelPlan
         plan = ParallelPlan.from_topology(cfg, self.topology, args=a)
@@ -135,7 +138,9 @@ class Context:
                     f"sp={a.sp} after a {tail}-token decode tail; raise "
                     "--max-seq-len or lower --sample-len")
             mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
-            fwd = SPGeneratorForward(mesh, cfg, ctx_len, max_seq - ctx_len)
+            fwd = SPGeneratorForward(
+                mesh, cfg, ctx_len, max_seq - ctx_len,
+                kv_dtype=kv_dtype if a.kv_dtype else None)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths
@@ -143,7 +148,7 @@ class Context:
             from cake_tpu.models.llama.cache import KVCache
             kwargs = dict(forward_fn=fwd,
                           cache=KVCache.create(cfg, a.batch_size, 1,
-                                               dtype=self.dtype))
+                                               dtype=kv_dtype))
             log.info("sp serving: ring prefill over sp=%d, ctx=%d tail=%d",
                      a.sp, ctx_len, max_seq - ctx_len)
         elif plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
@@ -166,7 +171,7 @@ class Context:
                 cfg, a.batch_size, max_seq, mesh,
                 tp_axis="tp" if tp else None,
                 dp_axis="dp" if dp else None,
-                stage_axis="stage", dtype=self.dtype,
+                stage_axis="stage", dtype=kv_dtype,
             )
             params, cache = place_for_pipeline(params, cache, mesh,
                                                tp=tp, dp=dp)
@@ -183,7 +188,7 @@ class Context:
             cfg, params, tokenizer,
             max_seq_len=max_seq,
             batch_size=a.batch_size, sampling=sampling, seed=a.seed,
-            cache_dtype=self.dtype, prefill_chunk=a.prefill_chunk,
+            cache_dtype=kv_dtype, prefill_chunk=a.prefill_chunk,
             **kwargs,
         )
         from cake_tpu.utils.profiling import log_memory

@@ -96,6 +96,7 @@ class Master:
             sampling=g.sampling,
             seed=self.args.seed,
             decode_scan_steps=self.args.decode_scan,
+            cache_dtype=g.cache.k.dtype,  # follow --kv-dtype
             **kwargs,
         )
 

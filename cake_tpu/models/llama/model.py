@@ -133,7 +133,10 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             # over the in-window k/v IS the cached-decode mask, so the
             # kernel reads only the S fresh keys — no cache traffic.
             attn = flash_attention(q, k, v, causal=True)
-        elif use_flash and chunked and flash_supported(S, T, H, KV):
+        elif (use_flash and chunked and flash_supported(S, T, H, KV)
+                and kc.dtype == q.dtype):
+            # (dtype guard: the Pallas kernel reads the cache directly, so
+            # fp8-stored KV takes the einsum path, which upcasts on read)
             # Continued prefill at pos>0: the cache-aware kernel attends
             # the cache under kj <= pos+qi; key blocks past the frontier
             # neither compute nor DMA (index-map clamp).

@@ -29,6 +29,11 @@ def gqa_attention(q, k, v, *, mask=None, scale: float | None = None):
     G = H // KV  # query heads per kv head
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
+    if k.dtype != q.dtype:
+        # fp8 KV storage: upcast on read — XLA fuses the convert into the
+        # dot, so HBM still streams the narrow dtype
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
 
     qg = q.reshape(B, S, KV, G, hd)
     # scores: [B, KV, G, S, T] with f32 accumulation on the MXU

@@ -124,6 +124,10 @@ def partial_attention_stats(q, k, v, valid, *, scale: float | None = None):
     G = H // KV
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
+    if k.dtype != q.dtype:
+        # fp8 KV storage (--kv-dtype): upcast on read, fused into the dot
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     qg = q.reshape(B, S, KV, G, hd)
     s = _chunk_scores(qg, k, scale=scale)
     s = jnp.where(valid, s, NEG_INF)
@@ -198,8 +202,12 @@ class SPCache(NamedTuple):
 
 
 def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                    tail_len: int):
+                    tail_len: int, kv_dtype=None):
     """Build (sp_prefill, sp_decode) jitted over the mesh's "sp" axis.
+
+    kv_dtype: storage dtype for the SPCache (fp8 halves the sharded
+    long-context cache — the dominant allocation of this mode); values
+    upcast into attention on read. None = compute dtype.
 
     sp_prefill(params, tokens [B, ctx_len], plen [B], rope)
         -> (logits [B, V] f32, SPCache)   # tokens right-padded to ctx_len;
@@ -265,8 +273,10 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             def attn_fn(q, k, v):
                 q = apply_rope(q, rope_c, rope_s)
                 k = apply_rope(k, rope_c, rope_s)
-                tk2 = lax.dynamic_update_slice_in_dim(tk, k, t_slot, axis=1)
-                tv2 = lax.dynamic_update_slice_in_dim(tv, v, t_slot, axis=1)
+                tk2 = lax.dynamic_update_slice_in_dim(
+                    tk, k.astype(tk.dtype), t_slot, axis=1)
+                tv2 = lax.dynamic_update_slice_in_dim(
+                    tv, v.astype(tv.dtype), t_slot, axis=1)
                 out = sp_merged_attention(q, ck, cv, tk2, tv2,
                                           ctx_valid, tail_valid, "sp")
                 return out, (tk2, tv2)
@@ -306,15 +316,16 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             params["lm_head"], tokens, plen, rope.cos, rope.sin)
         B = tokens.shape[0]
         KV, hd = config.num_key_value_heads, config.head_dim
+        store = kv_dtype if kv_dtype is not None else ks.dtype
+        ks = ks.astype(store)
+        vs = vs.astype(store)
         # two separate allocations: aliased tail_k/tail_v would make the
         # first donated sp_decode try to donate one buffer twice (JAX
         # falls back to a copy, defeating the donation)
         shape = (config.num_hidden_layers, B, tail_len, KV, hd)
         rep = NamedSharding(mesh, P())
-        tail_k = lax.with_sharding_constraint(
-            jnp.zeros(shape, ks.dtype), rep)
-        tail_v = lax.with_sharding_constraint(
-            jnp.zeros(shape, ks.dtype), rep)
+        tail_k = lax.with_sharding_constraint(jnp.zeros(shape, store), rep)
+        tail_v = lax.with_sharding_constraint(jnp.zeros(shape, store), rep)
         return logits, SPCache(ks, vs, tail_k, tail_v)
 
     @partial(jax.jit, donate_argnames=("cache",))
@@ -357,7 +368,7 @@ class SPGeneratorForward:
     """
 
     def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                 tail_len: int):
+                 tail_len: int, kv_dtype=None):
         if ctx_len % mesh.shape["sp"] != 0:
             raise ValueError(
                 f"sp context window {ctx_len} must divide over sp="
@@ -373,7 +384,7 @@ class SPGeneratorForward:
         # cache (generator skips its fresh() copy accordingly)
         self.allocates_cache = True
         self._prefill, self._decode = make_sp_forward(
-            mesh, config, ctx_len, tail_len)
+            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype)
 
     def __call__(self, params, tokens, cache, pos, rope,
                  last_idx=None, is_prefill: bool = False):

@@ -18,6 +18,15 @@ _DTYPES = {
     "f32": jnp.float32,
 }
 
+# storage-only dtypes: valid for the KV cache (--kv-dtype), where values
+# are written once and upcast into the attention matmul on read — halves
+# KV HBM traffic/footprint — but not for weights/activations
+_KV_DTYPES = {
+    **_DTYPES,
+    "f8_e4m3": jnp.float8_e4m3fn,
+    "f8_e5m2": jnp.float8_e5m2,
+}
+
 
 def resolve_dtype(name: str):
     """Map a CLI dtype name to a jnp dtype (reference cake/mod.rs:54-60)."""
@@ -26,6 +35,17 @@ def resolve_dtype(name: str):
     except KeyError:
         raise ValueError(
             f"unsupported dtype '{name}' (expected one of {sorted(_DTYPES)})"
+        ) from None
+
+
+def resolve_kv_dtype(name: str):
+    """Map a --kv-dtype name (compute dtypes + fp8 storage variants)."""
+    try:
+        return _KV_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported kv dtype '{name}' "
+            f"(expected one of {sorted(_KV_DTYPES)})"
         ) from None
 
 

@@ -251,3 +251,17 @@ def test_sp_decode_budget_enforced():
     plen = np.full((1,), gen._forward_fn.ctx_len, np.int32)
     with pytest.raises(ValueError, match="decode budget"):
         gen.generate_on_device(prompt, plen, tail + 1)
+
+
+def test_sp_honors_kv_dtype():
+    """--sp --kv-dtype f8: the real SPCache (context + tail) must store at
+    the requested dtype, not just the placeholder."""
+    import jax.numpy as jnp
+    gen = _ctx(_mk_args(sp=4, max_seq_len=256, sample_len=8,
+                        kv_dtype="f8_e4m3")).load_text_model()
+    gen.add_message(Message.user("hello"))
+    toks = [gen.next_token(i).id for i in range(3)]
+    assert len(toks) == 3
+    cache = gen.cache  # SPSessionCache after the first prefill
+    assert cache.sp.ctx_k.dtype == jnp.float8_e4m3fn
+    assert cache.sp.tail_k.dtype == jnp.float8_e4m3fn
