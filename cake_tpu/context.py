@@ -139,8 +139,7 @@ class Context:
                     "--max-seq-len or lower --sample-len")
             mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
             fwd = SPGeneratorForward(
-                mesh, cfg, ctx_len, max_seq - ctx_len,
-                kv_dtype=kv_dtype if a.kv_dtype else None)
+                mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths

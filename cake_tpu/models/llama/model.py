@@ -143,10 +143,16 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             attn = flash_attention_cached(q, kc, vc, pos)
         else:
             if use_flash:
-                log.warning(
-                    "flash attention requested but unsupported for "
-                    "S=%d T=%d H=%d KV=%d (non-tileable shapes) — "
-                    "falling back to the einsum path", S, T, H, KV)
+                if chunked and kc.dtype != q.dtype:
+                    # intended fallback, not a shape problem
+                    log.debug(
+                        "chunked prefill with %s-stored KV takes the "
+                        "einsum path (upcast on read)", kc.dtype)
+                else:
+                    log.warning(
+                        "flash attention requested but unsupported for "
+                        "S=%d T=%d H=%d KV=%d (non-tileable shapes) — "
+                        "falling back to the einsum path", S, T, H, KV)
             attn = gqa_attention(q, kc, vc, mask=mask)
         return attn, (kc, vc)
 

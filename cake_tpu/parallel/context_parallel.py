@@ -232,7 +232,15 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             def attn_fn(q, k, v):
                 q = apply_rope(q, rope_c, rope_s)
                 k = apply_rope(k, rope_c, rope_s)
-                return ring_attention(q, k, v, "sp", causal=True), (k, v)
+                out = ring_attention(q, k, v, "sp", causal=True)
+                # cast to the storage dtype HERE so the scan stacks the
+                # cache directly at fp8 width — casting after the scan
+                # would hold full-precision and fp8 copies concurrently,
+                # raising peak HBM instead of halving it
+                if kv_dtype is not None:
+                    k = k.astype(kv_dtype)
+                    v = v.astype(kv_dtype)
+                return out, (k, v)
             h, (k, v) = block_skeleton(lp, h, config, attn_fn)
             return h, (k, v)
 
@@ -316,9 +324,7 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             params["lm_head"], tokens, plen, rope.cos, rope.sin)
         B = tokens.shape[0]
         KV, hd = config.num_key_value_heads, config.head_dim
-        store = kv_dtype if kv_dtype is not None else ks.dtype
-        ks = ks.astype(store)
-        vs = vs.astype(store)
+        store = ks.dtype  # prefill_body already stacks at the storage dtype
         # two separate allocations: aliased tail_k/tail_v would make the
         # first donated sp_decode try to donate one buffer twice (JAX
         # falls back to a copy, defeating the donation)

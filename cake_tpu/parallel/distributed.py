@@ -75,20 +75,16 @@ def initialize(coordinator: Optional[str] = None,
         or len([h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
                 if h.strip()]) > 1
     )
-    try:
-        jax.distributed.initialize(**kwargs)
-    except ValueError:
-        if kwargs or multi_worker:
-            # Explicit config or genuine multi-worker signals must fail
-            # fast — silently downgrading one worker to single-process
-            # would hang its peers in their first collective.
-            raise
+    if not kwargs and not multi_worker:
         # Single-worker pod-ish env (e.g. a TPU VM image or tunnel exports
-        # TPU_WORKER_HOSTNAMES with one entry) and auto-detection found no
-        # coordinator: this is a single-process run.
-        log.warning("distributed auto-init found no coordinator; "
-                    "running single-process")
+        # TPU_WORKER_HOSTNAMES with one entry): there are no peers to
+        # coordinate with, and attempting auto-init after the XLA backend
+        # is live (library use, REPL, tests) raises RuntimeError.
         return False
+    # Explicit config or a genuine multi-worker signal: let failures
+    # propagate — silently downgrading one worker to single-process
+    # would hang its peers in their first collective.
+    jax.distributed.initialize(**kwargs)
     log.info("distributed: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
              jax.local_device_count(), jax.device_count())

@@ -21,6 +21,23 @@ def test_initialize_requires_signal():
     assert initialize(env={"HOSTNAME": "x"}) is False
 
 
+def test_initialize_single_entry_hostnames_after_backend_init():
+    """A single-entry TPU_WORKER_HOSTNAMES (TPU VM images and the dev
+    tunnel export it) is not a multi-worker signal: initialize() must
+    no-op even after the XLA backend is live, where attempting
+    jax.distributed.initialize raises RuntimeError (regression: the CLI
+    path failed when called from a warm process)."""
+    jax.devices()  # ensure the backend is initialised
+    assert initialize(env={"TPU_WORKER_HOSTNAMES": "localhost"}) is False
+
+
+def test_initialize_multi_worker_failfast_after_backend_init():
+    # a genuine multi-worker signal must NOT silently downgrade
+    jax.devices()
+    with pytest.raises(RuntimeError):
+        initialize(env={"TPU_WORKER_HOSTNAMES": "host0,host1"})
+
+
 def test_is_coordinator_single_process():
     assert is_coordinator() is True
 
