@@ -217,7 +217,12 @@ def run_engine_tier(name: str, model: str, quant: bool, max_seq: int,
     prompt = list(range(3, 3 + prompt_len))
     with engine:
         t0 = time.perf_counter()
-        warm = engine.submit(prompt, max_new_tokens=4)
+        # 32 = 3 full 8-step scans + a <8 single-step tail: compiles BOTH
+        # decode programs (a shorter warmup never reaches the scan path —
+        # _scan_steps_for falls back to single-step when the remaining
+        # budget is under decode_scan_steps — and the scan's compile would
+        # then land inside the measured decode_time_s)
+        warm = engine.submit(prompt, max_new_tokens=32)
         assert warm.wait(timeout=900), "warmup request timed out"
         log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
         base_tokens = engine.stats.tokens_generated

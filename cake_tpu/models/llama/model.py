@@ -143,7 +143,8 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             attn = flash_attention_cached(q, kc, vc, pos)
         else:
             if use_flash:
-                if chunked and kc.dtype != q.dtype:
+                if (chunked and flash_supported(S, T, H, KV)
+                        and kc.dtype != q.dtype):
                     # intended fallback, not a shape problem
                     log.debug(
                         "chunked prefill with %s-stored KV takes the "
