@@ -44,7 +44,11 @@ def log(*a):
 # a number is the headline. int8 8B is the flagship: ~8.5 GiB resident on a
 # 16 GiB v5e vs ~15 GiB params alone for bf16 8B.
 TIERS = [
-    ("llama3_8b_int8", dict(model="8b", quant=True, max_seq=1024)),
+    # int8 beats int4 at batch-1 on v5e (80.9 vs 61.3 tok/s): the int4
+    # kernel's nibble unpack is VPU-bound and cannot amortize over one
+    # row; int4 wins in the batched engine tier below instead
+    ("llama3_8b_int8", dict(model="8b", quant="int8", max_seq=1024)),
+    ("llama3_8b_int4", dict(model="8b", quant="int4", max_seq=1024)),
     ("llama3_8b", dict(model="8b", quant=False, max_seq=1024)),
     ("llama3_3b-ish", dict(model="3b", quant=False, max_seq=1024)),
     ("llama3_1b-ish", dict(model="1b", quant=False, max_seq=512)),
@@ -55,8 +59,10 @@ TIERS = [
 # the reference master.rs:93-121 timing semantics (compile excluded via a
 # warmup request). Merged into the headline JSON as extra keys.
 ENGINE_TIERS = [
-    ("engine_8b_int8", dict(model="8b", quant=True, max_seq=512)),
-    ("engine_1b", dict(model="1b", quant=False, max_seq=512)),
+    # 16 slots measured as the v5e throughput sweet spot: 408 tok/s agg
+    # vs 215 at 8 slots and 151 at 32 (32-slot cache + weights thrash HBM)
+    ("engine_8b_int8", dict(model="8b", quant=True, max_seq=512, slots=16)),
+    ("engine_1b", dict(model="1b", quant=False, max_seq=512, slots=16)),
 ]
 
 # CPU-runnable smoke tiers (tests/test_bench.py exercises them via
@@ -65,7 +71,9 @@ ENGINE_TIERS = [
 SMOKE_TIERS = {
     "tiny": dict(model="tiny", quant=False, max_seq=128,
                  prompt_len=16, gen_tokens=8),
-    "tiny_int8": dict(model="tiny", quant=True, max_seq=128,
+    "tiny_int8": dict(model="tiny", quant="int8", max_seq=128,
+                      prompt_len=16, gen_tokens=8),
+    "tiny_int4": dict(model="tiny", quant="int4", max_seq=128,
                       prompt_len=16, gen_tokens=8),
     "engine_tiny": dict(model="tiny", quant=False, max_seq=128,
                         slots=2, prompt_len=16, gen_tokens=8),
@@ -110,11 +118,12 @@ def make_config(model: str):
 def param_bytes(params) -> tuple[int, int]:
     """(logical param count, resident bytes) over a maybe-quantized tree."""
     import jax
-    from cake_tpu.ops.quant import QTensor
+    from cake_tpu.ops.quant import QTensor, is_groupwise
     n = b = 0
     for leaf in jax.tree.leaves(params, is_leaf=lambda x: isinstance(x, QTensor)):
         if isinstance(leaf, QTensor):
-            n += leaf.q.size
+            # packed int4 stores two logical weights per byte
+            n += leaf.q.size * (2 if is_groupwise(leaf) else 1)
             b += leaf.q.size * leaf.q.dtype.itemsize
             b += leaf.scale.size * leaf.scale.dtype.itemsize
         else:
@@ -123,7 +132,19 @@ def param_bytes(params) -> tuple[int, int]:
     return n, b
 
 
-def run_tier(name: str, model: str, quant: bool, max_seq: int,
+def _init_fn(quant):
+    """quant: False/None = full precision, True/"int8" = int8, "int4"."""
+    from functools import partial
+
+    from cake_tpu.models.llama.params import init_params, init_params_quantized
+    if not quant:
+        return init_params, "bf16"
+    bits = 4 if quant == "int4" else 8
+    return (partial(init_params_quantized, bits=bits),
+            f"int{bits} weight-only")
+
+
+def run_tier(name: str, model: str, quant, max_seq: int,
              batch_size: int = 1, prompt_len: int = 128,
              gen_tokens: int = 128) -> dict:
     from functools import partial
@@ -132,7 +153,6 @@ def run_tier(name: str, model: str, quant: bool, max_seq: int,
     import numpy as np
 
     from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
-    from cake_tpu.models.llama.params import init_params, init_params_quantized
     from cake_tpu.ops.sampling import SamplingConfig
 
     dev = jax.devices()[0]
@@ -140,12 +160,12 @@ def run_tier(name: str, model: str, quant: bool, max_seq: int,
     hbm_bps = device_bandwidth(dev.device_kind)
 
     cfg = make_config(model)
-    init = init_params_quantized if quant else init_params
+    init, qdesc = _init_fn(quant)
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     n_params, resident = param_bytes(params)
     log(f"params: {n_params/1e9:.2f}B logical, {resident/2**30:.1f} GiB "
-        f"resident ({'int8 weight-only' if quant else 'bf16'})")
+        f"resident ({qdesc})")
 
     gen = LlamaGenerator(
         cfg, params, ByteTokenizer(cfg.vocab_size),
@@ -182,7 +202,7 @@ def run_tier(name: str, model: str, quant: bool, max_seq: int,
     }
 
 
-def run_engine_tier(name: str, model: str, quant: bool, max_seq: int,
+def run_engine_tier(name: str, model: str, quant, max_seq: int,
                     slots: int = 8, prompt_len: int = 128,
                     gen_tokens: int = 64) -> dict:
     """p50 TTFT + decode tok/s through InferenceEngine (the API path).
@@ -195,14 +215,13 @@ def run_engine_tier(name: str, model: str, quant: bool, max_seq: int,
     import jax
 
     from cake_tpu.models.llama.generator import ByteTokenizer
-    from cake_tpu.models.llama.params import init_params, init_params_quantized
     from cake_tpu.ops.sampling import SamplingConfig
     from cake_tpu.serve.engine import InferenceEngine
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform}/{dev.device_kind}")
     cfg = make_config(model)
-    init = init_params_quantized if quant else init_params
+    init, _ = _init_fn(quant)
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
 

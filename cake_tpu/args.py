@@ -82,14 +82,15 @@ class Args:
     # on shutdown (serve/checkpoint.py; the reference has no runtime
     # checkpointing, SURVEY.md §5)
     checkpoint: Optional[str] = None
-    # weight quantization: "int8" halves decode HBM traffic via weight-only
-    # per-channel int8 (ops/quant.py); "none" keeps args.dtype weights
+    # weight quantization (ops/quant.py): "int8" halves decode HBM traffic
+    # (weight-only per-channel), "int4" quarters it (group-wise, dense
+    # models only); "none" keeps args.dtype weights
     quant: str = "none"
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
             raise ValueError(f"unsupported dtype '{self.dtype}'")
-        if self.quant not in ("none", "int8"):
+        if self.quant not in ("none", "int8", "int4"):
             raise ValueError(f"unsupported quant '{self.quant}'")
         if self.kv_dtype is not None:
             # single source of truth for storage dtypes

@@ -92,12 +92,18 @@ class Context:
 
         from cake_tpu.models import load_text_params
         params = load_text_params(cfg, a.model, self.dtype)
-        if a.quant == "int8":
+        if a.quant in ("int8", "int4"):
+            from functools import partial
+
             from cake_tpu.ops.quant import quantize_params
-            # donate: frees each bf16 buffer as its int8 copy materialises,
-            # so an 8B model quantizes without 1.5x peak HBM
-            params = jax.jit(quantize_params, donate_argnums=0)(params)
-            log.info("weights quantized to int8 (weight-only, per-channel)")
+            bits = 8 if a.quant == "int8" else 4
+            # donate: frees each full-precision buffer as its quantized
+            # copy materialises, so an 8B model quantizes without 1.5x
+            # peak HBM
+            params = jax.jit(partial(quantize_params, bits=bits),
+                             donate_argnums=0)(params)
+            log.info("weights quantized to %s (weight-only, %s)", a.quant,
+                     "per-channel" if bits == 8 else "group-wise")
 
         sampling = SamplingConfig(
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,

@@ -57,17 +57,18 @@ def init_params(config: LlamaConfig, rng: jax.Array, dtype=jnp.bfloat16):
 
 
 def init_params_quantized(config: LlamaConfig, rng: jax.Array,
-                          dtype=jnp.bfloat16):
-    """Random int8-quantized params built directly on device.
+                          dtype=jnp.bfloat16, bits: int = 8):
+    """Random quantized params built directly on device (int8 per-channel
+    or int4 group-wise, matching ``quantize_params(..., bits=bits)``).
 
     Produces the same pytree structure as ``quantize_params(init_params(...))``
     without ever materialising the full-precision tree — a bf16 8B tree is
     ~15 GiB, i.e. most of a v5e's HBM, so the quantize-after-init path is
     dead on arrival there. Benchmarks are weight-value independent
-    (bench.py), so random int8 + constant scales are as good as quantized
-    real weights.
+    (bench.py), so random weights + constant scales are as good as
+    quantized real weights.
     """
-    from cake_tpu.ops.quant import _BLOCK_CONTRACT, QTensor
+    from cake_tpu.ops.quant import _BLOCK_CONTRACT, QTensor, pick_group
 
     c = config
     L, D, F = c.num_hidden_layers, c.hidden_size, c.intermediate_size
@@ -75,12 +76,25 @@ def init_params_quantized(config: LlamaConfig, rng: jax.Array,
     keys = jax.random.split(rng, 12)
     kit = iter(keys)
 
-    def qleaf(shape, contract_dims, fan_in):
-        q = jax.random.randint(next(kit), shape, -127, 128, dtype=jnp.int8)
-        scale_shape = tuple(s for i, s in enumerate(shape)
-                            if i not in contract_dims)
+    def qleaf(shape, contract_dims, fan_in, leaf_bits=None):
+        qmax = 127 if (leaf_bits or bits) == 8 else 7
+        if (leaf_bits or bits) == 4:
+            # random bytes ARE the packed group-halves stream — each
+            # nibble is a uniform int4, which is all a weight-value-
+            # independent benchmark needs
+            cd = contract_dims[0]
+            g = pick_group(shape[cd])
+            q = jax.random.randint(
+                next(kit), shape[:cd] + (shape[cd] // 2,) + shape[cd + 1:],
+                0, 256, dtype=jnp.uint8)
+            scale_shape = (shape[:cd] + (shape[cd] // g,) + shape[cd + 1:])
+        else:
+            q = jax.random.randint(next(kit), shape, -qmax, qmax + 1,
+                                   dtype=jnp.int8)
+            scale_shape = tuple(s for i, s in enumerate(shape)
+                                if i not in contract_dims)
         # scale chosen so dequantized weights have the init std ~1/sqrt(fan_in)
-        scale = jnp.full(scale_shape, 1.0 / (127.0 * np.sqrt(fan_in)),
+        scale = jnp.full(scale_shape, 1.0 / (qmax * np.sqrt(fan_in)),
                          jnp.float32)
         return QTensor(q=q, scale=scale)
 
@@ -103,7 +117,9 @@ def init_params_quantized(config: LlamaConfig, rng: jax.Array,
         "embed": w((c.vocab_size, D), D),
         "blocks": blocks,
         "final_norm": jnp.ones((D,), dtype),
-        "lm_head": qleaf((D, c.vocab_size), (0,), D),
+        # lm_head stays int8 at bits=4 (quantize_params parity: the vocab
+        # width fragments the int4 kernel's blocks; int8 is roofline there)
+        "lm_head": qleaf((D, c.vocab_size), (0,), D, leaf_bits=8),
     }
 
 

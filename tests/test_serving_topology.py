@@ -137,6 +137,21 @@ def test_engine_int8_over_topology(topo_path):
     assert len(toks) == 4
 
 
+def test_engine_int4_over_topology(topo_path):
+    """--quant int4 (packed group-wise) composes with a 2-stage topology:
+    the packed q and group scales place with matching specs and the
+    pipelined forward decodes."""
+    gen = _ctx(_mk_args(topology=topo_path, quant="int4")).load_text_model()
+    from cake_tpu.ops.quant import QTensor, is_groupwise
+    wq = gen.params["blocks"]["wq"]
+    assert isinstance(wq, QTensor) and is_groupwise(wq)
+    assert wq.q.sharding.spec[0] == "stage"
+    assert wq.scale.sharding.spec[0] == "stage"
+    gen.add_message(Message.user("hi"))
+    toks = [gen.next_token(i).id for i in range(4)]
+    assert len(toks) == 4
+
+
 def test_int8_place_for_pipeline_specs(topo_path):
     """QTensor scale specs drop contracted dims: wo is [L, D, D] (square),
     which shape-matching cannot disambiguate — the name-driven rule must
