@@ -65,9 +65,16 @@ ENGINE_TIERS = [
     ("engine_1b", dict(model="1b", quant=False, max_seq=512, slots=16)),
 ]
 
-# CPU-runnable smoke tiers (tests/test_bench.py exercises them via
-# CAKE_BENCH_TIER=tiny / tiny_int8 / engine_tiny); never part of the real
-# fallback chain.
+# SD tier (BASELINE config #4 analog on one chip): per-denoise-step
+# latency — the metric the reference itself logs (sd.rs:469, 506-507) —
+# plus the 20-step txt2img wall time. Merged into the headline JSON as
+# extra keys; random-init weights (latency is weight-value independent).
+SD_TIERS = [
+    ("sd15_txt2img", dict(version="v1-5", height=512, width=512)),
+]
+
+# CPU-runnable smoke tiers (tests/test_bench.py exercises each via
+# CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
     "tiny": dict(model="tiny", quant=False, max_seq=128,
                  prompt_len=16, gen_tokens=8),
@@ -77,6 +84,9 @@ SMOKE_TIERS = {
                       prompt_len=16, gen_tokens=8),
     "engine_tiny": dict(model="tiny", quant=False, max_seq=128,
                         slots=2, prompt_len=16, gen_tokens=8),
+    # steps_b - steps_a must dwarf timing noise: with a tiny unet the
+    # fixed CLIP/VAE/PNG overhead dominates a 2-step delta
+    "sd_tiny": dict(version="tiny", steps_a=2, steps_b=12),
 }
 
 # HBM bandwidth (bytes/s) by device_kind substring; conservative defaults.
@@ -273,12 +283,80 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
     }
 
 
+def run_sd_tier(name: str, version: str, height: int | None = None,
+                width: int | None = None, steps_a: int = 20,
+                steps_b: int = 40) -> dict:
+    """Per-denoise-step latency via two-point differencing: running the
+    same prompt at steps_a and steps_b isolates the step cost from the
+    fixed CLIP-encode + VAE-decode + PNG overhead, with no timing hooks
+    inside the generator (same quantity the reference logs per step,
+    sd.rs:469, 506-507)."""
+    import jax
+
+    from cake_tpu.args import ImageGenerationArgs, SDVersion
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.config import get_sd_config, tiny_sd_config
+    from cake_tpu.models.sd.sd import SDGenerator, SimpleClipTokenizer
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    if version == "tiny":
+        cfg = tiny_sd_config()
+    else:
+        cfg = get_sd_config(SDVersion(version), height=height, width=width)
+    params = {
+        "clip": init_clip_params(cfg.clip, jax.random.PRNGKey(0)),
+        "unet": init_unet_params(cfg.unet, jax.random.PRNGKey(1)),
+        "vae": init_vae_params(cfg.vae, jax.random.PRNGKey(2)),
+    }
+    toks = [SimpleClipTokenizer(cfg.clip.vocab_size)]
+    if cfg.clip2 is not None:
+        toks.append(SimpleClipTokenizer(cfg.clip2.vocab_size))
+    gen = SDGenerator(cfg, params, toks)
+
+    def run(n):
+        out = []
+        gen.generate_image(
+            ImageGenerationArgs(image_prompt="a robot painting a sunset",
+                                sd_n_steps=n, sd_num_samples=1, sd_seed=7),
+            lambda imgs: out.extend(imgs))
+        assert out and out[0][:4] == b"\x89PNG"[:4]
+
+    t0 = time.perf_counter()
+    run(steps_a)
+    log(f"first image (compile+run, {steps_a} steps): "
+        f"{time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    run(steps_a)
+    t_a = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(steps_b)
+    t_b = time.perf_counter() - t0
+    step_ms = (t_b - t_a) / (steps_b - steps_a) * 1e3
+    log(f"{steps_a}-step image {t_a:.2f}s, {steps_b}-step {t_b:.2f}s -> "
+        f"{step_ms:.1f} ms/denoise-step")
+    return {
+        "metric": f"{name}_denoise_step",
+        "value": round(step_ms, 1),
+        "unit": "ms/step",
+        "vs_baseline": 0.0,
+        "sd_step_ms": round(step_ms, 1),
+        "sd_image_s": round(t_a, 2),
+        "sd_steps": steps_a,
+    }
+
+
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
     if name in dict(ENGINE_TIERS) or name == "engine_tiny":
         kwargs = {**dict(ENGINE_TIERS), **SMOKE_TIERS}[name]
         result = run_engine_tier(name, **kwargs)
+    elif name in dict(SD_TIERS) or name == "sd_tiny":
+        kwargs = {**dict(SD_TIERS), **SMOKE_TIERS}[name]
+        result = run_sd_tier(name, **kwargs)
     else:
         kwargs = {**dict(TIERS), **SMOKE_TIERS}[name]
         result = run_tier(name, **kwargs)
@@ -329,6 +407,14 @@ def main():
             if eres is not None:
                 result.update({k: v for k, v in eres.items()
                                if k.startswith(("ttft_", "engine_"))})
+                break
+        # SD per-step latency (BASELINE config #4) — extra keys, same
+        # failure isolation
+        for sname, _kw in SD_TIERS:
+            sres = _run_tier_subprocess(sname)
+            if sres is not None:
+                result.update({k: v for k, v in sres.items()
+                               if k.startswith("sd_")})
                 break
         print(json.dumps(result), flush=True)
         return

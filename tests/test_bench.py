@@ -28,7 +28,7 @@ def _run_tier(name: str) -> dict:
     return json.loads(line)
 
 
-@pytest.mark.parametrize("tier", ["tiny", "tiny_int8"])
+@pytest.mark.parametrize("tier", ["tiny", "tiny_int8", "tiny_int4"])
 def test_smoke_tier_json_contract(tier):
     result = _run_tier(tier)
     for key in ("metric", "value", "unit", "vs_baseline"):
@@ -36,6 +36,14 @@ def test_smoke_tier_json_contract(tier):
     assert result["value"] > 0
     assert result["unit"] == "tokens/s"
     assert tier in result["metric"]
+
+
+def test_sd_smoke_tier_reports_step_latency():
+    result = _run_tier("sd_tiny")
+    assert result["value"] > 0
+    assert result["unit"] == "ms/step"
+    assert result["sd_step_ms"] > 0
+    assert result["sd_image_s"] > 0
 
 
 def test_engine_smoke_tier_reports_ttft():
