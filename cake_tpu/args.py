@@ -86,6 +86,11 @@ class Args:
     # (weight-only per-channel), "int4" quarters it (group-wise, dense
     # models only); "none" keeps args.dtype weights
     quant: str = "none"
+    # speculative decoding (models/llama/speculative.py): path to a small
+    # draft model sharing the target's tokenizer; each target pass then
+    # verifies spec_gamma drafted tokens at once. Batch-1, single-device.
+    draft_model: Optional[str] = None
+    spec_gamma: int = 4
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -99,7 +104,7 @@ class Args:
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
-                     "max_slots", "decode_scan"):
+                     "max_slots", "decode_scan", "spec_gamma"):
             if getattr(self, knob) < 1:
                 raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self

@@ -92,18 +92,7 @@ class Context:
 
         from cake_tpu.models import load_text_params
         params = load_text_params(cfg, a.model, self.dtype)
-        if a.quant in ("int8", "int4"):
-            from functools import partial
-
-            from cake_tpu.ops.quant import quantize_params
-            bits = 8 if a.quant == "int8" else 4
-            # donate: frees each full-precision buffer as its quantized
-            # copy materialises, so an 8B model quantizes without 1.5x
-            # peak HBM
-            params = jax.jit(partial(quantize_params, bits=bits),
-                             donate_argnums=0)(params)
-            log.info("weights quantized to %s (weight-only, %s)", a.quant,
-                     "per-channel" if bits == 8 else "group-wise")
+        params = self._maybe_quantize(params)
 
         sampling = SamplingConfig(
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,
@@ -189,16 +178,77 @@ class Context:
                           parallel=(plan, mesh))
             log.info("topology-sharded serving:\n%s", plan.describe())
 
-        gen = LlamaGenerator(
-            cfg, params, tokenizer,
-            max_seq_len=max_seq,
-            batch_size=a.batch_size, sampling=sampling, seed=a.seed,
-            cache_dtype=kv_dtype, prefill_chunk=a.prefill_chunk,
-            **kwargs,
-        )
+        if a.draft_model is not None:
+            if kwargs or a.batch_size != 1:
+                raise ValueError(
+                    "--draft-model (speculative decoding) is batch-1 "
+                    "single-device; it does not compose with "
+                    "--sp/--tp/--dp/topology stages")
+            if a.prefill_chunk is not None:
+                raise ValueError(
+                    "--prefill-chunk is not supported with --draft-model "
+                    "(speculative prefill is whole-prompt)")
+            gen = self._load_speculative(cfg, params, tokenizer, sampling,
+                                         max_seq, kv_dtype)
+        else:
+            gen = LlamaGenerator(
+                cfg, params, tokenizer,
+                max_seq_len=max_seq,
+                batch_size=a.batch_size, sampling=sampling, seed=a.seed,
+                cache_dtype=kv_dtype, prefill_chunk=a.prefill_chunk,
+                **kwargs,
+            )
         from cake_tpu.utils.profiling import log_memory
         log_memory("model loaded")  # reference llama.rs:233-236
         return gen
+
+    def _maybe_quantize(self, params):
+        """Apply --quant to a param tree (donating: frees each
+        full-precision buffer as its quantized copy materialises, so an 8B
+        model quantizes without 1.5x peak HBM)."""
+        a = self.args
+        if a.quant not in ("int8", "int4"):
+            return params
+        from functools import partial
+
+        from cake_tpu.ops.quant import quantize_params
+        bits = 8 if a.quant == "int8" else 4
+        params = jax.jit(partial(quantize_params, bits=bits),
+                         donate_argnums=0)(params)
+        log.info("weights quantized to %s (weight-only, %s)", a.quant,
+                 "per-channel" if bits == 8 else "group-wise")
+        return params
+
+    def _load_speculative(self, cfg, params, tokenizer, sampling, max_seq,
+                          kv_dtype):
+        import dataclasses
+
+        from cake_tpu.models import load_text_params
+        from cake_tpu.models.llama.config import LlamaConfig, load_config
+        from cake_tpu.models.llama.speculative import SpeculativeGenerator
+
+        a = self.args
+        d_dir = a.draft_model
+        if os.path.exists(os.path.join(d_dir or "", "config.json")):
+            d_cfg = dataclasses.replace(
+                load_config(d_dir), use_flash_attention=_resolve_flash(a))
+        else:
+            d_cfg = dataclasses.replace(
+                LlamaConfig.tiny(), use_flash_attention=_resolve_flash(a))
+        if d_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {d_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}: speculation verifies draft token ids "
+                "directly, so the models must share a tokenizer")
+        d_params = self._maybe_quantize(
+            load_text_params(d_cfg, d_dir, self.dtype))
+        log.info("speculative serving: gamma=%d draft=%s", a.spec_gamma,
+                 d_dir or "<random tiny>")
+        return SpeculativeGenerator(
+            cfg, params, d_cfg, d_params, tokenizer,
+            gamma=a.spec_gamma, max_seq_len=max_seq, sampling=sampling,
+            seed=a.seed, cache_dtype=kv_dtype,
+        )
 
     def load_image_model(self):
         from cake_tpu.models.sd.sd import SDGenerator

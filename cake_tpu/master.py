@@ -56,6 +56,15 @@ class Master:
             raise RuntimeError("no text generator loaded")
         from cake_tpu.serve import InferenceEngine
         g = self.llm
+        from cake_tpu.models.llama.speculative import SpeculativeGenerator
+        if isinstance(g, SpeculativeGenerator):
+            # the batched engine has no draft/verify step contract yet;
+            # silently serving target-only would drop the speculation the
+            # user asked for
+            raise ValueError(
+                "continuous-batching/API serving does not support "
+                "--draft-model (speculation is a batch-1 latency mode); "
+                "drop --api or --draft-model")
         if getattr(g, "_forward_fn", None) is not None and g.parallel is None:
             # a custom forward without a (plan, mesh) — e.g. the --sp
             # adapter — has no engine-step contract; silently serving a

@@ -1,0 +1,328 @@
+"""Speculative decoding: draft-model propose, one target verify pass.
+
+A capability past the reference's surface (it decodes strictly one token
+per model pass, llama.rs:285-298): a small draft model proposes gamma
+tokens autoregressively, the target scores all of them in ONE forward
+(logits at every position, model.forward_logits_all), and the standard
+accept/resample rule keeps the leading agreeing prefix plus one
+correction token — so each target pass yields 1..gamma+1 tokens. With
+greedy sampling the output is the target's own greedy stream
+(tests/test_speculative.py asserts token-for-token equality against
+LlamaGenerator), up to one caveat shared by every speculative
+implementation: the verify pass scores gamma+1 positions in one batched
+forward, whose bf16 accumulation order differs from stepwise decode by
+~1e-2 logits — when the target's top-2 logits tie within that noise,
+the two evaluation shapes may break the tie differently. Both streams
+are valid greedy outputs of the same model. With temperature sampling
+the accept/resample rule preserves the target distribution (Leviathan
+et al., 2023 — public algorithm).
+
+TPU shape: one jitted program per spec step — the draft loop is a
+lax.scan of gamma+1 decode steps (the +1 writes the last draft's KV so an
+all-accept step needs no patch-up pass), the verify is a single
+cache-aware chunked forward of gamma+1 tokens, and accept/resample is
+branch-free arithmetic on the stacked logits. Nothing rolls back: both
+caches index KV by absolute position, and positions past the accepted
+frontier are masked (decode_mask) until overwritten, exactly like padded
+prefill garbage.
+
+Scope (v1): batch 1 (speculation is a latency feature), single device,
+repeat_penalty == 1.0 (the verify pass scores gamma+1 positions in
+parallel, so a within-burst penalty ring cannot be replayed exactly).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import Token
+from cake_tpu.models.chat import History, Message
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    bucket_length, encode_text, incremental_decode,
+)
+from cake_tpu.models.llama.model import (
+    RopeTables, forward, forward_logits_all, prefill,
+)
+from cake_tpu.ops.sampling import SamplingConfig
+
+log = logging.getLogger(__name__)
+
+
+@partial(jax.jit,
+         static_argnames=("t_cfg", "d_cfg", "gamma", "greedy"),
+         donate_argnames=("t_cache", "d_cache"))
+def spec_step(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
+              last_tok, pos, t_rope: RopeTables, d_rope: RopeTables,
+              rng, temperature,
+              t_cfg: LlamaConfig, d_cfg: LlamaConfig,
+              gamma: int, greedy: bool):
+    """One propose-verify-accept round.
+
+    last_tok [1, 1] at absolute `pos` (its KV not yet written).
+    Returns (tokens [1, gamma+1] — first n_emit valid, rest -1,
+    n_emit scalar, t_cache, d_cache, rng).
+    """
+    B = last_tok.shape[0]
+
+    def draft_body(carry, i):
+        cache, tok, p, rng = carry
+        logits, cache = forward(d_params, tok, cache, p, d_rope, d_cfg)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            probs = jax.nn.softmax(logits, axis=-1)
+        else:
+            rng, sub = jax.random.split(rng)
+            probs = jax.nn.softmax(logits / temperature, axis=-1)
+            nxt = jax.random.categorical(sub, logits / temperature
+                                         ).astype(jnp.int32)
+        return (cache, nxt[:, None], p + 1, rng), (nxt, probs)
+
+    # gamma+1 iterations: iteration gamma writes the gamma-th draft's KV
+    # (needed when every draft is accepted) and its proposal is discarded
+    (d_cache, _, _, rng), (drafts_all, d_probs_all) = jax.lax.scan(
+        draft_body, (d_cache, last_tok, pos, rng),
+        jnp.arange(gamma + 1))
+    drafts = drafts_all[:gamma].T                      # [B, gamma]
+    d_probs = jnp.swapaxes(d_probs_all[:gamma], 0, 1)  # [B, gamma, V]
+
+    # verify: target scores [last_tok, d_0..d_{gamma-1}] in one pass,
+    # writing target KV for positions pos..pos+gamma
+    tokens_v = jnp.concatenate([last_tok, drafts], axis=1)  # [B, gamma+1]
+    t_logits, t_cache = forward_logits_all(
+        t_params, tokens_v, t_cache, pos, t_rope, t_cfg)   # [B, g+1, V]
+
+    if greedy:
+        targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        match = (drafts == targets[:, :gamma])             # [B, gamma]
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(acc, axis=1)                       # [B]
+        # emitted = targets[:, :n_acc+1] (accepted drafts equal targets;
+        # position n_acc is the correction / bonus token)
+        out = targets
+        n_emit = n_acc + 1
+    else:
+        t_probs = jax.nn.softmax(t_logits / temperature, axis=-1)
+        idx = drafts[..., None]                            # [B, gamma, 1]
+        p_t = jnp.take_along_axis(t_probs[:, :gamma], idx, axis=-1)[..., 0]
+        p_d = jnp.take_along_axis(d_probs, idx, axis=-1)[..., 0]
+        rng, sub = jax.random.split(rng)
+        u = jax.random.uniform(sub, p_t.shape)
+        accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+        acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(acc, axis=1)
+        # residual distribution at the first rejected position r:
+        # norm(max(0, p_t - p_d)); at r == gamma (all accepted) the bonus
+        # token samples from the target's own distribution
+        r = jnp.minimum(n_acc, gamma)
+        row = jnp.arange(B)
+        p_t_r = t_probs[row, r]                            # [B, V]
+        p_d_r = jnp.where((r < gamma)[:, None],
+                          d_probs[row, jnp.minimum(r, gamma - 1)], 0.0)
+        resid = jnp.maximum(p_t_r - p_d_r, 0.0)
+        resid = resid / jnp.maximum(jnp.sum(resid, -1, keepdims=True),
+                                    1e-20)
+        rng, sub = jax.random.split(rng)
+        correction = jax.random.categorical(
+            sub, jnp.log(jnp.maximum(resid, 1e-20))).astype(jnp.int32)
+        out = jnp.where(jnp.arange(gamma + 1)[None] ==
+                        jnp.minimum(n_acc, gamma)[:, None],
+                        correction[:, None],
+                        jnp.concatenate(
+                            [drafts, drafts[:, -1:]], axis=1))
+        n_emit = n_acc + 1
+
+    mask = jnp.arange(gamma + 1)[None] < n_emit[:, None]
+    out = jnp.where(mask, out, -1)
+    return out, n_emit, t_cache, d_cache, rng
+
+
+class SpeculativeGenerator:
+    """TextGenerator with draft-model speculation (batch 1).
+
+    Exposes the same protocol as LlamaGenerator plus acceptance stats;
+    next_token streams from an internal burst buffer so the CLI/API token
+    loop is unchanged.
+    """
+
+    MODEL_NAME = "llama3-spec"
+
+    def __init__(self, config: LlamaConfig, params,
+                 draft_config: LlamaConfig, draft_params,
+                 tokenizer, *, gamma: int = 4, max_seq_len: int = 4096,
+                 sampling: Optional[SamplingConfig] = None,
+                 seed: int = 299792458, cache_dtype=jnp.bfloat16):
+        if gamma < 1:
+            raise ValueError("gamma must be >= 1")
+        sampling = sampling or SamplingConfig()
+        if sampling.repeat_penalty != 1.0:
+            raise ValueError(
+                "speculative decoding supports repeat_penalty=1.0 only "
+                "(the verify pass scores the burst in parallel)")
+        if sampling.top_k is not None or (sampling.top_p or 1.0) < 1.0:
+            raise ValueError(
+                "speculative decoding samples from the full temperature "
+                "softmax; top_k/top_p are not supported (the accept/"
+                "resample identity assumes the unfiltered distributions)")
+        self.config = config
+        self.params = params
+        self.draft_config = draft_config
+        self.draft_params = draft_params
+        self.tokenizer = tokenizer
+        self.gamma = gamma
+        self.max_seq_len = max_seq_len
+        self.sampling = sampling
+        self.rope = RopeTables.create(config, max_seq_len)
+        self.d_rope = RopeTables.create(draft_config, max_seq_len)
+        self.cache = KVCache.create(config, 1, max_seq_len,
+                                    dtype=cache_dtype)
+        self.d_cache = KVCache.create(draft_config, 1, max_seq_len,
+                                      dtype=cache_dtype)
+        self.history = History()
+        self.rng = jax.random.PRNGKey(seed)
+        self.proposed = 0        # drafts offered to the verifier
+        self.accepted = 0        # drafts kept
+        self._reset_session()
+
+    # -- TextGenerator protocol ----------------------------------------------
+
+    def add_message(self, message: Message) -> None:
+        self.history.add_message(message)
+
+    def reset(self) -> None:
+        self.history.clear()
+        self.cache = self.cache.fresh()
+        self.d_cache = self.d_cache.fresh()
+        self._reset_session()
+
+    def _reset_session(self) -> None:
+        self.tokens: List[int] = []
+        self.index_pos = 0
+        self._buffer: List[int] = []
+        self._pending_text = ""
+
+    def generated_tokens(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    def next_token(self, index: int) -> Token:
+        if index == 0:
+            self._prefill_prompt()
+        if not self._buffer:
+            self._fill_buffer()
+        tid = self._buffer.pop(0)
+        self.tokens.append(tid)
+        if tid in self.config.eos_token_ids:
+            return Token(id=tid, text="", is_end_of_stream=True)
+        new, self._pending_text = incremental_decode(
+            self.tokenizer, self.tokens, self._pending_text)
+        return Token(id=tid, text=new, is_end_of_stream=False)
+
+    # -- internals ------------------------------------------------------------
+
+    def _prefill_prompt(self) -> None:
+        ids = encode_text(self.tokenizer, self.history.render())
+        if len(ids) > self.max_seq_len - self.gamma - 2:
+            raise ValueError(
+                f"prompt length {len(ids)} leaves no speculation window "
+                f"(max_seq_len {self.max_seq_len}, gamma {self.gamma})")
+        bucket = bucket_length(len(ids), self.max_seq_len)
+        padded = ids + [0] * (bucket - len(ids))
+        toks = jnp.asarray([padded], jnp.int32)
+        plen = jnp.asarray([len(ids)], jnp.int32)
+        logits, self.cache = prefill(
+            self.params, toks, plen, self.cache, self.rope, self.config)
+        _, self.d_cache = prefill(
+            self.draft_params, toks, plen, self.d_cache, self.d_rope,
+            self.draft_config)
+        first = self._sample_first(logits)
+        self._buffer = [int(first)]
+        self.index_pos = len(ids)
+
+    def _sample_first(self, logits):
+        if self._greedy:
+            return jnp.argmax(logits, axis=-1)[0]
+        self.rng, sub = jax.random.split(self.rng)
+        return jax.random.categorical(
+            sub, logits / self.sampling.temperature)[0]
+
+    @property
+    def _greedy(self) -> bool:
+        t = self.sampling.temperature
+        return t is None or t <= 0.0
+
+    def _fill_buffer(self) -> None:
+        if self.index_pos + self.gamma + 1 >= self.max_seq_len:
+            raise ValueError(
+                f"speculation window exceeds max_seq_len {self.max_seq_len}"
+                f" at position {self.index_pos}")
+        if not self.tokens:
+            raise RuntimeError(
+                "next_token(index>0) called before the index==0 prefill")
+        last = jnp.asarray([[self.tokens[-1]]], jnp.int32)
+        out, n_emit, self.cache, self.d_cache, self.rng = spec_step(
+            self.params, self.draft_params, self.cache, self.d_cache,
+            last, jnp.int32(self.index_pos), self.rope, self.d_rope,
+            self.rng,
+            jnp.float32(self.sampling.temperature or 1.0),
+            self.config, self.draft_config, self.gamma, self._greedy)
+        n = int(n_emit[0])
+        self._buffer.extend(int(t) for t in np.asarray(out[0, :n]))
+        self.proposed += self.gamma
+        self.accepted += n - 1
+        self.index_pos += n
+
+    # -- batch generation (bench/tests parity with LlamaGenerator) -----------
+
+    def generate_on_device(self, prompt_ids: np.ndarray,
+                           prompt_len: np.ndarray,
+                           num_tokens: int) -> np.ndarray:
+        """Greedy/spec generation for a [1, S] prompt; returns
+        [1, num_tokens]. Host-stepped (one device call per burst)."""
+        if prompt_ids.shape[0] != 1:
+            raise ValueError("speculative decoding is batch-1")
+        toks = jnp.asarray(prompt_ids, jnp.int32)
+        plen = jnp.asarray(prompt_len, jnp.int32)
+        cache = self.cache.fresh()
+        d_cache = self.d_cache.fresh()
+        logits, cache = prefill(self.params, toks, plen, cache, self.rope,
+                                self.config)
+        _, d_cache = prefill(self.draft_params, toks, plen, d_cache,
+                             self.d_rope, self.draft_config)
+        rng = self.rng
+        if self._greedy:
+            first = int(jnp.argmax(logits, axis=-1)[0])
+        else:
+            rng, sub = jax.random.split(rng)
+            first = int(jax.random.categorical(
+                sub, logits / self.sampling.temperature)[0])
+        out = [first]
+        pos = int(np.asarray(plen)[0])
+        while len(out) < num_tokens:
+            if pos + self.gamma + 1 >= self.max_seq_len:
+                raise ValueError("speculation window exceeds max_seq_len")
+            last = jnp.asarray([[out[-1]]], jnp.int32)
+            burst, n_emit, cache, d_cache, rng = spec_step(
+                self.params, self.draft_params, cache, d_cache, last,
+                jnp.int32(pos), self.rope, self.d_rope, rng,
+                jnp.float32(self.sampling.temperature or 1.0),
+                self.config, self.draft_config, self.gamma, self._greedy)
+            n = int(n_emit[0])
+            self.proposed += self.gamma
+            self.accepted += n - 1
+            out.extend(int(t) for t in np.asarray(burst[0, :n]))
+            pos += n
+        # persist the advanced PRNG stream: repeated sampled calls must
+        # differ, matching LlamaGenerator.generate_on_device
+        self.rng = rng
+        return np.asarray([out[:num_tokens]], np.int32)

@@ -1,0 +1,204 @@
+"""Speculative decoding: exact greedy equivalence and mechanics.
+
+The invariant that makes speculation safe to ship: with temperature=0 the
+emitted stream equals the target-only greedy stream TOKEN FOR TOKEN, no
+matter how bad the draft is (a wrong draft only costs speed). The oracle
+is LlamaGenerator on the same target weights.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cake_tpu.models.chat import Message
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.models.llama.speculative import SpeculativeGenerator
+from cake_tpu.ops.sampling import SamplingConfig
+
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+@pytest.fixture(scope="module")
+def target(tiny_config):
+    return init_params(tiny_config, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft(tiny_config):
+    # a DIFFERENT model (other seed): drafts will frequently be wrong
+    return init_params(tiny_config, jax.random.PRNGKey(42))
+
+
+def _spec(tiny_config, target, draft, gamma=3, **kw):
+    return SpeculativeGenerator(
+        tiny_config, target, tiny_config, draft,
+        ByteTokenizer(tiny_config.vocab_size),
+        gamma=gamma, max_seq_len=256, sampling=GREEDY, **kw)
+
+
+def _oracle(tiny_config, target):
+    return LlamaGenerator(
+        tiny_config, target, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=256, sampling=GREEDY)
+
+
+def test_greedy_equivalence_bad_draft(tiny_config, target, draft):
+    """Wrong drafts must never change the output, only the speed."""
+    prompt = np.full((1, 9), 5, np.int32)
+    plen = np.full((1,), 9, np.int32)
+    want = _oracle(tiny_config, target).generate_on_device(prompt, plen, 14)
+    got = _spec(tiny_config, target, draft).generate_on_device(
+        prompt, plen, 14)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_greedy_equivalence_perfect_draft(tiny_config, target):
+    """draft == target: every draft accepted, output still identical."""
+    prompt = np.full((1, 7), 11, np.int32)
+    plen = np.full((1,), 7, np.int32)
+    want = _oracle(tiny_config, target).generate_on_device(prompt, plen, 13)
+    spec = _spec(tiny_config, target, target)
+    got = spec.generate_on_device(prompt, plen, 13)
+    np.testing.assert_array_equal(got, want)
+    assert spec.acceptance_rate == 1.0
+
+
+def test_interactive_session_matches_oracle(tiny_config, target, draft):
+    """next_token protocol (the CLI/API path) equals the oracle stream.
+
+    Prompt chosen tie-free: when the target's top-2 logits tie within
+    bf16 accumulation noise, the batched verify pass and stepwise decode
+    may break the tie differently (both are valid greedy streams — see
+    the speculative.py module docstring); random-weight fixtures make
+    such exact ties possible, so the fixed prompt here avoids one."""
+    oracle = _oracle(tiny_config, target)
+    spec = _spec(tiny_config, target, draft)
+    for g in (oracle, spec):
+        g.add_message(Message.user("hi"))
+    want = [oracle.next_token(i).id for i in range(10)]
+    got = [spec.next_token(i).id for i in range(10)]
+    assert got == want
+    # reset then regenerate: same stream again
+    spec.reset()
+    spec.add_message(Message.user("hi"))
+    assert [spec.next_token(i).id for i in range(10)] == want
+
+
+def test_acceptance_stats_track(tiny_config, target, draft):
+    spec = _spec(tiny_config, target, draft)
+    prompt = np.full((1, 5), 3, np.int32)
+    spec.generate_on_device(prompt, np.full((1,), 5, np.int32), 12)
+    assert spec.proposed > 0
+    assert 0.0 <= spec.acceptance_rate <= 1.0
+
+
+def test_sampling_path_generates(tiny_config, target, draft):
+    """temperature > 0: accept/resample path produces tokens and is
+    deterministic for a fixed seed."""
+    spec = SpeculativeGenerator(
+        tiny_config, target, tiny_config, draft,
+        ByteTokenizer(tiny_config.vocab_size), gamma=3, max_seq_len=128,
+        sampling=SamplingConfig(temperature=0.8, repeat_penalty=1.0),
+        seed=7)
+    prompt = np.full((1, 6), 9, np.int32)
+    plen = np.full((1,), 6, np.int32)
+    a = spec.generate_on_device(prompt, plen, 10)
+    spec2 = SpeculativeGenerator(
+        tiny_config, target, tiny_config, draft,
+        ByteTokenizer(tiny_config.vocab_size), gamma=3, max_seq_len=128,
+        sampling=SamplingConfig(temperature=0.8, repeat_penalty=1.0),
+        seed=7)
+    b = spec2.generate_on_device(prompt, plen, 10)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (1, 10)
+    assert (a >= 0).all()
+
+
+def test_repeat_penalty_rejected(tiny_config, target, draft):
+    with pytest.raises(ValueError, match="repeat_penalty"):
+        SpeculativeGenerator(
+            tiny_config, target, tiny_config, draft,
+            ByteTokenizer(tiny_config.vocab_size), max_seq_len=128,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.1))
+
+
+def test_top_kp_rejected(tiny_config, target, draft):
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        SpeculativeGenerator(
+            tiny_config, target, tiny_config, draft,
+            ByteTokenizer(tiny_config.vocab_size), max_seq_len=128,
+            sampling=SamplingConfig(temperature=0.8, repeat_penalty=1.0,
+                                    top_k=40))
+
+
+def test_sampled_calls_advance_rng(tiny_config, target, draft):
+    """Two sampled generate_on_device calls on ONE generator must differ
+    (the PRNG stream persists across calls, like LlamaGenerator)."""
+    spec = SpeculativeGenerator(
+        tiny_config, target, tiny_config, draft,
+        ByteTokenizer(tiny_config.vocab_size), gamma=3, max_seq_len=256,
+        sampling=SamplingConfig(temperature=0.8, repeat_penalty=1.0))
+    prompt = np.full((1, 6), 9, np.int32)
+    plen = np.full((1,), 6, np.int32)
+    a = spec.generate_on_device(prompt, plen, 10)
+    b = spec.generate_on_device(prompt, plen, 10)
+    assert not np.array_equal(a, b)
+
+
+def test_api_engine_rejected_with_draft(tiny_config):
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+    from cake_tpu.master import Master
+
+    args = Args(model="", draft_model="", max_seq_len=256,
+                temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    master = Master(args, text_generator=Context.from_args(args)
+                    .load_text_model())
+    with pytest.raises(ValueError, match="draft-model"):
+        master.make_engine(max_slots=2)
+
+
+def test_prefill_chunk_rejected_with_draft(tiny_config):
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+
+    args = Args(model="", draft_model="", prefill_chunk=32,
+                max_seq_len=256, temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    with pytest.raises(ValueError, match="prefill-chunk"):
+        Context.from_args(args).load_text_model()
+
+
+def test_context_wires_draft_model(tiny_config):
+    """--draft-model from the Args/Context path builds the speculative
+    generator (random-init draft when no weights exist)."""
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+
+    args = Args(model="", draft_model="", spec_gamma=2, max_seq_len=128,
+                temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    gen = Context.from_args(args).load_text_model()
+    assert isinstance(gen, SpeculativeGenerator)
+    gen.add_message(Message.user("hi"))
+    toks = [gen.next_token(i).id for i in range(4)]
+    assert len(toks) == 4
+
+
+def test_draft_does_not_compose_with_topology(tmp_path, tiny_config):
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text(
+        "w0:\n  host: a:1\n  layers: [model.layers.0-1]\n"
+        "w1:\n  host: b:1\n  layers: [model.layers.2-3]\n")
+    args = Args(model="", draft_model="", topology=str(topo),
+                max_seq_len=128, temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    with pytest.raises(ValueError, match="single-device"):
+        Context.from_args(args).load_text_model()
