@@ -91,6 +91,10 @@ class Args:
     # verifies spec_gamma drafted tokens at once. Batch-1, single-device.
     draft_model: Optional[str] = None
     spec_gamma: int = 4
+    # --auto-prefix: the API engine KV-caches each distinct system
+    # prompt's rendered head once (serve/engine.register_prefix), so
+    # conversations sharing it prefill only their own turns
+    auto_prefix: bool = False
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):

@@ -228,8 +228,20 @@ class Context:
         from cake_tpu.models.llama.speculative import SpeculativeGenerator
 
         a = self.args
+        import dataclasses as _dc
+
+        from cake_tpu.args import Args
+        default_penalty = Args.__dataclass_fields__["repeat_penalty"].default
+        if sampling.repeat_penalty == default_penalty:
+            # the CLI default (reference llama.rs 1.1) would make
+            # --draft-model unusable out of the box; speculation verifies
+            # the burst in parallel, which has no penalty-ring replay
+            sampling = _dc.replace(sampling, repeat_penalty=1.0)
+            log.info("speculative serving runs without repeat penalty "
+                     "(parallel verify; pass --repeat-penalty 1.0 to "
+                     "silence this)")
         d_dir = a.draft_model
-        if os.path.exists(os.path.join(d_dir or "", "config.json")):
+        if d_dir and os.path.exists(os.path.join(d_dir, "config.json")):
             d_cfg = dataclasses.replace(
                 load_config(d_dir), use_flash_attention=_resolve_flash(a))
         else:

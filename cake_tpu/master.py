@@ -106,6 +106,7 @@ class Master:
             seed=self.args.seed,
             decode_scan_steps=self.args.decode_scan,
             cache_dtype=g.cache.k.dtype,  # follow --kv-dtype
+            auto_prefix_system=getattr(self.args, "auto_prefix", False),
             **kwargs,
         )
 

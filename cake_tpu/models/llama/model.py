@@ -339,22 +339,36 @@ def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
 
 
 def slot_prefill(params, tokens, prompt_len, slot, cache: KVCache,
-                 forward_fn):
+                 forward_fn, prefix: Optional[Tuple] = None):
     """Prefill ONE request into batch slot `slot` of a shared cache.
 
     tokens: [1, S_padded]; prompt_len: [1]; slot: traced scalar. The slot's
-    cache lines are sliced out, prefilled from position 0 via
+    cache lines are sliced out, prefilled via
     forward_fn(params, tokens, sub_cache, pos0, last_idx) -> (logits, sub),
     and written back — other slots' state is untouched, so requests can be
     admitted while their neighbors are mid-decode (continuous batching).
     Shared by the single-device and pipelined engine prefills.
+
+    prefix: optional (k, v) [L, 1, P, KV, hd] — a cached prompt head
+    installed into positions 0..P-1 first, with the window then starting
+    at position P (prefix caching).
     """
     sub = KVCache(
         k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
         v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
     )
+    pos0 = jnp.int32(0)
+    if prefix is not None:
+        pk, pv = prefix
+        sub = KVCache(
+            k=lax.dynamic_update_slice(
+                sub.k, pk.astype(sub.k.dtype), (0, 0, 0, 0, 0)),
+            v=lax.dynamic_update_slice(
+                sub.v, pv.astype(sub.v.dtype), (0, 0, 0, 0, 0)),
+        )
+        pos0 = jnp.int32(pk.shape[2])
     last_idx = (prompt_len - 1).astype(jnp.int32)
-    logits, sub = forward_fn(params, tokens, sub, jnp.int32(0), last_idx)
+    logits, sub = forward_fn(params, tokens, sub, pos0, last_idx)
     cache = KVCache(
         k=lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
         v=lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
@@ -371,3 +385,24 @@ def prefill_slot(params, tokens, prompt_len, slot, cache: KVCache,
                        last_idx=last_idx, is_prefill=True)
 
     return slot_prefill(params, tokens, prompt_len, slot, cache, fwd)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_slot_prefixed(params, tokens, suffix_len, slot,
+                          prefix_k, prefix_v, cache: KVCache,
+                          rope: RopeTables, config: LlamaConfig):
+    """Slot prefill continuing a cached prefix (prefix/prompt caching).
+
+    prefix_k/v: [L, 1, P, KV, hd] precomputed KV of the shared prompt
+    head — installed into the slot's cache lines at positions 0..P-1,
+    then the suffix window `tokens` [1, S_padded] prefills at position P
+    through the cache-aware (chunked) path. Compiles once per
+    (P, suffix bucket) pair; P is a registered-prefix property, so the
+    set stays small.
+    """
+    def fwd(p, t, sub, pos, last_idx):
+        return forward(p, t, sub, pos, rope, config,
+                       last_idx=last_idx, is_prefill=True, chunked=True)
+
+    return slot_prefill(params, tokens, suffix_len, slot, cache, fwd,
+                        prefix=(prefix_k, prefix_v))

@@ -19,9 +19,11 @@ et al., 2023 — public algorithm).
 
 TPU shape: one jitted program per spec step — the draft loop is a
 lax.scan of gamma+1 decode steps (the +1 writes the last draft's KV so an
-all-accept step needs no patch-up pass), the verify is a single
-cache-aware chunked forward of gamma+1 tokens, and accept/resample is
-branch-free arithmetic on the stacked logits. Nothing rolls back: both
+all-accept step needs no patch-up pass), the verify is one forward over
+the gamma+1-token window (masked-einsum attention against the cache —
+the window is a handful of tokens, so the flash kernel would gain
+nothing), and accept/resample is branch-free arithmetic on the stacked
+logits. Nothing rolls back: both
 caches index KV by absolute position, and positions past the accepted
 frontier are masked (decode_mask) until overwritten, exactly like padded
 prefill garbage.

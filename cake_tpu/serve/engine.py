@@ -43,7 +43,7 @@ from cake_tpu.models.llama.generator import (
     bucket_length, encode_text, incremental_decode,
 )
 from cake_tpu.models.llama.model import (
-    RopeTables, decode_step_ragged, prefill_slot,
+    RopeTables, decode_step_ragged, prefill_slot, prefill_slot_prefixed,
 )
 from cake_tpu.native.scheduler import make_scheduler
 from cake_tpu.ops.sampling import (
@@ -119,6 +119,7 @@ class EngineStats:
     requests_completed: int = 0
     decode_time_s: float = 0.0
     prefill_time_s: float = 0.0
+    prefix_hits: int = 0     # prefills served from a registered prefix
     errors: int = 0
     last_error: str = ""
 
@@ -146,6 +147,8 @@ class InferenceEngine:
         step_fns=None,
         cache: Optional[KVCache] = None,
         decode_scan_steps: int = 1,
+        auto_prefix_system: bool = False,
+        max_auto_prefixes: int = 8,
     ):
         self.config = config
         self.params = params
@@ -198,6 +201,20 @@ class InferenceEngine:
         root = jax.random.PRNGKey(seed)
         self._keys = jax.random.split(root, B)       # [B] keys
         self._slot_req: List[Optional[_Request]] = [None] * B
+
+        # registered prompt prefixes: id -> (token ids, k, v) with k/v
+        # [L, 1, P, KV, hd] in cache dtype (register_prefix)
+        self._prefixes: dict = {}
+        self._next_prefix_id = 1
+        # auto_prefix_system: chat() registers each distinct system
+        # prompt's rendered head once (FIFO-capped so a public API cannot
+        # grow the registry without bound). Keyed by the rendered head
+        # STRING so the membership test costs no tokenization; the value
+        # is None while a registration is in flight (reservation — chat()
+        # runs on concurrent HTTP handler threads).
+        self._auto_prefix = auto_prefix_system
+        self._max_auto = max_auto_prefixes
+        self._auto_pids: dict = {}     # head str -> prefix id | None (FIFO)
 
         self._next_rid = 1
         self._rid_lock = threading.Lock()
@@ -282,12 +299,110 @@ class InferenceEngine:
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
 
+    def register_prefix(self, prefix_ids: Sequence[int]) -> int:
+        """Precompute and cache the KV of a shared prompt head (e.g. the
+        rendered system prompt). Later submits whose prompt starts with
+        these ids prefill only the suffix — prefill FLOPs and TTFT drop
+        proportionally. Returns a prefix id (for unregister_prefix).
+
+        HBM cost per prefix: L*P*KV*hd*2 entries in cache dtype (an
+        8B-model 1k-token prefix is ~130 MiB at bf16). Only available on
+        the built-in single-device step path.
+        """
+        if self._prefill_slot is not prefill_slot:
+            raise ValueError(
+                "prefix caching is only supported on the single-device "
+                "engine path (custom/pipelined step fns own their cache "
+                "layout)")
+        ids = list(prefix_ids)
+        if not ids:
+            raise ValueError("empty prefix")
+        if len(ids) >= self.max_seq_len - 1:
+            raise ValueError(
+                f"prefix length {len(ids)} leaves no room for a suffix "
+                f"(max_seq_len {self.max_seq_len})")
+        P = len(ids)
+        bucket = bucket_length(P, self.max_seq_len)
+        padded = ids + [0] * (bucket - P)
+        tmp = KVCache.create(self.config, 1, bucket,
+                             dtype=self._cache_dtype)
+        from cake_tpu.models.llama.model import prefill
+        _, tmp = prefill(self.params,
+                         jnp.asarray([padded], jnp.int32),
+                         jnp.asarray([P], jnp.int32),
+                         tmp, self.rope, self.config)
+        k = jax.lax.slice_in_dim(tmp.k, 0, P, axis=2)
+        v = jax.lax.slice_in_dim(tmp.v, 0, P, axis=2)
+        with self._rid_lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = (ids, k, v)
+        log.info("registered prefix %d: %d tokens", pid, P)
+        return pid
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        with self._rid_lock:
+            self._prefixes.pop(prefix_id, None)
+
+    def _match_prefix(self, ids: List[int]):
+        """Longest registered prefix that is a proper head of `ids`."""
+        best = None
+        with self._rid_lock:
+            entries = list(self._prefixes.values())
+        for p_ids, k, v in entries:
+            P = len(p_ids)
+            if P < len(ids) and ids[:P] == p_ids:
+                if best is None or P > len(best[0]):
+                    best = (p_ids, k, v)
+        return best
+
     def chat(self, messages: Sequence[Message], **kw) -> RequestHandle:
-        """Render a chat history through the Llama-3 template and submit."""
+        """Render a chat history through the Llama-3 template and submit.
+
+        With auto_prefix_system on, the system message's rendered head is
+        KV-cached once per distinct system prompt, so every conversation
+        sharing it prefills only its own turns."""
         hist = History()
         for m in messages:
             hist.add_message(m)
+        if (self._auto_prefix and messages
+                and messages[0].role.value == "system"
+                and self._prefill_slot is prefill_slot):
+            self._auto_register_system(messages[0])
         return self.submit(encode_text(self.tokenizer, hist.render()), **kw)
+
+    def _auto_register_system(self, system_msg: Message) -> None:
+        from cake_tpu.models.chat import BEGIN_OF_TEXT
+        head = BEGIN_OF_TEXT + History.encode_message(system_msg)
+        with self._rid_lock:
+            if head in self._auto_pids:
+                return
+            if len(self._auto_pids) >= self._max_auto:
+                # evict the oldest COMPLETED registration; in-flight
+                # reservations (None) are skipped
+                for k, pid in list(self._auto_pids.items()):
+                    if pid is not None:
+                        del self._auto_pids[k]
+                        self._prefixes.pop(pid, None)
+                        break
+                else:
+                    return    # registry full of in-flight reservations
+            self._auto_pids[head] = None   # reserve before the prefill
+        try:
+            ids = encode_text(self.tokenizer, head)
+            if len(ids) < 8 or len(ids) >= self.max_seq_len - 1:
+                raise _SkipPrefix
+            pid = self.register_prefix(ids)
+        except _SkipPrefix:
+            with self._rid_lock:
+                self._auto_pids.pop(head, None)
+        except Exception:
+            with self._rid_lock:
+                self._auto_pids.pop(head, None)
+            raise
+        else:
+            with self._rid_lock:
+                self._auto_pids[head] = pid
 
     @property
     def queue_depth(self) -> int:
@@ -345,14 +460,34 @@ class InferenceEngine:
         req.slot = slot
         self._slot_req[slot] = req
         ids = req.prompt_ids
-        bucket = bucket_length(len(ids), self.max_seq_len)
-        padded = ids + [0] * (bucket - len(ids))
-        toks = jnp.asarray([padded], jnp.int32)
-        plen = jnp.asarray([len(ids)], jnp.int32)
-        logits, self.cache = self._prefill_slot(
-            self.params, toks, plen, jnp.int32(slot), self.cache,
-            self.rope, self.config,
-        )
+        hit = (self._match_prefix(ids)
+               if self._prefill_slot is prefill_slot else None)
+        if hit is not None:
+            p_ids, pk, pv = hit
+            suffix = ids[len(p_ids):]
+            bucket = bucket_length(len(suffix), self.max_seq_len)
+            if len(p_ids) + bucket > self.max_seq_len:
+                # the padded window would clamp over the live prefix
+                # (dynamic_update_slice clamps out-of-range starts) —
+                # fall back to a whole-prompt prefill
+                hit = None
+        if hit is not None:
+            padded = suffix + [0] * (bucket - len(suffix))
+            logits, self.cache = prefill_slot_prefixed(
+                self.params, jnp.asarray([padded], jnp.int32),
+                jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
+                pk, pv, self.cache, self.rope, self.config,
+            )
+            self.stats.prefix_hits += 1
+        else:
+            bucket = bucket_length(len(ids), self.max_seq_len)
+            padded = ids + [0] * (bucket - len(ids))
+            toks = jnp.asarray([padded], jnp.int32)
+            plen = jnp.asarray([len(ids)], jnp.int32)
+            logits, self.cache = self._prefill_slot(
+                self.params, toks, plen, jnp.int32(slot), self.cache,
+                self.rope, self.config,
+            )
         # configure the slot
         self._pos[slot] = len(ids)
         self._steps[slot] = 0
@@ -528,6 +663,10 @@ class InferenceEngine:
 
 class QueueFullError(Exception):
     pass
+
+
+class _SkipPrefix(Exception):
+    """Internal: system head not worth caching (too short/long)."""
 
 
 @jax.jit

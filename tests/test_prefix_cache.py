@@ -1,0 +1,158 @@
+"""Engine prefix caching: shared-prompt KV reuse must not change output.
+
+Oracle: the same engine WITHOUT a registered prefix (and the sequential
+generator). A prefix hit skips the prefix's prefill compute but must be
+bit-identical in behavior — greedy token streams prove it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve.engine import InferenceEngine
+
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+PREFIX = list(range(3, 35))          # 32-token shared head
+SUFFIXES = [[40, 41, 42], [50, 51], [60, 61, 62, 63, 64]]
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    return init_params(tiny_config, jax.random.PRNGKey(0))
+
+
+def _engine(tiny_config, params, max_seq_len=128, **kw):
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_slots=2, max_seq_len=max_seq_len, sampling=GREEDY, **kw)
+
+
+def _collect(engine, prompts, n=6):
+    with engine:
+        handles = [engine.submit(p, max_new_tokens=n) for p in prompts]
+        assert all(h.wait(timeout=300) for h in handles)
+    return [h._req.out_tokens[:n] for h in handles]
+
+
+def test_prefix_hit_matches_cold_prefill(tiny_config, params):
+    prompts = [PREFIX + s for s in SUFFIXES]
+    cold = _collect(_engine(tiny_config, params), prompts)
+
+    warm_engine = _engine(tiny_config, params)
+    pid = warm_engine.register_prefix(PREFIX)
+    assert pid >= 1
+    warm = _collect(warm_engine, prompts)
+    assert warm == cold
+    assert warm_engine.stats.prefix_hits == len(prompts)
+
+
+def test_prefix_matches_sequential_generator(tiny_config, params):
+    engine = _engine(tiny_config, params)
+    engine.register_prefix(PREFIX)
+    prompt = PREFIX + SUFFIXES[0]
+    got = _collect(engine, [prompt])[0]
+
+    gen = LlamaGenerator(tiny_config, params,
+                         ByteTokenizer(tiny_config.vocab_size),
+                         max_seq_len=128, sampling=GREEDY)
+    want = gen.generate_on_device(
+        np.asarray([prompt], np.int32),
+        np.asarray([len(prompt)], np.int32), 6)[0].tolist()
+    assert got[:len(got)] == want[:len(got)] and len(got) >= 1
+
+
+def test_non_matching_prompt_unaffected(tiny_config, params):
+    engine = _engine(tiny_config, params)
+    engine.register_prefix(PREFIX)
+    other = [90, 91, 92, 93]
+    got = _collect(engine, [other])
+    assert engine.stats.prefix_hits == 0
+    cold = _collect(_engine(tiny_config, params), [other])
+    assert got == cold
+
+
+def test_exact_prefix_prompt_falls_back(tiny_config, params):
+    """A prompt equal to the prefix (no suffix) takes the normal path —
+    the match requires a PROPER head."""
+    engine = _engine(tiny_config, params)
+    engine.register_prefix(PREFIX)
+    got = _collect(engine, [list(PREFIX)])
+    assert engine.stats.prefix_hits == 0
+    assert len(got[0]) >= 1
+
+
+def test_longest_prefix_wins(tiny_config, params):
+    engine = _engine(tiny_config, params)
+    engine.register_prefix(PREFIX[:8])
+    engine.register_prefix(PREFIX)
+    prompts = [PREFIX + SUFFIXES[0]]
+    warm = _collect(engine, prompts)
+    cold = _collect(_engine(tiny_config, params), prompts)
+    assert warm == cold
+    assert engine.stats.prefix_hits == 1
+
+
+def test_unregister(tiny_config, params):
+    engine = _engine(tiny_config, params)
+    pid = engine.register_prefix(PREFIX)
+    engine.unregister_prefix(pid)
+    _collect(engine, [PREFIX + SUFFIXES[0]])
+    assert engine.stats.prefix_hits == 0
+
+
+def test_register_validation(tiny_config, params):
+    engine = _engine(tiny_config, params)
+    with pytest.raises(ValueError, match="empty"):
+        engine.register_prefix([])
+    with pytest.raises(ValueError, match="suffix"):
+        engine.register_prefix(list(range(3, 3 + 127)))
+
+
+def test_auto_prefix_system_prompt(tiny_config, params):
+    """auto_prefix_system: two conversations sharing a system prompt —
+    the second prefills only its own turns, outputs unchanged."""
+    from cake_tpu.models.chat import Message
+
+    msgs1 = [Message.system("You are terse."), Message.user("hi")]
+    msgs2 = [Message.system("You are terse."), Message.user("other")]
+
+    def run(auto):
+        engine = _engine(tiny_config, params, max_seq_len=512,
+                         auto_prefix_system=auto)
+        with engine:
+            hs = [engine.chat(m, max_new_tokens=4) for m in (msgs1, msgs2)]
+            assert all(h.wait(timeout=300) for h in hs)
+        return [h._req.out_tokens[:4] for h in hs], engine.stats.prefix_hits
+
+    cold, hits0 = run(False)
+    warm, hits1 = run(True)
+    assert warm == cold
+    assert hits0 == 0 and hits1 == 2   # both chats start past the head
+    # distinct system prompt -> its own prefix; registry caps FIFO
+    engine = _engine(tiny_config, params, max_seq_len=512,
+                     auto_prefix_system=True, max_auto_prefixes=1)
+    with engine:
+        for text in ("aaaa bbbb cccc", "dddd eeee ffff"):
+            h = engine.chat([Message.system(text), Message.user("x")],
+                            max_new_tokens=2)
+            assert h.wait(timeout=300)
+        assert len(engine._prefixes) == 1
+
+
+def test_overrun_window_falls_back(tiny_config, params):
+    """Prefix + padded suffix window exceeding max_seq_len must not clamp
+    over the prefix — it takes the whole-prompt path instead."""
+    engine = _engine(tiny_config, params)
+    long_prefix = list(range(3, 3 + 100))
+    engine.register_prefix(long_prefix)
+    # suffix of 20 buckets to 32; 100 + 32 > 128 -> fallback
+    prompt = long_prefix + list(range(110, 130))
+    got = _collect(engine, [prompt], n=4)
+    assert engine.stats.prefix_hits == 0
+    cold = _collect(_engine(tiny_config, params), [prompt], n=4)
+    assert got == cold
