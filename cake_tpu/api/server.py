@@ -178,6 +178,43 @@ class ApiServer:
         ]
         return out
 
+    def metrics(self) -> str:
+        """Prometheus text exposition of the serving counters (the
+        observability face of the reference's periodic worker-stat logs,
+        worker.rs:254-283 — scrape-able instead of grep-able)."""
+        lines = [
+            "# TYPE cake_requests_waiting gauge",
+            f"cake_requests_waiting {self._waiting}",
+        ]
+        if self.engine is not None:
+            st = self.engine.stats
+            pairs = [
+                ("cake_engine_queue_depth", "gauge",
+                 self.engine.queue_depth),
+                ("cake_engine_active_requests", "gauge",
+                 self.engine.active),
+                ("cake_engine_decode_slots", "gauge",
+                 self.engine.max_slots),
+                ("cake_engine_requests_completed_total", "counter",
+                 st.requests_completed),
+                ("cake_engine_tokens_generated_total", "counter",
+                 st.tokens_generated),
+                ("cake_engine_decode_steps_total", "counter", st.steps),
+                ("cake_engine_decode_seconds_total", "counter",
+                 round(st.decode_time_s, 4)),
+                ("cake_engine_prefill_seconds_total", "counter",
+                 round(st.prefill_time_s, 4)),
+                ("cake_engine_prefix_hits_total", "counter",
+                 st.prefix_hits),
+                ("cake_engine_errors_total", "counter", st.errors),
+                ("cake_engine_decode_tokens_per_second", "gauge",
+                 round(st.decode_tokens_per_s, 2)),
+            ]
+            for name, typ, val in pairs:
+                lines.append(f"# TYPE {name} {typ}")
+                lines.append(f"{name} {val}")
+        return "\n".join(lines) + "\n"
+
     # -- admission -----------------------------------------------------------
 
     def _admission(self):
@@ -229,6 +266,15 @@ def make_handler(api: ApiServer):
                 return self._json(200, api.health())
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
+            if self.path == "/metrics":
+                data = api.metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._json(404, {"error": "not found"})  # api/mod.rs:19-21
 
         def do_POST(self):

@@ -47,7 +47,11 @@ class Args:
     temperature: float = 1.0
     top_p: Optional[float] = None
     top_k: Optional[int] = None
-    repeat_penalty: float = 1.1
+    # None = "not set": resolves to the reference default 1.1
+    # (llama.rs:311-320) for normal serving, and to 1.0 for speculative
+    # serving (whose parallel verify cannot replay a penalty ring) — an
+    # EXPLICIT value is honored (or rejected) everywhere
+    repeat_penalty: Optional[float] = None
     repeat_last_n: int = 128
     dtype: str = "bf16"                 # f16 | bf16 | f32 (TPU default bf16)
     # KV-cache storage dtype; fp8 halves KV HBM traffic/footprint (values

@@ -94,9 +94,15 @@ class Context:
         params = load_text_params(cfg, a.model, self.dtype)
         params = self._maybe_quantize(params)
 
+        # --repeat-penalty unset -> reference default 1.1 (llama.rs:311);
+        # speculative mode resolves unset to 1.0 instead (parallel verify
+        # has no penalty-ring replay) while honoring explicit values
+        penalty = a.repeat_penalty
+        if penalty is None:
+            penalty = 1.0 if a.draft_model is not None else 1.1
         sampling = SamplingConfig(
             temperature=a.temperature, top_k=a.top_k, top_p=a.top_p,
-            repeat_penalty=a.repeat_penalty, repeat_last_n=a.repeat_last_n,
+            repeat_penalty=penalty, repeat_last_n=a.repeat_last_n,
         )
         max_seq = min(a.max_seq_len, cfg.max_position_embeddings)
         from cake_tpu.utils.devices import resolve_kv_dtype
@@ -228,18 +234,6 @@ class Context:
         from cake_tpu.models.llama.speculative import SpeculativeGenerator
 
         a = self.args
-        import dataclasses as _dc
-
-        from cake_tpu.args import Args
-        default_penalty = Args.__dataclass_fields__["repeat_penalty"].default
-        if sampling.repeat_penalty == default_penalty:
-            # the CLI default (reference llama.rs 1.1) would make
-            # --draft-model unusable out of the box; speculation verifies
-            # the burst in parallel, which has no penalty-ring replay
-            sampling = _dc.replace(sampling, repeat_penalty=1.0)
-            log.info("speculative serving runs without repeat penalty "
-                     "(parallel verify; pass --repeat-penalty 1.0 to "
-                     "silence this)")
         d_dir = a.draft_model
         if d_dir and os.path.exists(os.path.join(d_dir, "config.json")):
             d_cfg = dataclasses.replace(

@@ -87,9 +87,13 @@ HBM_BUDGET = {
 
 def placement_memory(config, *, dp: int = 1, stages: int = 1, tp: int = 1,
                      batch_size: int = 1, max_seq_len: int = 4096,
-                     dtype=None, quant: bool = False) -> dict:
+                     dtype=None,
+                     quant: "bool | str" = False) -> dict:
     """Per-device HBM estimate for a pipeline placement — without
     materializing anything (shapes via jax.eval_shape).
+
+    quant: False = full precision, True or "int8" = per-channel int8,
+    "int4" = packed group-wise int4 (lm_head stays int8).
 
     Uses the exact PartitionSpecs place_for_pipeline applies, so the
     estimate can't drift from the real placement. This is the
@@ -106,7 +110,12 @@ def placement_memory(config, *, dp: int = 1, stages: int = 1, tp: int = 1,
     from cake_tpu.parallel.pipeline import pipeline_param_specs
 
     dtype = dtype if dtype is not None else jnp.bfloat16
-    init = init_params_quantized if quant else init_params
+    if quant:
+        from functools import partial
+        bits = 4 if quant == "int4" else 8
+        init = partial(init_params_quantized, bits=bits)
+    else:
+        init = init_params
     shapes = jax.eval_shape(
         lambda: init(config, jax.random.PRNGKey(0), dtype=dtype))
 

@@ -391,15 +391,20 @@ class InferenceEngine:
         try:
             ids = encode_text(self.tokenizer, head)
             if len(ids) < 8 or len(ids) >= self.max_seq_len - 1:
-                raise _SkipPrefix
+                # unqualifying head: keep a negative sentinel so the
+                # membership check short-circuits every later request
+                # with the same system prompt
+                with self._rid_lock:
+                    self._auto_pids[head] = -1
+                return
             pid = self.register_prefix(ids)
-        except _SkipPrefix:
-            with self._rid_lock:
-                self._auto_pids.pop(head, None)
         except Exception:
+            # cache warming must never fail the request — drop the
+            # reservation and let the normal whole-prompt prefill serve it
+            log.exception("auto prefix registration failed; serving "
+                          "without prefix cache")
             with self._rid_lock:
                 self._auto_pids.pop(head, None)
-            raise
         else:
             with self._rid_lock:
                 self._auto_pids[head] = pid
@@ -663,10 +668,6 @@ class InferenceEngine:
 
 class QueueFullError(Exception):
     pass
-
-
-class _SkipPrefix(Exception):
-    """Internal: system head not worth caching (too short/long)."""
 
 
 @jax.jit

@@ -81,6 +81,20 @@ def test_health_and_cluster(server_url):
     assert len(c["devices"]) == 8  # virtual CPU mesh
 
 
+def test_metrics_endpoint(server_url):
+    resp = urllib.request.urlopen(server_url + "/metrics", timeout=10)
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    body = resp.read().decode()
+    assert "cake_engine_tokens_generated_total" in body
+    assert "# TYPE cake_engine_decode_slots gauge" in body
+    # every sample line parses as "name value"
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, val = line.split()
+        float(val)
+
+
 def test_404_fallback(server_url):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(server_url + "/nope", timeout=10)

@@ -12,10 +12,28 @@ def test_defaults_match_reference():
     a = Args()
     assert a.seed == 299792458          # lib.rs default
     assert a.sample_len == 100
-    assert a.repeat_penalty == 1.1
+    # repeat_penalty is a None sentinel so explicit values are
+    # distinguishable (speculative mode resolves unset to 1.0); the
+    # EFFECTIVE default for normal serving is still the reference's 1.1
+    assert a.repeat_penalty is None
     assert a.repeat_last_n == 128
     assert a.address == "127.0.0.1:10128"
     assert a.dtype == "bf16"            # TPU-native default (ref uses f16)
+
+
+def test_repeat_penalty_effective_defaults(tiny_config):
+    """Unset --repeat-penalty resolves to 1.1 (reference) for normal
+    serving and 1.0 for speculative serving; explicit values flow as-is."""
+    from cake_tpu.context import Context
+
+    def sampling_for(**kw):
+        args = Args(model="", max_seq_len=256, temperature=0.0,
+                    flash_attention=False, **kw).validate()
+        return Context.from_args(args).load_text_model().sampling
+
+    assert sampling_for().repeat_penalty == 1.1
+    assert sampling_for(draft_model="").repeat_penalty == 1.0
+    assert sampling_for(repeat_penalty=1.3).repeat_penalty == 1.3
 
 
 def test_parse_args_roundtrip():

@@ -8,10 +8,7 @@ bit-identical in behavior — greedy token streams prove it.
 import numpy as np
 import pytest
 
-import jax
-
 from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
-from cake_tpu.models.llama.params import init_params
 from cake_tpu.ops.sampling import SamplingConfig
 from cake_tpu.serve.engine import InferenceEngine
 
@@ -22,8 +19,8 @@ SUFFIXES = [[40, 41, 42], [50, 51], [60, 61, 62, 63, 64]]
 
 
 @pytest.fixture(scope="module")
-def params(tiny_config):
-    return init_params(tiny_config, jax.random.PRNGKey(0))
+def params(tiny_params):
+    return tiny_params       # session-scoped tree from conftest
 
 
 def _engine(tiny_config, params, max_seq_len=128, **kw):
