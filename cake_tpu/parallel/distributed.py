@@ -107,14 +107,19 @@ def coordinator_only(fn):
 
 def _slice_ids(devices: Sequence) -> List[int]:
     """Slice index per device; falls back to process index (one slice per
-    host) when the backend doesn't expose slice topology (e.g. CPU sim)."""
-    out = []
-    for d in devices:
-        sid = getattr(d, "slice_index", None)
-        if sid is None:
-            sid = d.process_index
-        out.append(sid)
-    return out
+    host) when the backend doesn't expose slice topology — either by
+    returning None, or on the CPU backend, which reports slice_index 0
+    everywhere even across processes (there, the process boundary IS the
+    DCN/Gloo boundary). Real TPU pods keep their reported slice ids: a
+    multi-host single-slice pod (e.g. v5p-16) is genuinely one
+    ICI-connected slice and must not be split by process."""
+    sids = [getattr(d, "slice_index", None) for d in devices]
+    is_cpu = bool(devices) and getattr(devices[0], "platform", "") == "cpu"
+    procs = {d.process_index for d in devices}
+    if any(s is None for s in sids) or (is_cpu and len(set(sids)) == 1
+                                        and len(procs) > 1):
+        return [d.process_index for d in devices]
+    return list(sids)
 
 
 def make_multihost_mesh(dp: int = 1, stage: int = 1, tp: int = 1,

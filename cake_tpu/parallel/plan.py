@@ -59,10 +59,26 @@ class ParallelPlan:
             )
         return cls(dp=dp, stages=stages, tp=tp, stage_layout=layout)
 
-    def build_mesh(self, devices=None, dcn_axis: str = "dp"):
+    def build_mesh(self, devices=None, dcn_axis: str = None):
         """Build the mesh; on multi-slice topologies the `dcn_axis` is laid
-        out so only that axis crosses the inter-slice (DCN) boundary."""
-        from cake_tpu.parallel.distributed import make_multihost_mesh
+        out so only that axis crosses the inter-slice (DCN) boundary.
+
+        dcn_axis=None auto-selects: the first of dp -> stage -> tp whose
+        size the slice count divides. dp replicas are fully independent
+        (best DCN tenant); stage crosses DCN once per pipeline hop — the
+        reference's machine-per-layer-range shape (SURVEY §2.7); tp is
+        the last resort (per-matmul collectives over DCN).
+        """
+        from cake_tpu.parallel.distributed import (
+            _slice_ids, make_multihost_mesh,
+        )
+        if dcn_axis is None:
+            import jax
+            devs = list(devices) if devices is not None else jax.devices()
+            n_slices = len(set(_slice_ids(devs)))
+            sizes = {"dp": self.dp, "stage": self.stages, "tp": self.tp}
+            dcn_axis = next((a for a in ("dp", "stage", "tp")
+                             if sizes[a] % n_slices == 0), "dp")
         return make_multihost_mesh(dp=self.dp, stage=self.stages,
                                    tp=self.tp, dcn_axis=dcn_axis,
                                    devices=devices)

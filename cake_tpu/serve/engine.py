@@ -214,7 +214,9 @@ class InferenceEngine:
         # runs on concurrent HTTP handler threads).
         self._auto_prefix = auto_prefix_system
         self._max_auto = max_auto_prefixes
-        self._auto_pids: dict = {}     # head str -> prefix id | None (FIFO)
+        # head str -> prefix id | None (in-flight) | -1 (unqualifying
+        # head, negative-cached) — only non-negative ids key _prefixes
+        self._auto_pids: dict = {}
 
         self._next_rid = 1
         self._rid_lock = threading.Lock()
