@@ -107,6 +107,9 @@ class Master:
             decode_scan_steps=self.args.decode_scan,
             cache_dtype=g.cache.k.dtype,  # follow --kv-dtype
             auto_prefix_system=getattr(self.args, "auto_prefix", False),
+            # pass through unconditionally: the engine's own step_fns
+            # guard warns when a pipelined path ignores the knob
+            prefill_chunk=getattr(self.args, "prefill_chunk", None),
             **kwargs,
         )
 

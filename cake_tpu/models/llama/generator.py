@@ -54,6 +54,17 @@ def bucket_length(n: int, max_seq_len: int) -> int:
     return max_seq_len
 
 
+def chunk_windows(ids: List[int], C: int):
+    """Yield (padded_window, n_real, start) fixed-C windows over a prompt —
+    the ONE definition of the chunked-prefill windowing contract
+    (right-padded final window, last real token at n_real - 1), shared by
+    the sequential generator and the engine."""
+    for start in range(0, len(ids), C):
+        w = ids[start:start + C]
+        n = len(w)
+        yield w + [0] * (C - n), n, start
+
+
 class ByteTokenizer:
     """Fallback tokenizer (tests / no tokenizer.json): UTF-8 bytes + offset."""
 
@@ -274,10 +285,7 @@ class LlamaGenerator:
         from cake_tpu.models.llama.model import prefill_chunk
         B = self.batch_size
         logits = None
-        for start in range(0, len(ids), C):
-            window = ids[start:start + C]
-            n_real = len(window)
-            window = window + [0] * (C - n_real)
+        for window, n_real, start in chunk_windows(ids, C):
             toks = jnp.asarray([window] * B, dtype=jnp.int32)
             last_idx = jnp.full((B,), n_real - 1, dtype=jnp.int32)
             logits, self.cache = prefill_chunk(

@@ -339,7 +339,7 @@ def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
 
 
 def slot_prefill(params, tokens, prompt_len, slot, cache: KVCache,
-                 forward_fn, prefix: Optional[Tuple] = None):
+                 forward_fn, prefix: Optional[Tuple] = None, pos0=None):
     """Prefill ONE request into batch slot `slot` of a shared cache.
 
     tokens: [1, S_padded]; prompt_len: [1]; slot: traced scalar. The slot's
@@ -347,33 +347,50 @@ def slot_prefill(params, tokens, prompt_len, slot, cache: KVCache,
     forward_fn(params, tokens, sub_cache, pos0, last_idx) -> (logits, sub),
     and written back — other slots' state is untouched, so requests can be
     admitted while their neighbors are mid-decode (continuous batching).
-    Shared by the single-device and pipelined engine prefills.
+    Shared by the single-device and pipelined engine prefills; the slot
+    slice/write-back splice lives in _slot_view/_slot_writeback.
 
     prefix: optional (k, v) [L, 1, P, KV, hd] — a cached prompt head
     installed into positions 0..P-1 first, with the window then starting
-    at position P (prefix caching).
+    at position P (prefix caching). pos0: optional traced start position
+    for the window (chunked prefill); mutually exclusive with prefix.
     """
-    sub = KVCache(
+    assert prefix is None or pos0 is None, "prefix implies its own pos0"
+    sub = _slot_view(cache, slot)
+    if prefix is not None:
+        sub = _install_prefix(sub, *prefix)
+        pos0 = jnp.int32(prefix[0].shape[2])
+    elif pos0 is None:
+        pos0 = jnp.int32(0)
+    last_idx = (prompt_len - 1).astype(jnp.int32)
+    logits, sub = forward_fn(params, tokens, sub, pos0, last_idx)
+    return logits, _slot_writeback(cache, sub, slot)
+
+
+def _slot_view(cache: KVCache, slot) -> KVCache:
+    """Slice one batch slot's cache lines out ([L, 1, T, KV, hd])."""
+    return KVCache(
         k=lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
         v=lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
     )
-    pos0 = jnp.int32(0)
-    if prefix is not None:
-        pk, pv = prefix
-        sub = KVCache(
-            k=lax.dynamic_update_slice(
-                sub.k, pk.astype(sub.k.dtype), (0, 0, 0, 0, 0)),
-            v=lax.dynamic_update_slice(
-                sub.v, pv.astype(sub.v.dtype), (0, 0, 0, 0, 0)),
-        )
-        pos0 = jnp.int32(pk.shape[2])
-    last_idx = (prompt_len - 1).astype(jnp.int32)
-    logits, sub = forward_fn(params, tokens, sub, pos0, last_idx)
-    cache = KVCache(
+
+
+def _install_prefix(sub: KVCache, pk, pv) -> KVCache:
+    """Write cached-prefix KV [L, 1, P, KV, hd] at positions 0..P-1."""
+    return KVCache(
+        k=lax.dynamic_update_slice(
+            sub.k, pk.astype(sub.k.dtype), (0, 0, 0, 0, 0)),
+        v=lax.dynamic_update_slice(
+            sub.v, pv.astype(sub.v.dtype), (0, 0, 0, 0, 0)),
+    )
+
+
+def _slot_writeback(cache: KVCache, sub: KVCache, slot) -> KVCache:
+    """Splice one slot's updated lines back into the shared cache."""
+    return KVCache(
         k=lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
         v=lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
     )
-    return logits, cache
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -385,6 +402,32 @@ def prefill_slot(params, tokens, prompt_len, slot, cache: KVCache,
                        last_idx=last_idx, is_prefill=True)
 
     return slot_prefill(params, tokens, prompt_len, slot, cache, fwd)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_slot_chunk(params, tokens, n_real, slot, pos0,
+                       cache: KVCache, rope: RopeTables,
+                       config: LlamaConfig):
+    """One fixed-size prefill window into batch slot `slot` at absolute
+    position `pos0` (engine-side chunked prefill: every chunk of every
+    prompt in any slot hits ONE compiled program per window shape).
+    tokens: [1, C]; n_real: [1] count of real tokens in the window.
+    """
+    def fwd(p, t, sub, pos, last_idx):
+        return forward(p, t, sub, pos, rope, config,
+                       last_idx=last_idx, is_prefill=True, chunked=True)
+
+    return slot_prefill(params, tokens, n_real, slot, cache, fwd,
+                        pos0=pos0)
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def install_prefix_slot(cache: KVCache, prefix_k, prefix_v, slot):
+    """Copy cached-prefix KV [L, 1, P, KV, hd] into slot `slot` at
+    positions 0..P-1 (prefix caching + chunked suffix: the install and
+    the windows are separate programs)."""
+    sub = _install_prefix(_slot_view(cache, slot), prefix_k, prefix_v)
+    return _slot_writeback(cache, sub, slot)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))

@@ -59,7 +59,7 @@ class ParallelPlan:
             )
         return cls(dp=dp, stages=stages, tp=tp, stage_layout=layout)
 
-    def build_mesh(self, devices=None, dcn_axis: str = None):
+    def build_mesh(self, devices=None, dcn_axis: Optional[str] = None):
         """Build the mesh; on multi-slice topologies the `dcn_axis` is laid
         out so only that axis crosses the inter-slice (DCN) boundary.
 

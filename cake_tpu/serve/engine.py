@@ -149,6 +149,7 @@ class InferenceEngine:
         decode_scan_steps: int = 1,
         auto_prefix_system: bool = False,
         max_auto_prefixes: int = 8,
+        prefill_chunk: Optional[int] = None,
     ):
         self.config = config
         self.params = params
@@ -178,6 +179,21 @@ class InferenceEngine:
                 "own their jit/donation and run step-by-step",
                 decode_scan_steps)
         self._decode_scan = decode_scan_steps if step_fns is None else 1
+        # prefill_chunk: admit prompts longer than C in fixed C-token
+        # windows (one compiled program for every prompt length; bounded
+        # activation memory). Same divisibility contract as the
+        # generator's knob — a clamped final window would overwrite
+        # earlier cache entries.
+        if prefill_chunk is not None and (
+                prefill_chunk < 1 or max_seq_len % prefill_chunk != 0):
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be >= 1 and divide "
+                f"max_seq_len {max_seq_len}")
+        if prefill_chunk is not None and step_fns is not None:
+            log.warning("prefill_chunk ignored: custom (pipelined) step "
+                        "fns own their prefill")
+            prefill_chunk = None
+        self.prefill_chunk = prefill_chunk
         self.cache = cache if cache is not None else KVCache.create(
             config, max_slots, max_seq_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
@@ -467,18 +483,37 @@ class InferenceEngine:
         req.slot = slot
         self._slot_req[slot] = req
         ids = req.prompt_ids
+        C = self.prefill_chunk
         hit = (self._match_prefix(ids)
                if self._prefill_slot is prefill_slot else None)
+        chunk_suffix = False
         if hit is not None:
             p_ids, pk, pv = hit
             suffix = ids[len(p_ids):]
-            bucket = bucket_length(len(suffix), self.max_seq_len)
-            if len(p_ids) + bucket > self.max_seq_len:
-                # the padded window would clamp over the live prefix
-                # (dynamic_update_slice clamps out-of-range starts) —
-                # fall back to a whole-prompt prefill
-                hit = None
-        if hit is not None:
+            if C and len(suffix) > C:
+                # long suffix: install the prefix, then window the
+                # suffix — keeps --prefill-chunk's bounded-activation
+                # guarantee on exactly the long-prompt case it targets
+                n_win = -(-len(suffix) // C)
+                chunk_suffix = (len(p_ids) + n_win * C
+                                <= self.max_seq_len)
+                if not chunk_suffix:
+                    hit = None   # last window would clamp over the prefix
+            else:
+                bucket = bucket_length(len(suffix), self.max_seq_len)
+                if len(p_ids) + bucket > self.max_seq_len:
+                    # the padded window would clamp over the live prefix
+                    # (dynamic_update_slice clamps out-of-range starts) —
+                    # fall back to a whole-prompt prefill
+                    hit = None
+        if hit is not None and chunk_suffix:
+            from cake_tpu.models.llama.model import install_prefix_slot
+            self.cache = install_prefix_slot(self.cache, pk, pv,
+                                             jnp.int32(slot))
+            logits = self._prefill_chunked(suffix, slot, C,
+                                           pos0=len(p_ids))
+            self.stats.prefix_hits += 1
+        elif hit is not None:
             padded = suffix + [0] * (bucket - len(suffix))
             logits, self.cache = prefill_slot_prefixed(
                 self.params, jnp.asarray([padded], jnp.int32),
@@ -486,6 +521,9 @@ class InferenceEngine:
                 pk, pv, self.cache, self.rope, self.config,
             )
             self.stats.prefix_hits += 1
+        elif (C and len(ids) > C
+                and self._prefill_slot is prefill_slot):
+            logits = self._prefill_chunked(ids, slot, C)
         else:
             bucket = bucket_length(len(ids), self.max_seq_len)
             padded = ids + [0] * (bucket - len(ids))
@@ -520,6 +558,24 @@ class InferenceEngine:
             rows=[slot])
         self.stats.prefill_time_s += time.perf_counter() - t0
         self._emit(req, int(first[slot]))
+
+    def _prefill_chunked(self, ids: List[int], slot: int, C: int,
+                         pos0: int = 0):
+        """Walk a prompt (or a prefix-cache suffix starting at absolute
+        position pos0) through slot `slot` in fixed C-token windows —
+        the engine analog of the generator's --prefill-chunk path, using
+        the same chunk_windows contract."""
+        from cake_tpu.models.llama.generator import chunk_windows
+        from cake_tpu.models.llama.model import prefill_slot_chunk
+        logits = None
+        for window, n_real, start in chunk_windows(ids, C):
+            logits, self.cache = prefill_slot_chunk(
+                self.params, jnp.asarray([window], jnp.int32),
+                jnp.asarray([n_real], jnp.int32), jnp.int32(slot),
+                jnp.int32(pos0 + start), self.cache, self.rope,
+                self.config,
+            )
+        return logits
 
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()

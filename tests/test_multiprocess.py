@@ -103,10 +103,17 @@ def test_two_process_pipeline_matches_single(tmp_path, tiny_config,
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=420)
-        assert p.returncode == 0, out[-3000:]
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            assert p.returncode == 0, out[-3000:]
+            outs.append(out)
+    finally:
+        # a crashed worker leaves its peer blocked in the collective;
+        # never leak children past the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     tokens = []
     for out in outs:

@@ -110,6 +110,36 @@ def test_register_validation(tiny_config, params):
         engine.register_prefix(list(range(3, 3 + 127)))
 
 
+def test_engine_chunked_prefill_matches_whole_prompt(tiny_config, params):
+    """--prefill-chunk on the engine path: windowed prefill must produce
+    the same greedy stream as whole-prompt prefill."""
+    prompts = [list(range(3, 3 + 50)), list(range(60, 60 + 17)),
+               list(range(5, 5 + 16))]     # > C, > C, == C (no chunking)
+    whole = _collect(_engine(tiny_config, params), prompts)
+    chunked = _collect(_engine(tiny_config, params, prefill_chunk=16),
+                       prompts)
+    assert chunked == whole
+
+
+def test_engine_chunked_prefill_validation(tiny_config, params):
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _engine(tiny_config, params, prefill_chunk=33)  # !| 128
+
+
+def test_prefix_hit_with_chunked_suffix(tiny_config, params):
+    """--auto-prefix + --prefill-chunk: a long suffix after a cached
+    prefix is windowed (install + chunk path), output unchanged."""
+    long_suffix = list(range(40, 40 + 37))       # > C=16 -> 3 windows
+    prompt = PREFIX + long_suffix
+    cold = _collect(_engine(tiny_config, params), [prompt])
+
+    warm_engine = _engine(tiny_config, params, prefill_chunk=16)
+    warm_engine.register_prefix(PREFIX)
+    warm = _collect(warm_engine, [prompt])
+    assert warm == cold
+    assert warm_engine.stats.prefix_hits == 1
+
+
 def test_auto_prefix_system_prompt(tiny_config, params):
     """auto_prefix_system: two conversations sharing a system prompt —
     the second prefills only its own turns, outputs unchanged."""
