@@ -120,6 +120,11 @@ class Context:
                 raise ValueError(
                     "--sp does not compose with --tp/--dp/topology stages "
                     "in this release; run sp on its own mesh")
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "--sp (ring attention) does not implement "
+                    "sliding-window attention; serve this model without "
+                    "--sp")
             import numpy as np
             from jax.sharding import Mesh
 

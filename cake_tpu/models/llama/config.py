@@ -50,6 +50,11 @@ class LlamaConfig:
     bos_token_id: int = 128000
     eos_token_ids: Tuple[int, ...] = (128001, 128009)
     tie_word_embeddings: bool = False
+    # Sliding-window attention (Mistral-family, HF "sliding_window"):
+    # each query attends at most this many most-recent positions. None =
+    # full causal attention (Llama). The KV cache stays full-length
+    # (correct; a ring buffer is a memory optimization, not semantics).
+    sliding_window: Optional[int] = None
     # Use the Pallas flash-attention kernel for prefill windows whose shapes
     # tile (ops/flash_attention.py). Off by default so CPU test runs don't
     # pay interpret-mode cost; the TPU Context enables it.
@@ -98,6 +103,7 @@ class LlamaConfig:
             bos_token_id=raw.get("bos_token_id", 128000),
             eos_token_ids=eos,
             tie_word_embeddings=raw.get("tie_word_embeddings", False),
+            sliding_window=raw.get("sliding_window"),
         )
 
     @classmethod
@@ -119,6 +125,19 @@ class LlamaConfig:
             num_hidden_layers=32, num_attention_heads=32,
             num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=500000.0,
             max_position_embeddings=8192,
+        )
+
+    @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama architecture + 4096-token sliding
+        window (HF mistralai/Mistral-7B-v0.1 config.json; weight names
+        are identical, so loading/sharding/quantization all apply)."""
+        return cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=10000.0,
+            max_position_embeddings=32768, bos_token_id=1,
+            eos_token_ids=(2,), sliding_window=4096,
         )
 
     @classmethod

@@ -127,7 +127,10 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
         q = apply_rope(q, rope_c, rope_s)
         k = apply_rope(k, rope_c, rope_s)
         kc, vc = update_layer_cache(k_cache, v_cache, k, v, pos)
-        use_flash = is_prefill and config.use_flash_attention
+        # the flash kernels implement plain causal masking only;
+        # sliding-window models take the einsum path
+        use_flash = (is_prefill and config.use_flash_attention
+                     and config.sliding_window is None)
         if use_flash and not chunked and flash_supported(S, S, H, KV):
             # Fresh prompt at pos=0 with an empty cache: causal attention
             # over the in-window k/v IS the cached-decode mask, so the
@@ -199,7 +202,7 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
     T = cache.max_seq_len
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
-    mask = decode_mask(pos, S, T)
+    mask = decode_mask(pos, S, T, window=config.sliding_window)
     x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
                           mask, config, is_prefill=is_prefill,
                           chunked=chunked)
@@ -307,7 +310,8 @@ def ragged_decode(params, tokens, pos, active, cache: KVCache,
     T = cache.max_seq_len
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
-    mask = decode_mask_per_row(pos, T)
+    mask = decode_mask_per_row(pos, T,
+                               window=config.sliding_window)
     x, cache = blocks_runner(params["blocks"], x, cache, pos, active,
                              rope_c, rope_s, mask)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)

@@ -73,16 +73,27 @@ def causal_mask(seq_len: int, dtype=bool):
     return (j <= i).astype(dtype)
 
 
-def decode_mask(pos, seq_len: int, max_seq_len: int):
+def decode_mask(pos, seq_len: int, max_seq_len: int, window=None):
     """[S, T] mask for cached decode: query i (at absolute pos+i) may attend
-    cache slots j <= pos+i. Static shapes; `pos` may be a traced scalar."""
+    cache slots j <= pos+i. Static shapes; `pos` may be a traced scalar.
+
+    window: sliding-window attention (Mistral-style) — additionally
+    require kj > pos+i - window, so each query sees at most `window`
+    most-recent positions (its own included)."""
     qi = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 0)
     kj = lax.broadcasted_iota(jnp.int32, (seq_len, max_seq_len), 1)
-    return kj <= (qi + pos)
+    m = kj <= (qi + pos)
+    if window is not None:
+        m &= kj > (qi + pos - window)
+    return m
 
 
-def decode_mask_per_row(pos, max_seq_len: int):
+def decode_mask_per_row(pos, max_seq_len: int, window=None):
     """[B, 1, T] mask for ragged single-token decode: row b (whose query sits
-    at absolute position pos[b]) may attend cache slots j <= pos[b]."""
+    at absolute position pos[b]) may attend cache slots j <= pos[b].
+    window: see decode_mask."""
     kj = lax.broadcasted_iota(jnp.int32, (pos.shape[0], 1, max_seq_len), 2)
-    return kj <= pos[:, None, None]
+    m = kj <= pos[:, None, None]
+    if window is not None:
+        m &= kj > (pos[:, None, None] - window)
+    return m

@@ -171,7 +171,7 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
         T = cache.max_seq_len
         x = jnp.take(params["embed"], tokens, axis=0)
         rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
-        mask = decode_mask(pos, S, T)
+        mask = decode_mask(pos, S, T, window=config.sliding_window)
         y, k, v = stage_fns[is_prefill](params["blocks"], cache.k, cache.v,
                                         x, pos, rope_c, rope_s, mask)
         y = rms_norm(y, params["final_norm"], config.rms_norm_eps)

@@ -184,15 +184,17 @@ class InferenceEngine:
         # activation memory). Same divisibility contract as the
         # generator's knob — a clamped final window would overwrite
         # earlier cache entries.
+        if prefill_chunk is not None and step_fns is not None:
+            # check BEFORE validation: a pipelined engine ignores the
+            # knob with a warning, it must not crash on it
+            log.warning("prefill_chunk ignored: custom (pipelined) step "
+                        "fns own their prefill")
+            prefill_chunk = None
         if prefill_chunk is not None and (
                 prefill_chunk < 1 or max_seq_len % prefill_chunk != 0):
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= 1 and divide "
                 f"max_seq_len {max_seq_len}")
-        if prefill_chunk is not None and step_fns is not None:
-            log.warning("prefill_chunk ignored: custom (pipelined) step "
-                        "fns own their prefill")
-            prefill_chunk = None
         self.prefill_chunk = prefill_chunk
         self.cache = cache if cache is not None else KVCache.create(
             config, max_slots, max_seq_len, dtype=cache_dtype)

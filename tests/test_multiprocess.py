@@ -110,10 +110,11 @@ def test_two_process_pipeline_matches_single(tmp_path, tiny_config,
             outs.append(out)
     finally:
         # a crashed worker leaves its peer blocked in the collective;
-        # never leak children past the test
+        # never leak children (or zombies) past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                p.communicate()
 
     tokens = []
     for out in outs:

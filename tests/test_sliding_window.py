@@ -1,0 +1,165 @@
+"""Sliding-window attention (Mistral family).
+
+Oracles:
+  * window >= sequence length == full causal attention (exact equality);
+  * tokens OUTSIDE a query's window cannot influence its logits — we
+    corrupt the out-of-window prompt head and demand identical logits
+    (the defining property of the mask, checked end-to-end through the
+    cache/decode machinery, not just on the mask array);
+  * the engine's ragged decode path applies the same window.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig, load_config_dict
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.model import RopeTables, decode_step, prefill
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.attention import decode_mask
+from cake_tpu.ops.sampling import SamplingConfig
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+W = 8
+
+
+@pytest.fixture(scope="module")
+def cfg_w(tiny_config):
+    return dataclasses.replace(tiny_config, sliding_window=W)
+
+
+def test_mask_semantics():
+    m = np.asarray(decode_mask(jnp.int32(10), 2, 32, window=4))
+    # query 0 at absolute 10: positions 7..10; query 1 at 11: 8..11
+    assert m[0].nonzero()[0].tolist() == [7, 8, 9, 10]
+    assert m[1].nonzero()[0].tolist() == [8, 9, 10, 11]
+    full = np.asarray(decode_mask(jnp.int32(10), 2, 32))
+    assert full[0].nonzero()[0].tolist() == list(range(11))
+
+
+def test_window_geq_seq_equals_full(tiny_config, tiny_params):
+    big = dataclasses.replace(tiny_config, sliding_window=64)
+    prompt = np.full((1, 12), 7, np.int32)
+    plen = np.full((1,), 12, np.int32)
+    outs = {}
+    for name, cfg in (("full", tiny_config), ("win64", big)):
+        gen = LlamaGenerator(cfg, tiny_params,
+                             ByteTokenizer(cfg.vocab_size),
+                             max_seq_len=64, sampling=GREEDY)
+        outs[name] = gen.generate_on_device(prompt, plen, 8)
+    np.testing.assert_array_equal(outs["full"], outs["win64"])
+
+
+def test_out_of_receptive_field_tokens_cannot_influence_logits(
+        tiny_config, tiny_params):
+    """The window is PER LAYER, so the final logits' receptive field is
+    L*W positions. Corrupting prompt tokens beyond that horizon must
+    leave the last-position logits (and the next decode step) bit-equal
+    — the defining mask property, checked end-to-end through the
+    cache/prefill/decode machinery."""
+    Wt = 4
+    cfg = dataclasses.replace(tiny_config, sliding_window=Wt)
+    L = cfg.num_hidden_layers
+    rope = RopeTables.create(cfg, 64)
+    P = 24
+    horizon = L * Wt                 # 16: positions < P - horizon are dead
+    assert P - horizon >= 8
+    base = np.arange(3, 3 + P, dtype=np.int32)[None]
+    corrupt = base.copy()
+    corrupt[0, : P - horizon] = 99   # garbage beyond the receptive field
+
+    logits = {}
+    caches = {}
+    for name, toks in (("base", base), ("corrupt", corrupt)):
+        cache = KVCache.create(cfg, 1, 64)
+        lg, cache = prefill(tiny_params, jnp.asarray(toks),
+                            jnp.asarray([P]), cache, rope, cfg)
+        logits[name] = np.asarray(lg)
+        caches[name] = cache
+    np.testing.assert_array_equal(logits["base"], logits["corrupt"])
+
+    # decode one token at position P: its receptive field P-horizon..P
+    # still excludes every corrupted position
+    tok = jnp.asarray([[5]], jnp.int32)
+    for name in ("base", "corrupt"):
+        lg, _ = decode_step(tiny_params, tok, jnp.int32(P), caches[name],
+                            rope, cfg)
+        logits[name + "_d"] = np.asarray(lg)
+    np.testing.assert_array_equal(logits["base_d"], logits["corrupt_d"])
+
+
+def test_window_changes_output_vs_full(cfg_w, tiny_config, tiny_params):
+    """Sanity: with a prompt longer than W, windowed and full attention
+    genuinely differ (the flag is not a no-op)."""
+    prompt = np.arange(3, 3 + 24, dtype=np.int32)[None]
+    plen = np.full((1,), 24, np.int32)
+    a = LlamaGenerator(cfg_w, tiny_params, ByteTokenizer(cfg_w.vocab_size),
+                       max_seq_len=64, sampling=GREEDY
+                       ).generate_on_device(prompt, plen, 8)
+    b = LlamaGenerator(tiny_config, tiny_params,
+                       ByteTokenizer(tiny_config.vocab_size),
+                       max_seq_len=64, sampling=GREEDY
+                       ).generate_on_device(prompt, plen, 8)
+    assert not np.array_equal(a, b)
+
+
+def test_engine_ragged_decode_applies_window(cfg_w, tiny_params):
+    """Engine (ragged per-row decode) output == sequential generator for
+    a sliding-window model."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    prompt = list(range(3, 3 + 20))
+    engine = InferenceEngine(cfg_w, tiny_params,
+                             ByteTokenizer(cfg_w.vocab_size),
+                             max_slots=2, max_seq_len=64, sampling=GREEDY)
+    with engine:
+        h = engine.submit(prompt, max_new_tokens=6)
+        assert h.wait(timeout=300)
+    got = h._req.out_tokens[:6]
+
+    gen = LlamaGenerator(cfg_w, tiny_params,
+                         ByteTokenizer(cfg_w.vocab_size),
+                         max_seq_len=64, sampling=GREEDY)
+    want = gen.generate_on_device(
+        np.asarray([prompt], np.int32),
+        np.asarray([len(prompt)], np.int32), 6)[0].tolist()
+    assert got == want[:len(got)] and len(got) >= 1
+
+
+def test_hf_config_loads_sliding_window():
+    cfg = load_config_dict({
+        "model_type": "mistral", "vocab_size": 32000,
+        "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "sliding_window": 4096,
+        "rope_theta": 10000.0, "eos_token_id": 2,
+    })
+    assert isinstance(cfg, LlamaConfig)
+    assert cfg.sliding_window == 4096
+    assert LlamaConfig.mistral_7b().sliding_window == 4096
+
+
+def test_sp_rejects_sliding_window(tmp_path):
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+
+    cfg_path = tmp_path / "config.json"
+    import json
+    json.dump({
+        "model_type": "mistral", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 4,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "sliding_window": 16, "eos_token_id": 2,
+        "max_position_embeddings": 256,
+    }, open(cfg_path, "w"))
+    args = Args(model=str(tmp_path), sp=4, max_seq_len=128,
+                temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    with pytest.raises(ValueError, match="sliding-window"):
+        Context.from_args(args).load_text_model()
