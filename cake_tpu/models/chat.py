@@ -57,10 +57,23 @@ END_HEADER = "<|end_header_id|>"
 EOT = "<|eot_id|>"
 
 
-class History:
-    """Chat history -> Llama-3 prompt string (reference history.rs:8-33)."""
+TEMPLATES = ("llama3", "mistral")
 
-    def __init__(self) -> None:
+
+class History:
+    """Chat history -> prompt string.
+
+    template="llama3" (default): the reference's format (history.rs:8-33).
+    template="mistral": the Mistral-instruct format — `<s>[INST] ...
+    [/INST] answer</s>` turns, system prompt merged into the first user
+    turn (the official template has no system role), ending after the
+    last `[/INST]` to cue completion."""
+
+    def __init__(self, template: str = "llama3") -> None:
+        if template not in TEMPLATES:
+            raise ValueError(
+                f"unknown chat template '{template}' (have {TEMPLATES})")
+        self.template = template
         self._messages: List[Message] = []
 
     def add_message(self, message: Message) -> None:
@@ -84,9 +97,34 @@ class History:
         return History.encode_header(message.role.value) + message.content.strip() + EOT
 
     def render(self) -> str:
-        """Full dialog prompt, ending with an open assistant header."""
+        """Full dialog prompt, ending with the template's completion cue."""
+        if self.template == "mistral":
+            return self._render_mistral()
         out = [BEGIN_OF_TEXT]
         for m in self._messages:
             out.append(self.encode_message(m))
         out.append(self.encode_header(MessageRole.ASSISTANT.value))
+        return "".join(out)
+
+    def _render_mistral(self) -> str:
+        out = ["<s>"]
+        pending_system: List[str] = []
+        for m in self._messages:
+            if m.role == MessageRole.SYSTEM:
+                # no system role in the template: accumulate (several
+                # system messages concatenate) and merge into the next
+                # user turn
+                pending_system.append(m.content.strip())
+            elif m.role == MessageRole.USER:
+                text = m.content.strip()
+                if pending_system:
+                    text = "\n\n".join(pending_system + [text])
+                    pending_system = []
+                out.append(f"[INST] {text} [/INST]")
+            else:
+                out.append(f" {m.content.strip()}</s>")
+        if pending_system:
+            # trailing system with no user turn: render as its own
+            # instruction block rather than dropping it silently
+            out.append(f"[INST] {chr(10).join(pending_system)} [/INST]")
         return "".join(out)

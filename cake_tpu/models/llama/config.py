@@ -55,6 +55,9 @@ class LlamaConfig:
     # full causal attention (Llama). The KV cache stays full-length
     # (correct; a ring buffer is a memory optimization, not semantics).
     sliding_window: Optional[int] = None
+    # prompt template for the chat paths (models/chat.TEMPLATES);
+    # from_hf_dict sets "mistral" for model_type mistral
+    chat_template: str = "llama3"
     # Use the Pallas flash-attention kernel for prefill windows whose shapes
     # tile (ops/flash_attention.py). Off by default so CPU test runs don't
     # pay interpret-mode cost; the TPU Context enables it.
@@ -104,6 +107,11 @@ class LlamaConfig:
             eos_token_ids=eos,
             tie_word_embeddings=raw.get("tie_word_embeddings", False),
             sliding_window=raw.get("sliding_window"),
+            # Mixtral shares Mistral's [INST] instruct format and
+            # SentencePiece vocab — Llama-3 header tokens don't exist there
+            chat_template=("mistral"
+                           if raw.get("model_type") in ("mistral", "mixtral")
+                           else "llama3"),
         )
 
     @classmethod
@@ -138,6 +146,7 @@ class LlamaConfig:
             num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=10000.0,
             max_position_embeddings=32768, bos_token_id=1,
             eos_token_ids=(2,), sliding_window=4096,
+            chat_template="mistral",
         )
 
     @classmethod

@@ -162,7 +162,7 @@ class LlamaGenerator:
         self.prefill_chunk = prefill_chunk
         self.cache = cache if cache is not None else KVCache.create(
             config, batch_size, max_seq_len, dtype=cache_dtype)
-        self.history = History()
+        self.history = History(config.chat_template)
         self.rng = jax.random.PRNGKey(seed)
         self._reset_session()
 

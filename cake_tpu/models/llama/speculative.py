@@ -187,7 +187,7 @@ class SpeculativeGenerator:
                                     dtype=cache_dtype)
         self.d_cache = KVCache.create(draft_config, 1, max_seq_len,
                                       dtype=cache_dtype)
-        self.history = History()
+        self.history = History(config.chat_template)
         self.rng = jax.random.PRNGKey(seed)
         self.proposed = 0        # drafts offered to the verifier
         self.accepted = 0        # drafts kept

@@ -48,4 +48,5 @@ class MoEConfig(LlamaConfig):
             num_key_value_heads=8, rms_norm_eps=1e-5, rope_theta=1e6,
             max_position_embeddings=32768, bos_token_id=1,
             eos_token_ids=(2,), num_local_experts=8, num_experts_per_tok=2,
+            chat_template="mistral",
         )

@@ -382,12 +382,16 @@ class InferenceEngine:
         With auto_prefix_system on, the system message's rendered head is
         KV-cached once per distinct system prompt, so every conversation
         sharing it prefills only its own turns."""
-        hist = History()
+        hist = History(self.config.chat_template)
         for m in messages:
             hist.add_message(m)
         if (self._auto_prefix and messages
                 and messages[0].role.value == "system"
-                and self._prefill_slot is prefill_slot):
+                and self._prefill_slot is prefill_slot
+                and hist.template == "llama3"):
+            # the head builder below renders the llama3 system block;
+            # other templates (mistral merges system into the first user
+            # turn) have no standalone shared head
             self._auto_register_system(messages[0])
         return self.submit(encode_text(self.tokenizer, hist.render()), **kw)
 

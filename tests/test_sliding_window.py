@@ -145,6 +145,47 @@ def test_hf_config_loads_sliding_window():
     assert LlamaConfig.mistral_7b().sliding_window == 4096
 
 
+def test_mistral_chat_template():
+    from cake_tpu.models.chat import History, Message
+
+    h = History("mistral")
+    h.add_message(Message.system("Be brief."))
+    h.add_message(Message.user("hi"))
+    h.add_message(Message.assistant("hello"))
+    h.add_message(Message.user("more"))
+    assert h.render() == (
+        "<s>[INST] Be brief.\n\nhi [/INST] hello</s>[INST] more [/INST]")
+    # config plumbs the template; generators follow it
+    assert LlamaConfig.mistral_7b().chat_template == "mistral"
+    assert load_config_dict({
+        "model_type": "mistral", "vocab_size": 32, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "eos_token_id": 2,
+    }).chat_template == "mistral"
+    with pytest.raises(ValueError, match="template"):
+        History("gpt")
+    # multiple system messages concatenate; a trailing system message
+    # renders as its own instruction block instead of vanishing
+    h2 = History("mistral")
+    h2.add_message(Message.system("A"))
+    h2.add_message(Message.system("B"))
+    h2.add_message(Message.user("hi"))
+    assert h2.render() == "<s>[INST] A\n\nB\n\nhi [/INST]"
+    h3 = History("mistral")
+    h3.add_message(Message.user("hi"))
+    h3.add_message(Message.assistant("ok"))
+    h3.add_message(Message.system("answer in French"))
+    assert h3.render().endswith("[INST] answer in French [/INST]")
+    # Mixtral uses the same instruct format
+    from cake_tpu.models.moe import MoEConfig
+    assert MoEConfig.mixtral_8x7b().chat_template == "mistral"
+    assert load_config_dict({
+        "model_type": "mixtral", "vocab_size": 32, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "eos_token_id": 2,
+    }).chat_template == "mistral"
+
+
 def test_sp_rejects_sliding_window(tmp_path):
     from cake_tpu.args import Args
     from cake_tpu.context import Context
