@@ -57,7 +57,7 @@ END_HEADER = "<|end_header_id|>"
 EOT = "<|eot_id|>"
 
 
-TEMPLATES = ("llama3", "mistral")
+TEMPLATES = ("llama3", "mistral", "chatml")
 
 
 class History:
@@ -67,7 +67,9 @@ class History:
     template="mistral": the Mistral-instruct format — `<s>[INST] ...
     [/INST] answer</s>` turns, system prompt merged into the first user
     turn (the official template has no system role), ending after the
-    last `[/INST]` to cue completion."""
+    last `[/INST]` to cue completion.
+    template="chatml": the Qwen2 format — `<|im_start|>{role}\\n{content}
+    <|im_end|>\\n` per message, ending with an open assistant header."""
 
     def __init__(self, template: str = "llama3") -> None:
         if template not in TEMPLATES:
@@ -100,6 +102,18 @@ class History:
         """Full dialog prompt, ending with the template's completion cue."""
         if self.template == "mistral":
             return self._render_mistral()
+        if self.template == "chatml":
+            out = []
+            if not (self._messages
+                    and self._messages[0].role == MessageRole.SYSTEM):
+                # Qwen2's official template injects this default system
+                # prompt when the dialog opens without one
+                out.append("<|im_start|>system\n"
+                           "You are a helpful assistant.<|im_end|>\n")
+            out += [f"<|im_start|>{m.role.value}\n{m.content.strip()}"
+                    f"<|im_end|>\n" for m in self._messages]
+            out.append("<|im_start|>assistant\n")
+            return "".join(out)
         out = [BEGIN_OF_TEXT]
         for m in self._messages:
             out.append(self.encode_message(m))

@@ -56,8 +56,12 @@ class LlamaConfig:
     # (correct; a ring buffer is a memory optimization, not semantics).
     sliding_window: Optional[int] = None
     # prompt template for the chat paths (models/chat.TEMPLATES);
-    # from_hf_dict sets "mistral" for model_type mistral
+    # from_hf_dict sets "mistral" for model_type mistral/mixtral and
+    # "chatml" for qwen2
     chat_template: str = "llama3"
+    # QKV projection bias (Qwen2-family; HF "attention_bias" / implied by
+    # model_type qwen2) — adds bq/bk/bv leaves to every block
+    attention_bias: bool = False
     # Use the Pallas flash-attention kernel for prefill windows whose shapes
     # tile (ops/flash_attention.py). Off by default so CPU test runs don't
     # pay interpret-mode cost; the TPU Context enables it.
@@ -106,12 +110,19 @@ class LlamaConfig:
             bos_token_id=raw.get("bos_token_id", 128000),
             eos_token_ids=eos,
             tie_word_embeddings=raw.get("tie_word_embeddings", False),
-            sliding_window=raw.get("sliding_window"),
+            # Qwen2/2.5 checkpoints ship sliding_window alongside
+            # use_sliding_window: false (full attention) — honor the gate
+            sliding_window=(raw.get("sliding_window")
+                            if raw.get("use_sliding_window", True)
+                            else None),
             # Mixtral shares Mistral's [INST] instruct format and
-            # SentencePiece vocab — Llama-3 header tokens don't exist there
-            chat_template=("mistral"
-                           if raw.get("model_type") in ("mistral", "mixtral")
-                           else "llama3"),
+            # SentencePiece vocab — Llama-3 header tokens don't exist
+            # there; Qwen2 uses ChatML
+            chat_template={"mistral": "mistral", "mixtral": "mistral",
+                           "qwen2": "chatml"}.get(
+                               raw.get("model_type", ""), "llama3"),
+            attention_bias=raw.get("attention_bias",
+                                   raw.get("model_type") == "qwen2"),
         )
 
     @classmethod
@@ -147,6 +158,19 @@ class LlamaConfig:
             max_position_embeddings=32768, bos_token_id=1,
             eos_token_ids=(2,), sliding_window=4096,
             chat_template="mistral",
+        )
+
+    @classmethod
+    def qwen2_7b(cls) -> "LlamaConfig":
+        """Qwen2-7B-Instruct: Llama architecture + QKV bias + ChatML
+        (HF Qwen/Qwen2-7B-Instruct config.json)."""
+        return cls(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28,
+            num_key_value_heads=4, rms_norm_eps=1e-6, rope_theta=1e6,
+            max_position_embeddings=32768, bos_token_id=151643,
+            eos_token_ids=(151645, 151643), attention_bias=True,
+            chat_template="chatml",
         )
 
     @classmethod

@@ -79,9 +79,16 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
     KV = lp["wk"].shape[-1] // hd
 
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = qmatmul(h, lp["wq"]).reshape(B, S, H, hd)
-    k = qmatmul(h, lp["wk"]).reshape(B, S, KV, hd)
-    v = qmatmul(h, lp["wv"]).reshape(B, S, KV, hd)
+    q = qmatmul(h, lp["wq"])
+    k = qmatmul(h, lp["wk"])
+    v = qmatmul(h, lp["wv"])
+    if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, S, H, hd)
+    k = k.reshape(B, S, KV, hd)
+    v = v.reshape(B, S, KV, hd)
     attn, extras = attn_fn(q, k, v)
     attn_out = qmatmul(attn.reshape(B, S, H * hd), lp["wo"])
     if tp_axis is not None:

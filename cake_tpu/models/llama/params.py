@@ -29,7 +29,7 @@ def init_params(config: LlamaConfig, rng: jax.Array, dtype=jnp.bfloat16):
     c = config
     L, D, F = c.num_hidden_layers, c.hidden_size, c.intermediate_size
     H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
-    keys = jax.random.split(rng, 10)
+    keys = jax.random.split(rng, 12)
 
     def w(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -51,6 +51,12 @@ def init_params(config: LlamaConfig, rng: jax.Array, dtype=jnp.bfloat16):
         "final_norm": jnp.ones((D,), dtype),
         "lm_head": w(keys[8], (D, c.vocab_size), D),
     }
+    if config.attention_bias:
+        # distinct keys: identical bk/bv would hide a k/v bias swap from
+        # any value-sensitive test
+        params["blocks"]["bq"] = w(keys[9], (L, H * hd), D)
+        params["blocks"]["bk"] = w(keys[10], (L, KV * hd), D)
+        params["blocks"]["bv"] = w(keys[11], (L, KV * hd), D)
     if config.tie_word_embeddings:
         params["lm_head"] = params["embed"].T
     return params
@@ -113,6 +119,11 @@ def init_params_quantized(config: LlamaConfig, rng: jax.Array,
         "w_up": qleaf((L, D, F), _BLOCK_CONTRACT["w_up"], D),
         "w_down": qleaf((L, F, D), _BLOCK_CONTRACT["w_down"], F),
     }
+    if c.attention_bias:
+        # full-precision, matching quantize_params (biases never quantize)
+        blocks["bq"] = w((L, H * hd), D)
+        blocks["bk"] = w((L, KV * hd), D)
+        blocks["bv"] = w((L, KV * hd), D)
     return {
         "embed": w((c.vocab_size, D), D),
         "blocks": blocks,
@@ -148,6 +159,12 @@ def hf_param_layout(config: LlamaConfig):
         "w_up": ("mlp.up_proj.weight", True),
         "w_down": ("mlp.down_proj.weight", True),
     }
+    if config.attention_bias:
+        per_layer.update({
+            "bq": ("self_attn.q_proj.bias", False),
+            "bk": ("self_attn.k_proj.bias", False),
+            "bv": ("self_attn.v_proj.bias", False),
+        })
     return layout, per_layer, L
 
 
@@ -233,6 +250,8 @@ def block_param_keys(config=None, *, moe: Optional[bool] = None) -> tuple:
     if moe is None:
         moe = bool(config is not None and config.is_moe)
     keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
+    if config is not None and getattr(config, "attention_bias", False):
+        keys += ["bq", "bk", "bv"]
     keys += (["router", "we_gate", "we_up", "we_down"] if moe
              else ["w_gate", "w_up", "w_down"])
     return tuple(keys)
@@ -253,6 +272,11 @@ def block_specs(keys, stage_axis: Optional[str] = None,
         "wq": P(S, None, T),
         "wk": P(S, None, T),
         "wv": P(S, None, T),
+        # QKV bias (Qwen2): head dim sharded like the matching weight's
+        # output dim
+        "bq": P(S, T),
+        "bk": P(S, T),
+        "bv": P(S, T),
         "wo": P(S, T, None),
         "mlp_norm": P(S, None),
         "w_gate": P(S, None, T),
@@ -271,7 +295,8 @@ def block_specs(keys, stage_axis: Optional[str] = None,
     return {k: table[k] for k in keys}
 
 
-def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None):
+def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None,
+                config: Optional[LlamaConfig] = None):
     """PartitionSpec pytree for Megatron-style tensor parallelism.
 
     Column-parallel: q/k/v, gate/up (output dim over tp).
@@ -280,10 +305,12 @@ def param_specs(tp_axis: str = "tp", stage_axis: Optional[str] = None):
     stage_axis, if given, shards the stacked layer dim (pipeline via scan
     is NOT done this way — see parallel/pipeline.py — but a stage axis on
     the layer dim gives cheap weight-memory sharding for fits-in-HBM checks).
+    config: pass the model config so family-dependent leaves (Qwen2's
+    bq/bk/bv) get specs; without it the dense biasless set is assumed.
     """
     return {
         "embed": P(tp_axis, None),
-        "blocks": block_specs(block_param_keys(moe=False),
+        "blocks": block_specs(block_param_keys(config, moe=False),
                               stage_axis=stage_axis, tp_axis=tp_axis),
         "final_norm": P(None),
         "lm_head": P(None, tp_axis),

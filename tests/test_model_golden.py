@@ -42,6 +42,50 @@ def hf_model_dir(tmp_path_factory):
     return d, model
 
 
+TINY_QWEN2 = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+    rms_norm_eps=1e-6, rope_theta=10000.0, max_position_embeddings=128,
+    bos_token_id=1, eos_token_id=2, tie_word_embeddings=False,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_qwen2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_tiny_qwen2")
+    cfg = transformers.Qwen2Config(**TINY_QWEN2,
+                                   attn_implementation="eager")
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(str(d), safe_serialization=True)
+    (d / "config.json").write_text(
+        json.dumps({**TINY_QWEN2, "model_type": "qwen2"}))
+    return d, model
+
+
+def test_qwen2_logits_match_hf(hf_qwen2_dir):
+    """Qwen2 family: the QKV-bias path against transformers' reference
+    implementation, through the full load path (config.json dispatch ->
+    bias leaves -> forward)."""
+    d, hf = hf_qwen2_dir
+    cfg = LlamaConfig.from_path(str(d))
+    assert cfg.attention_bias and cfg.chat_template == "chatml"
+    params = load_params_from_hf(str(d), cfg, dtype=jnp.float32)
+    assert "bq" in params["blocks"]
+
+    tokens = np.array([[1, 5, 9, 42, 7, 100, 3, 250]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+
+    rope = RopeTables.create(cfg, 64)
+    cache = KVCache.create(cfg, batch_size=1, max_seq_len=64,
+                           dtype=jnp.float32)
+    ours, _ = forward_logits_all(params, jnp.asarray(tokens), cache,
+                                 jnp.int32(0), rope, cfg)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
+
+
 def test_logits_match_hf(hf_model_dir):
     d, hf = hf_model_dir
     cfg = LlamaConfig.from_path(str(d))
