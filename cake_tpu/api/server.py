@@ -130,12 +130,23 @@ class ApiServer:
                     break  # request ended without a final delta (error path)
                 continue
             if delta:
-                send_chunk(chunk_response(delta, self.model_name, rid=rid))
+                try:
+                    send_chunk(chunk_response(delta, self.model_name,
+                                              rid=rid))
+                except OSError:
+                    # client disconnected mid-stream: free the slot now
+                    # instead of decoding to max_tokens for nobody
+                    log.info("client disconnected; cancelling request")
+                    self.engine.cancel(h)
+                    return DISCONNECTED
             if final:
                 break
         h.text()  # raises if the engine failed the request
-        send_chunk(chunk_response("", self.model_name,
-                                  finish="stop", rid=rid))
+        try:
+            send_chunk(chunk_response("", self.model_name,
+                                      finish="stop", rid=rid))
+        except OSError:
+            return DISCONNECTED  # request already complete; just stop
         return None
 
     # -- image --------------------------------------------------------------
@@ -233,6 +244,11 @@ class ApiServer:
         return _Adm()
 
 
+# chat() return sentinel: the streaming client went away (handled; the
+# HTTP layer must not touch the dead socket again)
+DISCONNECTED = object()
+
+
 class QueueFull(Exception):
     pass
 
@@ -324,7 +340,12 @@ def make_handler(api: ApiServer):
                 self.wfile.write(payload + b"\r\n")
                 self.wfile.flush()
 
-            api.chat(body, send_chunk=send_chunk, on_start=on_start)
+            outcome = api.chat(body, send_chunk=send_chunk,
+                               on_start=on_start)
+            if outcome is DISCONNECTED:
+                # handled disconnect: the socket is dead, writing the
+                # trailer would only manufacture an error traceback
+                return
             done = b"data: [DONE]\n\n"
             self.wfile.write(hex(len(done))[2:].encode() + b"\r\n")
             self.wfile.write(done + b"\r\n")

@@ -239,6 +239,10 @@ class InferenceEngine:
         self._next_rid = 1
         self._rid_lock = threading.Lock()
         self._requests = {}
+        # rids whose callers gave up (client disconnect): drained by the
+        # ENGINE thread at the top of its loop, so request/slot teardown
+        # has a single writer
+        self._cancel_q: List[int] = []
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -433,6 +437,28 @@ class InferenceEngine:
             with self._rid_lock:
                 self._auto_pids[head] = pid
 
+    def cancel(self, handle: RequestHandle) -> None:
+        """Abandon a request (e.g. the streaming client disconnected):
+        its slot frees for the next queued request instead of decoding to
+        max_new_tokens for nobody. Safe from any thread; the engine
+        thread performs the actual teardown."""
+        with self._rid_lock:
+            self._cancel_q.append(handle._req.rid)
+        self._wake.set()
+
+    def _drain_cancellations(self) -> None:
+        with self._rid_lock:
+            rids, self._cancel_q = self._cancel_q, []
+        for rid in rids:
+            req = self._requests.pop(rid, None)
+            if req is None:
+                continue
+            self.scheduler.cancel(rid)
+            if req.slot >= 0 and self._slot_req[req.slot] is req:
+                self._slot_req[req.slot] = None
+            req.finish_t = time.perf_counter()
+            req.done.set()
+
     @property
     def queue_depth(self) -> int:
         return self.scheduler.queue_depth
@@ -444,7 +470,17 @@ class InferenceEngine:
     # -- engine loop ----------------------------------------------------------
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # cancellations enqueued in the stop window must still tear
+            # down (an undrained handle would block wait() forever and be
+            # replayed as live by a checkpoint snapshot)
+            self._drain_cancellations()
+
+    def _run_loop(self) -> None:
         while not self._stop.is_set():
+            self._drain_cancellations()
             prefill_plan, decode_plan = self.scheduler.plan()
             if not prefill_plan and not decode_plan:
                 self._wake.wait(timeout=0.05)

@@ -256,3 +256,57 @@ def test_decode_scan_with_stochastic_rows(setup):
                         temperature=0.0)
         assert hot.wait(120) and cold.wait(120)
     assert cold.token_ids == want
+
+
+def test_cancel_frees_slot_and_stops_decode(setup):
+    """engine.cancel (client disconnect): the request finishes early, the
+    slot frees for new work, and decode stops burning steps on it."""
+    with make_engine(setup, max_slots=1) as eng:
+        h = eng.submit(list(range(3, 20)), max_new_tokens=120)
+        # wait for the first token so the request holds the only slot
+        deadline = time.time() + 60
+        while not h._req.out_tokens and time.time() < deadline:
+            time.sleep(0.01)
+        assert h._req.out_tokens
+        eng.cancel(h)
+        assert h.wait(timeout=30)
+        n_at_cancel = len(h._req.out_tokens)
+        assert n_at_cancel < 120
+        # the slot must be free: a new request completes
+        h2 = eng.submit(list(range(30, 40)), max_new_tokens=4)
+        assert h2.wait(timeout=120)
+        assert len(h2._req.out_tokens) >= 1
+        # the cancelled request saw no further tokens
+        assert len(h._req.out_tokens) == n_at_cancel
+
+
+def test_api_stream_disconnect_cancels(setup):
+    """A send_chunk raising BrokenPipeError (client gone) cancels the
+    in-flight request instead of decoding to max_tokens."""
+    from cake_tpu.api.server import ApiServer
+    from cake_tpu.master import Master
+    from cake_tpu.args import Args
+
+    cfg, params, tok = setup
+    gen = LlamaGenerator(cfg, params, tok, max_seq_len=256,
+                         sampling=SamplingConfig(temperature=0.0),
+                         cache_dtype=jnp.float32)
+    master = Master(Args(model="", max_seq_len=256).validate(),
+                    text_generator=gen)
+    with make_engine(setup, max_slots=2) as eng:
+        api = ApiServer(master, "test", engine=eng)
+        sent = []
+
+        def send_chunk(data):
+            sent.append(data)
+            if len(sent) >= 2:
+                raise BrokenPipeError("client gone")
+
+        body = {"messages": [{"role": "user", "content": "hello there"}],
+                "max_tokens": 200, "stream": True}
+        api.chat(body, send_chunk=send_chunk)   # returns without raising
+        # the engine's request table drains (cancelled), not after 200 toks
+        deadline = time.time() + 30
+        while eng._requests and time.time() < deadline:
+            time.sleep(0.05)
+        assert not eng._requests
