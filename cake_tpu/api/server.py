@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -49,6 +50,7 @@ class ApiServer:
         self._gen_lock = threading.Lock()
         self._waiting = 0
         self._waiting_lock = threading.Lock()
+        self.started_at = int(time.time())  # /v1/models "created"
 
     # -- text ---------------------------------------------------------------
 
@@ -282,6 +284,14 @@ def make_handler(api: ApiServer):
                 return self._json(200, api.health())
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
+            if self.path in ("/v1/models", "/api/v1/models"):
+                # OpenAI client compatibility: SDKs list models on init
+                return self._json(200, {
+                    "object": "list",
+                    "data": [{"id": api.model_name, "object": "model",
+                              "created": api.started_at,
+                              "owned_by": "cake-tpu"}],
+                })
             if self.path == "/metrics":
                 data = api.metrics().encode()
                 self.send_response(200)
@@ -299,7 +309,10 @@ def make_handler(api: ApiServer):
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
             try:
-                if self.path == "/api/v1/chat/completions":
+                if self.path in ("/api/v1/chat/completions",
+                                 "/v1/chat/completions"):
+                    # the /v1 alias serves OpenAI SDKs pointed at
+                    # base_url=.../v1 (they discover via /v1/models)
                     return self._chat(body)
                 if self.path == "/api/v1/image":
                     return self._json(200, api.image(body))

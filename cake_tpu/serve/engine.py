@@ -264,6 +264,10 @@ class InferenceEngine:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        # catch cancellations enqueued after the engine thread's final
+        # drain but before join returned (the cancel() dead-thread check
+        # handles calls arriving later than this)
+        self._drain_cancellations()
 
     def __enter__(self):
         return self.start()
@@ -441,10 +445,15 @@ class InferenceEngine:
         """Abandon a request (e.g. the streaming client disconnected):
         its slot frees for the next queued request instead of decoding to
         max_new_tokens for nobody. Safe from any thread; the engine
-        thread performs the actual teardown."""
+        thread performs the actual teardown — unless it has already
+        exited (shutdown window), in which case teardown runs inline so
+        the request can neither hang wait() nor be checkpointed as live."""
         with self._rid_lock:
             self._cancel_q.append(handle._req.rid)
         self._wake.set()
+        if self._stop.is_set() and (self._thread is None
+                                    or not self._thread.is_alive()):
+            self._drain_cancellations()
 
     def _drain_cancellations(self) -> None:
         with self._rid_lock:

@@ -81,6 +81,14 @@ def test_health_and_cluster(server_url):
     assert len(c["devices"]) == 8  # virtual CPU mesh
 
 
+def test_models_endpoint(server_url):
+    for path in ("/v1/models", "/api/v1/models"):
+        m = json.loads(urllib.request.urlopen(
+            server_url + path, timeout=10).read())
+        assert m["object"] == "list"
+        assert m["data"][0]["object"] == "model"
+
+
 def test_metrics_endpoint(server_url):
     resp = urllib.request.urlopen(server_url + "/metrics", timeout=10)
     assert resp.headers["Content-Type"].startswith("text/plain")
