@@ -24,21 +24,29 @@ def parse_chat_request(body: dict) -> tuple[List[Message], dict]:
         "max_tokens": body.get("max_tokens"),
         "temperature": body.get("temperature"),
         "top_p": body.get("top_p"),
+        "logprobs": bool(body.get("logprobs", False)),
     }
     return msgs, opts
 
 
-def completion_response(text: str, model: str = "cake-tpu") -> dict:
+def completion_response(text: str, model: str = "cake-tpu",
+                        logprobs: list | None = None) -> dict:
+    """logprobs: optional [{"token": str, "logprob": float}] content list
+    (OpenAI `logprobs: true`; non-streaming responses only)."""
+    choice = {
+        "index": 0,
+        "message": {"role": "assistant", "content": text},
+        "finish_reason": "stop",
+        # OpenAI schema: logprobs is null unless requested
+        "logprobs": ({"content": logprobs}
+                     if logprobs is not None else None),
+    }
     return {
         "id": str(uuid.uuid4()),
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{
-            "index": 0,
-            "message": {"role": "assistant", "content": text},
-            "finish_reason": "stop",
-        }],
+        "choices": [choice],
     }
 
 

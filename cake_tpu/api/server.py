@@ -64,6 +64,10 @@ class ApiServer:
         if self.engine is not None:
             return self._chat_engine(body, send_chunk, on_start)
         messages, opts = parse_chat_request(body)
+        if opts.get("logprobs"):
+            raise ValueError(
+                "logprobs requires the batching engine (this deployment "
+                "serves through the legacy locked path)")
         with self._admission():
             with self._gen_lock:
                 m = self.master
@@ -106,7 +110,17 @@ class ApiServer:
             except QueueFullError:
                 raise QueueFull()
             h.wait()
-            return completion_response(h.text(), self.model_name)
+            lp = None
+            if opts.get("logprobs"):
+                def item(t, l):
+                    text = self.engine.tokenizer.decode([t])
+                    return {"token": text,
+                            "logprob": round(l, 6),
+                            "bytes": list(text.encode()),
+                            "top_logprobs": []}
+                lp = [item(t, l) for t, l in h.token_logprobs]
+            return completion_response(h.text(), self.model_name,
+                                       logprobs=lp)
 
         rid = str(uuid.uuid4())
         # Deltas are queued by the engine thread and written here on the
@@ -317,6 +331,12 @@ def make_handler(api: ApiServer):
                 if self.path == "/api/v1/image":
                     return self._json(200, api.image(body))
                 return self._json(404, {"error": "not found"})
+            except ValueError as e:
+                # invalid option combinations (e.g. logprobs on the
+                # engine-less path) are client errors, not server faults
+                if getattr(self, "_stream_started", False):
+                    return
+                return self._json(400, {"error": str(e)})
             except QueueFull:
                 if getattr(self, "_stream_started", False):
                     return  # headers already gone; just drop the connection

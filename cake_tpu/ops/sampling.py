@@ -131,7 +131,12 @@ def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
     top_k:           static engine-wide k (the REST API exposes only
                      temperature/top_p per request, matching the reference's
                      global Args.top_k)
-    Returns [B] int32.
+    Returns ([B] int32 ids, [B] f32 logprobs) — the chosen token's
+    log-probability under the post-penalty model distribution (the OpenAI
+    `logprobs` quantity; temperature/top-p are sampling transforms and do
+    not change the reported probability, the HF/vLLM convention). Computed
+    here so the penalized logits are reused — one penalty pass, one
+    softmax.
     """
     logits = logits.astype(jnp.float32)
     logits = _apply_repeat_penalty_per_row(logits, recent_tokens,
@@ -156,4 +161,7 @@ def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
     sampled = jax.vmap(
         lambda k, lg: jax.random.categorical(k, lg)
     )(keys, filtered).astype(jnp.int32)
-    return jnp.where(greedy, argmax_ids, sampled)
+    ids = jnp.where(greedy, argmax_ids, sampled)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    chosen_lp = jnp.take_along_axis(lp, ids[:, None], axis=-1)[:, 0]
+    return ids, chosen_lp

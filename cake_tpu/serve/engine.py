@@ -66,6 +66,7 @@ class _Request:
     # (checkpoint resume): seeds the slot's repeat-penalty ring
     prime_tokens: List[int] = field(default_factory=list)
     out_tokens: List[int] = field(default_factory=list)
+    out_logprobs: List[float] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
     slot: int = -1
@@ -90,6 +91,14 @@ class RequestHandle:
     def token_ids(self) -> List[int]:
         ids = self._req.out_tokens
         return [t for t in ids if t not in self._eos_ids]
+
+    @property
+    def token_logprobs(self) -> List[tuple]:
+        """(token_id, logprob) pairs aligned with token_ids (EOS dropped;
+        the OpenAI `logprobs` content)."""
+        return [(t, lp) for t, lp in zip(self._req.out_tokens,
+                                         self._req.out_logprobs)
+                if t not in self._eos_ids]
 
     def text(self) -> str:
         if self._req.error is not None:
@@ -604,11 +613,11 @@ class InferenceEngine:
             self._ring = self._ring.at[slot].set(jnp.asarray(row))
             self._steps[slot] = len(req.prime_tokens)
         # sample the first token with the slot's own key/options
-        first = self._sample_rows(
+        first, first_lp = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
             rows=[slot])
         self.stats.prefill_time_s += time.perf_counter() - t0
-        self._emit(req, int(first[slot]))
+        self._emit(req, int(first[slot]), logprob=float(first_lp[slot]))
 
     def _prefill_chunked(self, ids: List[int], slot: int, C: int,
                          pos0: int = 0):
@@ -641,7 +650,7 @@ class InferenceEngine:
             self.params, toks, pos, jnp.asarray(active), self.cache,
             self.rope, self.config,
         )
-        nxt = self._sample_rows(logits, rows=[s for _, s in decode_plan])
+        nxt, lp = self._sample_rows(logits, rows=[s for _, s in decode_plan])
         self._pos += active  # only active rows advanced
         self.stats.steps += 1
         self.stats.decode_time_s += time.perf_counter() - t0
@@ -650,7 +659,7 @@ class InferenceEngine:
             req = self._slot_req[slot]
             if req is None or req.rid != rid:
                 continue
-            self._emit(req, int(nxt[slot]))
+            self._emit(req, int(nxt[slot]), logprob=float(lp[slot]))
 
     def _scan_steps_for(self, decode_plan) -> int:
         """Fixed scan length when multi-step decode is safe right now:
@@ -678,7 +687,7 @@ class InferenceEngine:
         active = np.zeros(B, bool)
         for _, slot in decode_plan:
             active[slot] = True
-        toks, self.cache, self._keys, self._ring = _decode_scan(
+        toks, lps, self.cache, self._keys, self._ring = _decode_scan(
             self.params,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
@@ -691,6 +700,7 @@ class InferenceEngine:
             num_steps=n, top_k=self.defaults.top_k,
         )
         toks_host = np.asarray(toks)                 # [B, n]
+        lps_host = np.asarray(lps)                   # [B, n]
         self.stats.steps += n
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan) * n)
@@ -705,7 +715,8 @@ class InferenceEngine:
                 # per-token position so _emit's cap check sees the value a
                 # single-step loop would have had
                 self._pos[slot] = pos0 + j + 1
-                self._emit(req, int(toks_host[slot, j]))
+                self._emit(req, int(toks_host[slot, j]),
+                           logprob=float(lps_host[slot, j]))
                 if req.done.is_set():
                     # EOS/budget mid-scan: later tokens are overshoot; the
                     # slot's cache garbage is overwritten by the next
@@ -721,7 +732,7 @@ class InferenceEngine:
         row_mask = np.zeros(B, bool)
         for r in rows:
             row_mask[r] = True
-        nxt, self._keys, self._ring = _masked_sample(
+        nxt, self._keys, self._ring, lp = _masked_sample(
             jnp.asarray(row_mask), self._keys, logits, self._ring,
             jnp.asarray(self._steps, jnp.int32),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
@@ -731,12 +742,14 @@ class InferenceEngine:
         for r in rows:
             self._steps[r] += 1
             self._last_tok[r] = nxt_host[r]
-        return nxt_host
+        return nxt_host, np.asarray(lp)
 
     # -- token plumbing -------------------------------------------------------
 
-    def _emit(self, req: _Request, token_id: int) -> None:
+    def _emit(self, req: _Request, token_id: int,
+              logprob: float = 0.0) -> None:
         now = time.perf_counter()
+        req.out_logprobs.append(logprob)
         if not req.out_tokens:
             req.first_token_t = now
         req.out_tokens.append(token_id)
@@ -792,14 +805,14 @@ def _masked_sample(active_mask, keys, logits, ring, steps, temp, top_p,
     the engine's sampling semantics: rows outside active_mask keep their
     PRNG key and ring untouched. Used eagerly by _sample_rows and traced
     inside _decode_scan, so the two decode paths cannot drift.
-    Returns (next_tokens [B], keys, ring)."""
+    Returns (next_tokens [B], keys, ring, logprobs [B])."""
     new_keys, sub = _split_keys(keys)
-    nxt = sample_tokens_ragged(sub, logits, ring, temp, top_p, penalty,
-                               top_k=top_k)
+    nxt, lp = sample_tokens_ragged(sub, logits, ring, temp, top_p, penalty,
+                                   top_k=top_k)
     keys = jnp.where(active_mask[:, None], new_keys, keys)
     ring = jnp.where(active_mask[:, None],
                      update_ring_per_row(ring, nxt, steps), ring)
-    return nxt, keys, ring
+    return nxt, keys, ring, lp
 
 
 @partial(jax.jit, static_argnames=("config", "num_steps", "top_k"),
@@ -815,8 +828,9 @@ def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
     emits EOS mid-scan freezes for the remaining steps — in single-step
     mode the scheduler frees the slot immediately, so without freezing
     the slot's PRNG/ring stream would diverge between the two modes.
-    Returns ([B, num_steps] tokens, cache, keys, ring); the host mirrors
-    (_pos/_steps/_last_tok) are advanced by the caller.
+    Returns ([B, num_steps] tokens, [B, num_steps] logprobs, cache, keys,
+    ring); the host mirrors (_pos/_steps/_last_tok) are advanced by the
+    caller.
     """
     from cake_tpu.models.llama.model import forward_ragged
 
@@ -826,15 +840,16 @@ def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
         tok, pos, cache, keys, ring, steps, live = carry
         logits, cache = forward_ragged(params, tok[:, None], cache, pos,
                                        live, rope, config)
-        nxt, keys, ring = _masked_sample(live, keys, logits, ring, steps,
-                                         temp, top_p, penalty, top_k=top_k)
+        nxt, keys, ring, lp = _masked_sample(live, keys, logits, ring,
+                                             steps, temp, top_p, penalty,
+                                             top_k=top_k)
         tok = jnp.where(live, nxt, tok)
         pos = pos + live
         steps = steps + live
         live = live & ~jnp.isin(nxt, eos_ids)
-        return (tok, pos, cache, keys, ring, steps, live), nxt
+        return (tok, pos, cache, keys, ring, steps, live), (nxt, lp)
 
-    (tok, pos, cache, keys, ring, steps, live), toks = jax.lax.scan(
+    (tok, pos, cache, keys, ring, steps, live), (toks, lps) = jax.lax.scan(
         body, (last_tok, pos, cache, keys, ring, steps, active), None,
         length=num_steps)
-    return toks.T, cache, keys, ring  # toks: [B, num_steps]
+    return toks.T, lps.T, cache, keys, ring  # [B, num_steps] each
