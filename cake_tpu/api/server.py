@@ -122,6 +122,13 @@ class ApiServer:
             return completion_response(h.text(), self.model_name,
                                        logprobs=lp)
 
+        if opts.get("logprobs"):
+            # before headers go out, so the client gets a clean 400 (the
+            # chunk schema has no logprobs field here; silently dropping
+            # the option would misreport what was served)
+            raise ValueError(
+                "logprobs is supported on non-streaming responses only")
+
         rid = str(uuid.uuid4())
         # Deltas are queued by the engine thread and written here on the
         # handler thread: a slow client must never block the engine loop

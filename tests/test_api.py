@@ -89,6 +89,15 @@ def test_models_endpoint(server_url):
         assert m["data"][0]["object"] == "model"
 
 
+def test_streaming_logprobs_rejected(server_url):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server_url + "/api/v1/chat/completions",
+              {"messages": [{"role": "user", "content": "x"}],
+               "stream": True, "logprobs": True, "max_tokens": 2})
+    assert e.value.code == 400
+    assert b"non-streaming" in e.value.read()
+
+
 def test_metrics_endpoint(server_url):
     resp = urllib.request.urlopen(server_url + "/metrics", timeout=10)
     assert resp.headers["Content-Type"].startswith("text/plain")
