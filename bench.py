@@ -34,6 +34,14 @@ import sys
 import time
 
 ORCH_ENV = "CAKE_BENCH_TIER"
+PROBE_ENV = "CAKE_BENCH_PROBE"
+# A healthy backend answers the probe in ~5-15 s (tunnel handshake +
+# device enumeration); 120 s is generous. A hung tunnel (the round-3
+# failure: jax.devices() blocks forever) must not cost more than this.
+try:
+    PROBE_TIMEOUT_S = int(os.environ.get("CAKE_BENCH_PROBE_TIMEOUT", "120"))
+except ValueError:
+    PROBE_TIMEOUT_S = 120
 
 
 def log(*a):
@@ -363,23 +371,72 @@ def tier_main():
     print(json.dumps(result), flush=True)
 
 
-def _run_tier_subprocess(name: str) -> dict | None:
-    log(f"--- tier {name} (fresh subprocess) ---")
-    env = dict(os.environ, **{ORCH_ENV: name})
+def probe_main():
+    """Child-process entry: init the backend, print one JSON line.
+
+    Deliberately does nothing else — the point is to discover a dead or
+    hung backend in seconds, in a process the orchestrator can kill."""
+    import jax
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform,
+                      "device_kind": dev.device_kind}), flush=True)
+
+
+def _spawn_self(env_key: str, value: str, timeout: int, label: str):
+    """Re-exec this file with env_key=value set; returns (proc, json_line)
+    or (None, None) on timeout (partial stderr logged either way).
+    json_line is None when the first '{'-line isn't parseable JSON, so no
+    caller can crash out of the one-JSON-line output contract."""
+    env = dict(os.environ, **{env_key: value})
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, timeout=1800,
+            env=env, capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired as e:
         err = e.stderr or b""
         if isinstance(err, bytes):
             err = err.decode(errors="replace")
-        log(f"{name}: timed out; partial stderr:\n{err[-2000:]}")
-        return None
-    sys.stderr.write(proc.stderr)
+        log(f"{label}: timed out after {timeout}s; "
+            f"partial stderr:\n{err[-2000:]}")
+        return None, None
     line = next((ln for ln in proc.stdout.splitlines()
                  if ln.startswith("{")), None)
+    if line is not None:
+        try:
+            json.loads(line)
+        except json.JSONDecodeError:
+            log(f"{label}: unparseable output line: {line[:200]}")
+            line = None
+    return proc, line
+
+
+def _probe_backend() -> dict | None:
+    """Fail-fast backend check. Returns device info, or None if the
+    backend is unreachable/hung — in which case the caller must emit an
+    error JSON line immediately instead of burning tier timeouts."""
+    log(f"--- backend probe (timeout {PROBE_TIMEOUT_S}s) ---")
+    t0 = time.perf_counter()
+    proc, line = _spawn_self(PROBE_ENV, "1", PROBE_TIMEOUT_S, "probe")
+    if proc is None:
+        return None
+    if proc.returncode == 0 and line:
+        info = json.loads(line)
+        log(f"probe: ok in {time.perf_counter() - t0:.1f}s -> "
+            f"{info.get('platform')}/{info.get('device_kind')}")
+        return info
+    tail = (proc.stderr or "").strip().splitlines()
+    log(f"probe: failed rc={proc.returncode}: "
+        f"{tail[-1] if tail else 'no stderr'}")
+    return None
+
+
+def _run_tier_subprocess(name: str) -> dict | None:
+    log(f"--- tier {name} (fresh subprocess) ---")
+    proc, line = _spawn_self(ORCH_ENV, name, 1800, name)
+    if proc is None:
+        return None
+    sys.stderr.write(proc.stderr)
     if proc.returncode == 0 and line:
         result = json.loads(line)
         if result.get("value", 0) > 0:
@@ -389,6 +446,17 @@ def _run_tier_subprocess(name: str) -> dict | None:
 
 
 def main():
+    if _probe_backend() is None:
+        # One immediate, diagnosable line instead of rc=124 after hours
+        # of per-tier timeouts against a backend that cannot answer
+        # (the round-3 failure mode).
+        print(json.dumps({
+            "metric": "decode_tok_s_per_chip", "value": 0.0,
+            "unit": "tokens/s", "vs_baseline": 0.0,
+            "error": "backend unreachable: device init failed or hung "
+                     f"within {PROBE_TIMEOUT_S}s",
+        }), flush=True)
+        sys.exit(1)
     for name, _kwargs in TIERS:
         result = _run_tier_subprocess(name)
         if result is None:
@@ -426,7 +494,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get(ORCH_ENV):
+    if os.environ.get(PROBE_ENV):
+        probe_main()
+    elif os.environ.get(ORCH_ENV):
         tier_main()
     else:
         main()

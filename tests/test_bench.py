@@ -15,13 +15,17 @@ import pytest
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 
-def _run_tier(name: str) -> dict:
-    env = dict(os.environ, CAKE_BENCH_TIER=name, JAX_PLATFORMS="cpu")
-    # skip the axon TPU-claim hook: these are CPU smoke runs
+def _base_env(**extra):
+    # JAX_PLATFORMS=cpu + dropping the axon TPU-claim hook: CPU smoke runs
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **extra}
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_tier(name: str) -> dict:
     proc = subprocess.run(
-        [sys.executable, BENCH], env=env, capture_output=True, text=True,
-        timeout=300,
+        [sys.executable, BENCH], env=_base_env(CAKE_BENCH_TIER=name),
+        capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
@@ -52,3 +56,32 @@ def test_engine_smoke_tier_reports_ttft():
     assert result["ttft_p50_ms"] > 0
     assert result["engine_decode_tok_s"] > 0
     assert result["engine_streams"] == 2
+
+
+def test_probe_reports_device():
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_base_env(CAKE_BENCH_PROBE="1"),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
+    assert json.loads(line)["platform"] == "cpu"
+
+
+def test_unreachable_backend_fails_fast_with_error_line():
+    # A bogus platform makes device init raise immediately in the probe
+    # child; the orchestrator must emit ONE diagnosable JSON line and
+    # exit nonzero without entering the tier chain (the round-3 rc=124
+    # failure mode was hours of per-tier timeouts against a hung tunnel).
+    env = _base_env(JAX_PLATFORMS="no_such_platform",
+                    CAKE_BENCH_PROBE_TIMEOUT="60")
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
+    result = json.loads(line)
+    assert result["value"] == 0.0
+    assert "backend unreachable" in result["error"]
+    assert "--- tier" not in proc.stderr  # never reached the tier chain
