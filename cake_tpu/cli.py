@@ -13,6 +13,139 @@ import logging
 import sys
 
 
+def _serve_multihost(master, args) -> int:
+    """Serve the REST API over a mesh that spans processes.
+
+    Under multi-controller SPMD every process must dispatch each engine
+    step, so the coordinator publishes one tiny op record per step over a
+    TCP control channel and every other host replays it — the reference's
+    master→worker request loop (worker.rs:289-303) re-expressed for a
+    single SPMD program. Every host runs this same command; process roles
+    come from jax.distributed (parallel/distributed.initialize)."""
+    import jax
+
+    from cake_tpu.api import start
+    from cake_tpu.parallel.distributed import is_coordinator
+    from cake_tpu.serve.control import (
+        ControlClient, ControlServer, broadcast_control_address,
+    )
+
+    if master.llm is None:
+        raise ValueError(
+            "multi-host API serving is text-only: the SD pipeline places "
+            "whole components per device (place_components) and needs no "
+            "cross-process step dispatch — run --model-type image on one "
+            "host, or shard a text model with a topology")
+    # every process builds the identical engine (the shared-cache zeros
+    # allocation is a global computation, so construction order matters
+    # and must match across hosts)
+    engine = master.make_engine()
+    # a model without a cross-process placement (no topology/tp/dp) runs
+    # entirely inside the coordinator: no step replay needed — followers
+    # just idle on the control channel until the stop op, preserving the
+    # pre-existing behavior for this configuration
+    replayed = getattr(master.llm, "parallel", None) is not None
+    if is_coordinator():
+        import os
+        import secrets
+        import signal
+        import threading
+
+        token = secrets.token_hex(16)
+        adv = _advertised_host(args)
+        try:
+            control = ControlServer(jax.process_count() - 1, host=adv,
+                                    token=token)
+        except OSError:
+            # the advertised name may not be a bindable interface (NAT,
+            # aliases); fall back to all interfaces — the token still
+            # gates who can become a follower or see ops
+            control = ControlServer(jax.process_count() - 1, token=token)
+        broadcast_control_address(f"{adv}:{control.port}|{token}")
+        control.accept_followers()
+        if replayed:
+            engine.attach_control(control)
+
+        done = threading.Event()
+
+        def teardown():
+            # ordering matters: stop (publishes the stop op) -> wait for
+            # followers to disconnect from BOTH the control channel and
+            # the jax.distributed service -> only then take the leader
+            # service down. Killing the leader while a follower is still
+            # connected aborts the follower from its heartbeat thread.
+            if done.is_set():
+                return
+            done.set()
+            engine.stop()
+            if not replayed:
+                # idle followers never got a stop from the (local-only)
+                # engine; release them explicitly
+                try:
+                    control.publish({"op": "stop"})
+                except Exception:  # noqa: BLE001
+                    pass
+            control.wait_closed()
+            control.close()
+            _distributed_shutdown()
+
+        def on_sigterm(signum, frame):
+            # api.start (checkpoint mode) chains here AFTER its own
+            # save_and_exit; exiting 0 replaces the default-handler death
+            # that would strand the followers mid-heartbeat
+            teardown()
+            os._exit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, on_sigterm)
+        except ValueError:
+            pass  # not the main thread; caller owns signals
+        try:
+            start(master, address=args.api, engine=engine,
+                  checkpoint_path=args.checkpoint)
+        finally:
+            teardown()
+    else:
+        payload = broadcast_control_address(None)
+        addr, _, token = payload.partition("|")
+        client = ControlClient(addr, token=token or None)
+        try:
+            # with a cross-process placement this replays every engine
+            # step; without one no step ops ever arrive and the loop just
+            # blocks until the coordinator's stop
+            engine.run_follower_loop(client)
+        finally:
+            # close first: the coordinator is blocked in wait_closed()
+            # keeping the leader service alive for our clean disconnect
+            client.close()
+            _distributed_shutdown()
+    return 0
+
+
+def _distributed_shutdown() -> None:
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — teardown must never mask the exit
+        logging.getLogger(__name__).debug("distributed shutdown failed",
+                                          exc_info=True)
+
+
+def _advertised_host(args) -> str:
+    """Address followers use to reach the coordinator's control socket:
+    CAKE_CONTROL_HOST, else the host the jax coordinator was reached at
+    (CAKE_COORDINATOR), else this host's name."""
+    import os
+    import socket
+
+    if os.environ.get("CAKE_CONTROL_HOST"):
+        return os.environ["CAKE_CONTROL_HOST"]
+    coord = os.environ.get("CAKE_COORDINATOR", "")
+    if ":" in coord:
+        return coord.rsplit(":", 1)[0]
+    return socket.gethostname()
+
+
 def main(argv=None) -> int:
     from cake_tpu.args import parse_args
     from cake_tpu.master import Master
@@ -34,22 +167,18 @@ def main(argv=None) -> int:
 
     # multi-host: every host runs this same program (SPMD); coordinates
     # auto-detected on TPU pods or taken from CAKE_* env vars
-    from cake_tpu.parallel.distributed import initialize, is_coordinator
+    from cake_tpu.parallel.distributed import initialize
     initialize()
 
     master = Master.from_args(args, sd_args)
 
     if args.api:
+        import jax
+
         from cake_tpu.api import start
-        if is_coordinator():
-            start(master, address=args.api,
-                  checkpoint_path=args.checkpoint)
-        else:
-            # non-coordinator hosts participate in the SPMD computations
-            # driven by the coordinator's engine; they idle here
-            import time as _time
-            while True:
-                _time.sleep(3600)
+        if jax.process_count() > 1:
+            return _serve_multihost(master, args)
+        start(master, address=args.api, checkpoint_path=args.checkpoint)
         return 0
 
     if args.model_type.value == "image":

@@ -250,6 +250,12 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         check_vma=False,
     )
 
+    # logits leave the program fully replicated: multi-host serving
+    # localizes them per-process (np.asarray) so sampling needs no
+    # cross-process collective; single-host this is what GSPMD picks
+    # anyway for a [B, V] tensor computed from replicated operands
+    logits_repl = NamedSharding(mesh, P())
+
     @partial(jax.jit, donate_argnames=("cache",),
              static_argnames=("config",))
     def prefill_slot_fn(params, tokens, prompt_len, slot, cache: KVCache,
@@ -258,8 +264,9 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
             return fwd.body(p, t, sub, pos, rope,
                             last_idx=last_idx, is_prefill=True)
 
-        return slot_prefill(params, tokens, prompt_len, slot, cache,
-                            pipelined)
+        logits, cache = slot_prefill(params, tokens, prompt_len, slot,
+                                     cache, pipelined)
+        return jax.lax.with_sharding_constraint(logits, logits_repl), cache
 
     @partial(jax.jit, donate_argnames=("cache",),
              static_argnames=("config",))
@@ -270,8 +277,9 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                                    pos, active, rope_c, rope_s, mask)
             return y, KVCache(k, v)
 
-        return ragged_decode(params, tokens, pos, active, cache, rope,
-                             model_config, runner)
+        logits, cache = ragged_decode(params, tokens, pos, active, cache,
+                                      rope, model_config, runner)
+        return jax.lax.with_sharding_constraint(logits, logits_repl), cache
 
     return prefill_slot_fn, decode_ragged_fn
 

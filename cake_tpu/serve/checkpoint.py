@@ -54,6 +54,13 @@ def _params_digest(params) -> str:
         # multi-GiB leaf on the SIGTERM save path could overrun the kill
         # grace period
         h.update(str(tuple(leaf.shape)).encode())
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # mesh spans processes (multi-host serving): sample this
+            # process's first shard — deterministic for a fixed topology,
+            # and save/restore both run on the coordinator. A topology
+            # change surfaces as a fingerprint mismatch (the snapshot is
+            # then sidelined), which is the safe direction.
+            leaf = leaf.addressable_shards[0].data
         sample = np.asarray(leaf.reshape(-1)[:256])
         h.update(sample.astype(np.float32, copy=False).tobytes())
     return h.hexdigest()[:16]

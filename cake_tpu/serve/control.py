@@ -1,0 +1,246 @@
+"""Multi-host serving control channel.
+
+Under JAX multi-controller SPMD every process must dispatch the same
+computation in the same order; an engine step launched only by the
+coordinator would block forever in its first cross-process collective.
+The reference solves the analogous problem with a worker RPC loop —
+each worker blocks on the master's next message and executes it
+(cake-core/src/cake/worker.rs:289-303). The TPU-native analog is this
+control channel: the coordinator's engine publishes one tiny op record
+(slot/token metadata, NOT tensors — hidden states move over ICI inside
+the jitted program) before each device step, and every follower replays
+the identical step so the SPMD dispatch lines up.
+
+Transport: length-prefixed JSON over TCP. The payloads are ints/floats/
+lists only — no pickle, so a hostile peer on the serving network cannot
+execute code through this channel. The coordinator's bind address is
+exchanged through a one-time `multihost_utils.broadcast_one_to_all`
+(every process already shares the jax.distributed cluster), so no extra
+address flag is needed beyond what `initialize()` already requires.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct("!I")
+MAX_OP_BYTES = 16 << 20  # sanity bound; a real op is < max_seq_len ints
+
+
+def broadcast_control_address(addr: Optional[str]) -> str:
+    """Share the coordinator's control address with every process.
+
+    The coordinator passes its "host:port"; followers pass None. Uses a
+    fixed 128-byte buffer so the collective has one static shape. Must be
+    called at the same program point on every process (it is a
+    collective)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # 253-char max DNS name + ":65535|" + 32-hex token fits with room
+    buf = np.zeros(320, np.uint8)
+    if addr:
+        raw = addr.encode()
+        if len(raw) > buf.size:
+            raise ValueError(f"control address too long: {addr!r}")
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(out)).rstrip(b"\0").decode()
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        part = sock.recv(n)
+        if not part:
+            return None  # peer closed
+        chunks.append(part)
+        n -= len(part)
+    return b"".join(chunks)
+
+
+class ControlServer:
+    """Coordinator side: accepts one connection per follower, then
+    `publish()`es each op to all of them in dispatch order (TCP keeps
+    per-follower ordering; every follower sees the same sequence).
+
+    token: shared secret (distributed through the jax.distributed
+    broadcast, which only cluster members receive). A connection that
+    does not present it within 10s is dropped without ever occupying a
+    follower slot or receiving an op — so a rogue peer on the serving
+    network can neither exhaust the slots nor observe prompt token ids."""
+
+    def __init__(self, n_followers: int, host: str = "",
+                 port: int = 0, accept_timeout: float = 120.0,
+                 token: Optional[str] = None):
+        self.n_followers = n_followers
+        self.token = token
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(max(n_followers, 1))
+        self._accept_timeout = accept_timeout
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def accept_followers(self) -> None:
+        import hmac
+        import time as _time
+
+        deadline = _time.monotonic() + self._accept_timeout
+        while len(self._conns) < self.n_followers:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"only {len(self._conns)}/{self.n_followers} followers"
+                    f" connected within {self._accept_timeout}s")
+            self._sock.settimeout(remaining)
+            try:
+                conn, peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            if self.token is not None:
+                # bound BOTH the hello length (a token is tens of bytes —
+                # an attacker-controlled multi-GiB length must not
+                # allocate) and its wall time with an ABSOLUTE deadline
+                # (per-recv timeouts would multiply under byte-trickling
+                # and hold the accept loop hostage)
+                hd = _time.monotonic() + min(
+                    10.0, max(deadline - _time.monotonic(), 0.1))
+
+                def recv_bounded(n: int) -> Optional[bytes]:
+                    data = b""
+                    while len(data) < n:
+                        rem = hd - _time.monotonic()
+                        if rem <= 0:
+                            return None
+                        conn.settimeout(rem)
+                        part = conn.recv(n - len(data))
+                        if not part:
+                            return None
+                        data += part
+                    return data
+
+                try:
+                    head = recv_bounded(_LEN.size)
+                    n = _LEN.unpack(head)[0] if head else 0
+                    hello = (recv_bounded(n)
+                             if head and 0 < n <= 256 else None)
+                except OSError:
+                    hello = None
+                if hello is None or not hmac.compare_digest(
+                        hello, self.token.encode()):
+                    log.warning("control: rejected peer %s (bad token)",
+                                peer)
+                    conn.close()
+                    continue
+            conn.settimeout(None)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            log.info("control: follower connected from %s", peer)
+
+    def publish(self, op: dict) -> None:
+        """Send one op to every follower. Called from the engine thread
+        immediately before it dispatches the corresponding device step."""
+        payload = json.dumps(op).encode()
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    _send_msg(conn, payload)
+                except OSError:
+                    # a dead follower cannot be skipped silently — the
+                    # SPMD program it was part of will hang; surface it
+                    raise RuntimeError(
+                        "control: follower connection lost; the SPMD "
+                        "mesh is no longer fully driven")
+
+    def wait_closed(self, timeout: float = 30.0) -> None:
+        """Block until every follower closes its end (EOF). Called during
+        coordinator teardown so the jax.distributed leader service stays
+        alive until followers have disconnected from it — otherwise their
+        coordination-service heartbeat aborts the follower process."""
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.settimeout(timeout)
+                while conn.recv(4096):
+                    pass  # followers send nothing; drain until EOF
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        self._sock.close()
+
+
+class ControlClient:
+    """Follower side: connect (with retries — the coordinator may still
+    be binding), present the shared token, and iterate ops until the
+    stream closes."""
+
+    def __init__(self, address: str, connect_timeout: float = 120.0,
+                 token: Optional[str] = None):
+        host, port = address.rsplit(":", 1)
+        deadline = connect_timeout
+        import time
+        t0 = time.monotonic()
+        last: Optional[Exception] = None
+        while time.monotonic() - t0 < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=10.0)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                if token is not None:
+                    _send_msg(self._sock, token.encode())
+                self._sock.settimeout(None)  # ops may be minutes apart
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.2)
+        raise ConnectionError(
+            f"could not reach control server at {address}: {last}")
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next op, or None when the coordinator closed the channel.
+        With a timeout, raises socket.timeout if no op arrives in time
+        (used by the follower's failure-recovery wait)."""
+        self._sock.settimeout(timeout)
+        try:
+            head = _recv_exact(self._sock, _LEN.size)
+        finally:
+            self._sock.settimeout(None)
+        if head is None:
+            return None
+        (n,) = _LEN.unpack(head)
+        if n > MAX_OP_BYTES:
+            raise ValueError(f"oversized control op: {n} bytes")
+        payload = _recv_exact(self._sock, n)
+        if payload is None:
+            return None
+        return json.loads(payload)
+
+    def close(self) -> None:
+        self._sock.close()

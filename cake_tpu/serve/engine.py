@@ -245,6 +245,13 @@ class InferenceEngine:
         # head, negative-cached) — only non-negative ids key _prefixes
         self._auto_pids: dict = {}
 
+        # multi-host serving: the coordinator publishes each device-step
+        # op through _control (serve/control.py) so follower processes
+        # replay the identical SPMD dispatch; _multihost additionally
+        # localizes logits so sampling is process-local + deterministic
+        self._control = None
+        self._multihost = False
+
         self._next_rid = 1
         self._rid_lock = threading.Lock()
         self._requests = {}
@@ -277,6 +284,90 @@ class InferenceEngine:
         # drain but before join returned (the cancel() dead-thread check
         # handles calls arriving later than this)
         self._drain_cancellations()
+        if self._control is not None:
+            # published only after the engine thread has exited, so no
+            # step op can be ordered after the stop on the wire
+            try:
+                self._control.publish({"op": "stop"})
+            except Exception:  # noqa: BLE001
+                log.warning("control: stop publish failed (followers "
+                            "will exit on channel close)")
+
+    # -- multi-host -----------------------------------------------------------
+
+    def attach_control(self, control) -> None:
+        """Coordinator side of multi-host serving: publish every device
+        step through `control` (a serve.control.ControlServer) before
+        dispatching it, so every follower process enters the same SPMD
+        program. Reference behavior analog: the master streaming work to
+        workers (worker.rs:289-303). Must be called before start()."""
+        from cake_tpu.models.llama.model import prefill_slot as _builtin
+        if self._prefill_slot is _builtin:
+            raise ValueError(
+                "multi-host control requires pipelined step fns (a mesh "
+                "spanning processes); the single-device engine has no "
+                "cross-process computation to coordinate")
+        self._control = control
+        self._multihost = True
+
+    def run_follower_loop(self, client,
+                          reset_wait_s: float = 120.0) -> None:
+        """Non-coordinator side: replay the coordinator's op stream.
+        Blocks until the coordinator publishes a stop or closes the
+        channel. The engine thread is never started here — this process
+        only mirrors device steps so the SPMD collectives line up.
+
+        After a failed op this process is out of sync (its donated cache
+        may be gone). The symmetric case — the collective failed on every
+        process — is recovered by the coordinator's reset op. If no reset
+        arrives within reset_wait_s, the failure was follower-local
+        (asymmetric); the only safe move is to disconnect, which makes
+        the coordinator's next publish raise and fail its requests
+        instead of hanging its next collective forever."""
+        import socket as _socket
+
+        self._multihost = True
+        log.info("engine follower: replaying coordinator ops")
+        failed = False
+        while True:
+            try:
+                op = client.recv(timeout=reset_wait_s if failed else None)
+            except (_socket.timeout, TimeoutError):
+                log.error("engine follower: op failed and no reset came "
+                          "within %.0fs; disconnecting", reset_wait_s)
+                return
+            if op is None or op.get("op") == "stop":
+                log.info("engine follower: coordinator %s",
+                         "stopped" if op else "closed the channel")
+                return
+            if failed and op.get("op") != "reset":
+                # a normal op after our failure means the coordinator's
+                # twin dispatch SUCCEEDED — our mirrors may have drifted,
+                # and executing more ops would silently diverge; bail
+                log.error("engine follower: op %r after a local failure "
+                          "(no reset) — out of sync; disconnecting",
+                          op.get("op"))
+                return
+            try:
+                kind = op["op"]
+                if kind == "prefill":
+                    self._prefill_device(
+                        op["ids"], op["slot"], op["temp"], op["top_p"],
+                        op["penalty"], op.get("prime", ()))
+                elif kind == "decode":
+                    self._decode_device(op["rows"])
+                elif kind == "reset":
+                    self._reset_after_error()
+                else:
+                    log.error("engine follower: unknown op %r", kind)
+                failed = False
+            except Exception:  # noqa: BLE001
+                log.exception("follower op failed (awaiting reset)")
+                failed = True
+
+    def _publish(self, op: dict) -> None:
+        if self._control is not None:
+            self._control.publish(op)
 
     def __enter__(self):
         return self.start()
@@ -516,15 +607,27 @@ class InferenceEngine:
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
                 self._fail_all(e)
-                # the jitted steps donate the cache buffer; after a failed
-                # call it may already be deleted — rebuild so the engine
-                # survives (transient OOM/XLA error must not brick serving)
-                self.cache = self._fresh_cache()
-                self._pos[:] = 0
-                self._last_tok[:] = 0
-                self._steps[:] = 0
+                try:
+                    self._publish({"op": "reset"})
+                except Exception:  # noqa: BLE001
+                    # followers unreachable: the SPMD mesh is no longer
+                    # fully driven — stop serving instead of hanging the
+                    # next collective
+                    log.exception("control publish failed; stopping")
+                    self._stop.set()
+                    return
+                self._reset_after_error()
                 self.stats.errors += 1
                 self.stats.last_error = f"{type(e).__name__}: {e}"
+
+    def _reset_after_error(self) -> None:
+        # the jitted steps donate the cache buffer; after a failed call it
+        # may already be deleted — rebuild so the engine survives
+        # (transient OOM/XLA error must not brick serving)
+        self.cache = self._fresh_cache()
+        self._pos[:] = 0
+        self._last_tok[:] = 0
+        self._steps[:] = 0
 
     def _fresh_cache(self) -> KVCache:
         fresh = KVCache.create(self.config, self.max_slots,
@@ -585,39 +688,78 @@ class InferenceEngine:
                 and self._prefill_slot is prefill_slot):
             logits = self._prefill_chunked(ids, slot, C)
         else:
-            bucket = bucket_length(len(ids), self.max_seq_len)
-            padded = ids + [0] * (bucket - len(ids))
-            toks = jnp.asarray([padded], jnp.int32)
-            plen = jnp.asarray([len(ids)], jnp.int32)
-            logits, self.cache = self._prefill_slot(
-                self.params, toks, plen, jnp.int32(slot), self.cache,
-                self.rope, self.config,
-            )
-        # configure the slot
-        self._pos[slot] = len(ids)
+            # the only branch a pipelined (step_fns) engine reaches —
+            # prefix/chunk variants are disabled for it in __init__ — so
+            # multi-host publication here covers every prefill
+            self._publish({
+                "op": "prefill", "ids": ids, "slot": slot,
+                "temp": req.temperature, "top_p": req.top_p,
+                "penalty": req.repeat_penalty,
+                "prime": list(req.prime_tokens),
+            })
+            logits = self._prefill_raw(ids, slot)
+        tok, lp = self._finish_prefill(
+            logits, slot, len(ids), req.temperature, req.top_p,
+            req.repeat_penalty, req.prime_tokens)
+        self.stats.prefill_time_s += time.perf_counter() - t0
+        self._emit(req, tok, logprob=lp)
+
+    def _prefill_raw(self, ids, slot: int):
+        """Whole-prompt prefill device call (no sampling-state changes)."""
+        ids = list(ids)
+        bucket = bucket_length(len(ids), self.max_seq_len)
+        padded = ids + [0] * (bucket - len(ids))
+        toks = jnp.asarray([padded], jnp.int32)
+        plen = jnp.asarray([len(ids)], jnp.int32)
+        logits, self.cache = self._prefill_slot(
+            self.params, toks, plen, jnp.int32(slot), self.cache,
+            self.rope, self.config,
+        )
+        return logits
+
+    def _prefill_device(self, ids, slot: int, temp: float, top_p: float,
+                        penalty: float, prime) -> tuple:
+        """Whole-prompt prefill into one slot + first-token sample: the
+        device-and-mirror sequence of _do_prefill's plain branch, replayed
+        verbatim by multi-host followers (run_follower_loop) so the SPMD
+        dispatch sequence cannot drift between processes."""
+        logits = self._prefill_raw(ids, slot)
+        return self._finish_prefill(logits, slot, len(list(ids)), temp,
+                                    top_p, penalty, prime)
+
+    def _finish_prefill(self, logits, slot: int, prompt_len: int,
+                        temp: float, top_p: float, penalty: float,
+                        prime) -> tuple:
+        """Configure the slot's sampling state and sample its first
+        token. Returns (token_id, logprob)."""
+        if self._multihost:
+            # replicated logits -> local host copy, so sampling is a
+            # process-local computation (identical on every process by
+            # determinism) instead of a cross-process collective
+            logits = np.asarray(logits)
+        self._pos[slot] = prompt_len
         self._steps[slot] = 0
-        self._temp[slot] = req.temperature
-        self._top_p[slot] = req.top_p
-        self._penalty[slot] = req.repeat_penalty
+        self._temp[slot] = temp
+        self._top_p[slot] = top_p
+        self._penalty[slot] = penalty
         self._ring = self._ring.at[slot].set(-1)
-        if req.prime_tokens:
+        if prime:
             # checkpoint resume: reconstruct the repeat-penalty ring exactly
             # as the uninterrupted run would have it — each prior token at
             # its true step index, and the step counter continuing from
             # there, so subsequent writes land where they always would.
             N = self._ring.shape[1]
             row = np.full(N, -1, np.int32)
-            start = max(0, len(req.prime_tokens) - N)
-            for i, t in enumerate(req.prime_tokens[start:], start=start):
+            start = max(0, len(prime) - N)
+            for i, t in enumerate(prime[start:], start=start):
                 row[i % N] = t
             self._ring = self._ring.at[slot].set(jnp.asarray(row))
-            self._steps[slot] = len(req.prime_tokens)
+            self._steps[slot] = len(prime)
         # sample the first token with the slot's own key/options
         first, first_lp = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
             rows=[slot])
-        self.stats.prefill_time_s += time.perf_counter() - t0
-        self._emit(req, int(first[slot]), logprob=float(first_lp[slot]))
+        return int(first[slot]), float(first_lp[slot])
 
     def _prefill_chunked(self, ids: List[int], slot: int, C: int,
                          pos0: int = 0):
@@ -639,19 +781,9 @@ class InferenceEngine:
 
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()
-        B = self.max_slots
-        active = np.zeros(B, bool)
-        for _, slot in decode_plan:
-            active[slot] = True
-        toks = jnp.asarray(self._last_tok[:, None], jnp.int32)
-        pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
-                          jnp.int32)
-        logits, self.cache = self._decode_step(
-            self.params, toks, pos, jnp.asarray(active), self.cache,
-            self.rope, self.config,
-        )
-        nxt, lp = self._sample_rows(logits, rows=[s for _, s in decode_plan])
-        self._pos += active  # only active rows advanced
+        rows = [s for _, s in decode_plan]
+        self._publish({"op": "decode", "rows": rows})
+        nxt, lp = self._decode_device(rows)
         self.stats.steps += 1
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan))
@@ -660,6 +792,27 @@ class InferenceEngine:
             if req is None or req.rid != rid:
                 continue
             self._emit(req, int(nxt[slot]), logprob=float(lp[slot]))
+
+    def _decode_device(self, rows) -> tuple:
+        """One ragged decode step + sample for the given slot rows: the
+        device-and-mirror half of _do_decode, shared verbatim by the
+        coordinator and multi-host followers."""
+        B = self.max_slots
+        active = np.zeros(B, bool)
+        for slot in rows:
+            active[slot] = True
+        toks = jnp.asarray(self._last_tok[:, None], jnp.int32)
+        pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                          jnp.int32)
+        logits, self.cache = self._decode_step(
+            self.params, toks, pos, jnp.asarray(active), self.cache,
+            self.rope, self.config,
+        )
+        if self._multihost:
+            logits = np.asarray(logits)  # see _finish_prefill
+        nxt, lp = self._sample_rows(logits, rows=rows)
+        self._pos += active  # only active rows advanced
+        return nxt, lp
 
     def _scan_steps_for(self, decode_plan) -> int:
         """Fixed scan length when multi-step decode is safe right now:
