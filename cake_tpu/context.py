@@ -91,8 +91,25 @@ class Context:
             tokenizer = ByteTokenizer(cfg.vocab_size)
 
         from cake_tpu.models import load_text_params
-        params = load_text_params(cfg, a.model, self.dtype)
-        params = self._maybe_quantize(params)
+        from cake_tpu.parallel.plan import ParallelPlan
+        from cake_tpu.utils.loading import has_weights
+        plan = ParallelPlan.from_topology(cfg, self.topology, args=a)
+
+        # stage-local streaming load (reference worker.rs:106-127 parity,
+        # per shard): with a sharded placement and real weights on disk,
+        # every tensor lands directly on its mesh shard — no full-model
+        # host/device copy ever exists, which is what lets a 70B topology
+        # actually load instead of dying at the eager full-tree load.
+        # MoE checkpoints still use the eager loader (no streaming path).
+        stream_sharded = (
+            (plan.stages > 1 or plan.tp > 1 or plan.dp > 1)
+            and a.sp <= 1 and not cfg.is_moe and has_weights(a.model)
+        )
+        if stream_sharded:
+            params = None   # loaded inside the topology branch, post-mesh
+        else:
+            params = load_text_params(cfg, a.model, self.dtype)
+            params = self._maybe_quantize(params)
 
         # --repeat-penalty unset -> reference default 1.1 (llama.rs:311);
         # speculative mode resolves unset to 1.0 instead (parallel verify
@@ -109,8 +126,6 @@ class Context:
         kv_dtype = (resolve_kv_dtype(a.kv_dtype) if a.kv_dtype
                     else self.dtype)
 
-        from cake_tpu.parallel.plan import ParallelPlan
-        plan = ParallelPlan.from_topology(cfg, self.topology, args=a)
         kwargs = {}
         if a.sp > 1:
             # sequence/context parallelism: ring-attention prefill +
@@ -178,6 +193,9 @@ class Context:
                 dp_axis="dp" if dp else None,
                 stage_axis="stage", dtype=kv_dtype,
             )
+            if params is None:   # streaming stage-local load (see above)
+                params = self._load_params_streamed(cfg, mesh, tp)
+                params = self._maybe_quantize(params)
             params, cache = place_for_pipeline(params, cache, mesh,
                                                tp=tp, dp=dp)
             fwd = make_pipeline_forward(
@@ -212,6 +230,32 @@ class Context:
         from cake_tpu.utils.profiling import log_memory
         log_memory("model loaded")  # reference llama.rs:233-236
         return gen
+
+    def _load_params_streamed(self, cfg, mesh, tp: bool):
+        """Stream weights from disk directly onto their pipeline shards
+        (models/llama/params.load_params_sharded) — each tensor is read
+        once per addressable shard slice and never exists as a full
+        host/device array. Quantization (_maybe_quantize) then runs
+        shard-wise on the placed tree, so peak per-device HBM is
+        ~1.5x one shard, not 1.5x the model."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from cake_tpu.models.llama.params import (
+            block_param_keys, load_params_sharded,
+        )
+        from cake_tpu.parallel.pipeline import pipeline_param_specs
+
+        specs = pipeline_param_specs(block_param_keys(cfg),
+                                     tp_axis="tp" if tp else None)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        log.info("streaming stage-local weight load from %s",
+                 self.args.model)
+        return load_params_sharded(self.args.model, cfg, shardings,
+                                   dtype=self.dtype)
 
     def _maybe_quantize(self, params):
         """Apply --quant to a param tree (donating: frees each

@@ -243,6 +243,75 @@ def _np_dtype(jdtype):
             jnp.float32: np.float32}.get(jdtype, np.float32)
 
 
+def load_params_sharded(model_dir: str, config: LlamaConfig, shardings,
+                        dtype=jnp.bfloat16):
+    """Stream HF safetensors directly onto mesh shards.
+
+    The eager loader (load_params_from_hf) materialises the full tree on
+    the default device — at 70B (~140 GiB bf16) that dies long before
+    place_for_pipeline runs, even though the *sharded* model fits
+    comfortably. This loader never builds a full host or device copy:
+    each leaf is a `jax.make_array_from_callback` whose callback slices
+    the mmap'd safetensors views, so only the bytes of locally
+    addressable shards are ever read (mmap pages fault in per shard
+    slice), matching the reference worker's materialise-only-your-layers
+    behavior (worker.rs:106-127) per *shard* instead of per host.
+
+    shardings: pytree of jax.sharding.Sharding matching the param tree
+    ({"embed", "blocks": {leaf...}, "final_norm", "lm_head"}).
+    """
+    from cake_tpu.utils.loading import load_weights
+
+    layout, per_layer, L = hf_param_layout(config)
+    # host tensors stay zero-copy mmap views; nothing is read here —
+    # prefetch=False keeps the native reader from madvise(WILLNEED)ing
+    # the whole checkpoint (only shard slices will ever be touched)
+    host = load_weights(model_dir, prefetch=False)
+    nd = _np_dtype(dtype)
+
+    def simple_leaf(name: str, transpose: bool, sharding):
+        src = host[name].T if transpose else host[name]
+
+        def cb(index):
+            return np.ascontiguousarray(src[index]).astype(nd, copy=False)
+
+        return jax.make_array_from_callback(tuple(src.shape), sharding, cb)
+
+    def block_leaf(hf_suffix: str, transpose: bool, sharding):
+        views = [host[f"model.layers.{i}.{hf_suffix}"] for i in range(L)]
+        views = [v.T if transpose else v for v in views]
+        shape = (L,) + tuple(views[0].shape)
+
+        def cb(index):
+            sub = np.stack([np.asarray(views[i][index[1:]])
+                            for i in range(L)[index[0]]])
+            return sub.astype(nd, copy=False)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    def shard_of(*path):
+        node = shardings
+        for k in path:
+            node = node[k]
+        return node
+
+    params: Dict = {
+        "blocks": {
+            key: block_leaf(hf_suffix, transpose,
+                            shard_of("blocks", key))
+            for key, (hf_suffix, transpose) in per_layer.items()
+        },
+    }
+    for (key,), (hf_name, transpose) in layout.items():
+        if key == "lm_head" and config.tie_word_embeddings:
+            # read the embed source again transposed instead of an eager
+            # .T on the placed array (which would be a cross-process
+            # eager op on a multi-host mesh)
+            hf_name = "model.embed_tokens.weight"
+        params[key] = simple_leaf(hf_name, transpose, shard_of(key))
+    return params
+
+
 # -- sharding ---------------------------------------------------------------
 
 def block_param_keys(config=None, *, moe: Optional[bool] = None) -> tuple:

@@ -46,7 +46,7 @@ class StFile:
         return [self._lib.cake_st_name(self._h, i).decode()
                 for i in range(n)]
 
-    def _tensor(self, i: int) -> np.ndarray:
+    def _tensor(self, i: int, prefetch: bool = True) -> np.ndarray:
         lib, h = self._lib, self._h
         dtype = _ST_DTYPES[lib.cake_st_dtype(h, i).decode()]
         ndim = lib.cake_st_ndim(h, i)
@@ -55,7 +55,8 @@ class StFile:
         shape = tuple(shape_buf[d] for d in range(ndim))
         nbytes = ctypes.c_int64()
         ptr = lib.cake_st_data(h, i, ctypes.byref(nbytes))
-        lib.cake_st_prefetch(h, i)
+        if prefetch:
+            lib.cake_st_prefetch(h, i)
         buf = (ctypes.c_uint8 * nbytes.value).from_address(
             ctypes.addressof(ptr.contents))
         arr = np.frombuffer(buf, dtype=dtype).view(_MmapView)
@@ -64,8 +65,8 @@ class StFile:
         arr.flags.writeable = False
         return arr
 
-    def tensors(self, names: Optional[Iterable[str]] = None
-                ) -> Dict[str, np.ndarray]:
+    def tensors(self, names: Optional[Iterable[str]] = None,
+                prefetch: bool = True) -> Dict[str, np.ndarray]:
         wanted = set(names) if names is not None else None
         out = {}
         n = self._lib.cake_st_num_tensors(self._h)
@@ -73,7 +74,7 @@ class StFile:
             name = self._lib.cake_st_name(self._h, i).decode()
             if wanted is not None and name not in wanted:
                 continue
-            out[name] = self._tensor(i)
+            out[name] = self._tensor(i, prefetch=prefetch)
         return out
 
     def close(self):
@@ -92,13 +93,14 @@ class StFile:
             pass
 
 
-def read_file(path: str, names: Optional[Iterable[str]] = None):
+def read_file(path: str, names: Optional[Iterable[str]] = None,
+              prefetch: bool = True):
     """(tensors dict, file handle or None). The arrays keep the mapping
     alive on their own (base chain), so the handle is informational; do not
     close() it while arrays are in use. Falls back to the pure-Python
     memmap reader when the native library is unavailable."""
     if get_library() is not None:
         f = StFile(path)
-        return f.tensors(names), f
+        return f.tensors(names, prefetch=prefetch), f
     from cake_tpu.utils.loading import _st_load_file
     return _st_load_file(path, names), None

@@ -138,6 +138,7 @@ def load_weights(
     model_dir: str,
     filter_fn: Optional[Callable[[str], bool]] = None,
     to_device: Optional[Callable[[str, np.ndarray], object]] = None,
+    prefetch: bool = True,
 ) -> Dict[str, object]:
     """Load model weights by name.
 
@@ -147,6 +148,10 @@ def load_weights(
     to_device:  optional (name, host_array) -> device array placement hook;
                 defaults to returning the host array untouched so the caller
                 controls dtype casting + sharding.
+    prefetch:   madvise(WILLNEED) each tensor's pages up front (native
+                reader). Pass False when the caller will only touch shard
+                slices of each tensor (load_params_sharded) — prefetching
+                would fault in the whole checkpoint on every host.
     """
     from cake_tpu.native.safetensors import read_file
 
@@ -163,7 +168,8 @@ def load_weights(
         # native mmap reader (madvise-prefetched zero-copy views) when the
         # C++ library built; numpy memmap otherwise. Views keep their
         # mapping alive through the array base chain in both cases.
-        tensors, _handle = read_file(os.path.join(base_dir, fname), names)
+        tensors, _handle = read_file(os.path.join(base_dir, fname), names,
+                                     prefetch=prefetch)
         for name, arr in tensors.items():
             out[name] = to_device(name, arr) if to_device else arr
     return out
