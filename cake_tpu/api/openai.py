@@ -25,7 +25,15 @@ def parse_chat_request(body: dict) -> tuple[List[Message], dict]:
         "temperature": body.get("temperature"),
         "top_p": body.get("top_p"),
         "logprobs": bool(body.get("logprobs", False)),
+        "top_logprobs": body.get("top_logprobs"),
     }
+    if opts["top_logprobs"] is not None:
+        n = opts["top_logprobs"]
+        if (not isinstance(n, int) or isinstance(n, bool)
+                or not (0 <= n <= 20)):
+            raise ValueError("top_logprobs must be an integer in [0, 20]")
+        if not opts["logprobs"]:
+            raise ValueError("top_logprobs requires logprobs: true")
     return msgs, opts
 
 
@@ -51,7 +59,11 @@ def completion_response(text: str, model: str = "cake-tpu",
 
 
 def chunk_response(delta: str, model: str = "cake-tpu",
-                   finish: Optional[str] = None, rid: str = "") -> dict:
+                   finish: Optional[str] = None, rid: str = "",
+                   logprobs: Optional[list] = None) -> dict:
+    """logprobs: optional list of per-token content entries covering the
+    tokens that produced this delta (OpenAI streaming `logprobs` shape:
+    choices[0].logprobs.content)."""
     return {
         "id": rid,
         "object": "chat.completion.chunk",
@@ -60,6 +72,8 @@ def chunk_response(delta: str, model: str = "cake-tpu",
         "choices": [{
             "index": 0,
             "delta": {} if finish else {"content": delta},
+            "logprobs": ({"content": logprobs}
+                         if logprobs is not None else None),
             "finish_reason": finish,
         }],
     }

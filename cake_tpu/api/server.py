@@ -41,10 +41,14 @@ class ApiServer:
     serialise on a generation lock (still an upgrade over the reference's
     silent RwLock, api/text.rs:67)."""
 
-    def __init__(self, master, model_name: str = "cake-tpu", engine=None):
+    def __init__(self, master, model_name: str = "cake-tpu", engine=None,
+                 health=None):
         self.master = master
         self.model_name = model_name
         self.engine = engine
+        # parallel.health.ServingHealth: when it flips to failed, chat
+        # requests 503 and /api/v1/health reports the reason
+        self.health_state = health
         if engine is not None:
             engine.start()
         self._gen_lock = threading.Lock()
@@ -99,11 +103,27 @@ class ApiServer:
         request's decode steps with every other in-flight request."""
         from cake_tpu.serve.engine import QueueFullError
         messages, opts = parse_chat_request(body)
+        want_lp = bool(opts.get("logprobs"))
+        n_top = opts.get("top_logprobs") or 0
         kw = dict(
             max_new_tokens=opts["max_tokens"] or self.master.args.sample_len,
             temperature=opts["temperature"],
             top_p=opts["top_p"],
+            want_top_logprobs=n_top > 0,
         )
+
+        def lp_entry(t, lp, top):
+            text = self.engine.tokenizer.decode([t])
+            e = {"token": text, "logprob": round(lp, 6),
+                 "bytes": list(text.encode()), "top_logprobs": []}
+            if n_top:
+                def alt(at, al):
+                    atext = self.engine.tokenizer.decode([at])
+                    return {"token": atext, "logprob": round(al, 6),
+                            "bytes": list(atext.encode())}
+                e["top_logprobs"] = [alt(at, al) for at, al in top[:n_top]]
+            return e
+
         if send_chunk is None:
             try:
                 h = self.engine.chat(messages, **kw)
@@ -111,23 +131,11 @@ class ApiServer:
                 raise QueueFull()
             h.wait()
             lp = None
-            if opts.get("logprobs"):
-                def item(t, l):
-                    text = self.engine.tokenizer.decode([t])
-                    return {"token": text,
-                            "logprob": round(l, 6),
-                            "bytes": list(text.encode()),
-                            "top_logprobs": []}
-                lp = [item(t, l) for t, l in h.token_logprobs]
+            if want_lp:
+                lp = [lp_entry(t, l, top) for (t, l), top
+                      in zip(h.token_logprobs, h.token_top_logprobs)]
             return completion_response(h.text(), self.model_name,
                                        logprobs=lp)
-
-        if opts.get("logprobs"):
-            # before headers go out, so the client gets a clean 400 (the
-            # chunk schema has no logprobs field here; silently dropping
-            # the option would misreport what was served)
-            raise ValueError(
-                "logprobs is supported on non-streaming responses only")
 
         rid = str(uuid.uuid4())
         # Deltas are queued by the engine thread and written here on the
@@ -145,6 +153,28 @@ class ApiServer:
             raise QueueFull()
         if on_start is not None:
             on_start()
+        # streaming logprobs: each chunk carries the per-token entries
+        # finalized since the previous chunk (OpenAI stream+logprobs
+        # shape). _emit appends to the request's lists BEFORE queueing
+        # the delta, so reading up to len(out_tokens) here can only
+        # over-deliver into an earlier chunk, never drop an entry.
+        lp_cursor = 0
+        eos_ids = self.engine.config.eos_token_ids
+
+        def chunk_lp():
+            nonlocal lp_cursor
+            if not want_lp:
+                return None
+            r = h._req
+            upto = len(r.out_tokens)
+            entries = [
+                lp_entry(r.out_tokens[i], r.out_logprobs[i], r.out_top[i])
+                for i in range(lp_cursor, upto)
+                if r.out_tokens[i] not in eos_ids
+            ]
+            lp_cursor = upto
+            return entries
+
         while True:
             try:
                 delta, final = deltas.get(timeout=0.5)
@@ -155,7 +185,7 @@ class ApiServer:
             if delta:
                 try:
                     send_chunk(chunk_response(delta, self.model_name,
-                                              rid=rid))
+                                              rid=rid, logprobs=chunk_lp()))
                 except OSError:
                     # client disconnected mid-stream: free the slot now
                     # instead of decoding to max_tokens for nobody
@@ -166,8 +196,12 @@ class ApiServer:
                 break
         h.text()  # raises if the engine failed the request
         try:
+            # the finish chunk flushes entries finalized with an empty
+            # final delta (held-back UTF-8 tail), keeping the one-entry-
+            # per-token contract
             send_chunk(chunk_response("", self.model_name,
-                                      finish="stop", rid=rid))
+                                      finish="stop", rid=rid,
+                                      logprobs=chunk_lp()))
         except OSError:
             return DISCONNECTED  # request already complete; just stop
         return None
@@ -187,8 +221,13 @@ class ApiServer:
     # -- introspection -------------------------------------------------------
 
     def health(self) -> dict:
-        out = {"status": "ok", "model": self.model_name,
+        failed = (self.health_state is not None
+                  and self.health_state.failed)
+        out = {"status": "failed" if failed else "ok",
+               "model": self.model_name,
                "queue_depth": self._waiting}
+        if failed:
+            out["reason"] = self.health_state.reason
         if self.engine is not None:
             st = self.engine.stats
             out.update(
@@ -219,6 +258,10 @@ class ApiServer:
         lines = [
             "# TYPE cake_requests_waiting gauge",
             f"cake_requests_waiting {self._waiting}",
+            "# TYPE cake_serving_healthy gauge",
+            "cake_serving_healthy %d" % (
+                0 if (self.health_state is not None
+                      and self.health_state.failed) else 1),
         ]
         if self.engine is not None:
             st = self.engine.stats
@@ -329,6 +372,12 @@ def make_handler(api: ApiServer):
                 body = self._read_body()
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
+            # after the body read: responding early would leave unread
+            # body bytes desyncing this keep-alive connection
+            if api.health_state is not None and api.health_state.failed:
+                # fail fast instead of queueing work onto a dead mesh
+                return self._json(503, {
+                    "error": f"serving failed: {api.health_state.reason}"})
             try:
                 if self.path in ("/api/v1/chat/completions",
                                  "/v1/chat/completions"):
@@ -396,7 +445,7 @@ def make_handler(api: ApiServer):
 
 def start(master, address: str = "127.0.0.1:10128",
           model_name: str = "cake-tpu", block: bool = True, engine=None,
-          checkpoint_path: str | None = None):
+          checkpoint_path: str | None = None, health=None):
     """Bind and serve (reference api/mod.rs:23-48). When the master holds a
     text model, a continuous-batching engine is built automatically so
     concurrent chat requests share the decode loop.
@@ -407,7 +456,12 @@ def start(master, address: str = "127.0.0.1:10128",
     host, port = address.rsplit(":", 1)
     if engine is None and master.llm is not None:
         engine = master.make_engine()
-    api = ApiServer(master, model_name, engine=engine)
+    if health is None and engine is not None:
+        # always-on progress watchdog; multi-host callers pass a
+        # ServingHealth that additionally heartbeats the followers
+        from cake_tpu.parallel.health import ServingHealth
+        health = ServingHealth(engine)
+    api = ApiServer(master, model_name, engine=engine, health=health)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
 
@@ -482,6 +536,8 @@ def start(master, address: str = "127.0.0.1:10128",
             # not just SIGTERM
             if save_and_exit is not None:
                 save_and_exit()
+            if health is not None:
+                health.close()
 
     if block:
         serve()

@@ -51,17 +51,30 @@ def _serve_multihost(master, args) -> int:
         import signal
         import threading
 
+        from cake_tpu.parallel.health import ServingHealth
+
         token = secrets.token_hex(16)
         adv = _advertised_host(args)
         try:
             control = ControlServer(jax.process_count() - 1, host=adv,
                                     token=token)
+            bind_host = adv
         except OSError:
             # the advertised name may not be a bindable interface (NAT,
             # aliases); fall back to all interfaces — the token still
             # gates who can become a follower or see ops
             control = ControlServer(jax.process_count() - 1, token=token)
-        broadcast_control_address(f"{adv}:{control.port}|{token}")
+            bind_host = ""
+        # failure detection (SURVEY §5): follower heartbeats feed the
+        # serving health — a dead host 503s the API instead of letting
+        # the next collective hang forever
+        health = ServingHealth(engine)
+        hb_addr = health.expect_workers(
+            [f"proc{i}" for i in range(1, jax.process_count())],
+            bind_host=bind_host)
+        hb_adv = f"{adv}:{hb_addr.rsplit(':', 1)[1]}"
+        broadcast_control_address(
+            f"{adv}:{control.port}|{token}|{hb_adv}")
         control.accept_followers()
         if replayed:
             engine.attach_control(control)
@@ -77,6 +90,10 @@ def _serve_multihost(master, args) -> int:
             if done.is_set():
                 return
             done.set()
+            try:
+                health.close()
+            except Exception:  # noqa: BLE001
+                pass
             engine.stop()
             if not replayed:
                 # idle followers never got a stop from the (local-only)
@@ -102,19 +119,26 @@ def _serve_multihost(master, args) -> int:
             pass  # not the main thread; caller owns signals
         try:
             start(master, address=args.api, engine=engine,
-                  checkpoint_path=args.checkpoint)
+                  checkpoint_path=args.checkpoint, health=health)
         finally:
             teardown()
     else:
+        from cake_tpu.parallel.health import HeartbeatSender
+
         payload = broadcast_control_address(None)
-        addr, _, token = payload.partition("|")
+        addr, _, rest = payload.partition("|")
+        token, _, hb_addr = rest.partition("|")
         client = ControlClient(addr, token=token or None)
+        beat = (HeartbeatSender(hb_addr, f"proc{jax.process_index()}")
+                if hb_addr else None)
         try:
             # with a cross-process placement this replays every engine
             # step; without one no step ops ever arrive and the loop just
             # blocks until the coordinator's stop
             engine.run_follower_loop(client)
         finally:
+            if beat is not None:
+                beat.close()
             # close first: the coordinator is blocked in wait_closed()
             # keeping the leader service alive for our clean disconnect
             client.close()

@@ -115,9 +115,10 @@ def _apply_repeat_penalty_per_row(logits, recent_tokens, penalty):
     return jnp.where(hit, penalised, logits)
 
 
-@partial(jax.jit, static_argnames=("top_k",))
+@partial(jax.jit, static_argnames=("top_k", "n_top"))
 def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
-                         repeat_penalty, top_k: Optional[int] = None):
+                         repeat_penalty, top_k: Optional[int] = None,
+                         n_top: int = 0):
     """Batched sampling with PER-ROW options (continuous batching: each slot
     carries its own request's temperature/top_p/repeat_penalty).
 
@@ -131,12 +132,15 @@ def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
     top_k:           static engine-wide k (the REST API exposes only
                      temperature/top_p per request, matching the reference's
                      global Args.top_k)
-    Returns ([B] int32 ids, [B] f32 logprobs) — the chosen token's
-    log-probability under the post-penalty model distribution (the OpenAI
-    `logprobs` quantity; temperature/top-p are sampling transforms and do
-    not change the reported probability, the HF/vLLM convention). Computed
-    here so the penalized logits are reused — one penalty pass, one
-    softmax.
+    n_top:           static: also return the n most probable alternative
+                     tokens per row (the OpenAI `top_logprobs` quantity);
+                     0 skips the extra top_k entirely
+    Returns ([B] int32 ids, [B] f32 logprobs, [B, n_top] int32 top ids,
+    [B, n_top] f32 top logprobs) — the chosen token's log-probability
+    under the post-penalty model distribution (the OpenAI `logprobs`
+    quantity; temperature/top-p are sampling transforms and do not change
+    the reported probability, the HF/vLLM convention). Computed here so
+    the penalized logits are reused — one penalty pass, one softmax.
     """
     logits = logits.astype(jnp.float32)
     logits = _apply_repeat_penalty_per_row(logits, recent_tokens,
@@ -164,4 +168,11 @@ def sample_tokens_ragged(keys, logits, recent_tokens, temperature, top_p,
     ids = jnp.where(greedy, argmax_ids, sampled)
     lp = jax.nn.log_softmax(logits, axis=-1)
     chosen_lp = jnp.take_along_axis(lp, ids[:, None], axis=-1)[:, 0]
-    return ids, chosen_lp
+    B = logits.shape[0]
+    if n_top > 0:
+        top_lps, top_ids = jax.lax.top_k(lp, n_top)
+        top_ids = top_ids.astype(jnp.int32)
+    else:
+        top_ids = jnp.zeros((B, 0), jnp.int32)
+        top_lps = jnp.zeros((B, 0), jnp.float32)
+    return ids, chosen_lp, top_ids, top_lps

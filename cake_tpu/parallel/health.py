@@ -209,6 +209,84 @@ class HeartbeatSender:
         self._thread.join(timeout=5.0)
 
 
+# -- serving glue ------------------------------------------------------------
+
+class ServingHealth:
+    """Failure detection wired into the serving path (SURVEY §5).
+
+    Composes the detectors around a live engine so a failure actually
+    does something: the API flips /api/v1/health to "failed", new chat
+    requests get 503s (api/server.py gates on `failed`), and every
+    in-flight request is failed immediately instead of hanging its
+    client until timeout.
+
+      * a Watchdog on tokens_generated fires when the engine stops
+        making progress with active requests (wedged device, dead host
+        blocking a collective);
+      * `expect_workers()` (multi-host serving) starts a
+        HeartbeatMonitor over the follower hosts — a lapsed heartbeat
+        fails serving before the next collective deadlocks on the dead
+        host (cli._serve_multihost wires the followers' senders).
+    """
+
+    def __init__(self, engine, stall_after_s: float = 300.0):
+        self.engine = engine
+        self.reason: Optional[str] = None
+        self._lock = threading.Lock()
+        self.monitor: Optional[HeartbeatMonitor] = None
+        # tokens_generated advances on prefill first-tokens too, so a
+        # long prefill is not a false stall; stall_after_s must exceed
+        # worst-case first-request compile time
+        self._watchdog = Watchdog(
+            lambda: engine.stats.tokens_generated,
+            stall_after_s,
+            on_stall=lambda: self.fail(
+                f"engine made no progress for {stall_after_s:.0f}s "
+                "with active requests"),
+            active=lambda: engine.active > 0,
+        )
+
+    @property
+    def failed(self) -> bool:
+        return self.reason is not None
+
+    def expect_workers(self, names: List[str], bind_host: str = "",
+                       stale_after_s: float = 15.0) -> str:
+        """Start heartbeat monitoring for worker hosts that MUST stay
+        alive. Returns the monitor's bound address for distribution to
+        the workers (cli broadcasts it on the control handshake)."""
+        self.monitor = HeartbeatMonitor(
+            address=f"{bind_host}:0",
+            on_failure=lambda n: self.fail(f"worker {n} heartbeat lost"),
+            stale_after_s=stale_after_s,
+            expected=list(names),
+        )
+        return self.monitor.address
+
+    def fail(self, reason: str) -> None:
+        """Idempotent: first failure wins; later detections are logged
+        only. Fails every in-flight engine request so clients see an
+        error now, not a timeout. (The engine thread may be wedged in a
+        collective — _fail_all from this thread releases the waiters;
+        request teardown races are benign because _emit re-checks
+        _slot_req identity.)"""
+        with self._lock:
+            if self.reason is not None:
+                log.warning("serving health (already failed): %s", reason)
+                return
+            self.reason = reason
+        log.error("serving health: FAILED — %s", reason)
+        try:
+            self.engine._fail_all(RuntimeError(f"serving failed: {reason}"))
+        except Exception:  # noqa: BLE001
+            log.exception("failing in-flight requests failed")
+
+    def close(self) -> None:
+        self._watchdog.close()
+        if self.monitor is not None:
+            self.monitor.close()
+
+
 # -- progress watchdog -------------------------------------------------------
 
 class Watchdog:

@@ -65,8 +65,14 @@ class _Request:
     # previously-generated tokens whose penalty state must be reconstructed
     # (checkpoint resume): seeds the slot's repeat-penalty ring
     prime_tokens: List[int] = field(default_factory=list)
+    # request asked for top-N alternatives (OpenAI top_logprobs): the
+    # extra lax.top_k + host transfer is only paid while such a request
+    # is in the batch
+    want_top: bool = False
     out_tokens: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
+    # per emitted token: [(alt_token_id, alt_logprob), ...] top-N list
+    out_top: List[list] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
     slot: int = -1
@@ -98,6 +104,15 @@ class RequestHandle:
         the OpenAI `logprobs` content)."""
         return [(t, lp) for t, lp in zip(self._req.out_tokens,
                                          self._req.out_logprobs)
+                if t not in self._eos_ids]
+
+    @property
+    def token_top_logprobs(self) -> List[list]:
+        """Per emitted token, the top-N most probable alternatives as
+        [(token_id, logprob), ...] (the OpenAI `top_logprobs` content),
+        aligned with token_ids (EOS dropped)."""
+        return [top for t, top in zip(self._req.out_tokens,
+                                      self._req.out_top)
                 if t not in self._eos_ids]
 
     def text(self) -> str:
@@ -159,6 +174,7 @@ class InferenceEngine:
         auto_prefix_system: bool = False,
         max_auto_prefixes: int = 8,
         prefill_chunk: Optional[int] = None,
+        top_logprobs_cap: int = 20,
     ):
         self.config = config
         self.params = params
@@ -166,6 +182,10 @@ class InferenceEngine:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.defaults = sampling or SamplingConfig()
+        # alternatives computed per sample step for OpenAI `top_logprobs`
+        # (requests slice their n <= cap host-side; 20 is the API maximum;
+        # one lax.top_k over [B, V] — noise next to the forward pass)
+        self.n_top = top_logprobs_cap
         self.rope = RopeTables.create(config, max_seq_len)
         # step_fns: (prefill_slot_fn, decode_ragged_fn) replacements with
         # the same signatures as model.prefill_slot/decode_step_ragged —
@@ -387,6 +407,7 @@ class InferenceEngine:
         repeat_penalty: Optional[float] = None,
         stream: Optional[Callable[[str, bool], None]] = None,
         prime_penalty_tokens: Optional[Sequence[int]] = None,
+        want_top_logprobs: bool = False,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; the handle's wait()/text()
@@ -417,6 +438,7 @@ class InferenceEngine:
                             else repeat_penalty),
             stream=stream, submit_t=time.perf_counter(),
             prime_tokens=list(prime_penalty_tokens or ()),
+            want_top=want_top_logprobs,
         )
         # register BEFORE scheduler.submit: the engine thread may plan the
         # rid immediately, and _do_prefill treats an unknown rid as cancelled
@@ -698,11 +720,11 @@ class InferenceEngine:
                 "prime": list(req.prime_tokens),
             })
             logits = self._prefill_raw(ids, slot)
-        tok, lp = self._finish_prefill(
+        tok, lp, top = self._finish_prefill(
             logits, slot, len(ids), req.temperature, req.top_p,
             req.repeat_penalty, req.prime_tokens)
         self.stats.prefill_time_s += time.perf_counter() - t0
-        self._emit(req, tok, logprob=lp)
+        self._emit(req, tok, logprob=lp, top=top)
 
     def _prefill_raw(self, ids, slot: int):
         """Whole-prompt prefill device call (no sampling-state changes)."""
@@ -731,7 +753,7 @@ class InferenceEngine:
                         temp: float, top_p: float, penalty: float,
                         prime) -> tuple:
         """Configure the slot's sampling state and sample its first
-        token. Returns (token_id, logprob)."""
+        token. Returns (token_id, logprob, top-N alternatives)."""
         if self._multihost:
             # replicated logits -> local host copy, so sampling is a
             # process-local computation (identical on every process by
@@ -756,10 +778,12 @@ class InferenceEngine:
             self._ring = self._ring.at[slot].set(jnp.asarray(row))
             self._steps[slot] = len(prime)
         # sample the first token with the slot's own key/options
-        first, first_lp = self._sample_rows(
+        first, first_lp, tids, tlps = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
             rows=[slot])
-        return int(first[slot]), float(first_lp[slot])
+        top = (list(zip(tids[slot].tolist(), tlps[slot].tolist()))
+               if tids.size else [])
+        return int(first[slot]), float(first_lp[slot]), top
 
     def _prefill_chunked(self, ids: List[int], slot: int, C: int,
                          pos0: int = 0):
@@ -783,7 +807,7 @@ class InferenceEngine:
         t0 = time.perf_counter()
         rows = [s for _, s in decode_plan]
         self._publish({"op": "decode", "rows": rows})
-        nxt, lp = self._decode_device(rows)
+        nxt, lp, tids, tlps = self._decode_device(rows)
         self.stats.steps += 1
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan))
@@ -791,7 +815,10 @@ class InferenceEngine:
             req = self._slot_req[slot]
             if req is None or req.rid != rid:
                 continue
-            self._emit(req, int(nxt[slot]), logprob=float(lp[slot]))
+            self._emit(req, int(nxt[slot]), logprob=float(lp[slot]),
+                       top=(list(zip(tids[slot].tolist(),
+                                     tlps[slot].tolist()))
+                            if tids.size else []))
 
     def _decode_device(self, rows) -> tuple:
         """One ragged decode step + sample for the given slot rows: the
@@ -810,9 +837,9 @@ class InferenceEngine:
         )
         if self._multihost:
             logits = np.asarray(logits)  # see _finish_prefill
-        nxt, lp = self._sample_rows(logits, rows=rows)
+        nxt, lp, tids, tlps = self._sample_rows(logits, rows=rows)
         self._pos += active  # only active rows advanced
-        return nxt, lp
+        return nxt, lp, tids, tlps
 
     def _scan_steps_for(self, decode_plan) -> int:
         """Fixed scan length when multi-step decode is safe right now:
@@ -840,7 +867,8 @@ class InferenceEngine:
         active = np.zeros(B, bool)
         for _, slot in decode_plan:
             active[slot] = True
-        toks, lps, self.cache, self._keys, self._ring = _decode_scan(
+        (toks, lps, tops_i, tops_l, self.cache, self._keys,
+         self._ring) = _decode_scan(
             self.params,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
@@ -851,9 +879,12 @@ class InferenceEngine:
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._penalty),
             num_steps=n, top_k=self.defaults.top_k,
+            n_top=self._n_top_for([s for _, s in decode_plan]),
         )
         toks_host = np.asarray(toks)                 # [B, n]
         lps_host = np.asarray(lps)                   # [B, n]
+        tops_i_host = np.asarray(tops_i)             # [B, n, n_top]
+        tops_l_host = np.asarray(tops_l)
         self.stats.steps += n
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan) * n)
@@ -869,7 +900,10 @@ class InferenceEngine:
                 # single-step loop would have had
                 self._pos[slot] = pos0 + j + 1
                 self._emit(req, int(toks_host[slot, j]),
-                           logprob=float(lps_host[slot, j]))
+                           logprob=float(lps_host[slot, j]),
+                           top=(list(zip(tops_i_host[slot, j].tolist(),
+                                         tops_l_host[slot, j].tolist()))
+                                if tops_i_host.size else []))
                 if req.done.is_set():
                     # EOS/budget mid-scan: later tokens are overshoot; the
                     # slot's cache garbage is overwritten by the next
@@ -878,6 +912,17 @@ class InferenceEngine:
             else:
                 self._pos[slot] = pos0 + n
 
+    def _n_top_for(self, rows) -> int:
+        """cap when any of the rows' requests asked for top_logprobs,
+        else 0 (both variants are separately compiled and cached; on a
+        follower no requests exist, so this is always 0 — safe, because
+        multi-host sampling is process-local, not a collective)."""
+        for r in rows:
+            req = self._slot_req[r]
+            if req is not None and req.want_top:
+                return self.n_top
+        return 0
+
     def _sample_rows(self, logits, rows: List[int]):
         """Sample all B rows; advance keys/ring only for `rows` (so an
         inactive slot's PRNG stream is untouched)."""
@@ -885,24 +930,27 @@ class InferenceEngine:
         row_mask = np.zeros(B, bool)
         for r in rows:
             row_mask[r] = True
-        nxt, self._keys, self._ring, lp = _masked_sample(
+        nxt, self._keys, self._ring, lp, top_ids, top_lps = _masked_sample(
             jnp.asarray(row_mask), self._keys, logits, self._ring,
             jnp.asarray(self._steps, jnp.int32),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._penalty), top_k=self.defaults.top_k,
+            n_top=self._n_top_for(rows),
         )
         nxt_host = np.asarray(nxt)
         for r in rows:
             self._steps[r] += 1
             self._last_tok[r] = nxt_host[r]
-        return nxt_host, np.asarray(lp)
+        return (nxt_host, np.asarray(lp), np.asarray(top_ids),
+                np.asarray(top_lps))
 
     # -- token plumbing -------------------------------------------------------
 
     def _emit(self, req: _Request, token_id: int,
-              logprob: float = 0.0) -> None:
+              logprob: float = 0.0, top=None) -> None:
         now = time.perf_counter()
         req.out_logprobs.append(logprob)
+        req.out_top.append(top or [])
         if not req.out_tokens:
             req.first_token_t = now
         req.out_tokens.append(token_id)
@@ -953,26 +1001,28 @@ def _split_keys(keys):
 
 
 def _masked_sample(active_mask, keys, logits, ring, steps, temp, top_p,
-                   penalty, *, top_k):
+                   penalty, *, top_k, n_top=0):
     """ONE per-row sample with masked state advance — the single source of
     the engine's sampling semantics: rows outside active_mask keep their
     PRNG key and ring untouched. Used eagerly by _sample_rows and traced
     inside _decode_scan, so the two decode paths cannot drift.
-    Returns (next_tokens [B], keys, ring, logprobs [B])."""
+    Returns (next_tokens [B], keys, ring, logprobs [B],
+    top ids [B, n_top], top logprobs [B, n_top])."""
     new_keys, sub = _split_keys(keys)
-    nxt, lp = sample_tokens_ragged(sub, logits, ring, temp, top_p, penalty,
-                                   top_k=top_k)
+    nxt, lp, top_ids, top_lps = sample_tokens_ragged(
+        sub, logits, ring, temp, top_p, penalty, top_k=top_k, n_top=n_top)
     keys = jnp.where(active_mask[:, None], new_keys, keys)
     ring = jnp.where(active_mask[:, None],
                      update_ring_per_row(ring, nxt, steps), ring)
-    return nxt, keys, ring, lp
+    return nxt, keys, ring, lp, top_ids, top_lps
 
 
-@partial(jax.jit, static_argnames=("config", "num_steps", "top_k"),
+@partial(jax.jit, static_argnames=("config", "num_steps", "top_k",
+                                   "n_top"),
          donate_argnames=("cache",))
 def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
                  config, keys, ring, steps, temp, top_p, penalty,
-                 num_steps: int, top_k):
+                 num_steps: int, top_k, n_top: int = 0):
     """num_steps ragged decode+sample steps as ONE compiled program.
 
     Same per-row semantics as the single-step path (_do_decode +
@@ -993,16 +1043,20 @@ def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
         tok, pos, cache, keys, ring, steps, live = carry
         logits, cache = forward_ragged(params, tok[:, None], cache, pos,
                                        live, rope, config)
-        nxt, keys, ring, lp = _masked_sample(live, keys, logits, ring,
-                                             steps, temp, top_p, penalty,
-                                             top_k=top_k)
+        nxt, keys, ring, lp, t_i, t_l = _masked_sample(
+            live, keys, logits, ring, steps, temp, top_p, penalty,
+            top_k=top_k, n_top=n_top)
         tok = jnp.where(live, nxt, tok)
         pos = pos + live
         steps = steps + live
         live = live & ~jnp.isin(nxt, eos_ids)
-        return (tok, pos, cache, keys, ring, steps, live), (nxt, lp)
+        return ((tok, pos, cache, keys, ring, steps, live),
+                (nxt, lp, t_i, t_l))
 
-    (tok, pos, cache, keys, ring, steps, live), (toks, lps) = jax.lax.scan(
+    ((tok, pos, cache, keys, ring, steps, live),
+     (toks, lps, tops_i, tops_l)) = jax.lax.scan(
         body, (last_tok, pos, cache, keys, ring, steps, active), None,
         length=num_steps)
-    return toks.T, lps.T, cache, keys, ring  # [B, num_steps] each
+    # [B, num_steps(, n_top)] each
+    return (toks.T, lps.T, jnp.swapaxes(tops_i, 0, 1),
+            jnp.swapaxes(tops_l, 0, 1), cache, keys, ring)

@@ -89,13 +89,57 @@ def test_models_endpoint(server_url):
         assert m["data"][0]["object"] == "model"
 
 
-def test_streaming_logprobs_rejected(server_url):
-    with pytest.raises(urllib.error.HTTPError) as e:
-        _post(server_url + "/api/v1/chat/completions",
-              {"messages": [{"role": "user", "content": "x"}],
-               "stream": True, "logprobs": True, "max_tokens": 2})
-    assert e.value.code == 400
-    assert b"non-streaming" in e.value.read()
+def test_streaming_logprobs(server_url):
+    """OpenAI stream+logprobs: every chunk carries the token entries
+    finalized since the previous chunk; concatenating them reconstructs
+    the full completion."""
+    resp = _post(server_url + "/api/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "stream": True, "logprobs": True, "top_logprobs": 3,
+        "max_tokens": 4,
+    })
+    entries, text = [], []
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        c = json.loads(line[6:])["choices"][0]
+        if c["delta"].get("content"):
+            text.append(c["delta"]["content"])
+        if c.get("logprobs"):
+            entries.extend(c["logprobs"]["content"])
+    assert entries, "no logprobs content in any chunk"
+    assert "".join(e["token"] for e in entries) == "".join(text)
+    for e in entries:
+        assert isinstance(e["logprob"], float)
+        assert len(e["top_logprobs"]) == 3
+        alts = [a["logprob"] for a in e["top_logprobs"]]
+        assert alts == sorted(alts, reverse=True)
+        # greedy sampling: the chosen token IS the most probable one
+        assert abs(e["logprob"] - alts[0]) < 1e-4
+
+
+def test_top_logprobs_non_streaming(server_url):
+    resp = _post(server_url + "/api/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "logprobs": True, "top_logprobs": 2, "max_tokens": 3,
+    })
+    content = json.loads(resp.read())["choices"][0]["logprobs"]["content"]
+    assert content
+    for e in content:
+        assert len(e["top_logprobs"]) == 2
+        assert e["top_logprobs"][0]["logprob"] >= e["top_logprobs"][1]["logprob"]
+
+
+def test_top_logprobs_validation(server_url):
+    for bad in ({"top_logprobs": 2},                      # missing logprobs
+                {"logprobs": True, "top_logprobs": 30},   # out of range
+                {"logprobs": True, "top_logprobs": "x"}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server_url + "/api/v1/chat/completions",
+                  {"messages": [{"role": "user", "content": "x"}],
+                   "max_tokens": 2, **bad})
+        assert e.value.code == 400
 
 
 def test_metrics_endpoint(server_url):
