@@ -460,7 +460,8 @@ def start(master, address: str = "127.0.0.1:10128",
         # always-on progress watchdog; multi-host callers pass a
         # ServingHealth that additionally heartbeats the followers
         from cake_tpu.parallel.health import ServingHealth
-        health = ServingHealth(engine)
+        health = ServingHealth(engine, stall_after_s=getattr(
+            master.args, "stall_timeout", 600.0))
     api = ApiServer(master, model_name, engine=engine, health=health)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)

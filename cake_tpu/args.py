@@ -95,6 +95,10 @@ class Args:
     # verifies spec_gamma drafted tokens at once. Batch-1, single-device.
     draft_model: Optional[str] = None
     spec_gamma: int = 4
+    # serving watchdog: fail (recoverably) when the engine makes no
+    # progress for this many seconds with active requests; must exceed
+    # the worst-case first-request compile time (parallel/health.py)
+    stall_timeout: float = 600.0
     # --auto-prefix: the API engine KV-caches each distinct system
     # prompt's rendered head once (serve/engine.register_prefix), so
     # conversations sharing it prefill only their own turns

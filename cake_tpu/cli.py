@@ -68,7 +68,8 @@ def _serve_multihost(master, args) -> int:
         # failure detection (SURVEY §5): follower heartbeats feed the
         # serving health — a dead host 503s the API instead of letting
         # the next collective hang forever
-        health = ServingHealth(engine)
+        health = ServingHealth(engine,
+                               stall_after_s=args.stall_timeout)
         hb_addr = health.expect_workers(
             [f"proc{i}" for i in range(1, jax.process_count())],
             bind_host=bind_host)

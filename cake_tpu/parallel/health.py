@@ -229,22 +229,41 @@ class ServingHealth:
         host (cli._serve_multihost wires the followers' senders).
     """
 
-    def __init__(self, engine, stall_after_s: float = 300.0):
+    def __init__(self, engine, stall_after_s: float = 600.0):
         self.engine = engine
         self.reason: Optional[str] = None
         self._lock = threading.Lock()
+        self._recoverable = False
+        self._failed_at_tokens = 0
         self.monitor: Optional[HeartbeatMonitor] = None
         # tokens_generated advances on prefill first-tokens too, so a
         # long prefill is not a false stall; stall_after_s must exceed
-        # worst-case first-request compile time
+        # worst-case first-request compile time (configurable via
+        # --stall-timeout; a too-small value + giant compile would
+        # false-fail, which is why stall failures self-recover below)
         self._watchdog = Watchdog(
-            lambda: engine.stats.tokens_generated,
+            self._progress_counter,
             stall_after_s,
             on_stall=lambda: self.fail(
                 f"engine made no progress for {stall_after_s:.0f}s "
-                "with active requests"),
+                "with active requests", recoverable=True),
             active=lambda: engine.active > 0,
         )
+
+    def _progress_counter(self) -> int:
+        """Watchdog counter; doubles as the recovery probe: a stall
+        failure (recoverable) clears itself the moment tokens flow again
+        — e.g. a false positive from an extra-long XLA compile must not
+        brick an otherwise healthy server. Heartbeat failures (a dead
+        host) never self-clear."""
+        v = self.engine.stats.tokens_generated
+        with self._lock:
+            if (self.reason is not None and self._recoverable
+                    and v != self._failed_at_tokens):
+                log.warning("serving health: RECOVERED (progress resumed "
+                            "after: %s)", self.reason)
+                self.reason = None
+        return v
 
     @property
     def failed(self) -> bool:
@@ -263,18 +282,22 @@ class ServingHealth:
         )
         return self.monitor.address
 
-    def fail(self, reason: str) -> None:
+    def fail(self, reason: str, recoverable: bool = False) -> None:
         """Idempotent: first failure wins; later detections are logged
         only. Fails every in-flight engine request so clients see an
         error now, not a timeout. (The engine thread may be wedged in a
         collective — _fail_all from this thread releases the waiters;
         request teardown races are benign because _emit re-checks
-        _slot_req identity.)"""
+        _slot_req identity.) recoverable: the condition can clear itself
+        when progress resumes (watchdog stalls); non-recoverable
+        failures (dead hosts) latch until restart."""
         with self._lock:
             if self.reason is not None:
                 log.warning("serving health (already failed): %s", reason)
                 return
             self.reason = reason
+            self._recoverable = recoverable
+            self._failed_at_tokens = self.engine.stats.tokens_generated
         log.error("serving health: FAILED — %s", reason)
         try:
             self.engine._fail_all(RuntimeError(f"serving failed: {reason}"))

@@ -101,7 +101,8 @@ def _gpipe_stage_loop(k, v, x, run_microbatch, *, num_microbatches: int):
 
 def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
                          config: LlamaConfig, num_microbatches: int,
-                         tp_axis: Optional[str], is_prefill: bool = False):
+                         tp_axis: Optional[str], is_prefill: bool = False,
+                         chunked: bool = False):
     """Per-device body for uniform-position forward (prefill / batch
     decode): pos, rope rows and mask are shared across the batch.
     """
@@ -109,6 +110,7 @@ def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
         y, cache_mb = run_blocks(
             blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
             config, tp_axis=tp_axis, is_prefill=is_prefill,
+            chunked=chunked,
         )
         return y, cache_mb.k, cache_mb.v
 
@@ -151,11 +153,11 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
     cache_spec = P("stage", dp_axis, None, tp_axis, None)
     x_spec = P(dp_axis, None, None)
 
-    def make_stage_fn(is_prefill: bool):
+    def make_stage_fn(is_prefill: bool, chunked: bool = False):
         return jax.shard_map(
             partial(_stage_pipeline_body, config=config,
                     num_microbatches=num_microbatches, tp_axis=tp_axis,
-                    is_prefill=is_prefill),
+                    is_prefill=is_prefill, chunked=chunked),
             mesh=mesh,
             in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
                       P(), P(), P(), P()),
@@ -163,17 +165,21 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
             check_vma=False,
         )
 
-    stage_fns = {False: make_stage_fn(False), True: make_stage_fn(True)}
+    stage_fns = {(False, False): make_stage_fn(False),
+                 (True, False): make_stage_fn(True),
+                 (True, True): make_stage_fn(True, chunked=True)}
 
     def forward_body(params, tokens, cache: KVCache, pos, rope: RopeTables,
-                     last_idx=None, is_prefill: bool = False):
+                     last_idx=None, is_prefill: bool = False,
+                     chunked: bool = False):
         B, S = tokens.shape
         T = cache.max_seq_len
         x = jnp.take(params["embed"], tokens, axis=0)
         rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
         mask = decode_mask(pos, S, T, window=config.sliding_window)
-        y, k, v = stage_fns[is_prefill](params["blocks"], cache.k, cache.v,
-                                        x, pos, rope_c, rope_s, mask)
+        y, k, v = stage_fns[(is_prefill, chunked)](
+            params["blocks"], cache.k, cache.v,
+            x, pos, rope_c, rope_s, mask)
         y = rms_norm(y, params["final_norm"], config.rms_norm_eps)
         if last_idx is None:
             last = y[:, -1]
@@ -185,7 +191,7 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
         return logits, KVCache(k, v)
 
     jitted = jax.jit(forward_body, donate_argnames=("cache",),
-                     static_argnames=("is_prefill",))
+                     static_argnames=("is_prefill", "chunked"))
 
     def pipeline_forward(*args, **kwargs):
         return jitted(*args, **kwargs)
@@ -221,13 +227,16 @@ def _stage_pipeline_body_ragged(blocks, k, v, x, pos, active,
 def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                          num_microbatches: int = 1, tp: bool = False,
                          params=None):
-    """Pipelined replacements for the engine's two jitted steps.
+    """Pipelined replacements for the engine's jitted steps.
 
-    Returns (prefill_slot_fn, decode_ragged_fn) with the exact call
-    signatures of model.prefill_slot / model.decode_step_ragged, so
-    serve/engine.py runs continuous batching over a topology-sharded
-    model unchanged. The batch (slot) axis is NOT dp-sharded — slots are
-    admitted one at a time and sliced dynamically, which must stay local.
+    Returns (prefill_slot_fn, decode_ragged_fn, decode_scan_fn,
+    prefill_chunk_fn) with the exact call signatures of
+    model.prefill_slot / model.decode_step_ragged / the engine's
+    decode-scan / model.prefill_slot_chunk, so serve/engine.py runs
+    continuous batching — including K-step scanned decode and chunked
+    prefill — over a topology-sharded model unchanged. The batch (slot)
+    axis is NOT dp-sharded — slots are admitted one at a time and sliced
+    dynamically, which must stay local.
     """
     tp_axis = "tp" if tp else None
     blocks_specs = _blocks_in_specs(config, tp_axis, params)
@@ -256,6 +265,17 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     # anyway for a [B, V] tensor computed from replicated operands
     logits_repl = NamedSharding(mesh, P())
 
+    def ragged_forward(params, tokens, cache, pos, active, rope, config):
+        """model.forward_ragged-shaped pipelined forward (un-jitted:
+        traced inside decode_ragged_fn and the decode scan)."""
+        def runner(blocks, x, cache, pos, active, rope_c, rope_s, mask):
+            y, k, v = ragged_stage(blocks, cache.k, cache.v, x,
+                                   pos, active, rope_c, rope_s, mask)
+            return y, KVCache(k, v)
+
+        return ragged_decode(params, tokens, pos, active, cache,
+                             rope, model_config, runner)
+
     @partial(jax.jit, donate_argnames=("cache",),
              static_argnames=("config",))
     def prefill_slot_fn(params, tokens, prompt_len, slot, cache: KVCache,
@@ -272,16 +292,30 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
              static_argnames=("config",))
     def decode_ragged_fn(params, tokens, pos, active, cache: KVCache,
                          rope: RopeTables, config=None):
-        def runner(blocks, x, cache, pos, active, rope_c, rope_s, mask):
-            y, k, v = ragged_stage(blocks, cache.k, cache.v, x,
-                                   pos, active, rope_c, rope_s, mask)
-            return y, KVCache(k, v)
-
-        logits, cache = ragged_decode(params, tokens, pos, active, cache,
-                                      rope, model_config, runner)
+        logits, cache = ragged_forward(params, tokens, cache, pos, active,
+                                       rope, config)
         return jax.lax.with_sharding_constraint(logits, logits_repl), cache
 
-    return prefill_slot_fn, decode_ragged_fn
+    from cake_tpu.serve.engine import make_decode_scan
+    decode_scan_fn = make_decode_scan(ragged_forward,
+                                      out_sharding=logits_repl)
+
+    @partial(jax.jit, donate_argnames=("cache",),
+             static_argnames=("config",))
+    def prefill_chunk_fn(params, tokens, n_real, slot, pos0,
+                         cache: KVCache, rope: RopeTables, config=None):
+        """Pipelined analog of model.prefill_slot_chunk: one fixed-size
+        window into slot `slot` at absolute position pos0, through the
+        cache-aware (chunked) pipelined forward."""
+        def pipelined(p, t, sub, pos, last_idx):
+            return fwd.body(p, t, sub, pos, rope, last_idx=last_idx,
+                            is_prefill=True, chunked=True)
+
+        logits, cache = slot_prefill(params, tokens, n_real, slot, cache,
+                                     pipelined, pos0=pos0)
+        return jax.lax.with_sharding_constraint(logits, logits_repl), cache
+
+    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn, prefill_chunk_fn
 
 
 def pipeline_param_specs(blocks_keys, tp_axis: Optional[str] = None):

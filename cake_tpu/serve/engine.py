@@ -192,32 +192,44 @@ class InferenceEngine:
         # e.g. parallel.pipeline.make_engine_step_fns for topology-sharded
         # serving. cache: optional pre-placed KV cache (must match the step
         # fns' sharding contract).
-        self._prefill_slot, self._decode_step = (
-            step_fns if step_fns is not None
-            else (prefill_slot, decode_step_ragged))
+        # step_fns: 2-4 fns replacing the built-in jitted steps —
+        # (prefill_slot_fn, decode_ragged_fn[, decode_scan_fn
+        # [, prefill_chunk_fn]]), e.g. parallel.pipeline
+        # .make_engine_step_fns for topology-sharded serving. With the
+        # scan/chunk fns present, multi-step decode and chunked prefill
+        # work over the pipeline exactly as on the built-in path.
+        if step_fns is None:
+            from cake_tpu.models.llama.model import prefill_slot_chunk
+            self._prefill_slot = prefill_slot
+            self._decode_step = decode_step_ragged
+            self._decode_scan_impl = _decode_scan
+            self._prefill_chunk_step = prefill_slot_chunk
+        else:
+            fns = tuple(step_fns)
+            self._prefill_slot, self._decode_step = fns[0], fns[1]
+            self._decode_scan_impl = fns[2] if len(fns) > 2 else None
+            self._prefill_chunk_step = fns[3] if len(fns) > 3 else None
         # decode_scan_steps > 1: when no request is waiting, run K decode
         # steps as ONE on-device lax.scan per host round-trip — host/tunnel
-        # dispatch latency amortizes across K tokens. Only for the built-in
-        # single-device steps (a custom pipelined step fn owns its own
-        # jit/donation and cannot be re-scanned here).
+        # dispatch latency amortizes across K tokens.
         if decode_scan_steps < 1:
             raise ValueError("decode_scan_steps must be >= 1")
-        if decode_scan_steps > 1 and step_fns is not None:
+        if decode_scan_steps > 1 and self._decode_scan_impl is None:
             log.warning(
-                "decode_scan_steps=%d ignored: custom (pipelined) step fns "
-                "own their jit/donation and run step-by-step",
-                decode_scan_steps)
-        self._decode_scan = decode_scan_steps if step_fns is None else 1
+                "decode_scan_steps=%d ignored: these custom step fns "
+                "provide no scan variant", decode_scan_steps)
+            decode_scan_steps = 1
+        self._decode_scan = decode_scan_steps
         # prefill_chunk: admit prompts longer than C in fixed C-token
         # windows (one compiled program for every prompt length; bounded
         # activation memory). Same divisibility contract as the
         # generator's knob — a clamped final window would overwrite
         # earlier cache entries.
-        if prefill_chunk is not None and step_fns is not None:
-            # check BEFORE validation: a pipelined engine ignores the
-            # knob with a warning, it must not crash on it
-            log.warning("prefill_chunk ignored: custom (pipelined) step "
-                        "fns own their prefill")
+        if prefill_chunk is not None and self._prefill_chunk_step is None:
+            # check BEFORE validation: an engine whose step fns lack a
+            # chunk variant ignores the knob with a warning, not a crash
+            log.warning("prefill_chunk ignored: these custom step fns "
+                        "provide no chunked-prefill variant")
             prefill_chunk = None
         if prefill_chunk is not None and (
                 prefill_chunk < 1 or max_seq_len % prefill_chunk != 0):
@@ -373,9 +385,15 @@ class InferenceEngine:
                 if kind == "prefill":
                     self._prefill_device(
                         op["ids"], op["slot"], op["temp"], op["top_p"],
-                        op["penalty"], op.get("prime", ()))
+                        op["penalty"], op.get("prime", ()),
+                        n_top=op.get("n_top", 0))
                 elif kind == "decode":
-                    self._decode_device(op["rows"])
+                    self._decode_device(op["rows"],
+                                        n_top=op.get("n_top", 0))
+                elif kind == "decode_scan":
+                    toks, _lps, _ti, _tl = self._decode_scan_device(
+                        op["rows"], op["n"], op["n_top"])
+                    self._finalize_scan_mirrors(op["rows"], op["n"], toks)
                 elif kind == "reset":
                     self._reset_after_error()
                 else:
@@ -691,38 +709,41 @@ class InferenceEngine:
                     # (dynamic_update_slice clamps out-of-range starts) —
                     # fall back to a whole-prompt prefill
                     hit = None
-        if hit is not None and chunk_suffix:
-            from cake_tpu.models.llama.model import install_prefix_slot
-            self.cache = install_prefix_slot(self.cache, pk, pv,
-                                             jnp.int32(slot))
-            logits = self._prefill_chunked(suffix, slot, C,
-                                           pos0=len(p_ids))
+        if hit is not None:
+            if chunk_suffix:
+                from cake_tpu.models.llama.model import install_prefix_slot
+                self.cache = install_prefix_slot(self.cache, pk, pv,
+                                                 jnp.int32(slot))
+                logits = self._prefill_chunked(suffix, slot, C,
+                                               pos0=len(p_ids))
+            else:
+                padded = suffix + [0] * (bucket - len(suffix))
+                logits, self.cache = prefill_slot_prefixed(
+                    self.params, jnp.asarray([padded], jnp.int32),
+                    jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
+                    pk, pv, self.cache, self.rope, self.config,
+                )
             self.stats.prefix_hits += 1
-        elif hit is not None:
-            padded = suffix + [0] * (bucket - len(suffix))
-            logits, self.cache = prefill_slot_prefixed(
-                self.params, jnp.asarray([padded], jnp.int32),
-                jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
-                pk, pv, self.cache, self.rope, self.config,
-            )
-            self.stats.prefix_hits += 1
-        elif (C and len(ids) > C
-                and self._prefill_slot is prefill_slot):
-            logits = self._prefill_chunked(ids, slot, C)
+            tok, lp, top = self._finish_prefill(
+                logits, slot, len(ids), req.temperature, req.top_p,
+                req.repeat_penalty, req.prime_tokens)
         else:
-            # the only branch a pipelined (step_fns) engine reaches —
-            # prefix/chunk variants are disabled for it in __init__ — so
-            # multi-host publication here covers every prefill
+            # covers whole-prompt AND chunked prefill — _prefill_device
+            # picks between them from (prefill_chunk, len) alone, the
+            # same deterministic rule a multi-host follower applies to
+            # this published op. Prefix branches never occur with
+            # step_fns (register_prefix refuses them), so publication
+            # here covers every pipelined prefill.
+            n_top = self._n_top_for([slot])
             self._publish({
                 "op": "prefill", "ids": ids, "slot": slot,
                 "temp": req.temperature, "top_p": req.top_p,
                 "penalty": req.repeat_penalty,
-                "prime": list(req.prime_tokens),
+                "prime": list(req.prime_tokens), "n_top": n_top,
             })
-            logits = self._prefill_raw(ids, slot)
-        tok, lp, top = self._finish_prefill(
-            logits, slot, len(ids), req.temperature, req.top_p,
-            req.repeat_penalty, req.prime_tokens)
+            tok, lp, top = self._prefill_device(
+                ids, slot, req.temperature, req.top_p,
+                req.repeat_penalty, req.prime_tokens, n_top=n_top)
         self.stats.prefill_time_s += time.perf_counter() - t0
         self._emit(req, tok, logprob=lp, top=top)
 
@@ -740,18 +761,24 @@ class InferenceEngine:
         return logits
 
     def _prefill_device(self, ids, slot: int, temp: float, top_p: float,
-                        penalty: float, prime) -> tuple:
-        """Whole-prompt prefill into one slot + first-token sample: the
-        device-and-mirror sequence of _do_prefill's plain branch, replayed
-        verbatim by multi-host followers (run_follower_loop) so the SPMD
-        dispatch sequence cannot drift between processes."""
-        logits = self._prefill_raw(ids, slot)
-        return self._finish_prefill(logits, slot, len(list(ids)), temp,
-                                    top_p, penalty, prime)
+                        penalty: float, prime, n_top: int = 0) -> tuple:
+        """Prefill one slot (whole-prompt or chunked, decided from
+        shared config + prompt length) + first-token sample: the
+        device-and-mirror sequence of _do_prefill's non-prefix branch,
+        replayed verbatim by multi-host followers (run_follower_loop) so
+        the SPMD dispatch sequence cannot drift between processes."""
+        ids = list(ids)
+        C = self.prefill_chunk
+        if C and len(ids) > C:
+            logits = self._prefill_chunked(ids, slot, C)
+        else:
+            logits = self._prefill_raw(ids, slot)
+        return self._finish_prefill(logits, slot, len(ids), temp,
+                                    top_p, penalty, prime, n_top=n_top)
 
     def _finish_prefill(self, logits, slot: int, prompt_len: int,
                         temp: float, top_p: float, penalty: float,
-                        prime) -> tuple:
+                        prime, n_top: Optional[int] = None) -> tuple:
         """Configure the slot's sampling state and sample its first
         token. Returns (token_id, logprob, top-N alternatives)."""
         if self._multihost:
@@ -780,7 +807,7 @@ class InferenceEngine:
         # sample the first token with the slot's own key/options
         first, first_lp, tids, tlps = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
-            rows=[slot])
+            rows=[slot], n_top=n_top)
         top = (list(zip(tids[slot].tolist(), tlps[slot].tolist()))
                if tids.size else [])
         return int(first[slot]), float(first_lp[slot]), top
@@ -792,10 +819,9 @@ class InferenceEngine:
         the engine analog of the generator's --prefill-chunk path, using
         the same chunk_windows contract."""
         from cake_tpu.models.llama.generator import chunk_windows
-        from cake_tpu.models.llama.model import prefill_slot_chunk
         logits = None
         for window, n_real, start in chunk_windows(ids, C):
-            logits, self.cache = prefill_slot_chunk(
+            logits, self.cache = self._prefill_chunk_step(
                 self.params, jnp.asarray([window], jnp.int32),
                 jnp.asarray([n_real], jnp.int32), jnp.int32(slot),
                 jnp.int32(pos0 + start), self.cache, self.rope,
@@ -806,8 +832,9 @@ class InferenceEngine:
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()
         rows = [s for _, s in decode_plan]
-        self._publish({"op": "decode", "rows": rows})
-        nxt, lp, tids, tlps = self._decode_device(rows)
+        n_top = self._n_top_for(rows)
+        self._publish({"op": "decode", "rows": rows, "n_top": n_top})
+        nxt, lp, tids, tlps = self._decode_device(rows, n_top=n_top)
         self.stats.steps += 1
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan))
@@ -820,7 +847,7 @@ class InferenceEngine:
                                      tlps[slot].tolist()))
                             if tids.size else []))
 
-    def _decode_device(self, rows) -> tuple:
+    def _decode_device(self, rows, n_top: Optional[int] = None) -> tuple:
         """One ragged decode step + sample for the given slot rows: the
         device-and-mirror half of _do_decode, shared verbatim by the
         coordinator and multi-host followers."""
@@ -837,7 +864,8 @@ class InferenceEngine:
         )
         if self._multihost:
             logits = np.asarray(logits)  # see _finish_prefill
-        nxt, lp, tids, tlps = self._sample_rows(logits, rows=rows)
+        nxt, lp, tids, tlps = self._sample_rows(logits, rows=rows,
+                                                n_top=n_top)
         self._pos += active  # only active rows advanced
         return nxt, lp, tids, tlps
 
@@ -863,28 +891,16 @@ class InferenceEngine:
     def _do_decode_scan(self, decode_plan, n: int) -> None:
         """n ragged decode steps + sampling as one compiled program."""
         t0 = time.perf_counter()
-        B = self.max_slots
-        active = np.zeros(B, bool)
-        for _, slot in decode_plan:
-            active[slot] = True
-        (toks, lps, tops_i, tops_l, self.cache, self._keys,
-         self._ring) = _decode_scan(
-            self.params,
-            jnp.asarray(self._last_tok, jnp.int32),
-            jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
-                        jnp.int32),
-            jnp.asarray(active), self.cache, self.rope, self.config,
-            self._keys, self._ring,
-            jnp.asarray(self._steps, jnp.int32),
-            jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._penalty),
-            num_steps=n, top_k=self.defaults.top_k,
-            n_top=self._n_top_for([s for _, s in decode_plan]),
-        )
-        toks_host = np.asarray(toks)                 # [B, n]
-        lps_host = np.asarray(lps)                   # [B, n]
-        tops_i_host = np.asarray(tops_i)             # [B, n, n_top]
-        tops_l_host = np.asarray(tops_l)
+        rows = [s for _, s in decode_plan]
+        n_top = self._n_top_for(rows)
+        # n_top must ride the op: in a multi-host scan the sampling is
+        # INSIDE the mesh program, so a follower compiling the n_top=0
+        # variant while the coordinator runs n_top=20 would dispatch a
+        # different program and wedge the collective
+        self._publish({"op": "decode_scan", "rows": rows, "n": n,
+                       "n_top": n_top})
+        (toks_host, lps_host, tops_i_host,
+         tops_l_host) = self._decode_scan_device(rows, n, n_top)
         self.stats.steps += n
         self.stats.decode_time_s += time.perf_counter() - t0
         self._step_stats.step(bytes_out=len(decode_plan) * n)
@@ -912,6 +928,59 @@ class InferenceEngine:
             else:
                 self._pos[slot] = pos0 + n
 
+    def _decode_scan_device(self, rows, n: int, n_top: int) -> tuple:
+        """Device half of the K-step scan, shared verbatim with
+        multi-host followers. In multi-host mode keys/ring are localized
+        around the call (host numpy in, replicated output localized), so
+        the surrounding single-step ops keep their process-local
+        sampling while the scan itself runs sampling inside the mesh
+        program identically on every process."""
+        B = self.max_slots
+        active = np.zeros(B, bool)
+        for slot in rows:
+            active[slot] = True
+        keys, ring = self._keys, self._ring
+        if self._multihost:
+            keys, ring = np.asarray(keys), np.asarray(ring)
+        (toks, lps, tops_i, tops_l, self.cache, keys_o,
+         ring_o) = self._decode_scan_impl(
+            self.params,
+            jnp.asarray(self._last_tok, jnp.int32),
+            jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                        jnp.int32),
+            jnp.asarray(active), self.cache, self.rope, self.config,
+            keys, ring,
+            jnp.asarray(self._steps, jnp.int32),
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._penalty),
+            num_steps=n, top_k=self.defaults.top_k, n_top=n_top,
+        )
+        if self._multihost:
+            keys_o = jnp.asarray(np.asarray(keys_o))
+            ring_o = jnp.asarray(np.asarray(ring_o))
+        self._keys, self._ring = keys_o, ring_o
+        return (np.asarray(toks), np.asarray(lps), np.asarray(tops_i),
+                np.asarray(tops_l))
+
+    def _finalize_scan_mirrors(self, rows, n: int, toks_host) -> None:
+        """Follower-side mirror advance after a replayed scan. MUST
+        agree with the coordinator's emit loop in _do_decode_scan: a row
+        that emitted EOS at step j ends at pos0+j+1 (the loop breaks
+        there); otherwise pos0+n. Budget exhaustion can only land on the
+        last step (_scan_steps_for guarantees >= n budget), which equals
+        the no-EOS endpoint."""
+        eos = self.config.eos_token_ids
+        for slot in rows:
+            pos0 = int(self._pos[slot])
+            self._steps[slot] += n
+            self._last_tok[slot] = toks_host[slot, -1]
+            end = n
+            for j in range(n):
+                if int(toks_host[slot, j]) in eos:
+                    end = j + 1
+                    break
+            self._pos[slot] = pos0 + end
+
     def _n_top_for(self, rows) -> int:
         """cap when any of the rows' requests asked for top_logprobs,
         else 0 (both variants are separately compiled and cached; on a
@@ -923,9 +992,14 @@ class InferenceEngine:
                 return self.n_top
         return 0
 
-    def _sample_rows(self, logits, rows: List[int]):
+    def _sample_rows(self, logits, rows: List[int],
+                     n_top: Optional[int] = None):
         """Sample all B rows; advance keys/ring only for `rows` (so an
-        inactive slot's PRNG stream is untouched)."""
+        inactive slot's PRNG stream is untouched). n_top: explicit value
+        in multi-host replay (it rides every op so coordinator and
+        followers compile the SAME sampling program — different n_top
+        variants may fuse differently and flip a sampled token near a
+        top-p boundary); None derives it from the rows' requests."""
         B = self.max_slots
         row_mask = np.zeros(B, bool)
         for r in rows:
@@ -935,7 +1009,7 @@ class InferenceEngine:
             jnp.asarray(self._steps, jnp.int32),
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
             jnp.asarray(self._penalty), top_k=self.defaults.top_k,
-            n_top=self._n_top_for(rows),
+            n_top=self._n_top_for(rows) if n_top is None else n_top,
         )
         nxt_host = np.asarray(nxt)
         for r in rows:
@@ -1017,13 +1091,19 @@ def _masked_sample(active_mask, keys, logits, ring, steps, temp, top_p,
     return nxt, keys, ring, lp, top_ids, top_lps
 
 
-@partial(jax.jit, static_argnames=("config", "num_steps", "top_k",
-                                   "n_top"),
-         donate_argnames=("cache",))
-def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
-                 config, keys, ring, steps, temp, top_p, penalty,
-                 num_steps: int, top_k, n_top: int = 0):
-    """num_steps ragged decode+sample steps as ONE compiled program.
+def make_decode_scan(forward_fn, out_sharding=None):
+    """Build a jitted num_steps-ragged-decode+sample scan over any
+    ragged forward (single-device model.forward_ragged, or the
+    shard_mapped pipelined forward from parallel.pipeline
+    .make_engine_step_fns — the step_fns-forces-scan-1 limitation is
+    gone: a pipelined engine amortizes host dispatch across K tokens
+    per round trip exactly like the single-device engine).
+
+    forward_fn(params, tokens, cache, pos, active, rope, config)
+    -> (logits, cache), with model.forward_ragged's signature.
+    out_sharding: optional sharding constraint for the non-cache
+    outputs (multi-host serving localizes them per process, so they
+    must leave the program fully replicated).
 
     Same per-row semantics as the single-step path (_do_decode +
     _sample_rows — both go through _masked_sample): inactive rows touch
@@ -1031,32 +1111,53 @@ def _decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
     emits EOS mid-scan freezes for the remaining steps — in single-step
     mode the scheduler frees the slot immediately, so without freezing
     the slot's PRNG/ring stream would diverge between the two modes.
-    Returns ([B, num_steps] tokens, [B, num_steps] logprobs, cache, keys,
-    ring); the host mirrors (_pos/_steps/_last_tok) are advanced by the
-    caller.
+    Returns ([B, num_steps] tokens, [B, num_steps] logprobs,
+    [B, num_steps, n_top] x2, cache, keys, ring); the host mirrors
+    (_pos/_steps/_last_tok) are advanced by the caller.
     """
-    from cake_tpu.models.llama.model import forward_ragged
 
-    eos_ids = jnp.asarray(config.eos_token_ids, jnp.int32)
+    @partial(jax.jit, static_argnames=("config", "num_steps", "top_k",
+                                       "n_top"),
+             donate_argnames=("cache",))
+    def decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
+                    config, keys, ring, steps, temp, top_p, penalty,
+                    num_steps: int, top_k, n_top: int = 0):
+        eos_ids = jnp.asarray(config.eos_token_ids, jnp.int32)
 
-    def body(carry, _):
-        tok, pos, cache, keys, ring, steps, live = carry
-        logits, cache = forward_ragged(params, tok[:, None], cache, pos,
+        def body(carry, _):
+            tok, pos, cache, keys, ring, steps, live = carry
+            logits, cache = forward_fn(params, tok[:, None], cache, pos,
                                        live, rope, config)
-        nxt, keys, ring, lp, t_i, t_l = _masked_sample(
-            live, keys, logits, ring, steps, temp, top_p, penalty,
-            top_k=top_k, n_top=n_top)
-        tok = jnp.where(live, nxt, tok)
-        pos = pos + live
-        steps = steps + live
-        live = live & ~jnp.isin(nxt, eos_ids)
-        return ((tok, pos, cache, keys, ring, steps, live),
-                (nxt, lp, t_i, t_l))
+            nxt, keys, ring, lp, t_i, t_l = _masked_sample(
+                live, keys, logits, ring, steps, temp, top_p, penalty,
+                top_k=top_k, n_top=n_top)
+            tok = jnp.where(live, nxt, tok)
+            pos = pos + live
+            steps = steps + live
+            live = live & ~jnp.isin(nxt, eos_ids)
+            return ((tok, pos, cache, keys, ring, steps, live),
+                    (nxt, lp, t_i, t_l))
 
-    ((tok, pos, cache, keys, ring, steps, live),
-     (toks, lps, tops_i, tops_l)) = jax.lax.scan(
-        body, (last_tok, pos, cache, keys, ring, steps, active), None,
-        length=num_steps)
-    # [B, num_steps(, n_top)] each
-    return (toks.T, lps.T, jnp.swapaxes(tops_i, 0, 1),
-            jnp.swapaxes(tops_l, 0, 1), cache, keys, ring)
+        ((tok, pos, cache, keys, ring, steps, live),
+         (toks, lps, tops_i, tops_l)) = jax.lax.scan(
+            body, (last_tok, pos, cache, keys, ring, steps, active), None,
+            length=num_steps)
+        # [B, num_steps(, n_top)] each
+        outs = (toks.T, lps.T, jnp.swapaxes(tops_i, 0, 1),
+                jnp.swapaxes(tops_l, 0, 1), keys, ring)
+        if out_sharding is not None:
+            outs = tuple(jax.lax.with_sharding_constraint(o, out_sharding)
+                         for o in outs)
+        toks_o, lps_o, ti_o, tl_o, keys_o, ring_o = outs
+        return toks_o, lps_o, ti_o, tl_o, cache, keys_o, ring_o
+
+    return decode_scan
+
+
+def _builtin_forward_ragged(params, tokens, cache, pos, active, rope,
+                            config):
+    from cake_tpu.models.llama.model import forward_ragged
+    return forward_ragged(params, tokens, cache, pos, active, rope, config)
+
+
+_decode_scan = make_decode_scan(_builtin_forward_ragged)

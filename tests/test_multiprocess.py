@@ -145,6 +145,7 @@ API_WORKER = textwrap.dedent("""
         "--max-seq-len", "256", "--temperature", "0.0",
         "--repeat-penalty", "1.0", "--no-flash-attention",
         "--max-slots", "2", "--api", api_addr, "--checkpoint", ckpt,
+        "--decode-scan", "4",
     ]))
 """)
 

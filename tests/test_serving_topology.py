@@ -280,3 +280,47 @@ def test_sp_honors_kv_dtype():
     cache = gen.cache  # SPSessionCache after the first prefill
     assert cache.sp.ctx_k.dtype == jnp.float8_e4m3fn
     assert cache.sp.tail_k.dtype == jnp.float8_e4m3fn
+
+
+def test_engine_over_topology_multistep_scan_matches_k1(topo_path):
+    """Round-3 verdict #4: the pipelined engine decodes K tokens per
+    dispatch (scan INSIDE the shard_mapped program) and its output is
+    token-identical to the step-by-step path."""
+    prompts = [[7, 11, 13], [5, 3, 2, 6]]
+    outs = {}
+    for name, scan in (("k1", 1), ("k4", 4)):
+        gen = _ctx(_mk_args(topology=topo_path,
+                            decode_scan=scan)).load_text_model()
+        from cake_tpu.master import Master
+        master = Master(_mk_args(topology=topo_path, decode_scan=scan),
+                        text_generator=gen)
+        engine = master.make_engine(max_slots=4)
+        assert engine._decode_scan == scan  # scan not silently disabled
+        with engine:
+            handles = [engine.submit(p, max_new_tokens=8, temperature=0.0,
+                                     repeat_penalty=1.0)
+                       for p in prompts]
+            assert all(h.wait(timeout=180) for h in handles)
+        outs[name] = [h._req.out_tokens for h in handles]
+    assert outs["k1"] == outs["k4"]
+
+
+def test_engine_over_topology_chunked_prefill_matches_whole(topo_path):
+    """Round-3 verdict #4 (second half): --prefill-chunk now works for
+    the pipelined engine — same tokens as whole-prompt prefill."""
+    long_prompt = list(range(3, 3 + 70))   # > chunk of 32
+    outs = {}
+    for name, chunk in (("whole", None), ("chunked", 32)):
+        args = _mk_args(topology=topo_path, prefill_chunk=chunk)
+        gen = _ctx(args).load_text_model()
+        from cake_tpu.master import Master
+        master = Master(args, text_generator=gen)
+        engine = master.make_engine(max_slots=4)
+        if chunk:
+            assert engine.prefill_chunk == chunk  # not silently dropped
+        with engine:
+            h = engine.submit(long_prompt, max_new_tokens=6,
+                              temperature=0.0, repeat_penalty=1.0)
+            assert h.wait(timeout=180)
+        outs[name] = h._req.out_tokens
+    assert outs["whole"] == outs["chunked"]
