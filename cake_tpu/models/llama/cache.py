@@ -85,3 +85,41 @@ def update_layer_cache(k_cache, v_cache, new_k, new_v, pos):
     k_cache = lax.dynamic_update_slice(k_cache, new_k.astype(k_cache.dtype), zeros)
     v_cache = lax.dynamic_update_slice(v_cache, new_v.astype(v_cache.dtype), zeros)
     return k_cache, v_cache
+
+
+# -- ring-buffer (sliding-window) writes --------------------------------------
+
+def update_layer_cache_ring(k_cache, v_cache, new_k, new_v, pos, n_real=None):
+    """Write S <= W new k/v at ring slots (pos+i) % W.
+
+    k_cache/v_cache: [B, W, KV, hd] ring buffers (W = window capacity)
+    new_k/new_v:     [B, S, KV, hd]
+    pos:             traced scalar absolute start position
+    n_real:          traced count of REAL tokens in the window; entries
+                     i >= n_real keep the slot's previous content — a
+                     padded chunk's junk would otherwise alias ring slots
+                     of positions still inside upcoming queries' windows
+                     (the dense cache never had this hazard: junk landed
+                     at untouched higher positions).
+    """
+    B, W = k_cache.shape[0], k_cache.shape[1]
+    S = new_k.shape[1]
+    assert S <= W, f"ring write of {S} tokens exceeds ring capacity {W}"
+    slots = jnp.mod(pos + jnp.arange(S), W)                  # [S] unique
+    keep = (jnp.arange(S) >= (S if n_real is None else n_real))
+    old_k = k_cache[:, slots]
+    old_v = v_cache[:, slots]
+    sel = keep[None, :, None, None]
+    k_cache = k_cache.at[:, slots].set(
+        jnp.where(sel, old_k, new_k.astype(k_cache.dtype)))
+    v_cache = v_cache.at[:, slots].set(
+        jnp.where(sel, old_v, new_v.astype(v_cache.dtype)))
+    return k_cache, v_cache
+
+
+def update_layer_cache_per_row_ring(k_cache, v_cache, new_k, new_v, pos,
+                                    active):
+    """Ragged single-token ring write: row b writes at slot pos[b] % W."""
+    W = k_cache.shape[1]
+    return update_layer_cache_per_row(k_cache, v_cache, new_k, new_v,
+                                      jnp.mod(pos, W), active)

@@ -114,7 +114,8 @@ def block_skeleton(lp, x, config: LlamaConfig, attn_fn,
 def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
                   config: LlamaConfig, tp_axis: Optional[str] = None,
                   ep_axis: Optional[str] = None,
-                  is_prefill: bool = False, chunked: bool = False):
+                  is_prefill: bool = False, chunked: bool = False,
+                  ring: bool = False, write_len=None):
     """One decoder block with KV-cache update.
 
     lp: single-layer param dict (leaves without the L axis)
@@ -133,16 +134,31 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
         T = k_cache.shape[1]
         q = apply_rope(q, rope_c, rope_s)
         k = apply_rope(k, rope_c, rope_s)
+        if ring:
+            # Ring (sliding-window) uniform forward: attend the PRE-write
+            # ring + the fresh window (a full-W window's write would
+            # destroy in-window history its own early queries need), then
+            # write. Ring slots permute key positions, which the flash
+            # kernels' sequential-position masks cannot express -> einsum.
+            from cake_tpu.models.llama.cache import update_layer_cache_ring
+            k_full = jnp.concatenate(
+                [k_cache, k.astype(k_cache.dtype)], axis=1)
+            v_full = jnp.concatenate(
+                [v_cache, v.astype(v_cache.dtype)], axis=1)
+            attn = gqa_attention(q, k_full, v_full, mask=mask)
+            kc, vc = update_layer_cache_ring(k_cache, v_cache, k, v, pos,
+                                             n_real=write_len)
+            return attn, (kc, vc)
         kc, vc = update_layer_cache(k_cache, v_cache, k, v, pos)
-        # the flash kernels implement plain causal masking only;
-        # sliding-window models take the einsum path
-        use_flash = (is_prefill and config.use_flash_attention
-                     and config.sliding_window is None)
+        use_flash = is_prefill and config.use_flash_attention
         if use_flash and not chunked and flash_supported(S, S, H, KV):
             # Fresh prompt at pos=0 with an empty cache: causal attention
             # over the in-window k/v IS the cached-decode mask, so the
             # kernel reads only the S fresh keys — no cache traffic.
-            attn = flash_attention(q, k, v, causal=True)
+            # Sliding-window models pass the window to the kernel (out-of-
+            # window key blocks are skipped entirely).
+            attn = flash_attention(q, k, v, causal=True,
+                                   window=config.sliding_window)
         elif (use_flash and chunked and flash_supported(S, T, H, KV)
                 and kc.dtype == q.dtype):
             # (dtype guard: the Pallas kernel reads the cache directly, so
@@ -150,7 +166,8 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             # Continued prefill at pos>0: the cache-aware kernel attends
             # the cache under kj <= pos+qi; key blocks past the frontier
             # neither compute nor DMA (index-map clamp).
-            attn = flash_attention_cached(q, kc, vc, pos)
+            attn = flash_attention_cached(q, kc, vc, pos,
+                                          window=config.sliding_window)
         else:
             if use_flash:
                 if (chunked and flash_supported(S, T, H, KV)
@@ -177,7 +194,9 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
                tp_axis: Optional[str] = None,
                ep_axis: Optional[str] = None,
                is_prefill: bool = False,
-               chunked: bool = False) -> Tuple[jnp.ndarray, KVCache]:
+               chunked: bool = False,
+               ring: bool = False,
+               write_len=None) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks [L, ...] over the hidden state.
 
     This is the TPU equivalent of the reference's sequential block walk with
@@ -189,7 +208,8 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
         lp, kc, vc = xs
         h, kc, vc = block_forward(lp, h, kc, vc, pos, rope_c, rope_s, mask,
                                   config, tp_axis=tp_axis, ep_axis=ep_axis,
-                                  is_prefill=is_prefill, chunked=chunked)
+                                  is_prefill=is_prefill, chunked=chunked,
+                                  ring=ring, write_len=write_len)
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
@@ -199,7 +219,7 @@ def run_blocks(blocks, x, cache: KVCache, pos, rope_c, rope_s, mask,
 def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
             config: LlamaConfig, last_idx: Optional[jnp.ndarray] = None,
             return_hidden: bool = False, is_prefill: bool = False,
-            chunked: bool = False):
+            chunked: bool = False, ring: bool = False, write_len=None):
     """Full forward: tokens [B, S] + cache @ pos -> (logits [B, V] f32, cache).
 
     last_idx: per-batch index of the final *real* token within the window
@@ -209,10 +229,17 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
     T = cache.max_seq_len
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
-    mask = decode_mask(pos, S, T, window=config.sliding_window)
+    if ring:
+        # T is the ring capacity W here; queries attend the pre-write
+        # ring + the fresh window (see ring_concat_mask)
+        from cake_tpu.ops.attention import ring_concat_mask
+        mask = ring_concat_mask(pos, S, T, config.sliding_window,
+                                n_real=write_len)
+    else:
+        mask = decode_mask(pos, S, T, window=config.sliding_window)
     x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
                           mask, config, is_prefill=is_prefill,
-                          chunked=chunked)
+                          chunked=chunked, ring=ring, write_len=write_len)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     if return_hidden:
         return x, cache
@@ -277,7 +304,8 @@ def prefill_chunk(params, tokens, pos, last_idx, cache: KVCache,
 def run_blocks_ragged(blocks, x, cache: KVCache, pos, active,
                       rope_c, rope_s, mask, config: LlamaConfig,
                       tp_axis: Optional[str] = None,
-                      ep_axis: Optional[str] = None
+                      ep_axis: Optional[str] = None,
+                      ring: bool = False
                       ) -> Tuple[jnp.ndarray, KVCache]:
     """Scan the stacked blocks for per-row-position single-token decode.
 
@@ -293,7 +321,15 @@ def run_blocks_ragged(blocks, x, cache: KVCache, pos, active,
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            kc2, vc2 = update_layer_cache_per_row(kc, vc, k, v, pos, active)
+            if ring:
+                from cake_tpu.models.llama.cache import (
+                    update_layer_cache_per_row_ring,
+                )
+                kc2, vc2 = update_layer_cache_per_row_ring(kc, vc, k, v,
+                                                           pos, active)
+            else:
+                kc2, vc2 = update_layer_cache_per_row(kc, vc, k, v, pos,
+                                                      active)
             return gqa_attention(q, kc2, vc2, mask=mask), (kc2, vc2)
 
         h, (kc, vc) = block_skeleton(lp, h, config, attn_fn,
@@ -305,7 +341,8 @@ def run_blocks_ragged(blocks, x, cache: KVCache, pos, active,
 
 
 def ragged_decode(params, tokens, pos, active, cache: KVCache,
-                  rope: RopeTables, config: LlamaConfig, blocks_runner):
+                  rope: RopeTables, config: LlamaConfig, blocks_runner,
+                  ring: bool = False):
     """Shared frame for per-row-position single-token decode: embedding →
     per-row rope rows/masks → blocks_runner → final norm → logits.
 
@@ -317,8 +354,12 @@ def ragged_decode(params, tokens, pos, active, cache: KVCache,
     T = cache.max_seq_len
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
-    mask = decode_mask_per_row(pos, T,
-                               window=config.sliding_window)
+    if ring:
+        from cake_tpu.ops.attention import ring_decode_mask_per_row
+        mask = ring_decode_mask_per_row(pos, T)
+    else:
+        mask = decode_mask_per_row(pos, T,
+                                   window=config.sliding_window)
     x, cache = blocks_runner(params["blocks"], x, cache, pos, active,
                              rope_c, rope_s, mask)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
@@ -347,6 +388,28 @@ def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
                        rope: RopeTables, config: LlamaConfig):
     """Jitted ragged decode step (compiles once per batch size)."""
     return forward_ragged(params, tokens, cache, pos, active, rope, config)
+
+
+def forward_ragged_ring(params, tokens, cache: KVCache, pos, active,
+                        rope: RopeTables, config: LlamaConfig):
+    """forward_ragged over a ring (sliding-window) cache: positions map
+    to slot p % W and validity is ring-slot liveness
+    (ops/attention.ring_decode_mask_per_row)."""
+    def runner(blocks, x, cache, pos, active, rope_c, rope_s, mask):
+        return run_blocks_ragged(blocks, x, cache, pos, active,
+                                 rope_c, rope_s, mask, config, ring=True)
+
+    return ragged_decode(params, tokens, pos, active, cache, rope, config,
+                         runner, ring=True)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step_ragged_ring(params, tokens, pos, active, cache: KVCache,
+                            rope: RopeTables, config: LlamaConfig):
+    """Jitted ragged decode step over a ring cache (the engine's
+    sliding-window serving path: KV memory = window, not max_seq)."""
+    return forward_ragged_ring(params, tokens, cache, pos, active, rope,
+                               config)
 
 
 def slot_prefill(params, tokens, prompt_len, slot, cache: KVCache,
@@ -427,6 +490,24 @@ def prefill_slot_chunk(params, tokens, n_real, slot, pos0,
     def fwd(p, t, sub, pos, last_idx):
         return forward(p, t, sub, pos, rope, config,
                        last_idx=last_idx, is_prefill=True, chunked=True)
+
+    return slot_prefill(params, tokens, n_real, slot, cache, fwd,
+                        pos0=pos0)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_slot_chunk_ring(params, tokens, n_real, slot, pos0,
+                            cache: KVCache, rope: RopeTables,
+                            config: LlamaConfig):
+    """prefill_slot_chunk over a ring (sliding-window) cache: queries
+    attend the pre-write ring + fresh window (ops/attention
+    .ring_concat_mask), then the window writes ring slots (pos0+i) % W
+    with junk-masked padding. Every prompt in ring mode walks through
+    this (windows <= W keep scatter indices unique)."""
+    def fwd(p, t, sub, pos, last_idx):
+        return forward(p, t, sub, pos, rope, config,
+                       last_idx=last_idx, is_prefill=True, chunked=True,
+                       ring=True, write_len=n_real[0])
 
     return slot_prefill(params, tokens, n_real, slot, cache, fwd,
                         pos0=pos0)

@@ -97,3 +97,53 @@ def decode_mask_per_row(pos, max_seq_len: int, window=None):
     if window is not None:
         m &= kj > (pos[:, None, None] - window)
     return m
+
+
+# -- ring-buffer (sliding-window) cache masks ---------------------------------
+#
+# A sliding-window model never attends further back than `window`, so the
+# cache only needs W = min(window, max_seq) slots: position p lives in ring
+# slot p % W (models/llama/cache.update_layer_cache_ring). The masks below
+# translate "which absolute positions may query q attend" into ring-slot
+# space. The reference keeps a dense cache and trims by concatenation
+# (llama3/cache.rs:93-122); the ring drops KV memory to window/max_seq with
+# zero copies per step.
+
+def ring_decode_mask_per_row(pos, ring_len: int):
+    """[B, 1, W] mask for ragged single-token decode over a ring cache.
+
+    After this step's write, slot j holds absolute position
+    p - ((p - j) mod W) — always within (p - W, p], i.e. inside any
+    window >= W. So validity is purely "has slot j been written":
+    j <= pos[b] (pre-wrap) or pos[b] >= W (every slot live)."""
+    kj = lax.broadcasted_iota(jnp.int32, (pos.shape[0], 1, ring_len), 2)
+    p = pos[:, None, None]
+    return (kj <= p) | (p >= ring_len)
+
+
+def ring_concat_mask(pos, seq_len: int, ring_len: int, window: int,
+                     n_real=None):
+    """[S, W+S] mask for a prefill window of S <= W tokens at absolute
+    positions pos..pos+S-1 attending concat(old ring, fresh window).
+
+    The window's queries must see in-window history that the window's
+    own ring write will overwrite (a full-W window replaces the entire
+    ring), so ring prefill attends the PRE-write ring plus the fresh
+    keys, and writes after (models/llama/model.block_forward ring path).
+
+      * ring column j (< W): holds absolute a_j = pos-1 - ((pos-1-j)
+        mod W) — the newest position < pos in that slot; valid iff
+        a_j >= 0 (ever written) and a_j > pos+i - window.
+      * fresh column W+jj: the window's token at absolute pos+jj;
+        causal jj <= i (in-window by S <= W <= window). Junk columns
+        jj >= n_real only reach padding queries i >= n_real, whose
+        output the caller discards via last_idx."""
+    del n_real  # junk freshness is handled by causality (see above)
+    i_r = lax.broadcasted_iota(jnp.int32, (seq_len, ring_len), 0)
+    j_r = lax.broadcasted_iota(jnp.int32, (seq_len, ring_len), 1)
+    a_j = pos - 1 - jnp.mod(pos - 1 - j_r, ring_len)
+    ring_ok = (a_j >= 0) & (a_j > pos + i_r - window)
+    i_f = lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
+    fresh_ok = jj <= i_f
+    return jnp.concatenate([ring_ok, fresh_ok], axis=1)

@@ -72,7 +72,8 @@ def _finish_block(o_ref, acc_ref, l_ref):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, block_q: int, block_k: int, causal: bool):
+                  scale: float, block_q: int, block_k: int, causal: bool,
+                  window=None):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -94,12 +95,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             kj = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             mask = kj <= qi
+            if window is not None:
+                # sliding-window attention: at most `window` most-recent
+                # positions per query (own position included)
+                mask &= kj > qi - window
         _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
                              scale=scale, mask=mask)
 
     if causal:
-        # k_start/q_start are traced (grid ids), so gate at runtime
-        @pl.when(k_start <= q_start + block_q - 1)
+        # k_start/q_start are traced (grid ids), so gate at runtime;
+        # with a window, key blocks entirely BELOW every query's window
+        # are skipped too (the flash win windows exist for: out-of-window
+        # tiles cost ~0)
+        gate = k_start <= q_start + block_q - 1
+        if window is not None:
+            gate &= k_start + block_k - 1 > q_start - window
+
+        @pl.when(gate)
         def _():
             compute()
     else:
@@ -113,7 +125,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 def _flash_kernel_cached(pos_ref, q_ref, k_ref, v_ref, o_ref,
                          acc_ref, m_ref, l_ref, *,
                          scale: float, block_q: int, block_k: int,
-                         seq_len: int):
+                         seq_len: int, window=None):
     """Cache-aware variant: queries sit at absolute positions
     pos..pos+seq_len-1 and attend the whole KV cache [T], masked to
     kj <= pos + qi (chunked/continued prefill; pos is a prefetched
@@ -134,19 +146,27 @@ def _flash_kernel_cached(pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     # skip key blocks entirely above this query block's last position
     # (their DMAs are also elided — the k/v index maps clamp to the same
-    # limit, so Pallas re-reads the resident block instead of fetching)
-    @pl.when(k_start <= pos + q_start + block_q - 1)
+    # limit, so Pallas re-reads the resident block instead of fetching);
+    # with a window, blocks entirely below every query's window skip too
+    gate = k_start <= pos + q_start + block_q - 1
+    if window is not None:
+        gate &= k_start + block_k - 1 > pos + q_start - window
+
+    @pl.when(gate)
     def _():
         qi = pos + q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         kj = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
+        mask = kj <= qi
+        if window is not None:
+            mask &= kj > qi - window
         # cache slots at/after the write frontier pos+seq_len may hold
         # stale or non-finite garbage in the boundary block
         col_valid = (k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, 1), 0)) < pos + seq_len
         _online_softmax_step(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
-                             scale=scale, mask=kj <= qi,
+                             scale=scale, mask=mask,
                              v_valid=col_valid)
 
     @pl.when(ik == nk - 1)
@@ -154,7 +174,8 @@ def _flash_kernel_cached(pos_ref, q_ref, k_ref, v_ref, o_ref,
         _finish_block(o_ref, acc_ref, l_ref)
 
 
-def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+                window=None):
     """q [B,H,S,hd], k/v [B,KV,T,hd] -> [B,H,S,hd]."""
     B, H, S, hd = q.shape
     _, KV, T, _ = k.shape
@@ -165,7 +186,7 @@ def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     grid = (B, H, nq, nk)
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal,
+        causal=causal, window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -198,7 +219,8 @@ def _flash_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
 def flash_attention(q, k, v, *, scale: float | None = None,
                     causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+                    block_k: int = 128, interpret: bool | None = None,
+                    window: int | None = None):
     """Flash attention over [B, S, H, hd] q and [B, T, KV, hd] k/v.
 
     Falls back to None-signalling (caller uses the einsum path) is NOT done
@@ -218,11 +240,13 @@ def flash_attention(q, k, v, *, scale: float | None = None,
     kt = jnp.swapaxes(k, 1, 2)        # [B, KV, T, hd]
     vt = jnp.swapaxes(v, 1, 2)
     out = _flash_bhsd(qt, kt, vt, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+                      block_q=block_q, block_k=block_k, interpret=interpret,
+                      window=window)
     return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_bhsd_cached(pos, q, k, v, *, scale, block_q, block_k, interpret):
+def _flash_bhsd_cached(pos, q, k, v, *, scale, block_q, block_k,
+                       interpret, window=None):
     """q [B,H,S,hd] at absolute offset pos; k/v [B,KV,T,hd] full cache."""
     B, H, S, hd = q.shape
     _, KV, T, _ = k.shape
@@ -230,17 +254,27 @@ def _flash_bhsd_cached(pos, q, k, v, *, scale, block_q, block_k, interpret):
     grid = (B, H, S // block_q, T // block_k)
     kernel = functools.partial(
         _flash_kernel_cached, scale=scale, block_q=block_q, block_k=block_k,
-        seq_len=S,
+        seq_len=S, window=window,
     )
 
     def kv_index(b, h, i, j, pos_ref):
         # clamp skipped k-blocks (beyond this q-block's causal limit) to
         # the limit block: Pallas elides the DMA when the index repeats,
         # so a pos=0 whole-cache call reads only the live prefix, not all
-        # T slots
+        # T slots. With a window, blocks entirely BELOW every query's
+        # window clamp to the lowest in-window block — at long context
+        # this is most of the cache, and flash there is bandwidth-bound,
+        # so eliding these DMAs is the point of the window.
         limit = jax.lax.div(pos_ref[0] + i * block_q + block_q - 1,
                             jnp.int32(block_k))
-        return (b, h // G, jnp.minimum(j, limit), 0)
+        j = jnp.minimum(j, limit)
+        if window is not None:
+            lo = jax.lax.div(
+                jnp.maximum(pos_ref[0] + i * block_q - window + 1,
+                            jnp.int32(0)),
+                jnp.int32(block_k))
+            j = jnp.maximum(j, lo)
+        return (b, h // G, j, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -274,7 +308,8 @@ def _flash_bhsd_cached(pos, q, k, v, *, scale, block_q, block_k, interpret):
 def flash_attention_cached(q, k_cache, v_cache, pos, *,
                            scale: float | None = None, block_q: int = 128,
                            block_k: int = 128,
-                           interpret: bool | None = None):
+                           interpret: bool | None = None,
+                           window: int | None = None):
     """Flash attention for a query window at absolute position `pos`
     against the full KV cache (chunked/continued prefill, pos > 0).
 
@@ -299,7 +334,7 @@ def flash_attention_cached(q, k_cache, v_cache, pos, *,
     vt = jnp.swapaxes(v_cache, 1, 2)
     out = _flash_bhsd_cached(pos, qt, kt, vt, scale=scale,
                              block_q=block_q, block_k=block_k,
-                             interpret=interpret)
+                             interpret=interpret, window=window)
     return jnp.swapaxes(out, 1, 2)
 
 

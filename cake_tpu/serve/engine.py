@@ -198,12 +198,28 @@ class InferenceEngine:
         # .make_engine_step_fns for topology-sharded serving. With the
         # scan/chunk fns present, multi-step decode and chunked prefill
         # work over the pipeline exactly as on the built-in path.
+        self.ring = False
         if step_fns is None:
             from cake_tpu.models.llama.model import prefill_slot_chunk
             self._prefill_slot = prefill_slot
             self._decode_step = decode_step_ragged
             self._decode_scan_impl = _decode_scan
             self._prefill_chunk_step = prefill_slot_chunk
+            if (config.sliding_window is not None
+                    and config.sliding_window < max_seq_len):
+                # ring-buffer KV cache: a sliding-window model never
+                # attends past `window`, so the cache holds only W =
+                # window slots (position p -> slot p % W) — KV memory
+                # drops to window/max_seq of dense. All prompts prefill
+                # through the ring chunk fn (windows <= W keep scatter
+                # indices unique); decode writes wrap modularly.
+                from cake_tpu.models.llama.model import (
+                    decode_step_ragged_ring, prefill_slot_chunk_ring,
+                )
+                self.ring = True
+                self._decode_step = decode_step_ragged_ring
+                self._prefill_chunk_step = prefill_slot_chunk_ring
+                self._decode_scan_impl = _decode_scan_ring
         else:
             fns = tuple(step_fns)
             self._prefill_slot, self._decode_step = fns[0], fns[1]
@@ -231,14 +247,22 @@ class InferenceEngine:
             log.warning("prefill_chunk ignored: these custom step fns "
                         "provide no chunked-prefill variant")
             prefill_chunk = None
+        if self.ring:
+            # every prefill must be a ring window <= W
+            W = config.sliding_window
+            prefill_chunk = min(prefill_chunk or min(512, W), W)
         if prefill_chunk is not None and (
                 prefill_chunk < 1 or max_seq_len % prefill_chunk != 0):
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= 1 and divide "
-                f"max_seq_len {max_seq_len}")
+                f"max_seq_len {max_seq_len}"
+                + (" (ring/sliding-window serving requires a chunk that "
+                   "divides max_seq_len; pass --prefill-chunk)"
+                   if self.ring else ""))
         self.prefill_chunk = prefill_chunk
+        cache_len = (config.sliding_window if self.ring else max_seq_len)
         self.cache = cache if cache is not None else KVCache.create(
-            config, max_slots, max_seq_len, dtype=cache_dtype)
+            config, max_slots, cache_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
         # an identically-sharded cache even after donation freed the buffers
         self._cache_shardings = KVCache(k=self.cache.k.sharding,
@@ -477,11 +501,11 @@ class InferenceEngine:
         8B-model 1k-token prefix is ~130 MiB at bf16). Only available on
         the built-in single-device step path.
         """
-        if self._prefill_slot is not prefill_slot:
+        if self._prefill_slot is not prefill_slot or self.ring:
             raise ValueError(
                 "prefix caching is only supported on the single-device "
-                "engine path (custom/pipelined step fns own their cache "
-                "layout)")
+                "dense-cache engine path (custom/pipelined step fns and "
+                "the ring sliding-window cache own their cache layout)")
         ids = list(prefix_ids)
         if not ids:
             raise ValueError("empty prefix")
@@ -535,7 +559,7 @@ class InferenceEngine:
             hist.add_message(m)
         if (self._auto_prefix and messages
                 and messages[0].role.value == "system"
-                and self._prefill_slot is prefill_slot
+                and self._prefill_slot is prefill_slot and not self.ring
                 and hist.template == "llama3"):
             # the head builder below renders the llama3 system block;
             # other templates (mistral merges system into the first user
@@ -671,7 +695,9 @@ class InferenceEngine:
 
     def _fresh_cache(self) -> KVCache:
         fresh = KVCache.create(self.config, self.max_slots,
-                               self.max_seq_len, dtype=self._cache_dtype)
+                               self.cache.max_seq_len
+                               if self.ring else self.max_seq_len,
+                               dtype=self._cache_dtype)
         return KVCache(
             k=jax.device_put(fresh.k, self._cache_shardings.k),
             v=jax.device_put(fresh.v, self._cache_shardings.v),
@@ -688,7 +714,8 @@ class InferenceEngine:
         ids = req.prompt_ids
         C = self.prefill_chunk
         hit = (self._match_prefix(ids)
-               if self._prefill_slot is prefill_slot else None)
+               if self._prefill_slot is prefill_slot and not self.ring
+               else None)
         chunk_suffix = False
         if hit is not None:
             p_ids, pk, pv = hit
@@ -769,7 +796,9 @@ class InferenceEngine:
         the SPMD dispatch sequence cannot drift between processes."""
         ids = list(ids)
         C = self.prefill_chunk
-        if C and len(ids) > C:
+        if C and (len(ids) > C or self.ring):
+            # ring mode routes EVERY prompt through chunk windows — the
+            # whole-bucket path would write past the ring capacity
             logits = self._prefill_chunked(ids, slot, C)
         else:
             logits = self._prefill_raw(ids, slot)
@@ -1161,3 +1190,12 @@ def _builtin_forward_ragged(params, tokens, cache, pos, active, rope,
 
 
 _decode_scan = make_decode_scan(_builtin_forward_ragged)
+
+
+def _ring_forward_ragged(params, tokens, cache, pos, active, rope, config):
+    from cake_tpu.models.llama.model import forward_ragged_ring
+    return forward_ragged_ring(params, tokens, cache, pos, active, rope,
+                               config)
+
+
+_decode_scan_ring = make_decode_scan(_ring_forward_ragged)

@@ -293,3 +293,141 @@ def test_sp_rejects_sliding_window(tmp_path):
                 flash_attention=False).validate()
     with pytest.raises(ValueError, match="sliding-window"):
         Context.from_args(args).load_text_model()
+
+# -- ring-buffer KV cache (round-3 verdict #5) --------------------------------
+
+def test_ring_cache_memory_is_window_sized(cfg_w, tiny_params):
+    """The engine's sliding-window cache holds W slots, not max_seq —
+    KV memory drops to window/max_seq of dense."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine(cfg_w, tiny_params,
+                             ByteTokenizer(cfg_w.vocab_size),
+                             max_slots=2, max_seq_len=64, sampling=GREEDY)
+    assert engine.ring
+    assert engine.cache.max_seq_len == W          # 8, not 64
+    dense_bytes = 2 * 64  # per-slot per-layer positions, dense
+    ring_bytes = 2 * engine.cache.max_seq_len
+    assert ring_bytes * 8 == dense_bytes  # window/max_seq = 1/8
+
+
+def test_ring_decode_past_wraparound_matches_dense(cfg_w, tiny_params):
+    """Generate far past the ring capacity: every write wraps, and the
+    output still matches the dense-cache windowed oracle token for
+    token."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    prompt = list(range(3, 3 + 30))   # prefills across 4 ring wraps
+    engine = InferenceEngine(cfg_w, tiny_params,
+                             ByteTokenizer(cfg_w.vocab_size),
+                             max_slots=2, max_seq_len=64, sampling=GREEDY)
+    with engine:
+        h = engine.submit(prompt, max_new_tokens=20)
+        assert h.wait(timeout=300)
+    got = h._req.out_tokens[:20]
+
+    gen = LlamaGenerator(cfg_w, tiny_params, ByteTokenizer(cfg_w.vocab_size),
+                         max_seq_len=64, sampling=GREEDY)
+    want = gen.generate_on_device(
+        np.asarray([prompt], np.int32),
+        np.asarray([len(prompt)], np.int32), 20)[0].tolist()
+    assert got == want[:len(got)] and len(got) >= 1
+
+
+def test_ring_decode_scan_matches_single_step(cfg_w, tiny_params):
+    """decode_scan_steps > 1 over the ring cache == step-by-step."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    prompt = list(range(3, 3 + 12))
+    outs = {}
+    for scan in (1, 4):
+        engine = InferenceEngine(cfg_w, tiny_params,
+                                 ByteTokenizer(cfg_w.vocab_size),
+                                 max_slots=2, max_seq_len=64,
+                                 sampling=GREEDY, decode_scan_steps=scan)
+        with engine:
+            h = engine.submit(prompt, max_new_tokens=12)
+            assert h.wait(timeout=300)
+        outs[scan] = h._req.out_tokens
+    assert outs[1] == outs[4]
+
+
+def test_ring_rejects_prefix_caching(cfg_w, tiny_params):
+    from cake_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine(cfg_w, tiny_params,
+                             ByteTokenizer(cfg_w.vocab_size),
+                             max_slots=2, max_seq_len=64, sampling=GREEDY)
+    with pytest.raises(ValueError, match="ring"):
+        engine.register_prefix(list(range(3, 3 + 10)))
+
+
+# -- windowed flash kernels (round-3 verdict #5, flash half) ------------------
+
+def test_flash_windowed_matches_einsum():
+    from cake_tpu.ops.attention import causal_mask, gqa_attention
+    from cake_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, KV, hd, win = 1, 128, 4, 2, 32, 48
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd))
+    k = jax.random.normal(ks[1], (B, S, KV, hd))
+    v = jax.random.normal(ks[2], (B, S, KV, hd))
+    i = np.arange(S)[:, None]
+    j = np.arange(S)[None, :]
+    mask = jnp.asarray((j <= i) & (j > i - win))
+    ref = gqa_attention(q, k, v, mask=mask)
+    got = flash_attention(q, k, v, causal=True, window=win,
+                          block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_cached_windowed_matches_einsum():
+    from cake_tpu.ops.attention import decode_mask, gqa_attention
+    from cake_tpu.ops.flash_attention import flash_attention_cached
+
+    B, S, T, H, KV, hd, win, pos = 1, 32, 128, 4, 2, 32, 40, 64
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd))
+    kc = jax.random.normal(ks[1], (B, T, KV, hd))
+    vc = jax.random.normal(ks[2], (B, T, KV, hd))
+    ref = gqa_attention(q, kc, vc,
+                        mask=decode_mask(jnp.int32(pos), S, T, window=win))
+    got = flash_attention_cached(q, kc, vc, jnp.int32(pos), window=win,
+                                 block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_prefill_uses_flash(cfg_w, tiny_params, monkeypatch):
+    """With flash enabled, a sliding-window model's fresh prefill goes
+    through the windowed flash kernel (previously: einsum fallback)."""
+    import dataclasses as dc
+
+    import cake_tpu.models.llama.model as model_mod
+    from cake_tpu.models.llama.cache import KVCache
+    from cake_tpu.models.llama.model import RopeTables, prefill
+
+    calls = []
+    real = model_mod.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("window"))
+        return real(*a, interpret=True, **kw)
+
+    monkeypatch.setattr(model_mod, "flash_attention", spy)
+    cfg = dc.replace(cfg_w, sliding_window=16, use_flash_attention=True)
+    params = tiny_params
+    rope = RopeTables.create(cfg, 64)
+    cache = KVCache.create(cfg, 1, 64)
+    toks = jnp.asarray(np.arange(3, 3 + 32)[None], jnp.int32)
+    logits, _ = prefill(params, toks, jnp.asarray([32]), cache, rope, cfg)
+    assert calls and all(w == 16 for w in calls)
+    # and the result equals the einsum path
+    cfg_e = dc.replace(cfg, use_flash_attention=False)
+    logits_e, _ = prefill(params, toks, jnp.asarray([32]),
+                          KVCache.create(cfg_e, 1, 64), rope, cfg_e)
+    # bf16 params: flash vs einsum accumulate differently
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_e),
+                               atol=5e-2, rtol=5e-2)
