@@ -81,6 +81,19 @@ SD_TIERS = [
     ("sd15_txt2img", dict(version="v1-5", height=512, width=512)),
 ]
 
+# Speculative-decoding tier (BASELINE batch-1 latency axis): acceptance
+# rate + end-to-end tok/s vs the target-only generator, through the real
+# SpeculativeGenerator. Random weights (no checkpoint egress here) make
+# the draft disagree with the target far more than a distilled draft
+# would, so the measured acceptance is a FLOOR and the speedup typically
+# < 1 on random weights; on real checkpoints the same tier reports the
+# real acceptance/speedup (instrumentation parity: the mechanism and
+# measurement are what this tier pins down).
+SPEC_TIERS = [
+    ("spec_8b_draft1b", dict(target="8b", draft="1b", max_seq=1024,
+                             gamma=4)),
+]
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
@@ -95,6 +108,9 @@ SMOKE_TIERS = {
     # steps_b - steps_a must dwarf timing noise: with a tiny unet the
     # fixed CLIP/VAE/PNG overhead dominates a 2-step delta
     "sd_tiny": dict(version="tiny", steps_a=2, steps_b=12),
+    # chat-template overhead is ~115 tokens; keep headroom
+    "spec_tiny": dict(target="tiny", draft="tiny", max_seq=256,
+                      gamma=4, prompt_len=8, gen_tokens=24),
 }
 
 # HBM bandwidth (bytes/s) by device_kind substring; conservative defaults.
@@ -356,6 +372,77 @@ def run_sd_tier(name: str, version: str, height: int | None = None,
     }
 
 
+def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
+                  gamma: int = 4, prompt_len: int = 128,
+                  gen_tokens: int = 128) -> dict:
+    """Speculative decoding vs target-only: acceptance rate + tok/s."""
+    from functools import partial
+
+    import jax
+    import numpy as np
+
+    from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+    from cake_tpu.models.llama.params import init_params
+    from cake_tpu.models.llama.speculative import SpeculativeGenerator
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    t_cfg, d_cfg = make_config(target), make_config(draft)
+    t_params = jax.jit(partial(init_params, t_cfg))(jax.random.PRNGKey(0))
+    d_params = jax.jit(partial(init_params, d_cfg))(jax.random.PRNGKey(1))
+    jax.block_until_ready((t_params, d_params))
+    sampling = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    tok = ByteTokenizer(t_cfg.vocab_size)
+    prompt_txt = "x" * prompt_len
+
+    def run_n_tokens(gen):
+        from cake_tpu.models.chat import Message
+        gen.reset()
+        gen.add_message(Message.user(prompt_txt))
+        t0 = time.perf_counter()
+        n = 0
+        for i in range(gen_tokens):
+            t = gen.next_token(i)
+            if i == 0:
+                t0 = time.perf_counter()  # exclude compile
+            else:
+                n += 1
+            if t.is_end_of_stream:
+                break
+        dt = time.perf_counter() - t0
+        return n / dt if dt > 0 and n else 0.0
+
+    def best_of(gen, runs: int = 2):
+        # identical warm discipline for both generators: discard the
+        # compile-heavy first run, report the best steady-state run —
+        # asymmetric warm-up would tilt the speedup comparison
+        run_n_tokens(gen)
+        return max(run_n_tokens(gen) for _ in range(runs))
+
+    base_gen = LlamaGenerator(t_cfg, t_params, tok, max_seq_len=max_seq,
+                              sampling=sampling)
+    base_tps = best_of(base_gen)
+
+    spec = SpeculativeGenerator(t_cfg, t_params, d_cfg, d_params, tok,
+                                gamma=gamma, max_seq_len=max_seq,
+                                sampling=sampling)
+    spec_tps = best_of(spec)
+    accept = spec.acceptance_rate
+    log(f"speculative: {spec_tps:.1f} tok/s (target-only {base_tps:.1f}), "
+        f"acceptance {accept:.2%} over {spec.proposed} proposals")
+    return {
+        "metric": f"{name}_speculative",
+        "value": round(spec_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "spec_tok_s": round(spec_tps, 2),
+        "spec_baseline_tok_s": round(base_tps, 2),
+        "spec_accept_rate": round(accept, 4),
+        "spec_gamma": gamma,
+    }
+
+
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
@@ -365,6 +452,9 @@ def tier_main():
     elif name in dict(SD_TIERS) or name == "sd_tiny":
         kwargs = {**dict(SD_TIERS), **SMOKE_TIERS}[name]
         result = run_sd_tier(name, **kwargs)
+    elif name in dict(SPEC_TIERS) or name == "spec_tiny":
+        kwargs = {**dict(SPEC_TIERS), **SMOKE_TIERS}[name]
+        result = run_spec_tier(name, **kwargs)
     else:
         kwargs = {**dict(TIERS), **SMOKE_TIERS}[name]
         result = run_tier(name, **kwargs)
@@ -484,6 +574,15 @@ def main():
                 result.update({k: v for k, v in sres.items()
                                if k.startswith("sd_")})
                 break
+        # speculative acceptance + speedup (batch-1 latency axis) — only
+        # when the 8B headline fit (the spec tier holds target AND draft)
+        if name.startswith("llama3_8b"):
+            for pname, _kw in SPEC_TIERS:
+                pres = _run_tier_subprocess(pname)
+                if pres is not None:
+                    result.update({k: v for k, v in pres.items()
+                                   if k.startswith("spec_")})
+                    break
         print(json.dumps(result), flush=True)
         return
     print(json.dumps({

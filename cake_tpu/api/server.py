@@ -72,6 +72,14 @@ class ApiServer:
             raise ValueError(
                 "logprobs requires the batching engine (this deployment "
                 "serves through the legacy locked path)")
+        # clamp to the serving mode's decode budget (e.g. the --sp
+        # adapter's replicated tail): generating past it raises mid-
+        # stream, after headers are gone — the client would hang on a
+        # never-terminated chunked response
+        budget = getattr(getattr(self.master.llm, "_forward_fn", None),
+                         "max_decode_tokens", None)
+        if budget is not None:
+            opts["max_tokens"] = min(opts["max_tokens"] or budget, budget)
         with self._admission():
             with self._gen_lock:
                 m = self.master
@@ -456,6 +464,15 @@ def start(master, address: str = "127.0.0.1:10128",
     host, port = address.rsplit(":", 1)
     if engine is None and master.llm is not None:
         engine = master.make_engine()
+    if engine is None and master.llm is not None:
+        # locked-path serving (--sp / --draft-model): these flags gate on
+        # the engine and silently doing nothing would surprise operators
+        if checkpoint_path:
+            log.warning("--checkpoint does not apply to engine-less "
+                        "(locked-path) serving; no snapshots will be "
+                        "taken")
+        log.info("engine-less serving: stall watchdog and /metrics "
+                 "engine counters are unavailable")
     if health is None and engine is not None:
         # always-on progress watchdog; multi-host callers pass a
         # ServingHealth that additionally heartbeats the followers

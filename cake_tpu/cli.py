@@ -40,6 +40,10 @@ def _serve_multihost(master, args) -> int:
     # allocation is a global computation, so construction order matters
     # and must match across hosts)
     engine = master.make_engine()
+    if engine is None:
+        raise ValueError(
+            "this serving mode (--sp / --draft-model) has no batching "
+            "engine and no multi-host step replay; serve it on one host")
     # a model without a cross-process placement (no topology/tp/dp) runs
     # entirely inside the coordinator: no step replay needed — followers
     # just idle on the control channel until the stop op, preserving the

@@ -129,12 +129,17 @@ class Context:
         kwargs = {}
         if a.sp > 1:
             # sequence/context parallelism: ring-attention prefill +
-            # merged-stats decode over an ("sp",) mesh — the long-context
-            # serving mode (prompt sharded over chips)
-            if plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
+            # merged-stats decode over an ("sp",) or ("sp","tp") mesh —
+            # the long-context serving mode (prompt sharded over chips,
+            # optionally with Megatron head sharding within each shard)
+            if plan.stages > 1 or plan.dp > 1:
                 raise ValueError(
-                    "--sp does not compose with --tp/--dp/topology stages "
-                    "in this release; run sp on its own mesh")
+                    "--sp does not compose with --dp/topology stages "
+                    "in this release; combine with --tp or run sp alone")
+            if plan.tp > 1 and a.quant != "none":
+                raise ValueError(
+                    "--sp with --tp does not support --quant yet "
+                    "(QTensor specs are not expanded on the sp mesh)")
             if cfg.sliding_window is not None:
                 raise ValueError(
                     "--sp (ring attention) does not implement "
@@ -145,9 +150,15 @@ class Context:
 
             from cake_tpu.parallel.context_parallel import SPGeneratorForward
             devices = jax.devices()
-            if a.sp > len(devices):
+            tp = plan.tp
+            if a.sp * tp > len(devices):
                 raise ValueError(
-                    f"--sp {a.sp} needs {a.sp} devices, have {len(devices)}")
+                    f"--sp {a.sp} x --tp {tp} needs {a.sp * tp} devices, "
+                    f"have {len(devices)}")
+            if tp > 1 and cfg.num_key_value_heads % tp != 0:
+                raise ValueError(
+                    f"--tp {tp} must divide kv heads "
+                    f"{cfg.num_key_value_heads}")
             # split the window: context (sharded) + decode tail (replicated);
             # the tail MUST hold sample_len generated tokens — a too-small
             # tail would clamp cache writes over live entries
@@ -158,9 +169,20 @@ class Context:
                     f"--max-seq-len {max_seq} leaves no context window for "
                     f"sp={a.sp} after a {tail}-token decode tail; raise "
                     "--max-seq-len or lower --sample-len")
-            mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
+            if tp > 1:
+                mesh = Mesh(np.array(devices[:a.sp * tp]).reshape(a.sp, tp),
+                            ("sp", "tp"))
+                # place the block params on their tp shards up front so
+                # every sp call doesn't pay a reshard from replicated
+                from cake_tpu.parallel.context_parallel import (
+                    place_sp_params,
+                )
+                params = place_sp_params(mesh, cfg, params, tp=True)
+            else:
+                mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
             fwd = SPGeneratorForward(
-                mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype)
+                mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype,
+                tp=tp > 1)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths

@@ -58,21 +58,23 @@ class Master:
         g = self.llm
         from cake_tpu.models.llama.speculative import SpeculativeGenerator
         if isinstance(g, SpeculativeGenerator):
-            # the batched engine has no draft/verify step contract yet;
-            # silently serving target-only would drop the speculation the
-            # user asked for
-            raise ValueError(
-                "continuous-batching/API serving does not support "
-                "--draft-model (speculation is a batch-1 latency mode); "
-                "drop --api or --draft-model")
+            # the batched engine has no draft/verify step contract;
+            # serve through the legacy locked path instead — batch-1
+            # speculative decoding behind --api (the latency mode the
+            # draft exists for), one request at a time
+            log.info("no batching engine for --draft-model: the API "
+                     "serves speculative requests one at a time")
+            return None
         if getattr(g, "_forward_fn", None) is not None and g.parallel is None:
             # a custom forward without a (plan, mesh) — e.g. the --sp
-            # adapter — has no engine-step contract; silently serving a
-            # dense engine would drop the sharding the user asked for
-            raise ValueError(
-                "continuous-batching/API serving is not available for this "
-                "serving mode (--sp is a one-shot/generator mode); drop "
-                "--api or use a stage/tp topology instead")
+            # adapter — has no engine-step contract. Returning None makes
+            # the REST layer serve through the legacy locked path (one
+            # generation at a time) instead: long-context one-shot
+            # requests work behind --api, they just don't batch.
+            log.info("no batching engine for this serving mode (--sp): "
+                     "the API serves requests one at a time through the "
+                     "generator")
+            return None
         slots = max_slots or getattr(self.args, "max_slots", 8)
         kwargs = {}
         if getattr(g, "parallel", None) is not None:

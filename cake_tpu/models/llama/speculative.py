@@ -213,6 +213,25 @@ class SpeculativeGenerator:
     def generated_tokens(self) -> int:
         return len(self.tokens)
 
+    def set_sampling(self, temperature=None, top_p=None, **overrides):
+        """Per-request sampling overrides (the locked API path's
+        contract). Speculation supports temperature only — the verify
+        pass scores raw model probabilities, so top-p/top-k filtering
+        would break the accept/resample correctness proof; a request
+        asking for them gets a clean error instead of silently different
+        sampling."""
+        from dataclasses import replace
+        if top_p is not None and top_p < 1.0:
+            raise ValueError(
+                "--draft-model serving supports temperature only "
+                "(top_p/top_k would break speculative accept/resample)")
+        if overrides.get("top_k") is not None:
+            raise ValueError(
+                "--draft-model serving supports temperature only")
+        if temperature is not None:
+            self.sampling = replace(self.sampling,
+                                    temperature=temperature)
+
     @property
     def acceptance_rate(self) -> float:
         return self.accepted / self.proposed if self.proposed else 0.0

@@ -202,8 +202,14 @@ class SPCache(NamedTuple):
 
 
 def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                    tail_len: int, kv_dtype=None):
+                    tail_len: int, kv_dtype=None, tp: bool = False):
     """Build (sp_prefill, sp_decode) jitted over the mesh's "sp" axis.
+
+    tp: the mesh also carries a "tp" axis — attention/ffn heads shard
+    Megatron-style within each sequence shard (block_skeleton's tp
+    psums), so ring attention rotates KV chunks of LOCAL heads: sp x tp
+    composes sequence and tensor parallelism on one mesh (round-3
+    verdict #6; the stage x sp composition remains future work).
 
     kv_dtype: storage dtype for the SPCache (fp8 halves the sharded
     long-context cache — the dominant allocation of this mode); values
@@ -219,6 +225,7 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
     sp_size = mesh.shape["sp"]
     assert ctx_len % sp_size == 0, (ctx_len, sp_size)
     Sl = ctx_len // sp_size
+    tp_axis = "tp" if tp else None
 
     def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
                      cos, sin):
@@ -241,7 +248,8 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
                     k = k.astype(kv_dtype)
                     v = v.astype(kv_dtype)
                 return out, (k, v)
-            h, (k, v) = block_skeleton(lp, h, config, attn_fn)
+            h, (k, v) = block_skeleton(lp, h, config, attn_fn,
+                                       tp_axis=tp_axis)
             return h, (k, v)
 
         x, (ks, vs) = lax.scan(layer, x, blocks)
@@ -289,7 +297,8 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
                                           ctx_valid, tail_valid, "sp")
                 return out, (tk2, tv2)
 
-            h, (tk2, tv2) = block_skeleton(lp, h, config, attn_fn)
+            h, (tk2, tv2) = block_skeleton(lp, h, config, attn_fn,
+                                           tp_axis=tp_axis)
             return h, (tk2, tv2)
 
         x, (tk_new, tv_new) = lax.scan(
@@ -298,10 +307,15 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         logits = (x[:, -1] @ lm_head).astype(jnp.float32)
         return logits, tk_new, tv_new
 
-    ctx_spec = P(None, None, "sp", None, None)
+    ctx_spec = P(None, None, "sp", tp_axis, None)
+    tail_spec = P(None, None, None, tp_axis, None) if tp else P()
     rep = P()
-    from cake_tpu.models.llama.params import block_param_keys
-    blocks_spec = {kk: P() for kk in block_param_keys(config)}
+    from cake_tpu.models.llama.params import block_param_keys, block_specs
+    if tp:
+        blocks_spec = block_specs(block_param_keys(config),
+                                  stage_axis=None, tp_axis="tp")
+    else:
+        blocks_spec = {kk: P() for kk in block_param_keys(config)}
 
     prefill_sm = jax.shard_map(
         prefill_body, mesh=mesh,
@@ -312,8 +326,8 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
     decode_sm = jax.shard_map(
         decode_body, mesh=mesh,
         in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
-                  ctx_spec, ctx_spec, rep, rep, rep, rep),
-        out_specs=(rep, rep, rep),
+                  ctx_spec, ctx_spec, tail_spec, tail_spec, rep, rep),
+        out_specs=(rep, tail_spec, tail_spec),
         check_vma=False,
     )
 
@@ -329,9 +343,11 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         # first donated sp_decode try to donate one buffer twice (JAX
         # falls back to a copy, defeating the donation)
         shape = (config.num_hidden_layers, B, tail_len, KV, hd)
-        rep = NamedSharding(mesh, P())
-        tail_k = lax.with_sharding_constraint(jnp.zeros(shape, store), rep)
-        tail_v = lax.with_sharding_constraint(jnp.zeros(shape, store), rep)
+        tspec = NamedSharding(mesh, tail_spec)
+        tail_k = lax.with_sharding_constraint(jnp.zeros(shape, store),
+                                              tspec)
+        tail_v = lax.with_sharding_constraint(jnp.zeros(shape, store),
+                                              tspec)
         return logits, SPCache(ks, vs, tail_k, tail_v)
 
     @partial(jax.jit, donate_argnames=("cache",))
@@ -345,6 +361,24 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         return logits, SPCache(cache.ctx_k, cache.ctx_v, tk, tv)
 
     return sp_prefill, sp_decode
+
+
+def place_sp_params(mesh: Mesh, config: LlamaConfig, params,
+                    tp: bool = False):
+    """device_put the block params with the specs make_sp_forward's
+    shard_map expects (tp head sharding when tp; replicated otherwise) —
+    the single placement rule for every sp caller, so call sites cannot
+    drift from the in_specs."""
+    if not tp:
+        return params
+    from cake_tpu.models.llama.params import block_param_keys, block_specs
+    bspecs = block_specs(block_param_keys(config), stage_axis=None,
+                         tp_axis="tp")
+    out = dict(params)
+    out["blocks"] = {
+        k: jax.device_put(v, NamedSharding(mesh, bspecs[k]))
+        for k, v in params["blocks"].items()}
+    return out
 
 
 class SPSessionCache(NamedTuple):
@@ -374,7 +408,7 @@ class SPGeneratorForward:
     """
 
     def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                 tail_len: int, kv_dtype=None):
+                 tail_len: int, kv_dtype=None, tp: bool = False):
         if ctx_len % mesh.shape["sp"] != 0:
             raise ValueError(
                 f"sp context window {ctx_len} must divide over sp="
@@ -390,7 +424,7 @@ class SPGeneratorForward:
         # cache (generator skips its fresh() copy accordingly)
         self.allocates_cache = True
         self._prefill, self._decode = make_sp_forward(
-            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype)
+            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype, tp=tp)
 
     def __call__(self, params, tokens, cache, pos, rope,
                  last_idx=None, is_prefill: bool = False):

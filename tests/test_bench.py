@@ -85,3 +85,11 @@ def test_unreachable_backend_fails_fast_with_error_line():
     assert result["value"] == 0.0
     assert "backend unreachable" in result["error"]
     assert "--- tier" not in proc.stderr  # never reached the tier chain
+
+
+def test_spec_smoke_tier_reports_acceptance():
+    result = _run_tier("spec_tiny")
+    assert result["value"] > 0
+    assert result["spec_baseline_tok_s"] > 0
+    assert 0.0 <= result["spec_accept_rate"] <= 1.0
+    assert result["spec_gamma"] == 4

@@ -248,14 +248,55 @@ def test_sp_scratch_generation_does_not_clobber_session():
     assert first + rest == want
 
 
-def test_sp_engine_refused():
-    """--sp + --api must fail loudly, not silently serve a dense engine."""
+def test_sp_has_no_engine_but_serves_via_locked_path():
+    """--sp + --api: no batching engine (the sp adapter has no engine
+    step contract) — make_engine returns None and the REST layer serves
+    one-shot long-prompt requests through the legacy locked path
+    (round-3 verdict #6)."""
+    import json
+    import urllib.request
+
+    from cake_tpu.api.server import start
     from cake_tpu.master import Master
     args = _mk_args(sp=4, max_seq_len=256, sample_len=8)
     gen = _ctx(args).load_text_model()
     master = Master(args, text_generator=gen)
-    with pytest.raises(ValueError, match="one-shot"):
-        master.make_engine()
+    assert master.make_engine() is None
+
+    httpd = start(master, address="127.0.0.1:0", block=False)
+    base = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        req = urllib.request.Request(
+            base + "/api/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            obj = json.loads(r.read())
+        assert obj["choices"][0]["message"]["role"] == "assistant"
+    finally:
+        httpd.shutdown()
+
+
+def test_sp_tp_composed_matches_dense():
+    """sp x tp on one mesh (round-3 verdict #6): ring attention over sp
+    with Megatron head sharding over tp — generated tokens equal the
+    dense single-device path for a full-window prompt."""
+    args_sp = _mk_args(sp=4, tp=2, max_seq_len=64, sample_len=8)
+    gen_sp = _ctx(args_sp).load_text_model()
+    assert gen_sp._forward_fn is not None
+    ctx_len = gen_sp._forward_fn.ctx_len
+    # block params actually tp-sharded
+    wq = gen_sp.params["blocks"]["wq"]
+    assert "tp" in str(wq.sharding.spec)
+
+    gen_dense = _ctx(_mk_args(max_seq_len=64)).load_text_model()
+    prompt = np.full((1, ctx_len), 7, np.int32)
+    plen = np.full((1,), ctx_len, np.int32)
+    a = gen_dense.generate_on_device(prompt, plen, 6)
+    b = gen_sp.generate_on_device(prompt, plen, 6)
+    np.testing.assert_array_equal(a, b)
 
 
 def test_sp_decode_budget_enforced():

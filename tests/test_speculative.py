@@ -148,7 +148,15 @@ def test_sampled_calls_advance_rng(tiny_config, target, draft):
     assert not np.array_equal(a, b)
 
 
-def test_api_engine_rejected_with_draft(tiny_config):
+def test_api_serves_draft_via_locked_path(tiny_config):
+    """--draft-model + --api: no batching engine (speculation is a
+    batch-1 latency mode) — make_engine returns None and the REST layer
+    serves speculative requests one at a time through the locked path
+    (round-3 verdict #8: --draft-model wired into batch-1 API serving)."""
+    import json
+    import urllib.request
+
+    from cake_tpu.api.server import start
     from cake_tpu.args import Args
     from cake_tpu.context import Context
     from cake_tpu.master import Master
@@ -156,10 +164,28 @@ def test_api_engine_rejected_with_draft(tiny_config):
     args = Args(model="", draft_model="", max_seq_len=256,
                 temperature=0.0, repeat_penalty=1.0,
                 flash_attention=False).validate()
-    master = Master(args, text_generator=Context.from_args(args)
-                    .load_text_model())
-    with pytest.raises(ValueError, match="draft-model"):
-        master.make_engine(max_slots=2)
+    gen = Context.from_args(args).load_text_model()
+    from cake_tpu.models.llama.speculative import SpeculativeGenerator
+    assert isinstance(gen, SpeculativeGenerator)
+    master = Master(args, text_generator=gen)
+    assert master.make_engine(max_slots=2) is None
+
+    httpd = start(master, address="127.0.0.1:0", block=False)
+    base = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        req = urllib.request.Request(
+            base + "/api/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            obj = json.loads(r.read())
+        assert obj["choices"][0]["message"]["role"] == "assistant"
+        # the speculative generator actually ran (stats advanced)
+        assert gen.proposed > 0
+    finally:
+        httpd.shutdown()
 
 
 def test_prefill_chunk_rejected_with_draft(tiny_config):
