@@ -98,12 +98,12 @@ class Context:
         # stage-local streaming load (reference worker.rs:106-127 parity,
         # per shard): with a sharded placement and real weights on disk,
         # every tensor lands directly on its mesh shard — no full-model
-        # host/device copy ever exists, which is what lets a 70B topology
-        # actually load instead of dying at the eager full-tree load.
-        # MoE checkpoints still use the eager loader (no streaming path).
+        # host/device copy ever exists, which is what lets a 70B (or
+        # Mixtral-8x22B) topology actually load instead of dying at the
+        # eager full-tree load.
         stream_sharded = (
             (plan.stages > 1 or plan.tp > 1 or plan.dp > 1)
-            and a.sp <= 1 and not cfg.is_moe and has_weights(a.model)
+            and a.sp <= 1 and has_weights(a.model)
         )
         if stream_sharded:
             params = None   # loaded inside the topology branch, post-mesh
@@ -264,10 +264,13 @@ class Context:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from cake_tpu.models.llama.params import (
-            block_param_keys, load_params_sharded,
-        )
+        from cake_tpu.models.llama.params import block_param_keys
         from cake_tpu.parallel.pipeline import pipeline_param_specs
+
+        if cfg.is_moe:
+            from cake_tpu.models.moe.params import load_params_sharded
+        else:
+            from cake_tpu.models.llama.params import load_params_sharded
 
         specs = pipeline_param_specs(block_param_keys(cfg),
                                      tp_axis="tp" if tp else None)

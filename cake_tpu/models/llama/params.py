@@ -243,6 +243,44 @@ def _np_dtype(jdtype):
             jnp.float32: np.float32}.get(jdtype, np.float32)
 
 
+def make_stream_leaf_builders(host, nd):
+    """(simple_leaf, block_leaf) closures for streaming sharded loads —
+    shared by the dense and MoE loaders so the slice semantics cannot
+    drift. host: name -> mmap view; nd: numpy target dtype."""
+
+    def simple_leaf(name: str, transpose: bool, sharding):
+        src = host[name].T if transpose else host[name]
+
+        def cb(index):
+            return np.ascontiguousarray(src[index]).astype(nd, copy=False)
+
+        return jax.make_array_from_callback(tuple(src.shape), sharding, cb)
+
+    def block_leaf(names, transpose: bool, sharding):
+        views = [host[n] for n in names]
+        views = [v.T if transpose else v for v in views]
+        L = len(views)
+        shape = (L,) + tuple(views[0].shape)
+
+        def cb(index):
+            sub = np.stack([np.asarray(views[i][index[1:]])
+                            for i in range(L)[index[0]]])
+            return sub.astype(nd, copy=False)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    return simple_leaf, block_leaf
+
+
+def stream_shard_of(shardings):
+    def shard_of(*path):
+        node = shardings
+        for k in path:
+            node = node[k]
+        return node
+    return shard_of
+
+
 def load_params_sharded(model_dir: str, config: LlamaConfig, shardings,
                         dtype=jnp.bfloat16):
     """Stream HF safetensors directly onto mesh shards.
@@ -267,38 +305,15 @@ def load_params_sharded(model_dir: str, config: LlamaConfig, shardings,
     # prefetch=False keeps the native reader from madvise(WILLNEED)ing
     # the whole checkpoint (only shard slices will ever be touched)
     host = load_weights(model_dir, prefetch=False)
-    nd = _np_dtype(dtype)
-
-    def simple_leaf(name: str, transpose: bool, sharding):
-        src = host[name].T if transpose else host[name]
-
-        def cb(index):
-            return np.ascontiguousarray(src[index]).astype(nd, copy=False)
-
-        return jax.make_array_from_callback(tuple(src.shape), sharding, cb)
-
-    def block_leaf(hf_suffix: str, transpose: bool, sharding):
-        views = [host[f"model.layers.{i}.{hf_suffix}"] for i in range(L)]
-        views = [v.T if transpose else v for v in views]
-        shape = (L,) + tuple(views[0].shape)
-
-        def cb(index):
-            sub = np.stack([np.asarray(views[i][index[1:]])
-                            for i in range(L)[index[0]]])
-            return sub.astype(nd, copy=False)
-
-        return jax.make_array_from_callback(shape, sharding, cb)
-
-    def shard_of(*path):
-        node = shardings
-        for k in path:
-            node = node[k]
-        return node
+    simple_leaf, block_leaf = make_stream_leaf_builders(
+        host, _np_dtype(dtype))
+    shard_of = stream_shard_of(shardings)
 
     params: Dict = {
         "blocks": {
-            key: block_leaf(hf_suffix, transpose,
-                            shard_of("blocks", key))
+            key: block_leaf(
+                [f"model.layers.{i}.{hf_suffix}" for i in range(L)],
+                transpose, shard_of("blocks", key))
             for key, (hf_suffix, transpose) in per_layer.items()
         },
     }

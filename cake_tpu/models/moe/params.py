@@ -55,6 +55,23 @@ def init_params(config: MoEConfig, rng: jax.Array, dtype=jnp.bfloat16):
     return params
 
 
+MOE_PREFIX = "block_sparse_moe"
+# our leaf -> (HF per-layer suffix, transpose); shared by the eager and
+# streaming loaders so their trees cannot structurally diverge
+MOE_ATTN_LAYOUT = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "router": (f"{MOE_PREFIX}.gate.weight", True),
+}
+# our expert leaf -> HF expert weight name (w1=gate, w3=up, w2=down)
+MOE_EXPERT_LAYOUT = (("we_gate", "w1"), ("we_up", "w3"),
+                     ("we_down", "w2"))
+
+
 def load_params_from_hf(model_dir: str, config: MoEConfig,
                         dtype=jnp.bfloat16,
                         layer_range: Optional[range] = None):
@@ -66,19 +83,11 @@ def load_params_from_hf(model_dir: str, config: MoEConfig,
     layers = list(layer_range) if layer_range is not None else list(range(L))
     nd = _np_dtype(dtype)
 
-    moe = "block_sparse_moe"
+    moe = MOE_PREFIX
     needed = {"model.embed_tokens.weight", "model.norm.weight"}
     if not c.tie_word_embeddings:
         needed.add("lm_head.weight")
-    attn = {
-        "attn_norm": ("input_layernorm.weight", False),
-        "wq": ("self_attn.q_proj.weight", True),
-        "wk": ("self_attn.k_proj.weight", True),
-        "wv": ("self_attn.v_proj.weight", True),
-        "wo": ("self_attn.o_proj.weight", True),
-        "mlp_norm": ("post_attention_layernorm.weight", False),
-        "router": (f"{moe}.gate.weight", True),
-    }
+    attn = MOE_ATTN_LAYOUT
     for i in layers:
         for suffix, _t in attn.values():
             needed.add(f"model.layers.{i}.{suffix}")
@@ -100,7 +109,7 @@ def load_params_from_hf(model_dir: str, config: MoEConfig,
     }
     # Experts: HF w1 [F, D] = gate, w3 [F, D] = up (both -> [D, F]);
     # w2 [D, F] = down (-> [F, D]).
-    for key, wn in (("we_gate", "w1"), ("we_up", "w3"), ("we_down", "w2")):
+    for key, wn in MOE_EXPERT_LAYOUT:
         blocks[key] = jnp.asarray(np.stack([
             np.stack([
                 t(f"model.layers.{i}.{moe}.experts.{e}.{wn}.weight", True)
@@ -115,6 +124,65 @@ def load_params_from_hf(model_dir: str, config: MoEConfig,
     }
     params["lm_head"] = (params["embed"].T if c.tie_word_embeddings
                          else jnp.asarray(t("lm_head.weight", True)))
+    return params
+
+
+def load_params_sharded(model_dir: str, config: MoEConfig, shardings,
+                        dtype=jnp.bfloat16):
+    """Stream HF Mixtral safetensors directly onto mesh shards — the MoE
+    analog of models/llama/params.load_params_sharded: each leaf is a
+    jax.make_array_from_callback over mmap views (prefetch disabled), so
+    only locally addressable shard bytes are ever read. At Mixtral-8x22B
+    scale the full tree (~280 GiB bf16) never fits one device; the
+    sharded slices do. Reference behavior: worker-side subset
+    materialisation (worker.rs:106-127), per shard.
+    """
+    from cake_tpu.models.llama.params import (
+        make_stream_leaf_builders, stream_shard_of,
+    )
+    from cake_tpu.utils.loading import load_weights
+
+    c = config
+    L, E = c.num_hidden_layers, c.num_local_experts
+    host = load_weights(model_dir, prefetch=False)
+    nd = _np_dtype(dtype)
+    simple_leaf, block_leaf = make_stream_leaf_builders(host, nd)
+    shard_of = stream_shard_of(shardings)
+    moe = MOE_PREFIX
+
+    def expert_leaf(wn, sharding):
+        # [L, E, in, out] stacked from per-expert [out, in] HF tensors
+        views = [[host[f"model.layers.{i}.{moe}.experts.{e}.{wn}.weight"].T
+                  for e in range(E)] for i in range(L)]
+        shape = (L, E) + tuple(views[0][0].shape)
+
+        def cb(index):
+            sub = np.stack([
+                np.stack([np.asarray(views[i][e][index[2:]])
+                          for e in range(E)[index[1]]])
+                for i in range(L)[index[0]]
+            ])
+            return sub.astype(nd, copy=False)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    blocks = {
+        key: block_leaf([f"model.layers.{i}.{suffix}" for i in range(L)],
+                        tr, shard_of("blocks", key))
+        for key, (suffix, tr) in MOE_ATTN_LAYOUT.items()}
+    for key, wn in MOE_EXPERT_LAYOUT:
+        blocks[key] = expert_leaf(wn, shard_of("blocks", key))
+
+    params = {
+        "blocks": blocks,
+        "embed": simple_leaf("model.embed_tokens.weight", False,
+                             shard_of("embed")),
+        "final_norm": simple_leaf("model.norm.weight", False,
+                                  shard_of("final_norm")),
+    }
+    params["lm_head"] = simple_leaf(
+        "model.embed_tokens.weight" if c.tie_word_embeddings
+        else "lm_head.weight", True, shard_of("lm_head"))
     return params
 
 
