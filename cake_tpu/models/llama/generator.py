@@ -97,15 +97,17 @@ def encode_text(tokenizer, text: str) -> List[int]:
 
 
 def incremental_decode(tokenizer, ids: List[int],
-                       pending: str) -> Tuple[str, str]:
+                       pending: str, final: bool = False) -> Tuple[str, str]:
     """Streaming detokenization step: (new_text, updated_pending).
 
     Text is held back (empty delta) while the tail decodes to an incomplete
     UTF-8 sequence (the replacement char), so multi-token characters stream
-    whole."""
+    whole. final=True flushes a permanently-incomplete tail at end of
+    stream — the streamed total must equal the buffered decode of the same
+    ids."""
     full = tokenizer.decode(ids)
     new = full[len(pending):]
-    if new.endswith("�"):
+    if new.endswith("�") and not final:
         return "", pending
     return new, full
 

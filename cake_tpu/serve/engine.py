@@ -1062,7 +1062,10 @@ class InferenceEngine:
         hit_cap = (self._pos[req.slot] + 1 >= self.max_seq_len)
         finished = self.scheduler.report(req.rid, 1, eos or hit_cap)
         if req.stream is not None:
-            delta = "" if eos else self._incremental_text(req)
+            # final=finished: flush any held-back UTF-8 tail — a stream
+            # ending on an incomplete sequence would otherwise deliver
+            # less text than the buffered response for the same request
+            delta = self._incremental_text(req, final=finished)
             if delta or finished:
                 try:
                     req.stream(delta, finished)
@@ -1075,11 +1078,11 @@ class InferenceEngine:
             self.stats.requests_completed += 1
             req.done.set()
 
-    def _incremental_text(self, req: _Request) -> str:
+    def _incremental_text(self, req: _Request, final: bool = False) -> str:
         ids = [t for t in req.out_tokens
                if t not in self.config.eos_token_ids]
         new, req._pending_text = incremental_decode(
-            self.tokenizer, ids, req._pending_text)
+            self.tokenizer, ids, req._pending_text, final=final)
         return new
 
     def _fail_all(self, err: Exception) -> None:

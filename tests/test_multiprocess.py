@@ -135,13 +135,13 @@ API_WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_matmul_precision", "highest")
 
-    pid, port, topo, api_addr, ckpt = sys.argv[1:6]
+    pid, port, topo, api_addr, ckpt, model = sys.argv[1:7]
     os.environ["CAKE_COORDINATOR"] = f"127.0.0.1:{port}"
     os.environ["CAKE_NUM_PROCESSES"] = "2"
     os.environ["CAKE_PROCESS_ID"] = pid
     from cake_tpu import cli
     sys.exit(cli.main([
-        "--model", "", "--topology", topo, "--tp", "2",
+        "--model", model, "--topology", topo, "--tp", "2",
         "--max-seq-len", "256", "--temperature", "0.0",
         "--repeat-penalty", "1.0", "--no-flash-attention",
         "--max-slots", "2", "--api", api_addr, "--checkpoint", ckpt,
@@ -155,7 +155,7 @@ MESSAGES = [
 ]
 
 
-def _oracle_chat_text(tiny_config) -> str:
+def _oracle_chat_text(tiny_config, model_dir) -> str:
     """Single-process engine result for MESSAGES — what the multi-host
     deployment must reproduce token for token."""
     from cake_tpu.models.chat import Message
@@ -165,7 +165,7 @@ def _oracle_chat_text(tiny_config) -> str:
     from cake_tpu.utils.devices import resolve_dtype
 
     from cake_tpu.models import load_text_params
-    params = load_text_params(tiny_config, "", resolve_dtype("bf16"))
+    params = load_text_params(tiny_config, model_dir, resolve_dtype("bf16"))
     eng = InferenceEngine(
         tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
         max_slots=2, max_seq_len=256,
@@ -199,7 +199,11 @@ def test_multihost_api_serving(tmp_path, tiny_config):
 
     topo = tmp_path / "topology.yml"
     topo.write_text(TOPOLOGY)
-    want = _oracle_chat_text(tiny_config)
+    # real disk weights: every process STREAMS its shards from the
+    # checkpoint (stage-local multi-host load) instead of random init
+    from test_stream_load import write_tiny_hf_checkpoint
+    model_dir = write_tiny_hf_checkpoint(tmp_path / "model", tiny_config)
+    want = _oracle_chat_text(tiny_config, model_dir)
     assert want  # the oracle itself must produce something
 
     port = _free_port()
@@ -211,7 +215,7 @@ def test_multihost_api_serving(tmp_path, tiny_config):
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", API_WORKER, str(i), str(port),
-             str(topo), api_addr, ckpt],
+             str(topo), api_addr, ckpt, model_dir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
         for i in range(2)

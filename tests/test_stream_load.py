@@ -27,21 +27,12 @@ from cake_tpu.models.llama.params import (
 from cake_tpu.parallel.pipeline import pipeline_param_specs
 from cake_tpu.utils.loading import save_safetensors
 
-HF_CONFIG = {
-    "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
-    "num_hidden_layers": 4, "num_attention_heads": 4,
-    "num_key_value_heads": 2, "rms_norm_eps": 1e-5, "rope_theta": 10000.0,
-    "max_position_embeddings": 256, "bos_token_id": 1, "eos_token_id": 2,
-}
-
-
-@pytest.fixture()
-def hf_dir(tmp_path, tiny_config):
-    """Tiny checkpoint in real HF safetensors layout, seed-deterministic."""
+def write_tiny_hf_checkpoint(dirpath, c):
+    """Tiny checkpoint in real HF safetensors layout, seed-deterministic.
+    Shared with tests/test_multiprocess.py (multi-host streaming load)."""
     rng = np.random.default_rng(7)
-    layout, per_layer, L = hf_param_layout(tiny_config)
+    layout, per_layer, L = hf_param_layout(c)
     tensors = {}
-    c = tiny_config
     D, F = c.hidden_size, c.intermediate_size
     H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     shapes = {   # HF ([out, in]) shapes
@@ -64,11 +55,27 @@ def hf_dir(tmp_path, tiny_config):
     tensors["model.norm.weight"] = np.ones((D,), np.float32)
     tensors["lm_head.weight"] = rng.standard_normal(
         (c.vocab_size, D)).astype(np.float32) * 0.02
-    d = tmp_path / "model"
-    d.mkdir()
-    save_safetensors(str(d / "model.safetensors"), tensors)
-    (d / "config.json").write_text(json.dumps(HF_CONFIG))
-    return str(d)
+    os.makedirs(dirpath, exist_ok=True)
+    save_safetensors(os.path.join(dirpath, "model.safetensors"), tensors)
+    # config.json derived from c so shapes and config can never diverge
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump({
+            "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+            "intermediate_size": c.intermediate_size,
+            "num_hidden_layers": c.num_hidden_layers,
+            "num_attention_heads": c.num_attention_heads,
+            "num_key_value_heads": c.num_key_value_heads,
+            "rms_norm_eps": c.rms_norm_eps, "rope_theta": c.rope_theta,
+            "max_position_embeddings": c.max_position_embeddings,
+            "bos_token_id": c.bos_token_id,
+            "eos_token_id": list(c.eos_token_ids),
+        }, f)
+    return str(dirpath)
+
+
+@pytest.fixture()
+def hf_dir(tmp_path, tiny_config):
+    return write_tiny_hf_checkpoint(tmp_path / "model", tiny_config)
 
 
 def _mesh(dp=1, stage=2, tp=2):
