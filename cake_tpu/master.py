@@ -89,16 +89,23 @@ class Master:
                 raise ValueError(
                     f"--max-slots {slots} must be divisible by "
                     f"--microbatches {microbatches}")
+            # sliding-window model over a topology: ring cache per stage
+            # (W slots instead of max_seq), same memory win as the
+            # single-device engine's ring path
+            ring = (g.config.sliding_window is not None
+                    and g.config.sliding_window < g.max_seq_len)
             cache = create_sharded_cache(
-                g.config, slots, g.max_seq_len, mesh,
+                g.config, slots,
+                g.config.sliding_window if ring else g.max_seq_len, mesh,
                 tp_axis="tp" if tp else None, dp_axis=None,
                 stage_axis="stage", dtype=g.cache.k.dtype,
             )
             kwargs = dict(
                 step_fns=make_engine_step_fns(
                     mesh, g.config, num_microbatches=microbatches,
-                    tp=tp, params=g.params),
+                    tp=tp, params=g.params, ring=ring),
                 cache=cache,
+                ring=ring,
             )
         return InferenceEngine(
             g.config, g.params, g.tokenizer,
@@ -143,6 +150,11 @@ class Master:
             else:
                 generated += 1
             if token.is_end_of_stream:
+                if token.text:
+                    # EOS carries the flushed UTF-8 tail (generator
+                    # parity with the buffered decode)
+                    pieces.append(token.text)
+                    stream(token)
                 break
             pieces.append(token.text)
             stream(token)

@@ -235,7 +235,12 @@ class LlamaGenerator:
         self.tokens.append(tid)
 
         if tid in self.config.eos_token_ids:
-            return Token(id=tid, text="", is_end_of_stream=True)
+            # flush any held-back UTF-8 tail so the streamed total equals
+            # the buffered decode of the same ids (engine parity)
+            tail, self._pending_text = incremental_decode(
+                self.tokenizer, self.tokens[:-1], self._pending_text,
+                final=True)
+            return Token(id=tid, text=tail, is_end_of_stream=True)
         return Token(id=tid, text=self._decode_incremental(), is_end_of_stream=False)
 
     # -- internals -----------------------------------------------------------

@@ -229,14 +229,9 @@ def forward(params, tokens, cache: KVCache, pos, rope: RopeTables,
     T = cache.max_seq_len
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
-    if ring:
-        # T is the ring capacity W here; queries attend the pre-write
-        # ring + the fresh window (see ring_concat_mask)
-        from cake_tpu.ops.attention import ring_concat_mask
-        mask = ring_concat_mask(pos, S, T, config.sliding_window,
+    from cake_tpu.ops.attention import uniform_forward_mask
+    mask = uniform_forward_mask(pos, S, T, config.sliding_window, ring,
                                 n_real=write_len)
-    else:
-        mask = decode_mask(pos, S, T, window=config.sliding_window)
     x, cache = run_blocks(params["blocks"], x, cache, pos, rope_c, rope_s,
                           mask, config, is_prefill=is_prefill,
                           chunked=chunked, ring=ring, write_len=write_len)

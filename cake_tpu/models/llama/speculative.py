@@ -244,7 +244,10 @@ class SpeculativeGenerator:
         tid = self._buffer.pop(0)
         self.tokens.append(tid)
         if tid in self.config.eos_token_ids:
-            return Token(id=tid, text="", is_end_of_stream=True)
+            tail, self._pending_text = incremental_decode(
+                self.tokenizer, self.tokens[:-1], self._pending_text,
+                final=True)
+            return Token(id=tid, text=tail, is_end_of_stream=True)
         new, self._pending_text = incremental_decode(
             self.tokenizer, self.tokens, self._pending_text)
         return Token(id=tid, text=new, is_end_of_stream=False)

@@ -121,6 +121,19 @@ def ring_decode_mask_per_row(pos, ring_len: int):
     return (kj <= p) | (p >= ring_len)
 
 
+def uniform_forward_mask(pos, seq_len: int, ring_len_or_T: int, window,
+                         ring: bool, n_real=None):
+    """THE mask policy for uniform-position forwards, shared by
+    model.forward and the pipelined forward_body so the single-device
+    and pipelined attention semantics cannot drift: ring ->
+    ring_concat_mask over [S, W+S]; dense -> decode_mask over [S, T]
+    (optionally windowed)."""
+    if ring:
+        return ring_concat_mask(pos, seq_len, ring_len_or_T, window,
+                                n_real=n_real)
+    return decode_mask(pos, seq_len, ring_len_or_T, window=window)
+
+
 def ring_concat_mask(pos, seq_len: int, ring_len: int, window: int,
                      n_real=None):
     """[S, W+S] mask for a prefill window of S <= W tokens at absolute

@@ -99,18 +99,22 @@ def _gpipe_stage_loop(k, v, x, run_microbatch, *, num_microbatches: int):
     return out, k, v
 
 
-def _stage_pipeline_body(blocks, k, v, x, pos, rope_c, rope_s, mask, *,
+def _stage_pipeline_body(blocks, k, v, x, pos, wlen, rope_c, rope_s,
+                         mask, *,
                          config: LlamaConfig, num_microbatches: int,
                          tp_axis: Optional[str], is_prefill: bool = False,
-                         chunked: bool = False):
+                         chunked: bool = False, ring: bool = False):
     """Per-device body for uniform-position forward (prefill / batch
     decode): pos, rope rows and mask are shared across the batch.
+    ring/wlen: sliding-window ring cache (stage-local [L_local, B, W]
+    slices; writes wrap at W with wlen junk-masking — model.run_blocks
+    ring semantics, identical per stage).
     """
     def run_microbatch(inp, k_mb, v_mb, idx, mb):
         y, cache_mb = run_blocks(
             blocks, inp, KVCache(k_mb, v_mb), pos, rope_c, rope_s, mask,
             config, tp_axis=tp_axis, is_prefill=is_prefill,
-            chunked=chunked,
+            chunked=chunked, ring=ring, write_len=wlen,
         )
         return y, cache_mb.k, cache_mb.v
 
@@ -135,7 +139,7 @@ def _blocks_in_specs(config: LlamaConfig, tp_axis, params=None):
 def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
                           num_microbatches: int = 1,
                           tp: bool = False, dp: bool = False,
-                          params=None):
+                          params=None, ring: bool = False):
     """Build a jitted pipelined forward(params, tokens, cache, pos, rope,
     last_idx, is_prefill) -> (logits, cache) for the given mesh.
 
@@ -157,10 +161,10 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
         return jax.shard_map(
             partial(_stage_pipeline_body, config=config,
                     num_microbatches=num_microbatches, tp_axis=tp_axis,
-                    is_prefill=is_prefill, chunked=chunked),
+                    is_prefill=is_prefill, chunked=chunked, ring=ring),
             mesh=mesh,
             in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
-                      P(), P(), P(), P()),
+                      P(), P(), P(), P(), P()),
             out_specs=(x_spec, cache_spec, cache_spec),
             check_vma=False,
         )
@@ -171,15 +175,19 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
 
     def forward_body(params, tokens, cache: KVCache, pos, rope: RopeTables,
                      last_idx=None, is_prefill: bool = False,
-                     chunked: bool = False):
+                     chunked: bool = False, write_len=None):
         B, S = tokens.shape
         T = cache.max_seq_len
         x = jnp.take(params["embed"], tokens, axis=0)
         rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos, S)
-        mask = decode_mask(pos, S, T, window=config.sliding_window)
+        from cake_tpu.ops.attention import uniform_forward_mask
+        mask = uniform_forward_mask(pos, S, T, config.sliding_window,
+                                    ring, n_real=write_len)
+        wlen = (jnp.int32(S) if write_len is None
+                else jnp.asarray(write_len, jnp.int32))
         y, k, v = stage_fns[(is_prefill, chunked)](
             params["blocks"], cache.k, cache.v,
-            x, pos, rope_c, rope_s, mask)
+            x, pos, wlen, rope_c, rope_s, mask)
         y = rms_norm(y, params["final_norm"], config.rms_norm_eps)
         if last_idx is None:
             last = y[:, -1]
@@ -206,7 +214,8 @@ def make_pipeline_forward(mesh: Mesh, config: LlamaConfig,
 def _stage_pipeline_body_ragged(blocks, k, v, x, pos, active,
                                 rope_c, rope_s, mask, *,
                                 config: LlamaConfig, num_microbatches: int,
-                                tp_axis: Optional[str]):
+                                tp_axis: Optional[str],
+                                ring: bool = False):
     """Per-device GPipe body for per-row-position single-token decode:
     every per-row quantity (pos, active, rope rows, mask) is sliced per
     microbatch and the stage runs `run_blocks_ragged`. x: [B, 1, D].
@@ -217,6 +226,7 @@ def _stage_pipeline_body_ragged(blocks, k, v, x, pos, active,
         y, cache_mb = run_blocks_ragged(
             blocks, inp, KVCache(k_mb, v_mb), sl(pos), sl(active),
             sl(rope_c), sl(rope_s), sl(mask), config, tp_axis=tp_axis,
+            ring=ring,
         )
         return y, cache_mb.k, cache_mb.v
 
@@ -226,7 +236,7 @@ def _stage_pipeline_body_ragged(blocks, k, v, x, pos, active,
 
 def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                          num_microbatches: int = 1, tp: bool = False,
-                         params=None):
+                         params=None, ring: bool = False):
     """Pipelined replacements for the engine's jitted steps.
 
     Returns (prefill_slot_fn, decode_ragged_fn, decode_scan_fn,
@@ -246,12 +256,13 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     from cake_tpu.models.llama.model import ragged_decode, slot_prefill
 
     fwd = make_pipeline_forward(mesh, config, num_microbatches=1, tp=tp,
-                                dp=False, params=params)
+                                dp=False, params=params, ring=ring)
     model_config = config
 
     ragged_stage = jax.shard_map(
         partial(_stage_pipeline_body_ragged, config=config,
-                num_microbatches=num_microbatches, tp_axis=tp_axis),
+                num_microbatches=num_microbatches, tp_axis=tp_axis,
+                ring=ring),
         mesh=mesh,
         in_specs=(blocks_specs, cache_spec, cache_spec, x_spec,
                   P(), P(), P(), P(), P()),
@@ -274,12 +285,19 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
             return y, KVCache(k, v)
 
         return ragged_decode(params, tokens, pos, active, cache,
-                             rope, model_config, runner)
+                             rope, model_config, runner, ring=ring)
 
     @partial(jax.jit, donate_argnames=("cache",),
              static_argnames=("config",))
     def prefill_slot_fn(params, tokens, prompt_len, slot, cache: KVCache,
                         rope: RopeTables, config=None):
+        if ring:
+            # the engine routes EVERY ring prompt through chunk windows;
+            # a whole-bucket prefill could exceed the ring capacity
+            raise RuntimeError(
+                "whole-bucket prefill is not available on the ring "
+                "pipelined path (engine forces chunked prefill)")
+
         def pipelined(p, t, sub, pos, last_idx):
             return fwd.body(p, t, sub, pos, rope,
                             last_idx=last_idx, is_prefill=True)
@@ -309,7 +327,8 @@ def make_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         cache-aware (chunked) pipelined forward."""
         def pipelined(p, t, sub, pos, last_idx):
             return fwd.body(p, t, sub, pos, rope, last_idx=last_idx,
-                            is_prefill=True, chunked=True)
+                            is_prefill=True, chunked=True,
+                            write_len=n_real[0] if ring else None)
 
         logits, cache = slot_prefill(params, tokens, n_real, slot, cache,
                                      pipelined, pos0=pos0)

@@ -175,6 +175,7 @@ class InferenceEngine:
         max_auto_prefixes: int = 8,
         prefill_chunk: Optional[int] = None,
         top_logprobs_cap: int = 20,
+        ring: Optional[bool] = None,
     ):
         self.config = config
         self.params = params
@@ -198,6 +199,10 @@ class InferenceEngine:
         # .make_engine_step_fns for topology-sharded serving. With the
         # scan/chunk fns present, multi-step decode and chunked prefill
         # work over the pipeline exactly as on the built-in path.
+        # ring: None = auto (builtin path decides from config); True =
+        # the caller's custom step fns operate on a ring cache (pipelined
+        # sliding-window serving — make_engine passes ring step fns AND a
+        # W-length sharded cache together)
         self.ring = False
         if step_fns is None:
             from cake_tpu.models.llama.model import prefill_slot_chunk
@@ -225,6 +230,12 @@ class InferenceEngine:
             self._prefill_slot, self._decode_step = fns[0], fns[1]
             self._decode_scan_impl = fns[2] if len(fns) > 2 else None
             self._prefill_chunk_step = fns[3] if len(fns) > 3 else None
+            if ring:
+                if self._prefill_chunk_step is None:
+                    raise ValueError(
+                        "ring step fns require a chunked-prefill variant "
+                        "(every ring prompt prefills in windows <= W)")
+                self.ring = True
         # decode_scan_steps > 1: when no request is waiting, run K decode
         # steps as ONE on-device lax.scan per host round-trip — host/tunnel
         # dispatch latency amortizes across K tokens.

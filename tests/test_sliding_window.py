@@ -431,3 +431,73 @@ def test_sliding_window_prefill_uses_flash(cfg_w, tiny_params, monkeypatch):
     # bf16 params: flash vs einsum accumulate differently
     np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_e),
                                atol=5e-2, rtol=5e-2)
+
+
+def test_ring_cache_over_topology_matches_dense(cfg_w, tiny_params,
+                                                tmp_path):
+    """Sliding-window model over a 2-stage topology: the engine's cache
+    is ring-sized per stage (W slots) and output matches the dense
+    windowed oracle — the pipelined analog of the single-device ring."""
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+    from cake_tpu.master import Master
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text(
+        "s0:\n  layers:\n    - model.layers.0-1\n"
+        "s1:\n  layers:\n    - model.layers.2-3\n"
+    )
+    args = Args(model="", topology=str(topo), max_seq_len=64,
+                temperature=0.0, repeat_penalty=1.0,
+                flash_attention=False).validate()
+    ctx = Context.from_args(args)
+    ctx.llama_config = cfg_w
+    gen = ctx.load_text_model()
+    master = Master(args, text_generator=gen)
+    engine = master.make_engine(max_slots=2)
+    assert engine.ring
+    assert engine.cache.max_seq_len == W          # ring capacity, not 64
+    assert engine.cache.k.sharding.spec[0] == "stage"
+
+    prompt = list(range(3, 3 + 20))               # spans ring wraps
+    with engine:
+        h = engine.submit(prompt, max_new_tokens=10)
+        assert h.wait(timeout=300)
+    got = h._req.out_tokens[:10]
+
+    oracle = LlamaGenerator(cfg_w, tiny_params,
+                            ByteTokenizer(cfg_w.vocab_size),
+                            max_seq_len=64, sampling=GREEDY)
+    want = oracle.generate_on_device(
+        np.asarray([prompt], np.int32),
+        np.asarray([len(prompt)], np.int32), 10)[0].tolist()
+    assert got == want[:len(got)] and len(got) >= 1
+
+
+def test_ring_over_topology_decode_scan(cfg_w, tmp_path):
+    """K-step scanned decode over the ring pipelined path == K=1."""
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+    from cake_tpu.master import Master
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text(
+        "s0:\n  layers:\n    - model.layers.0-1\n"
+        "s1:\n  layers:\n    - model.layers.2-3\n"
+    )
+    prompt = list(range(3, 3 + 12))
+    outs = {}
+    for scan in (1, 4):
+        args = Args(model="", topology=str(topo), max_seq_len=64,
+                    temperature=0.0, repeat_penalty=1.0, decode_scan=scan,
+                    flash_attention=False).validate()
+        ctx = Context.from_args(args)
+        ctx.llama_config = cfg_w
+        master = Master(args, text_generator=ctx.load_text_model())
+        engine = master.make_engine(max_slots=2)
+        assert engine.ring and engine._decode_scan == scan
+        with engine:
+            h = engine.submit(prompt, max_new_tokens=12)
+            assert h.wait(timeout=300)
+        outs[scan] = h._req.out_tokens
+    assert outs[1] == outs[4]
