@@ -372,19 +372,28 @@ class LlamaGenerator:
                             self.rope, last_idx=(plen - 1).astype(jnp.int32),
                             is_prefill=True)
         ring = jnp.full((B, self.sampling.repeat_last_n), -1, jnp.int32)
-        outs = []
-        tok = None
+        rng, sub = jax.random.split(rng)
+        first = sample_tokens(sub, logits, ring, self.sampling)
+        ring = update_ring(ring, first, 0)
+        if num_tokens > 1 and hasattr(fwd, "decode_scan"):
+            # adapter provides an on-device multi-step decode (SP): the
+            # remaining tokens cost ONE dispatch instead of one per token
+            rest, cache, ring, rng = fwd.decode_scan(
+                self.params, first[:, None], 0, cache, self.rope, rng,
+                ring, num_steps=num_tokens - 1, sampling=self.sampling)
+            out = jnp.concatenate([first[:, None], rest], axis=1)
+            return np.asarray(out).astype(np.int32)
+        outs = [np.asarray(first)]
+        tok = first
         pos = int(np.max(np.asarray(plen)))
-        for step in range(num_tokens):
+        for step in range(1, num_tokens):
+            logits, cache = fwd(self.params, tok[:, None], cache,
+                                jnp.int32(pos), self.rope)
+            pos += 1
             rng, sub = jax.random.split(rng)
             tok = sample_tokens(sub, logits, ring, self.sampling)
             ring = update_ring(ring, tok, step)
             outs.append(np.asarray(tok))
-            if step + 1 == num_tokens:
-                break
-            logits, cache = fwd(self.params, tok[:, None], cache,
-                                jnp.int32(pos), self.rope)
-            pos += 1
         return np.stack(outs, axis=1).astype(np.int32)
 
 

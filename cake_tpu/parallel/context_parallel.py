@@ -360,6 +360,43 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             rope.cos, rope.sin)
         return logits, SPCache(cache.ctx_k, cache.ctx_v, tk, tv)
 
+    @partial(jax.jit, static_argnames=("num_steps", "sampling"),
+             donate_argnames=("cache",))
+    def sp_decode_scan(params, token, pos0, plen, cache: SPCache,
+                       rope: RopeTables, rng, ring, num_steps: int,
+                       sampling):
+        """num_steps decode+sample steps as ONE compiled program — the
+        long-context analog of the engine's decode scan: host/tunnel
+        dispatch amortizes across num_steps tokens instead of paying a
+        round-trip per token (the dominant cost of sp serving at small
+        batch). Sampling (incl. the repeat-penalty ring) runs inside the
+        scan with the same ops the host loop uses."""
+        from cake_tpu.ops.sampling import sample_tokens, update_ring
+
+        def body(carry, step):
+            tok, pos, tk, tv, ring, rng = carry
+            logits, tk, tv = decode_sm(
+                params["blocks"], params["embed"], params["final_norm"],
+                params["lm_head"], tok, pos, plen,
+                cache.ctx_k, cache.ctx_v, tk, tv, rope.cos, rope.sin)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_tokens(sub, logits, ring, sampling)
+            ring = update_ring(ring, nxt, step)
+            return (nxt[:, None], pos + 1, tk, tv, ring, rng), nxt
+
+        # ring steps continue from the input token's step index (the
+        # pos0 operand encodes it: k0 = pos0 - ctx_len), so a mid-session
+        # continuation writes the same penalty-ring slots the host loop
+        # would
+        k0 = pos0 - ctx_len
+        (tok, pos, tk, tv, ring, rng), toks = lax.scan(
+            body,
+            (token, pos0, cache.tail_k, cache.tail_v, ring, rng),
+            k0 + jnp.arange(1, num_steps + 1))
+        return (jnp.transpose(toks, (1, 0)),
+                SPCache(cache.ctx_k, cache.ctx_v, tk, tv), ring, rng)
+
+    sp_prefill.decode_scan = sp_decode_scan
     return sp_prefill, sp_decode
 
 
@@ -448,3 +485,14 @@ class SPGeneratorForward:
                                    jnp.int32(self.ctx_len) + k, cache.plen,
                                    cache.sp, rope)
         return logits, SPSessionCache(spc, cache.plen)
+
+    def decode_scan(self, params, token, k0: int, cache, rope, rng, ring,
+                    num_steps: int, sampling):
+        """num_steps on-device decode+sample steps (see sp_decode_scan).
+        k0: decode step index of `token` (0 = the prefill's first sampled
+        token). Returns (tokens [B, num_steps], cache, ring, rng)."""
+        toks, spc, ring, rng = self._prefill.decode_scan(
+            params, token, jnp.int32(self.ctx_len + k0), cache.plen,
+            cache.sp, rope, rng, ring, num_steps=num_steps,
+            sampling=sampling)
+        return toks, SPSessionCache(spc, cache.plen), ring, rng

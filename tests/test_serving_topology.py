@@ -365,3 +365,35 @@ def test_engine_over_topology_chunked_prefill_matches_whole(topo_path):
             assert h.wait(timeout=180)
         outs[name] = h._req.out_tokens
     assert outs["whole"] == outs["chunked"]
+
+
+def test_sp_generate_uses_on_device_scan(monkeypatch):
+    """generate_on_device over the SP adapter dispatches the forward ONCE
+    (prefill); the remaining tokens decode inside one compiled scan
+    (host/tunnel dispatch amortized — the long-context perf path)."""
+    from cake_tpu.parallel.context_parallel import SPGeneratorForward
+
+    gen = _ctx(_mk_args(sp=4, max_seq_len=64, sample_len=8)
+               ).load_text_model()
+    fwd = gen._forward_fn
+    assert isinstance(fwd, SPGeneratorForward)
+    calls = {"fwd": 0, "scan": 0}
+    orig_call = SPGeneratorForward.__call__
+    orig_scan = SPGeneratorForward.decode_scan
+
+    def spy_call(self, *a, **k):
+        calls["fwd"] += 1
+        return orig_call(self, *a, **k)
+
+    def spy_scan(self, *a, **k):
+        calls["scan"] += 1
+        return orig_scan(self, *a, **k)
+
+    monkeypatch.setattr(SPGeneratorForward, "__call__", spy_call)
+    monkeypatch.setattr(SPGeneratorForward, "decode_scan", spy_scan)
+    ctx_len = fwd.ctx_len
+    prompt = np.full((1, ctx_len), 7, np.int32)
+    plen = np.full((1,), ctx_len, np.int32)
+    out = gen.generate_on_device(prompt, plen, 6)
+    assert out.shape == (1, 6)
+    assert calls == {"fwd": 1, "scan": 1}, calls
