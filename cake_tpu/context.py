@@ -136,10 +136,11 @@ class Context:
                 raise ValueError(
                     "--sp does not compose with --dp/topology stages "
                     "in this release; combine with --tp or run sp alone")
-            if plan.tp > 1 and a.quant != "none":
+            if plan.tp > 1 and a.quant == "int4":
                 raise ValueError(
-                    "--sp with --tp does not support --quant yet "
-                    "(QTensor specs are not expanded on the sp mesh)")
+                    "--sp with --tp supports --quant int8 only: int4's "
+                    "group-wise scales need not divide over tp (use int8 "
+                    "or drop --tp)")
             if cfg.sliding_window is not None:
                 raise ValueError(
                     "--sp (ring attention) does not implement "
@@ -182,7 +183,7 @@ class Context:
                 mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
             fwd = SPGeneratorForward(
                 mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype,
-                tp=tp > 1)
+                tp=tp > 1, params=params)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths

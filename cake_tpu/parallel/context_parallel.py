@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.model import RopeTables, block_skeleton
 from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.quant import qmatmul
 from cake_tpu.ops.rope import apply_rope
 
 NEG_INF = -1e30
@@ -201,8 +202,28 @@ class SPCache(NamedTuple):
 
 
 
+def sp_block_specs(config: LlamaConfig, tp: bool, params=None):
+    """THE block-param specs for the sp mesh — single source for both
+    make_sp_forward's shard_map in_specs and place_sp_params' placement,
+    so the two cannot drift. With tp and quantized params, QTensor
+    leaves expand to (q, scale) spec pairs; tp + quant REQUIRES the
+    params example tree (without it the specs stay unexpanded and
+    shard_map fails with a structural mismatch)."""
+    from cake_tpu.models.llama.params import block_param_keys, block_specs
+    if not tp:
+        return {kk: P() for kk in block_param_keys(config)}
+    specs = block_specs(block_param_keys(config), stage_axis=None,
+                        tp_axis="tp")
+    if params is not None:
+        from cake_tpu.ops.quant import expand_specs_for_quant
+        specs = {k: specs[k] for k in params["blocks"]}
+        specs = expand_specs_for_quant(params["blocks"], specs)
+    return specs
+
+
 def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                    tail_len: int, kv_dtype=None, tp: bool = False):
+                    tail_len: int, kv_dtype=None, tp: bool = False,
+                    params=None):
     """Build (sp_prefill, sp_decode) jitted over the mesh's "sp" axis.
 
     tp: the mesh also carries a "tp" axis — attention/ffn heads shard
@@ -263,7 +284,7 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         mine = ((last >= idx * Sl) & (last < (idx + 1) * Sl))
         val = jnp.where(mine[:, None], val, 0.0)
         val = lax.psum(val, "sp")
-        logits = (val @ lm_head).astype(jnp.float32)
+        logits = qmatmul(val, lm_head).astype(jnp.float32)
         return logits, ks, vs
 
     def decode_body(blocks, embed, final_norm, lm_head, token, pos, plen,
@@ -304,18 +325,13 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         x, (tk_new, tv_new) = lax.scan(
             layer, x, (blocks, ctx_k, ctx_v, tail_k, tail_v))
         x = rms_norm(x, final_norm, config.rms_norm_eps)
-        logits = (x[:, -1] @ lm_head).astype(jnp.float32)
+        logits = qmatmul(x[:, -1], lm_head).astype(jnp.float32)
         return logits, tk_new, tv_new
 
     ctx_spec = P(None, None, "sp", tp_axis, None)
     tail_spec = P(None, None, None, tp_axis, None) if tp else P()
     rep = P()
-    from cake_tpu.models.llama.params import block_param_keys, block_specs
-    if tp:
-        blocks_spec = block_specs(block_param_keys(config),
-                                  stage_axis=None, tp_axis="tp")
-    else:
-        blocks_spec = {kk: P() for kk in block_param_keys(config)}
+    blocks_spec = sp_block_specs(config, tp, params)
 
     prefill_sm = jax.shard_map(
         prefill_body, mesh=mesh,
@@ -408,12 +424,17 @@ def place_sp_params(mesh: Mesh, config: LlamaConfig, params,
     drift from the in_specs."""
     if not tp:
         return params
-    from cake_tpu.models.llama.params import block_param_keys, block_specs
-    bspecs = block_specs(block_param_keys(config), stage_axis=None,
-                         tp_axis="tp")
+    from cake_tpu.ops.quant import QTensor
+    bspecs = sp_block_specs(config, tp, params)
+
+    def put(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
     out = dict(params)
     out["blocks"] = {
-        k: jax.device_put(v, NamedSharding(mesh, bspecs[k]))
+        k: (QTensor(q=put(v.q, bspecs[k].q), scale=put(v.scale,
+                                                       bspecs[k].scale))
+            if isinstance(v, QTensor) else put(v, bspecs[k]))
         for k, v in params["blocks"].items()}
     return out
 
@@ -445,7 +466,8 @@ class SPGeneratorForward:
     """
 
     def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
-                 tail_len: int, kv_dtype=None, tp: bool = False):
+                 tail_len: int, kv_dtype=None, tp: bool = False,
+                 params=None):
         if ctx_len % mesh.shape["sp"] != 0:
             raise ValueError(
                 f"sp context window {ctx_len} must divide over sp="
@@ -461,7 +483,8 @@ class SPGeneratorForward:
         # cache (generator skips its fresh() copy accordingly)
         self.allocates_cache = True
         self._prefill, self._decode = make_sp_forward(
-            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype, tp=tp)
+            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype, tp=tp,
+            params=params)
 
     def __call__(self, params, tokens, cache, pos, rope,
                  last_idx=None, is_prefill: bool = False):

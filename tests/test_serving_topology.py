@@ -397,3 +397,24 @@ def test_sp_generate_uses_on_device_scan(monkeypatch):
     out = gen.generate_on_device(prompt, plen, 6)
     assert out.shape == (1, 6)
     assert calls == {"fwd": 1, "scan": 1}, calls
+
+
+def test_sp_tp_int8_matches_dense_int8():
+    """--quant int8 composes with the sp x tp mesh: QTensor (q, scale)
+    specs expand on the sp shard_map and output equals the dense int8
+    single-device path."""
+    from cake_tpu.ops.quant import QTensor
+
+    args_sp = _mk_args(sp=4, tp=2, max_seq_len=64, sample_len=8,
+                       quant="int8")
+    gen_sp = _ctx(args_sp).load_text_model()
+    assert isinstance(gen_sp.params["blocks"]["wq"], QTensor)
+    ctx_len = gen_sp._forward_fn.ctx_len
+
+    gen_dense = _ctx(_mk_args(max_seq_len=64, quant="int8")
+                     ).load_text_model()
+    prompt = np.full((1, ctx_len), 7, np.int32)
+    plen = np.full((1,), ctx_len, np.int32)
+    a = gen_dense.generate_on_device(prompt, plen, 6)
+    b = gen_sp.generate_on_device(prompt, plen, 6)
+    np.testing.assert_array_equal(a, b)
