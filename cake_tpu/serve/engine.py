@@ -247,6 +247,15 @@ class InferenceEngine:
                 "provide no scan variant", decode_scan_steps)
             decode_scan_steps = 1
         self._decode_scan = decode_scan_steps
+        # prefix caching capability: builtin dense path, or a pipelined
+        # path with a chunked-prefill variant (the suffix windows at
+        # pos0 = P through it). Ring caches own their layout (install
+        # writes dense positions) and multi-host serving would need the
+        # registration replayed (attach_control re-checks) — both refuse.
+        self._prefix_capable = (
+            not self.ring
+            and (self._prefill_slot is prefill_slot
+                 or self._prefill_chunk_step is not None))
         # prefill_chunk: admit prompts longer than C in fixed C-token
         # windows (one compiled program for every prompt length; bounded
         # activation memory). Same divisibility contract as the
@@ -374,6 +383,10 @@ class InferenceEngine:
                 "multi-host control requires pipelined step fns (a mesh "
                 "spanning processes); the single-device engine has no "
                 "cross-process computation to coordinate")
+        if self._prefixes:
+            raise ValueError(
+                "multi-host control cannot be attached after prefix "
+                "registrations (registrations are not replayed)")
         self._control = control
         self._multihost = True
 
@@ -509,14 +522,16 @@ class InferenceEngine:
         proportionally. Returns a prefix id (for unregister_prefix).
 
         HBM cost per prefix: L*P*KV*hd*2 entries in cache dtype (an
-        8B-model 1k-token prefix is ~130 MiB at bf16). Only available on
-        the built-in single-device step path.
+        8B-model 1k-token prefix is ~130 MiB at bf16; stage-sharded on a
+        pipelined engine). Unavailable on ring (sliding-window) caches
+        and multi-host serving (see _prefix_capable).
         """
-        if self._prefill_slot is not prefill_slot or self.ring:
+        if not self._prefix_capable or self._multihost:
             raise ValueError(
-                "prefix caching is only supported on the single-device "
-                "dense-cache engine path (custom/pipelined step fns and "
-                "the ring sliding-window cache own their cache layout)")
+                "prefix caching is unavailable here: ring sliding-window "
+                "caches own their layout, custom step fns without a "
+                "chunked-prefill variant cannot window the suffix, and "
+                "multi-host serving does not replay prefix registrations")
         ids = list(prefix_ids)
         if not ids:
             raise ValueError("empty prefix")
@@ -527,13 +542,23 @@ class InferenceEngine:
         P = len(ids)
         bucket = bucket_length(P, self.max_seq_len)
         padded = ids + [0] * (bucket - P)
-        tmp = KVCache.create(self.config, 1, bucket,
-                             dtype=self._cache_dtype)
-        from cake_tpu.models.llama.model import prefill
-        _, tmp = prefill(self.params,
-                         jnp.asarray([padded], jnp.int32),
-                         jnp.asarray([P], jnp.int32),
-                         tmp, self.rope, self.config)
+        if self._prefill_slot is prefill_slot:
+            tmp = KVCache.create(self.config, 1, bucket,
+                                 dtype=self._cache_dtype)
+            from cake_tpu.models.llama.model import prefill
+            _, tmp = prefill(self.params,
+                             jnp.asarray([padded], jnp.int32),
+                             jnp.asarray([P], jnp.int32),
+                             tmp, self.rope, self.config)
+        else:
+            # pipelined path: prefill slot 0 of a one-slot TEMP cache
+            # with the serving cache's sharding — the prefix k/v stay
+            # stage-sharded, matching the install target
+            tmp = self._sharded_like_cache(1, bucket)
+            _, tmp = self._prefill_slot(
+                self.params, jnp.asarray([padded], jnp.int32),
+                jnp.asarray([P], jnp.int32), jnp.int32(0), tmp,
+                self.rope, self.config)
         k = jax.lax.slice_in_dim(tmp.k, 0, P, axis=2)
         v = jax.lax.slice_in_dim(tmp.v, 0, P, axis=2)
         with self._rid_lock:
@@ -542,6 +567,16 @@ class InferenceEngine:
             self._prefixes[pid] = (ids, k, v)
         log.info("registered prefix %d: %d tokens", pid, P)
         return pid
+
+    def _sharded_like_cache(self, slots: int, length: int) -> KVCache:
+        """Zeroed [L, slots, length] cache with the serving cache's
+        sharding (stage/tp axes preserved, batch/seq unsharded dims
+        free to differ)."""
+        make = jax.jit(
+            lambda: KVCache.create(self.config, slots, length,
+                                   dtype=self._cache_dtype),
+            out_shardings=self._cache_shardings)
+        return make()
 
     def unregister_prefix(self, prefix_id: int) -> None:
         with self._rid_lock:
@@ -570,7 +605,7 @@ class InferenceEngine:
             hist.add_message(m)
         if (self._auto_prefix and messages
                 and messages[0].role.value == "system"
-                and self._prefill_slot is prefill_slot and not self.ring
+                and self._prefix_capable and not self._multihost
                 and hist.template == "llama3"):
             # the head builder below renders the llama3 system block;
             # other templates (mistral merges system into the first user
@@ -725,20 +760,25 @@ class InferenceEngine:
         ids = req.prompt_ids
         C = self.prefill_chunk
         hit = (self._match_prefix(ids)
-               if self._prefill_slot is prefill_slot and not self.ring
-               else None)
+               if self._prefix_capable and not self._multihost else None)
         chunk_suffix = False
         if hit is not None:
             p_ids, pk, pv = hit
             suffix = ids[len(p_ids):]
-            if C and len(suffix) > C:
-                # long suffix: install the prefix, then window the
-                # suffix — keeps --prefill-chunk's bounded-activation
-                # guarantee on exactly the long-prompt case it targets
-                n_win = -(-len(suffix) // C)
-                chunk_suffix = (len(p_ids) + n_win * C
-                                <= self.max_seq_len)
-                if not chunk_suffix:
+            # one clamp rule for both engines: windows (or the padded
+            # single-program bucket) must never clamp over the live
+            # prefix. The pipelined engine ALWAYS windows the suffix at
+            # pos0 = P (it has no single-program prefixed-prefill
+            # variant); the dense engine windows only when
+            # --prefill-chunk applies, else takes the single program.
+            pipelined = self._prefill_slot is not prefill_slot
+            if pipelined or (C and len(suffix) > C):
+                Cw = C or bucket_length(len(suffix), self.max_seq_len)
+                n_win = -(-len(suffix) // Cw)
+                if len(p_ids) + n_win * Cw <= self.max_seq_len:
+                    chunk_suffix = True
+                    C = Cw
+                else:
                     hit = None   # last window would clamp over the prefix
             else:
                 bucket = bucket_length(len(suffix), self.max_seq_len)
@@ -769,9 +809,10 @@ class InferenceEngine:
             # covers whole-prompt AND chunked prefill — _prefill_device
             # picks between them from (prefill_chunk, len) alone, the
             # same deterministic rule a multi-host follower applies to
-            # this published op. Prefix branches never occur with
-            # step_fns (register_prefix refuses them), so publication
-            # here covers every pipelined prefill.
+            # this published op. The prefix branches above are never
+            # taken under multihost (hits are gated off and
+            # attach_control refuses engines with registrations), so
+            # publication here covers every multihost prefill.
             n_top = self._n_top_for([slot])
             self._publish({
                 "op": "prefill", "ids": ids, "slot": slot,

@@ -183,3 +183,75 @@ def test_overrun_window_falls_back(tiny_config, params):
     assert engine.stats.prefix_hits == 0
     cold = _collect(_engine(tiny_config, params), [prompt], n=4)
     assert got == cold
+
+def _topology_engine(tmp_path, decode_scan=1, prefill_chunk=None):
+    from cake_tpu.args import Args
+    from cake_tpu.context import Context
+    from cake_tpu.master import Master
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text(
+        "s0:\n  layers:\n    - model.layers.0-1\n"
+        "s1:\n  layers:\n    - model.layers.2-3\n"
+    )
+    args = Args(model="", topology=str(topo), tp=2, max_seq_len=128,
+                temperature=0.0, repeat_penalty=1.0,
+                decode_scan=decode_scan, prefill_chunk=prefill_chunk,
+                flash_attention=False).validate()
+    master = Master(args,
+                    text_generator=Context.from_args(args).load_text_model())
+    return master.make_engine(max_slots=2)
+
+
+def test_pipelined_prefix_hit_matches_cold(tmp_path):
+    """Prefix caching over the pipelined (topology+tp) engine: the
+    stage-sharded prefix KV installs + the suffix windows at pos0=P,
+    reproducing the cold-prefill stream exactly."""
+    prompts = [PREFIX + s for s in SUFFIXES]
+    cold = _collect(_topology_engine(tmp_path), prompts)
+
+    warm = _topology_engine(tmp_path)
+    pid = warm.register_prefix(PREFIX)
+    assert pid >= 1
+    # prefix k/v actually stage-sharded (not a device-0 copy)
+    _ids, pk, _pv = warm._prefixes[pid]
+    assert pk.sharding.spec[0] == "stage"
+    got = _collect(warm, prompts)
+    assert got == cold
+    assert warm.stats.prefix_hits == len(prompts)
+
+
+def test_pipelined_prefix_with_chunked_suffix(tmp_path):
+    """--prefill-chunk + prefix over the pipeline: long suffixes window
+    through the pipelined chunk fn behind the installed prefix."""
+    long_suffix = list(range(40, 40 + 40))   # > chunk of 16
+    prompts = [PREFIX + long_suffix]
+    cold = _collect(_topology_engine(tmp_path, prefill_chunk=16), prompts)
+
+    warm = _topology_engine(tmp_path, prefill_chunk=16)
+    warm.register_prefix(PREFIX)
+    got = _collect(warm, prompts)
+    assert got == cold
+    assert warm.stats.prefix_hits == 1
+
+
+def test_pipelined_prefix_overrun_falls_back(tmp_path):
+    """A suffix whose windows would clamp over the installed prefix must
+    drop the hit and whole-prompt-prefill instead (pipelined analog of
+    the dense overrun fallback)."""
+    eng = _topology_engine(tmp_path)
+    # prefix + suffix whose windowed footprint exceeds max_seq_len=128:
+    # suffix 90 -> one 128-bucket window; 32 + 128 > 128
+    eng.register_prefix(PREFIX)
+    long_prompt = PREFIX + list(range(40, 40 + 90))
+    with eng:
+        h = eng.submit(long_prompt, max_new_tokens=4)
+        assert h.wait(timeout=300)
+    assert eng.stats.prefix_hits == 0        # hit dropped, not clamped
+
+    # oracle: cold engine, same prompt
+    cold = _topology_engine(tmp_path)
+    with cold:
+        hc = cold.submit(long_prompt, max_new_tokens=4)
+        assert hc.wait(timeout=300)
+    assert h._req.out_tokens == hc._req.out_tokens
