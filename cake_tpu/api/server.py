@@ -152,29 +152,29 @@ class ApiServer:
         import queue as _queue
         deltas: _queue.Queue = _queue.Queue()
 
-        def stream(delta: str, final: bool):
-            deltas.put((delta, final))
+        def stream(delta: str, final: bool, n_done: int = 0):
+            deltas.put((delta, final, n_done))
 
+        # wants_count: the engine snapshots the finalized-entry count on
+        # the engine thread at emit time, so each chunk's logprob entries
+        # pair exactly with the delta carrying their text (a held-back
+        # UTF-8 tail token's entry ships with the later chunk that
+        # contains its text, never ahead of it)
+        stream.wants_count = True
         try:
             h = self.engine.chat(messages, stream=stream, **kw)
         except QueueFullError:
             raise QueueFull()
         if on_start is not None:
             on_start()
-        # streaming logprobs: each chunk carries the per-token entries
-        # finalized since the previous chunk (OpenAI stream+logprobs
-        # shape). _emit appends to the request's lists BEFORE queueing
-        # the delta, so reading up to len(out_tokens) here can only
-        # over-deliver into an earlier chunk, never drop an entry.
         lp_cursor = 0
         eos_ids = self.engine.config.eos_token_ids
 
-        def chunk_lp():
+        def chunk_lp(upto):
             nonlocal lp_cursor
             if not want_lp:
                 return None
             r = h._req
-            upto = len(r.out_tokens)
             entries = [
                 lp_entry(r.out_tokens[i], r.out_logprobs[i], r.out_top[i])
                 for i in range(lp_cursor, upto)
@@ -185,7 +185,7 @@ class ApiServer:
 
         while True:
             try:
-                delta, final = deltas.get(timeout=0.5)
+                delta, final, n_done = deltas.get(timeout=0.5)
             except _queue.Empty:
                 if h._req.done.is_set() and deltas.empty():
                     break  # request ended without a final delta (error path)
@@ -193,7 +193,8 @@ class ApiServer:
             if delta:
                 try:
                     send_chunk(chunk_response(delta, self.model_name,
-                                              rid=rid, logprobs=chunk_lp()))
+                                              rid=rid,
+                                              logprobs=chunk_lp(n_done)))
                 except OSError:
                     # client disconnected mid-stream: free the slot now
                     # instead of decoding to max_tokens for nobody
@@ -204,12 +205,14 @@ class ApiServer:
                 break
         h.text()  # raises if the engine failed the request
         try:
-            # the finish chunk flushes entries finalized with an empty
-            # final delta (held-back UTF-8 tail), keeping the one-entry-
-            # per-token contract
+            # the finish chunk flushes entries finalized after the last
+            # text-bearing delta (e.g. an EOS-terminated request whose
+            # final delta was empty), keeping the one-entry-per-token
+            # contract; the request is done, so the full lists are stable
             send_chunk(chunk_response("", self.model_name,
                                       finish="stop", rid=rid,
-                                      logprobs=chunk_lp()))
+                                      logprobs=chunk_lp(
+                                          len(h._req.out_tokens))))
         except OSError:
             return DISCONNECTED  # request already complete; just stop
         return None

@@ -88,10 +88,13 @@ def _serve_multihost(master, args) -> int:
 
         def teardown():
             # ordering matters: stop (publishes the stop op) -> wait for
-            # followers to disconnect from BOTH the control channel and
-            # the jax.distributed service -> only then take the leader
-            # service down. Killing the leader while a follower is still
-            # connected aborts the follower from its heartbeat thread.
+            # control-socket EOF (the follower's signal that it is about
+            # to enter jax.distributed.shutdown()) -> enter our own
+            # shutdown. The coordination service's shutdown BARRIER then
+            # holds the leader service up until every follower has
+            # finished disconnecting — so the leader can never die while
+            # a follower is mid-disconnect (which would abort it from
+            # its heartbeat thread).
             if done.is_set():
                 return
             done.set()
@@ -144,8 +147,17 @@ def _serve_multihost(master, args) -> int:
         finally:
             if beat is not None:
                 beat.close()
-            # close first: the coordinator is blocked in wait_closed()
-            # keeping the leader service alive for our clean disconnect
+            # socket EOF first, THEN jax.distributed.shutdown() — this
+            # order is load-bearing both ways: (a) the coordination
+            # service has a shutdown BARRIER (a follower's shutdown()
+            # blocks until the leader also enters shutdown), so closing
+            # the socket after shutdown would mutual-wait with the
+            # coordinator's wait_closed() and stall every clean exit;
+            # (b) the same barrier is what keeps the leader service
+            # alive until we are fully disconnected — EOF merely tells
+            # the coordinator to enter the barrier, which then completes
+            # only once we do too, so the leader can never die while we
+            # are mid-disconnect
             client.close()
             _distributed_shutdown()
     return 0

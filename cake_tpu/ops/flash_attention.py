@@ -229,6 +229,10 @@ def flash_attention(q, k, v, *, scale: float | None = None,
     """
     B, S, H, hd = q.shape
     _, T, KV, _ = k.shape
+    if window is not None and not causal:
+        raise ValueError(
+            "window requires causal=True: the non-causal kernel applies "
+            "no window mask, so the window would be silently ignored")
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
     block_q = min(block_q, S)

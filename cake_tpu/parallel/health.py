@@ -316,9 +316,12 @@ class Watchdog:
     """Fires on_stall when a monotonically-advancing counter stops moving.
 
     counter: zero-arg callable (e.g. `lambda: engine.stats.steps`).
-    Armed only while the counter has advanced at least once since start /
-    the last stall (an idle engine with an empty queue is not a stall:
-    pass `active` to gate, e.g. `lambda: engine.active > 0`).
+    A stall is `active()` holding true for stall_after_s with no counter
+    advance — including before the counter's FIRST advance, so a request
+    that hangs before producing any token (wedged compile, dead tunnel)
+    still fires. While `active()` is false the deadline keeps refreshing:
+    an idle engine with an empty queue is never a stall, and a later
+    request always gets the full window.
     """
 
     def __init__(self, counter: Callable[[], int], stall_after_s: float,
@@ -338,17 +341,22 @@ class Watchdog:
     def _run(self, poll: float) -> None:
         last_value = self._counter()
         last_change = time.monotonic()
-        armed = False  # arm on the first advance: a never-started counter
-        fired = False  # (idle engine) is not a stall
+        fired = False
         while not self._stop.wait(poll):
             cur = self._counter()
             now = time.monotonic()
             if cur != last_value:
                 last_value, last_change, fired = cur, now, False
-                armed = True
                 continue
-            if (armed and not fired and self._active()
-                    and now - last_change > self._stall_after):
+            if not self._active():
+                # an idle interval ends the stall episode: refresh the
+                # deadline AND clear the fired latch so the next request
+                # gets both the full window and a fresh detection (the
+                # latch only suppresses re-firing within one episode)
+                last_change = now
+                fired = False
+                continue
+            if not fired and now - last_change > self._stall_after:
                 fired = True
                 log.warning("watchdog: no progress for %.1fs",
                             now - last_change)

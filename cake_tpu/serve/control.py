@@ -88,8 +88,14 @@ class ControlServer:
         self.token = token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(max(n_followers, 1))
+        try:
+            self._sock.bind((host, port))
+            self._sock.listen(max(n_followers, 1))
+        except OSError:
+            # callers retry with a different host on bind failure; the
+            # half-constructed socket must not leak its fd
+            self._sock.close()
+            raise
         self._accept_timeout = accept_timeout
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()

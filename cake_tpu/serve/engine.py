@@ -61,7 +61,15 @@ class _Request:
     temperature: float
     top_p: float
     repeat_penalty: float
-    stream: Optional[Callable[[str, bool], None]]
+    # (delta, is_final) — or (delta, is_final, n_done) when the callback
+    # declares wants_count (see stream_wants_count below)
+    stream: Optional[Callable[..., None]]
+    # stream callback declared `wants_count = True`: it is called with a
+    # third argument, the number of finalized (token, logprob, top) entries
+    # up to and including this delta — snapshotted on the engine thread so
+    # streamed logprob entries pair exactly with the delta carrying their
+    # text (api/server.py streaming logprobs)
+    stream_wants_count: bool = False
     # previously-generated tokens whose penalty state must be reconstructed
     # (checkpoint resume): seeds the slot's repeat-penalty ring
     prime_tokens: List[int] = field(default_factory=list)
@@ -471,13 +479,16 @@ class InferenceEngine:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         repeat_penalty: Optional[float] = None,
-        stream: Optional[Callable[[str, bool], None]] = None,
+        stream: Optional[Callable[..., None]] = None,
         prime_penalty_tokens: Optional[Sequence[int]] = None,
         want_top_logprobs: bool = False,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
-        the engine thread as tokens finalize; the handle's wait()/text()
-        gives the blocking interface."""
+        the engine thread as tokens finalize; a callback with attribute
+        `wants_count = True` instead gets (text_delta, is_final, n_done)
+        where n_done counts the finalized logprob entries up to and
+        including this delta. The handle's wait()/text() gives the
+        blocking interface."""
         if self._stop.is_set():
             # post-stop submits (e.g. an HTTP handler racing shutdown) must
             # not mutate state under a checkpoint snapshot
@@ -502,7 +513,9 @@ class InferenceEngine:
             top_p=eff_top_p if eff_top_p is not None else 1.0,
             repeat_penalty=(d.repeat_penalty if repeat_penalty is None
                             else repeat_penalty),
-            stream=stream, submit_t=time.perf_counter(),
+            stream=stream,
+            stream_wants_count=bool(getattr(stream, "wants_count", False)),
+            submit_t=time.perf_counter(),
             prime_tokens=list(prime_penalty_tokens or ()),
             want_top=want_top_logprobs,
         )
@@ -1120,7 +1133,10 @@ class InferenceEngine:
             delta = self._incremental_text(req, final=finished)
             if delta or finished:
                 try:
-                    req.stream(delta, finished)
+                    if req.stream_wants_count:
+                        req.stream(delta, finished, len(req.out_tokens))
+                    else:
+                        req.stream(delta, finished)
                 except Exception:  # noqa: BLE001
                     log.exception("stream callback failed rid=%d", req.rid)
         if finished:

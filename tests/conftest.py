@@ -32,6 +32,13 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second cases (2-process serving, big meshes); "
+        "deselect with -m 'not slow' for the fast lane")
+
+
 @pytest.fixture(scope="session")
 def tiny_config():
     from cake_tpu.models.llama.config import LlamaConfig

@@ -108,6 +108,10 @@ def test_streaming_logprobs(server_url):
             text.append(c["delta"]["content"])
         if c.get("logprobs"):
             entries.extend(c["logprobs"]["content"])
+            # entries never LEAD their text: everything delivered so far
+            # must already be contained in the deltas so far (round-4
+            # advisor finding — strict clients pair per-chunk)
+            assert "".join(e["token"] for e in entries) == "".join(text)
     assert entries, "no logprobs content in any chunk"
     assert "".join(e["token"] for e in entries) == "".join(text)
     for e in entries:

@@ -179,15 +179,18 @@ def test_watchdog_fires_on_stall_and_rearms():
     from cake_tpu.parallel.health import Watchdog
 
     value = [0]
+    active = [False]
     stalls = []
     wd = Watchdog(lambda: value[0], stall_after_s=0.3,
                   on_stall=lambda: stalls.append(time.monotonic()),
+                  active=lambda: active[0],
                   poll_interval_s=0.05)
     try:
-        # never-advanced counter (idle) -> not armed, no stall
+        # idle (no active work), never-advanced counter -> no stall
         time.sleep(0.6)
         assert stalls == []
         # progress -> no stall
+        active[0] = True
         for _ in range(5):
             value[0] += 1
             time.sleep(0.05)
@@ -197,6 +200,37 @@ def test_watchdog_fires_on_stall_and_rearms():
         assert len(stalls) == 1
         # progress resumes, then stalls again -> re-arms
         value[0] += 1
+        time.sleep(0.8)
+        assert len(stalls) == 2
+    finally:
+        wd.close()
+
+
+def test_watchdog_fires_before_first_token():
+    """A request that hangs before the counter EVER advances (wedged
+    compile, dead tunnel — the exact failure the watchdog exists for)
+    must still fire: the stall clock starts when active() flips on, not
+    at the first counter advance (round-4 advisor finding)."""
+    from cake_tpu.parallel.health import Watchdog
+
+    value = [0]
+    active = [False]
+    stalls = []
+    wd = Watchdog(lambda: value[0], stall_after_s=0.3,
+                  on_stall=lambda: stalls.append(time.monotonic()),
+                  active=lambda: active[0], poll_interval_s=0.05)
+    try:
+        time.sleep(0.5)   # idle: the deadline keeps refreshing
+        assert stalls == []
+        active[0] = True  # request admitted; first token never comes
+        time.sleep(0.8)
+        assert len(stalls) == 1
+        # the idle interval between requests ends the stall episode: a
+        # SECOND request that also wedges pre-first-token (counter still
+        # never advanced) must fire again, not be eaten by the latch
+        active[0] = False
+        time.sleep(0.3)
+        active[0] = True
         time.sleep(0.8)
         assert len(stalls) == 2
     finally:

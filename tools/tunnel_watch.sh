@@ -1,0 +1,29 @@
+#!/bin/bash
+# Tunnel watcher: probe the TPU backend every 5 minutes; the moment it
+# answers, run the full bench suite and save the output. Exits after a
+# successful bench run (or keeps probing forever until killed).
+#
+# Output: /root/repo/BENCH_WATCH.log (probe history)
+#         /root/repo/BENCH_WATCH_RESULT.txt (bench stdout when tunnel was up)
+cd /root/repo
+LOG=BENCH_WATCH.log
+echo "watcher start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if timeout 150 python -c "import jax; d=jax.devices(); assert d; print(d)" >> "$LOG" 2>&1; then
+    echo "TUNNEL UP $(date -u +%FT%TZ) — running bench" >> "$LOG"
+    timeout 5400 python bench.py > BENCH_WATCH_RESULT.txt 2> BENCH_WATCH_RESULT.err
+    rc=$?
+    echo "bench rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+    if [ $rc -eq 0 ] && grep -q '"value"' BENCH_WATCH_RESULT.txt && ! grep -q '"error"' BENCH_WATCH_RESULT.txt; then
+      echo "BENCH SUCCESS $(date -u +%FT%TZ)" >> "$LOG"
+      exit 0
+    fi
+    # tunnel answered the probe but bench failed/partial — keep looping,
+    # a later attempt may do better (partial results are preserved with
+    # a timestamp suffix so a failed retry can't clobber them)
+    cp BENCH_WATCH_RESULT.txt "BENCH_WATCH_RESULT.$(date -u +%H%M%S).txt" 2>/dev/null
+  else
+    echo "probe fail $(date -u +%FT%TZ)" >> "$LOG"
+  fi
+  sleep 300
+done
