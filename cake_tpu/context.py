@@ -103,7 +103,7 @@ class Context:
         # eager full-tree load.
         stream_sharded = (
             (plan.stages > 1 or plan.tp > 1 or plan.dp > 1)
-            and a.sp <= 1 and has_weights(a.model)
+            and (a.sp <= 1 or plan.stages > 1) and has_weights(a.model)
         )
         if stream_sharded:
             params = None   # loaded inside the topology branch, post-mesh
@@ -129,18 +129,37 @@ class Context:
         kwargs = {}
         if a.sp > 1:
             # sequence/context parallelism: ring-attention prefill +
-            # merged-stats decode over an ("sp",) or ("sp","tp") mesh —
-            # the long-context serving mode (prompt sharded over chips,
-            # optionally with Megatron head sharding within each shard)
-            if plan.stages > 1 or plan.dp > 1:
+            # merged-stats decode over an ("sp",) / ("sp","tp") /
+            # ("stage","sp"[,"tp"]) mesh — the long-context serving mode
+            # (prompt sharded over chips, optionally with Megatron head
+            # sharding within each shard and/or layer ranges over stages
+            # for models too big for one chip's HBM)
+            if plan.dp > 1:
                 raise ValueError(
-                    "--sp does not compose with --dp/topology stages "
-                    "in this release; combine with --tp or run sp alone")
+                    "--sp does not compose with --dp in this release; "
+                    "combine with --tp and/or topology stages")
             if plan.tp > 1 and a.quant == "int4":
-                raise ValueError(
-                    "--sp with --tp supports --quant int8 only: int4's "
-                    "group-wise scales need not divide over tp (use int8 "
-                    "or drop --tp)")
+                # int4 group-wise weights CAN shard their contract dim
+                # over tp (wo/w_down are contract-sharded Megatron-style)
+                # as long as every tp shard holds whole groups — the
+                # packed nibble layout and the per-group scales are then
+                # self-contained per shard (ops/quant.expand_spec already
+                # gives the scale's group dim the q spec). Misaligned
+                # dims would split a group across devices, so reject
+                # exactly those.
+                from cake_tpu.ops.quant import pick_group
+                for name, dim in (
+                        ("wo", cfg.num_attention_heads * cfg.head_dim),
+                        ("w_down", cfg.intermediate_size)):
+                    g = pick_group(dim)
+                    if (dim // g) % plan.tp:
+                        raise ValueError(
+                            f"--sp with --tp {plan.tp} and --quant int4: "
+                            f"{name}'s contract dim {dim} has {dim // g} "
+                            f"groups of {g}, not divisible over tp — a "
+                            "tp shard would split a quantization group. "
+                            "Use int8, drop --tp, or pick a tp that "
+                            "divides the group count")
             if cfg.sliding_window is not None:
                 raise ValueError(
                     "--sp (ring attention) does not implement "
@@ -152,14 +171,20 @@ class Context:
             from cake_tpu.parallel.context_parallel import SPGeneratorForward
             devices = jax.devices()
             tp = plan.tp
-            if a.sp * tp > len(devices):
+            stages = plan.stages
+            need = stages * a.sp * tp
+            if need > len(devices):
                 raise ValueError(
-                    f"--sp {a.sp} x --tp {tp} needs {a.sp * tp} devices, "
-                    f"have {len(devices)}")
+                    f"stages {stages} x --sp {a.sp} x --tp {tp} needs "
+                    f"{need} devices, have {len(devices)}")
             if tp > 1 and cfg.num_key_value_heads % tp != 0:
                 raise ValueError(
                     f"--tp {tp} must divide kv heads "
                     f"{cfg.num_key_value_heads}")
+            if stages > 1 and cfg.num_hidden_layers % stages != 0:
+                raise ValueError(
+                    f"topology stages {stages} must divide layer count "
+                    f"{cfg.num_hidden_layers}")
             # split the window: context (sharded) + decode tail (replicated);
             # the tail MUST hold sample_len generated tokens — a too-small
             # tail would clamp cache writes over live entries
@@ -170,7 +195,21 @@ class Context:
                     f"--max-seq-len {max_seq} leaves no context window for "
                     f"sp={a.sp} after a {tail}-token decode tail; raise "
                     "--max-seq-len or lower --sample-len")
-            if tp > 1:
+            if stages > 1:
+                axes = (["stage", "sp"] + (["tp"] if tp > 1 else []))
+                mesh = Mesh(
+                    np.array(devices[:need]).reshape(
+                        *(stages, a.sp) + ((tp,) if tp > 1 else ())),
+                    tuple(axes))
+                from cake_tpu.parallel.sp_pipeline import (
+                    place_sp_stage_params,
+                )
+                if params is None:   # streaming stage-local load
+                    params = self._load_params_streamed(cfg, mesh, tp > 1)
+                    params = self._maybe_quantize(params)
+                params = place_sp_stage_params(mesh, cfg, params,
+                                               tp=tp > 1)
+            elif tp > 1:
                 mesh = Mesh(np.array(devices[:a.sp * tp]).reshape(a.sp, tp),
                             ("sp", "tp"))
                 # place the block params on their tp shards up front so
@@ -183,7 +222,7 @@ class Context:
                 mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
             fwd = SPGeneratorForward(
                 mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype,
-                tp=tp > 1, params=params)
+                tp=tp > 1, params=params, stages=stages)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths
@@ -192,8 +231,10 @@ class Context:
             kwargs = dict(forward_fn=fwd,
                           cache=KVCache.create(cfg, a.batch_size, 1,
                                                dtype=kv_dtype))
-            log.info("sp serving: ring prefill over sp=%d, ctx=%d tail=%d",
-                     a.sp, ctx_len, max_seq - ctx_len)
+            log.info("sp serving: ring prefill over sp=%d%s, ctx=%d "
+                     "tail=%d", a.sp,
+                     f" x stages={stages}" if stages > 1 else "",
+                     ctx_len, max_seq - ctx_len)
         elif plan.stages > 1 or plan.tp > 1 or plan.dp > 1:
             from cake_tpu.parallel.pipeline import (
                 make_pipeline_forward, place_for_pipeline,
@@ -284,20 +325,29 @@ class Context:
                                    dtype=self.dtype)
 
     def _maybe_quantize(self, params):
-        """Apply --quant to a param tree (donating: frees each
-        full-precision buffer as its quantized copy materialises, so an 8B
-        model quantizes without 1.5x peak HBM)."""
+        """Apply --quant to a param tree without 1.5x peak HBM: int8
+        donates the tree (fp buffers free as quantized copies
+        materialise); int4 quantizes leaf-at-a-time (packed outputs can't
+        alias donated buffers, so donation would warn and hold fp leaves
+        to computation end)."""
         a = self.args
         if a.quant not in ("int8", "int4"):
             return params
         from functools import partial
 
-        from cake_tpu.ops.quant import quantize_params
-        bits = 8 if a.quant == "int8" else 4
-        params = jax.jit(partial(quantize_params, bits=bits),
-                         donate_argnums=0)(params)
+        from cake_tpu.ops.quant import (
+            quantize_params, quantize_params_leafwise,
+        )
+        if a.quant == "int8":
+            params = jax.jit(partial(quantize_params, bits=8),
+                             donate_argnums=0)(params)
+        else:
+            # int4 outputs (packed uint8 + group scales) can never alias
+            # a donated fp buffer; the leafwise path frees fp leaves
+            # incrementally without unusable-donation warnings
+            params = quantize_params_leafwise(params, bits=4)
         log.info("weights quantized to %s (weight-only, %s)", a.quant,
-                 "per-channel" if bits == 8 else "group-wise")
+                 "per-channel" if a.quant == "int8" else "group-wise")
         return params
 
     def _load_speculative(self, cfg, params, tokenizer, sampling, max_seq,

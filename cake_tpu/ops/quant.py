@@ -264,3 +264,51 @@ def quantize_params(params: dict, bits: int = 8, group: int = 128) -> dict:
     # already streams it at roofline
     out["lm_head"] = quantize(params["lm_head"], (0,))
     return out
+
+
+def quantize_params_leafwise(params: dict, bits: int = 4,
+                             group: int = 128) -> dict:
+    """quantize_params, one jitted call per leaf, dropping each
+    full-precision leaf as its quantized copy lands.
+
+    Use when whole-tree buffer donation cannot alias (int4: every output
+    is half-width packed uint8 + group scales, so `jit(..., donate)` on
+    the tree warns "donated buffers were not usable" for the leaves and
+    frees them only at computation end). Leaf-at-a-time gives the
+    peak-HBM bound full fp tree + one quantized leaf, warning-free.
+
+    CONSUMES the input: full-precision leaves are popped from the
+    caller's `params["blocks"]` dict itself as their quantized copies
+    land — popping a private copy would keep every fp leaf referenced
+    through the caller's tree until return, silently losing the bound
+    this function exists for.
+    """
+    import jax as _jax
+
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if bits == 4 and any(k.startswith("we_") for k in params["blocks"]):
+        raise NotImplementedError(
+            "int4 is matmul-only; MoE expert weights go through "
+            "qeinsum — use --quant int8 for MoE models")
+    src = params["blocks"]   # shared: pops drop the caller's refs too
+    blocks = {}
+    for k in list(src):
+        if k not in _BLOCK_CONTRACT:
+            blocks[k] = src[k]
+            continue
+        w = src.pop(k)
+        if bits == 4:
+            blocks[k] = _jax.jit(
+                lambda v, d=_BLOCK_CONTRACT[k][0]: quantize_group(
+                    v, d, group))(w)
+        else:
+            blocks[k] = _jax.jit(
+                lambda v, d=_BLOCK_CONTRACT[k]: quantize(v, d))(w)
+        del w
+    out = dict(params)
+    out["blocks"] = blocks
+    lm = params.pop("lm_head")
+    out["lm_head"] = _jax.jit(lambda v: quantize(v, (0,)))(lm)
+    del lm
+    return out

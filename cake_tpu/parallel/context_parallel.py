@@ -183,6 +183,120 @@ def sp_merged_attention(q, ctx_k, ctx_v, tail_k, tail_v, ctx_valid,
         q.dtype)
 
 
+# -- shared per-layer bodies --------------------------------------------------
+# Single source for the sp layer step, decode masks, and K-step decode
+# scan: make_sp_forward (("sp",)/("sp","tp") meshes) and
+# sp_pipeline.make_sp_stage_forward (("stage","sp"[,"tp"])) both build
+# from these, so a fix to one path cannot silently miss the other.
+
+
+def sp_prefill_layer(config: LlamaConfig, rope_c, rope_s, kv_dtype,
+                     tp_axis):
+    """lax.scan layer fn for ring-attention prefill: h, lp -> h, (k, v).
+    Runs under shard_map with an "sp" axis in scope."""
+    def layer(h, lp):
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            out = ring_attention(q, k, v, "sp", causal=True)
+            # cast to the storage dtype HERE so the scan stacks the
+            # cache directly at fp8 width — casting after the scan
+            # would hold full-precision and fp8 copies concurrently,
+            # raising peak HBM instead of halving it
+            if kv_dtype is not None:
+                k = k.astype(kv_dtype)
+                v = v.astype(kv_dtype)
+            return out, (k, v)
+        return block_skeleton(lp, h, config, attn_fn, tp_axis=tp_axis)
+    return layer
+
+
+def sp_decode_layer(config: LlamaConfig, rope_c, rope_s, t_slot,
+                    ctx_valid, tail_valid, tp_axis):
+    """lax.scan layer fn for merged-stats decode:
+    h, (lp, ck, cv, tk, tv) -> h, (tk', tv')."""
+    def layer(h, xs):
+        lp, ck, cv, tk, tv = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            tk2 = lax.dynamic_update_slice_in_dim(
+                tk, k.astype(tk.dtype), t_slot, axis=1)
+            tv2 = lax.dynamic_update_slice_in_dim(
+                tv, v.astype(tv.dtype), t_slot, axis=1)
+            out = sp_merged_attention(q, ck, cv, tk2, tv2,
+                                      ctx_valid, tail_valid, "sp")
+            return out, (tk2, tv2)
+
+        return block_skeleton(lp, h, config, attn_fn, tp_axis=tp_axis)
+    return layer
+
+
+def sp_decode_masks(idx, Sl: int, plen, tail_T: int, t_slot, B: int):
+    """(ctx_valid, tail_valid) for one decode step: context slots below
+    each row's prompt length (global slot ids from this device's sp
+    index), tail slots up to and including the one being written."""
+    slot_g = idx * Sl + jnp.arange(Sl)
+    ctx_valid = (slot_g[None] < plen[:, None])[:, None, None, None, :]
+    tail_valid = (jnp.arange(tail_T)[None] <= t_slot)
+    tail_valid = jnp.broadcast_to(
+        tail_valid, (B, tail_T))[:, None, None, None, :]
+    return ctx_valid, tail_valid
+
+
+def sp_select_last(x, plen, idx, Sl: int, lm_head):
+    """Select the hidden state at plen-1 (it lives on ONE sp shard),
+    psum it to every shard, and project: [B, Sl, D] -> logits [B, V]."""
+    B = x.shape[0]
+    last = (plen - 1).astype(jnp.int32)
+    local = jnp.clip(last - idx * Sl, 0, Sl - 1)
+    val = jnp.take_along_axis(x, local.reshape(B, 1, 1), axis=1)[:, 0]
+    mine = (last >= idx * Sl) & (last < (idx + 1) * Sl)
+    val = lax.psum(jnp.where(mine[:, None], val, 0.0), "sp")
+    return qmatmul(val, lm_head).astype(jnp.float32)
+
+
+def make_sp_decode_scan(decode_sm, ctx_len: int):
+    """K decode+sample steps as ONE compiled program — the long-context
+    analog of the engine's decode scan: host/tunnel dispatch amortizes
+    across num_steps tokens instead of paying a round-trip per token
+    (the dominant cost of sp serving at small batch). Sampling (incl.
+    the repeat-penalty ring) runs inside the scan with the same ops the
+    host loop uses. Shared by the plain-sp and stage x sp factories."""
+    @partial(jax.jit, static_argnames=("num_steps", "sampling"),
+             donate_argnames=("cache",))
+    def sp_decode_scan(params, token, pos0, plen, cache: SPCache,
+                       rope: RopeTables, rng, ring, num_steps: int,
+                       sampling):
+        from cake_tpu.ops.sampling import sample_tokens, update_ring
+
+        def body(carry, step):
+            tok, pos, tk, tv, ring, rng = carry
+            logits, tk, tv = decode_sm(
+                params["blocks"], params["embed"], params["final_norm"],
+                params["lm_head"], tok, pos, plen,
+                cache.ctx_k, cache.ctx_v, tk, tv, rope.cos, rope.sin)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_tokens(sub, logits, ring, sampling)
+            ring = update_ring(ring, nxt, step)
+            return (nxt[:, None], pos + 1, tk, tv, ring, rng), nxt
+
+        # ring steps continue from the input token's step index (the
+        # pos0 operand encodes it: k0 = pos0 - ctx_len), so a mid-session
+        # continuation writes the same penalty-ring slots the host loop
+        # would
+        k0 = pos0 - ctx_len
+        (tok, pos, tk, tv, ring, rng), toks = lax.scan(
+            body,
+            (token, pos0, cache.tail_k, cache.tail_v, ring, rng),
+            k0 + jnp.arange(1, num_steps + 1))
+        return (jnp.transpose(toks, (1, 0)),
+                SPCache(cache.ctx_k, cache.ctx_v, tk, tv), ring, rng)
+
+    return sp_decode_scan
+
+
 # -- whole-model sequence-parallel forward -----------------------------------
 
 
@@ -251,40 +365,14 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
     def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
                      cos, sin):
         idx = lax.axis_index("sp")
-        B = tokens.shape[0]
         x = jnp.take(embed, tokens, axis=0)                 # [B, Sl, D]
         rope_c = lax.dynamic_slice_in_dim(cos, idx * Sl, Sl, axis=0)
         rope_s = lax.dynamic_slice_in_dim(sin, idx * Sl, Sl, axis=0)
-
-        def layer(h, lp):
-            def attn_fn(q, k, v):
-                q = apply_rope(q, rope_c, rope_s)
-                k = apply_rope(k, rope_c, rope_s)
-                out = ring_attention(q, k, v, "sp", causal=True)
-                # cast to the storage dtype HERE so the scan stacks the
-                # cache directly at fp8 width — casting after the scan
-                # would hold full-precision and fp8 copies concurrently,
-                # raising peak HBM instead of halving it
-                if kv_dtype is not None:
-                    k = k.astype(kv_dtype)
-                    v = v.astype(kv_dtype)
-                return out, (k, v)
-            h, (k, v) = block_skeleton(lp, h, config, attn_fn,
-                                       tp_axis=tp_axis)
-            return h, (k, v)
-
+        layer = sp_prefill_layer(config, rope_c, rope_s, kv_dtype,
+                                 tp_axis)
         x, (ks, vs) = lax.scan(layer, x, blocks)
         x = rms_norm(x, final_norm, config.rms_norm_eps)
-
-        # select the hidden state at plen-1 (it lives on one sp shard)
-        last = (plen - 1).astype(jnp.int32)                 # [B] global idx
-        local = jnp.clip(last - idx * Sl, 0, Sl - 1)
-        val = jnp.take_along_axis(
-            x, local.reshape(B, 1, 1), axis=1)[:, 0]        # [B, D]
-        mine = ((last >= idx * Sl) & (last < (idx + 1) * Sl))
-        val = jnp.where(mine[:, None], val, 0.0)
-        val = lax.psum(val, "sp")
-        logits = qmatmul(val, lm_head).astype(jnp.float32)
+        logits = sp_select_last(x, plen, idx, Sl, lm_head)
         return logits, ks, vs
 
     def decode_body(blocks, embed, final_norm, lm_head, token, pos, plen,
@@ -295,33 +383,10 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         rope_c = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
         rope_s = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
         t_slot = pos - ctx_len                               # tail write slot
-
-        # validity masks (shared across layers)
-        slot_g = idx * Sl + jnp.arange(Sl)                   # global ctx slots
-        ctx_valid = (slot_g[None] < plen[:, None])           # [B, Tl]
-        ctx_valid = ctx_valid[:, None, None, None, :]        # [B,1,1,1,Tl]
-        tail_valid = (jnp.arange(tail_k.shape[2])[None] <= t_slot)
-        tail_valid = jnp.broadcast_to(
-            tail_valid, (B, tail_k.shape[2]))[:, None, None, None, :]
-
-        def layer(h, xs):
-            lp, ck, cv, tk, tv = xs
-
-            def attn_fn(q, k, v):
-                q = apply_rope(q, rope_c, rope_s)
-                k = apply_rope(k, rope_c, rope_s)
-                tk2 = lax.dynamic_update_slice_in_dim(
-                    tk, k.astype(tk.dtype), t_slot, axis=1)
-                tv2 = lax.dynamic_update_slice_in_dim(
-                    tv, v.astype(tv.dtype), t_slot, axis=1)
-                out = sp_merged_attention(q, ck, cv, tk2, tv2,
-                                          ctx_valid, tail_valid, "sp")
-                return out, (tk2, tv2)
-
-            h, (tk2, tv2) = block_skeleton(lp, h, config, attn_fn,
-                                           tp_axis=tp_axis)
-            return h, (tk2, tv2)
-
+        ctx_valid, tail_valid = sp_decode_masks(
+            idx, Sl, plen, tail_k.shape[2], t_slot, B)
+        layer = sp_decode_layer(config, rope_c, rope_s, t_slot,
+                                ctx_valid, tail_valid, tp_axis)
         x, (tk_new, tv_new) = lax.scan(
             layer, x, (blocks, ctx_k, ctx_v, tail_k, tail_v))
         x = rms_norm(x, final_norm, config.rms_norm_eps)
@@ -376,43 +441,7 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
             rope.cos, rope.sin)
         return logits, SPCache(cache.ctx_k, cache.ctx_v, tk, tv)
 
-    @partial(jax.jit, static_argnames=("num_steps", "sampling"),
-             donate_argnames=("cache",))
-    def sp_decode_scan(params, token, pos0, plen, cache: SPCache,
-                       rope: RopeTables, rng, ring, num_steps: int,
-                       sampling):
-        """num_steps decode+sample steps as ONE compiled program — the
-        long-context analog of the engine's decode scan: host/tunnel
-        dispatch amortizes across num_steps tokens instead of paying a
-        round-trip per token (the dominant cost of sp serving at small
-        batch). Sampling (incl. the repeat-penalty ring) runs inside the
-        scan with the same ops the host loop uses."""
-        from cake_tpu.ops.sampling import sample_tokens, update_ring
-
-        def body(carry, step):
-            tok, pos, tk, tv, ring, rng = carry
-            logits, tk, tv = decode_sm(
-                params["blocks"], params["embed"], params["final_norm"],
-                params["lm_head"], tok, pos, plen,
-                cache.ctx_k, cache.ctx_v, tk, tv, rope.cos, rope.sin)
-            rng, sub = jax.random.split(rng)
-            nxt = sample_tokens(sub, logits, ring, sampling)
-            ring = update_ring(ring, nxt, step)
-            return (nxt[:, None], pos + 1, tk, tv, ring, rng), nxt
-
-        # ring steps continue from the input token's step index (the
-        # pos0 operand encodes it: k0 = pos0 - ctx_len), so a mid-session
-        # continuation writes the same penalty-ring slots the host loop
-        # would
-        k0 = pos0 - ctx_len
-        (tok, pos, tk, tv, ring, rng), toks = lax.scan(
-            body,
-            (token, pos0, cache.tail_k, cache.tail_v, ring, rng),
-            k0 + jnp.arange(1, num_steps + 1))
-        return (jnp.transpose(toks, (1, 0)),
-                SPCache(cache.ctx_k, cache.ctx_v, tk, tv), ring, rng)
-
-    sp_prefill.decode_scan = sp_decode_scan
+    sp_prefill.decode_scan = make_sp_decode_scan(decode_sm, ctx_len)
     return sp_prefill, sp_decode
 
 
@@ -467,7 +496,7 @@ class SPGeneratorForward:
 
     def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
                  tail_len: int, kv_dtype=None, tp: bool = False,
-                 params=None):
+                 params=None, stages: int = 1):
         if ctx_len % mesh.shape["sp"] != 0:
             raise ValueError(
                 f"sp context window {ctx_len} must divide over sp="
@@ -482,7 +511,15 @@ class SPGeneratorForward:
         # the prefill allocates its own SPCache and ignores the passed-in
         # cache (generator skips its fresh() copy accordingly)
         self.allocates_cache = True
-        self._prefill, self._decode = make_sp_forward(
+        if stages > 1:
+            # sp x pipeline-stage composition: layers sharded over "stage",
+            # sequence over "sp" (parallel/sp_pipeline) — same call
+            # contract, so everything below is factory-agnostic
+            from cake_tpu.parallel.sp_pipeline import make_sp_stage_forward
+            factory = make_sp_stage_forward
+        else:
+            factory = make_sp_forward
+        self._prefill, self._decode = factory(
             mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype, tp=tp,
             params=params)
 

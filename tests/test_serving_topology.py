@@ -137,10 +137,15 @@ def test_engine_int8_over_topology(topo_path):
     assert len(toks) == 4
 
 
+@pytest.mark.filterwarnings(
+    "error:Some donated buffers were not usable")
 def test_engine_int4_over_topology(topo_path):
     """--quant int4 (packed group-wise) composes with a 2-stage topology:
     the packed q and group scales place with matching specs and the
-    pipelined forward decodes."""
+    pipelined forward decodes. Strict on donation: neither the leafwise
+    quantize nor the pipelined decode may fall back to silent copies
+    (round-4 verdict #3 — an unusable donated cache would copy the KV
+    every step on exactly the path int4 exists to slim down)."""
     gen = _ctx(_mk_args(topology=topo_path, quant="int4")).load_text_model()
     from cake_tpu.ops.quant import QTensor, is_groupwise
     wq = gen.params["blocks"]["wq"]
