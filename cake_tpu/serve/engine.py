@@ -338,6 +338,10 @@ class InferenceEngine:
 
         self._next_rid = 1
         self._rid_lock = threading.Lock()
+        # engine-thread command queue (multi-host prefix ops: their
+        # device work is a cross-process collective, so it must dispatch
+        # in the engine thread's program order — see _run_on_engine_thread)
+        self._cmd_q: list = []
         self._requests = {}
         # rids whose callers gave up (client disconnect): drained by the
         # ENGINE thread at the top of its loop, so request/slot teardown
@@ -450,6 +454,21 @@ class InferenceEngine:
                     toks, _lps, _ti, _tl = self._decode_scan_device(
                         op["rows"], op["n"], op["n_top"])
                     self._finalize_scan_mirrors(op["rows"], op["n"], toks)
+                elif kind == "register_prefix":
+                    ids = list(op["ids"])
+                    P = len(ids)
+                    k, v = self._prefix_kv_device(
+                        ids, P, bucket_length(P, self.max_seq_len))
+                    with self._rid_lock:
+                        self._prefixes[op["pid"]] = (ids, k, v)
+                elif kind == "unregister_prefix":
+                    with self._rid_lock:
+                        self._prefixes.pop(op["pid"], None)
+                elif kind == "prefill_prefixed":
+                    self._prefixed_prefill_device(
+                        op["pid"], op["ids"], op["slot"], op["temp"],
+                        op["top_p"], op["penalty"], op.get("prime", ()),
+                        n_top=op.get("n_top", 0))
                 elif kind == "reset":
                     self._reset_after_error()
                 else:
@@ -537,14 +556,21 @@ class InferenceEngine:
         HBM cost per prefix: L*P*KV*hd*2 entries in cache dtype (an
         8B-model 1k-token prefix is ~130 MiB at bf16; stage-sharded on a
         pipelined engine). Unavailable on ring (sliding-window) caches
-        and multi-host serving (see _prefix_capable).
+        (see _prefix_capable). Multi-host: the coordinator publishes a
+        register_prefix op and every follower computes the same prefix KV
+        (the registration prefill is itself a cross-process collective,
+        so it runs on the engine thread — wire position == dispatch
+        position); followers reject direct registrations.
         """
-        if not self._prefix_capable or self._multihost:
+        if self._multihost and self._control is None:
+            raise ValueError(
+                "followers mirror the coordinator's prefix registry; "
+                "register prefixes on the coordinator process")
+        if not self._prefix_capable:
             raise ValueError(
                 "prefix caching is unavailable here: ring sliding-window "
-                "caches own their layout, custom step fns without a "
-                "chunked-prefill variant cannot window the suffix, and "
-                "multi-host serving does not replay prefix registrations")
+                "caches own their layout, and custom step fns without a "
+                "chunked-prefill variant cannot window the suffix")
         ids = list(prefix_ids)
         if not ids:
             raise ValueError("empty prefix")
@@ -552,8 +578,30 @@ class InferenceEngine:
             raise ValueError(
                 f"prefix length {len(ids)} leaves no room for a suffix "
                 f"(max_seq_len {self.max_seq_len})")
+        if self._control is not None:
+            return self._run_on_engine_thread(
+                lambda: self._register_prefix_sync(ids))
+        return self._register_prefix_sync(ids)
+
+    def _register_prefix_sync(self, ids: List[int]) -> int:
+        """Allocate a pid, publish (multi-host), compute the prefix KV on
+        device, store. Coordinator-side; followers mirror via the
+        register_prefix op handler."""
         P = len(ids)
         bucket = bucket_length(P, self.max_seq_len)
+        with self._rid_lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+        self._publish({"op": "register_prefix", "ids": ids, "pid": pid})
+        k, v = self._prefix_kv_device(ids, P, bucket)
+        with self._rid_lock:
+            self._prefixes[pid] = (ids, k, v)
+        log.info("registered prefix %d: %d tokens", pid, P)
+        return pid
+
+    def _prefix_kv_device(self, ids: List[int], P: int, bucket: int):
+        """Device computation of a prefix's KV (identical on every
+        process: a multi-host follower replays this as one collective)."""
         padded = ids + [0] * (bucket - P)
         if self._prefill_slot is prefill_slot:
             tmp = KVCache.create(self.config, 1, bucket,
@@ -574,12 +622,50 @@ class InferenceEngine:
                 self.rope, self.config)
         k = jax.lax.slice_in_dim(tmp.k, 0, P, axis=2)
         v = jax.lax.slice_in_dim(tmp.v, 0, P, axis=2)
+        return k, v
+
+    def _run_on_engine_thread(self, fn, timeout: float = 300.0):
+        """Execute fn on the engine thread between iterations and return
+        its result. Multi-host prefix ops MUST run there: they dispatch
+        cross-process collectives, and only the engine thread's program
+        order matches the control channel's op order (a handler-thread
+        dispatch could interleave with a step op differently on the
+        coordinator than on a follower, wedging the mesh)."""
+        if self._thread is None or not self._thread.is_alive():
+            raise RuntimeError(
+                "engine not running: multi-host prefix operations "
+                "execute on the engine thread (start() first)")
+        box: dict = {}
+        ev = threading.Event()
         with self._rid_lock:
-            pid = self._next_prefix_id
-            self._next_prefix_id += 1
-            self._prefixes[pid] = (ids, k, v)
-        log.info("registered prefix %d: %d tokens", pid, P)
-        return pid
+            self._cmd_q.append((fn, box, ev))
+        self._wake.set()
+        if not ev.wait(timeout):
+            raise TimeoutError("engine thread did not run the command "
+                               f"within {timeout:.0f}s")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _drain_commands(self) -> None:
+        with self._rid_lock:
+            cmds, self._cmd_q = self._cmd_q, []
+        for fn, box, ev in cmds:
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                ev.set()
+
+    def _fail_pending_commands(self) -> None:
+        """Engine exit: release command waiters instead of letting them
+        time out against a dead thread."""
+        with self._rid_lock:
+            cmds, self._cmd_q = self._cmd_q, []
+        for _fn, box, ev in cmds:
+            box["error"] = RuntimeError("engine stopped")
+            ev.set()
 
     def _sharded_like_cache(self, slots: int, length: int) -> KVCache:
         """Zeroed [L, slots, length] cache with the serving cache's
@@ -592,19 +678,32 @@ class InferenceEngine:
         return make()
 
     def unregister_prefix(self, prefix_id: int) -> None:
+        if (self._control is not None and self._thread is not None
+                and self._thread.is_alive()):
+            # engine-thread ordering guarantees no later prefill_prefixed
+            # op on the wire references the dropped pid (matching happens
+            # on the same thread, after this pop)
+            def job():
+                self._publish({"op": "unregister_prefix",
+                               "pid": prefix_id})
+                with self._rid_lock:
+                    self._prefixes.pop(prefix_id, None)
+            self._run_on_engine_thread(job)
+            return
         with self._rid_lock:
             self._prefixes.pop(prefix_id, None)
 
     def _match_prefix(self, ids: List[int]):
-        """Longest registered prefix that is a proper head of `ids`."""
+        """Longest registered prefix that is a proper head of `ids`:
+        (pid, p_ids, k, v) or None."""
         best = None
         with self._rid_lock:
-            entries = list(self._prefixes.values())
-        for p_ids, k, v in entries:
+            entries = list(self._prefixes.items())
+        for pid, (p_ids, k, v) in entries:
             P = len(p_ids)
             if P < len(ids) and ids[:P] == p_ids:
-                if best is None or P > len(best[0]):
-                    best = (p_ids, k, v)
+                if best is None or P > len(best[1]):
+                    best = (pid, p_ids, k, v)
         return best
 
     def chat(self, messages: Sequence[Message], **kw) -> RequestHandle:
@@ -618,7 +717,8 @@ class InferenceEngine:
             hist.add_message(m)
         if (self._auto_prefix and messages
                 and messages[0].role.value == "system"
-                and self._prefix_capable and not self._multihost
+                and self._prefix_capable
+                and (not self._multihost or self._control is not None)
                 and hist.template == "llama3"):
             # the head builder below renders the llama3 system block;
             # other templates (mistral merges system into the first user
@@ -629,6 +729,7 @@ class InferenceEngine:
     def _auto_register_system(self, system_msg: Message) -> None:
         from cake_tpu.models.chat import BEGIN_OF_TEXT
         head = BEGIN_OF_TEXT + History.encode_message(system_msg)
+        evict = None
         with self._rid_lock:
             if head in self._auto_pids:
                 return
@@ -638,11 +739,21 @@ class InferenceEngine:
                 for k, pid in list(self._auto_pids.items()):
                     if pid is not None:
                         del self._auto_pids[k]
-                        self._prefixes.pop(pid, None)
+                        evict = pid
                         break
                 else:
                     return    # registry full of in-flight reservations
             self._auto_pids[head] = None   # reserve before the prefill
+        if evict is not None and evict >= 0:
+            # through unregister_prefix, OUTSIDE the lock: under
+            # multi-host it publishes the eviction to followers (a direct
+            # pop would leak the prefix KV in every follower's mirrored
+            # registry) and routes through the engine thread, which may
+            # itself need _rid_lock
+            try:
+                self.unregister_prefix(evict)
+            except Exception:  # noqa: BLE001
+                log.exception("auto-prefix eviction failed")
         try:
             ids = encode_text(self.tokenizer, head)
             if len(ids) < 8 or len(ids) >= self.max_seq_len - 1:
@@ -707,12 +818,15 @@ class InferenceEngine:
         finally:
             # cancellations enqueued in the stop window must still tear
             # down (an undrained handle would block wait() forever and be
-            # replayed as live by a checkpoint snapshot)
+            # replayed as live by a checkpoint snapshot); command waiters
+            # get an error instead of a timeout
             self._drain_cancellations()
+            self._fail_pending_commands()
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
             self._drain_cancellations()
+            self._drain_commands()
             prefill_plan, decode_plan = self.scheduler.plan()
             if not prefill_plan and not decode_plan:
                 self._wake.wait(timeout=0.05)
@@ -771,62 +885,31 @@ class InferenceEngine:
         req.slot = slot
         self._slot_req[slot] = req
         ids = req.prompt_ids
-        C = self.prefill_chunk
-        hit = (self._match_prefix(ids)
-               if self._prefix_capable and not self._multihost else None)
-        chunk_suffix = False
+        hit = (self._match_and_validate_prefix(ids)
+               if self._prefix_capable else None)
+        n_top = self._n_top_for([slot])
         if hit is not None:
-            p_ids, pk, pv = hit
-            suffix = ids[len(p_ids):]
-            # one clamp rule for both engines: windows (or the padded
-            # single-program bucket) must never clamp over the live
-            # prefix. The pipelined engine ALWAYS windows the suffix at
-            # pos0 = P (it has no single-program prefixed-prefill
-            # variant); the dense engine windows only when
-            # --prefill-chunk applies, else takes the single program.
-            pipelined = self._prefill_slot is not prefill_slot
-            if pipelined or (C and len(suffix) > C):
-                Cw = C or bucket_length(len(suffix), self.max_seq_len)
-                n_win = -(-len(suffix) // Cw)
-                if len(p_ids) + n_win * Cw <= self.max_seq_len:
-                    chunk_suffix = True
-                    C = Cw
-                else:
-                    hit = None   # last window would clamp over the prefix
-            else:
-                bucket = bucket_length(len(suffix), self.max_seq_len)
-                if len(p_ids) + bucket > self.max_seq_len:
-                    # the padded window would clamp over the live prefix
-                    # (dynamic_update_slice clamps out-of-range starts) —
-                    # fall back to a whole-prompt prefill
-                    hit = None
-        if hit is not None:
-            if chunk_suffix:
-                from cake_tpu.models.llama.model import install_prefix_slot
-                self.cache = install_prefix_slot(self.cache, pk, pv,
-                                                 jnp.int32(slot))
-                logits = self._prefill_chunked(suffix, slot, C,
-                                               pos0=len(p_ids))
-            else:
-                padded = suffix + [0] * (bucket - len(suffix))
-                logits, self.cache = prefill_slot_prefixed(
-                    self.params, jnp.asarray([padded], jnp.int32),
-                    jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
-                    pk, pv, self.cache, self.rope, self.config,
-                )
+            hit_pid, entry = hit
+            # the follower resolves the pid in ITS registry (mirrored by
+            # register_prefix ops — wire ordering guarantees presence)
+            # and re-derives the window plan from shared config —
+            # identical dispatch on every process
+            self._publish({
+                "op": "prefill_prefixed", "pid": hit_pid, "ids": ids,
+                "slot": slot, "temp": req.temperature,
+                "top_p": req.top_p, "penalty": req.repeat_penalty,
+                "prime": list(req.prime_tokens), "n_top": n_top,
+            })
+            tok, lp, top = self._prefixed_prefill_device(
+                hit_pid, ids, slot, req.temperature, req.top_p,
+                req.repeat_penalty, req.prime_tokens, n_top=n_top,
+                entry=entry)
             self.stats.prefix_hits += 1
-            tok, lp, top = self._finish_prefill(
-                logits, slot, len(ids), req.temperature, req.top_p,
-                req.repeat_penalty, req.prime_tokens)
         else:
             # covers whole-prompt AND chunked prefill — _prefill_device
             # picks between them from (prefill_chunk, len) alone, the
             # same deterministic rule a multi-host follower applies to
-            # this published op. The prefix branches above are never
-            # taken under multihost (hits are gated off and
-            # attach_control refuses engines with registrations), so
-            # publication here covers every multihost prefill.
-            n_top = self._n_top_for([slot])
+            # this published op
             self._publish({
                 "op": "prefill", "ids": ids, "slot": slot,
                 "temp": req.temperature, "top_p": req.top_p,
@@ -838,6 +921,86 @@ class InferenceEngine:
                 req.repeat_penalty, req.prime_tokens, n_top=n_top)
         self.stats.prefill_time_s += time.perf_counter() - t0
         self._emit(req, tok, logprob=lp, top=top)
+
+    def _match_and_validate_prefix(self, ids: List[int]):
+        """(pid, (p_ids, k, v)) of the longest matching registered prefix
+        that can serve this prompt without clamping over live cache
+        entries, or None. Returns the ENTRY, not just the pid: a
+        concurrent eviction (handler-thread auto-prefix FIFO) must not
+        turn the engine thread's later lookup into a KeyError."""
+        hit = self._match_prefix(ids)
+        if hit is None:
+            return None
+        pid, p_ids, k, v = hit
+        plan = self._prefix_window_plan(p_ids, ids)
+        return (pid, (p_ids, k, v)) if plan is not None else None
+
+    def _prefix_window_plan(self, p_ids: List[int], ids: List[int]):
+        """(chunk_suffix, C_or_bucket) for a prefix-hit prefill, or None
+        when the suffix windows would clamp over the live prefix. Pure
+        function of (p_ids, ids, prefill_chunk, max_seq_len, engine
+        flavor) — the coordinator decides with it and a multi-host
+        follower re-derives the identical plan from the published op.
+
+        One clamp rule for both engines: windows (or the padded
+        single-program bucket) must never clamp over the live prefix.
+        The pipelined engine ALWAYS windows the suffix at pos0 = P (it
+        has no single-program prefixed-prefill variant); the dense
+        engine windows only when --prefill-chunk applies, else takes
+        the single program."""
+        C = self.prefill_chunk
+        suffix = ids[len(p_ids):]
+        pipelined = self._prefill_slot is not prefill_slot
+        if pipelined or (C and len(suffix) > C):
+            Cw = C or bucket_length(len(suffix), self.max_seq_len)
+            n_win = -(-len(suffix) // Cw)
+            if len(p_ids) + n_win * Cw <= self.max_seq_len:
+                return (True, Cw)
+            return None   # last window would clamp over the prefix
+        bucket = bucket_length(len(suffix), self.max_seq_len)
+        if len(p_ids) + bucket > self.max_seq_len:
+            # the padded window would clamp over the live prefix
+            # (dynamic_update_slice clamps out-of-range starts)
+            return None
+        return (False, bucket)
+
+    def _prefixed_prefill_device(self, pid: int, ids, slot: int,
+                                 temp: float, top_p: float, penalty: float,
+                                 prime, n_top: int = 0,
+                                 entry=None) -> tuple:
+        """Prefix-hit prefill: install the cached prefix KV, prefill only
+        the suffix, sample the first token. Runs identically on the
+        coordinator (which passes the matched `entry` so a concurrent
+        eviction cannot invalidate the pid between match and use) and,
+        via the prefill_prefixed op, every follower (which resolves the
+        pid in its mirrored registry — safe by wire ordering: evictions
+        arrive as unregister ops on this same thread)."""
+        ids = list(ids)
+        if entry is None:
+            with self._rid_lock:
+                entry = self._prefixes[pid]
+        p_ids, pk, pv = entry
+        plan = self._prefix_window_plan(p_ids, ids)
+        if plan is None:  # cannot happen for a published op; be loud
+            raise RuntimeError(
+                f"prefix {pid} no longer serves prompt of len {len(ids)}")
+        chunk_suffix, width = plan
+        suffix = ids[len(p_ids):]
+        if chunk_suffix:
+            from cake_tpu.models.llama.model import install_prefix_slot
+            self.cache = install_prefix_slot(self.cache, pk, pv,
+                                             jnp.int32(slot))
+            logits = self._prefill_chunked(suffix, slot, width,
+                                           pos0=len(p_ids))
+        else:
+            padded = suffix + [0] * (width - len(suffix))
+            logits, self.cache = prefill_slot_prefixed(
+                self.params, jnp.asarray([padded], jnp.int32),
+                jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
+                pk, pv, self.cache, self.rope, self.config,
+            )
+        return self._finish_prefill(logits, slot, len(ids), temp,
+                                    top_p, penalty, prime, n_top=n_top)
 
     def _prefill_raw(self, ids, slot: int):
         """Whole-prompt prefill device call (no sampling-state changes)."""

@@ -145,7 +145,7 @@ API_WORKER = textwrap.dedent("""
         "--max-seq-len", "256", "--temperature", "0.0",
         "--repeat-penalty", "1.0", "--no-flash-attention",
         "--max-slots", "2", "--api", api_addr, "--checkpoint", ckpt,
-        "--decode-scan", "4",
+        "--decode-scan", "4", "--auto-prefix",
     ]))
 """)
 
@@ -267,6 +267,26 @@ def test_multihost_api_serving(tmp_path, tiny_config):
                 delta = json.loads(payload)["choices"][0]["delta"]
                 pieces.append(delta.get("content", ""))
         assert "".join(pieces) == want, ("".join(pieces), want)
+
+        # prefix replay (round-5): with --auto-prefix the coordinator
+        # registered the system prompt's head as a prefix (replayed to
+        # the follower as a register_prefix op), so a SECOND conversation
+        # sharing the system prompt prefills only its own turns — and
+        # the replayed prefill_prefixed op keeps both processes'
+        # dispatch aligned (a mismatch would wedge the collective and
+        # time this request out)
+        body2 = {"messages": [MESSAGES[0],
+                              {"role": "user", "content": "Say more"}],
+                 "max_tokens": 8, "temperature": 0.0, "top_p": 1.0}
+        resp2 = _http_json("POST", base + "/api/v1/chat/completions",
+                           body2, timeout=300.0)
+        assert resp2["choices"][0]["message"]["content"]
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=10).read().decode()
+        hits = next(float(ln.rsplit(" ", 1)[1])
+                    for ln in metrics.splitlines()
+                    if ln.startswith("cake_engine_prefix_hits_total"))
+        assert hits > 0, "no prefix hit on the shared system prompt"
 
         # graceful shutdown: SIGTERM to the coordinator saves the
         # checkpoint, publishes the stop op (follower exits 0), then
