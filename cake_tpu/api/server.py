@@ -491,6 +491,14 @@ def start(master, address: str = "127.0.0.1:10128",
 
         from cake_tpu.serve import checkpoint as ckpt
 
+        # arm the pre-fail snapshot: a serving failure (heartbeat loss,
+        # engine error) checkpoints in-flight requests BEFORE failing
+        # them (engine._fail_all), so a cluster restart resumes them.
+        # The weight digest is computed NOW, while the mesh is healthy —
+        # at fail time the device stream may be wedged
+        engine.snapshot_path = checkpoint_path
+        ckpt.warm_fingerprint(engine)
+
         if os.path.exists(checkpoint_path):
             try:
                 # strict: a fingerprint mismatch (e.g. different weights
@@ -524,7 +532,22 @@ def start(master, address: str = "127.0.0.1:10128",
             # helper thread — called from the serve_forever thread (the
             # block=True signal path) it deadlocks.
             engine.stop()
-            ckpt.save(engine, checkpoint_path)
+            if (getattr(engine, "_prefail_written", False)
+                    and ckpt.has_resumable(checkpoint_path)):
+                # the standard operator flow after a fatal failure is
+                # SIGTERM-and-restart: THIS process's pre-fail snapshot
+                # is the authoritative failure-time state (serving was
+                # over — no new work was admitted after it was written),
+                # while the live registry is empty or mid-teardown; an
+                # unconditional save here would clobber the file and
+                # lose resumable generations. A checkpoint left by a
+                # PREVIOUS process and already consumed by this one's
+                # restore is NOT kept (prefail_written is false), so
+                # completed resumes don't replay forever.
+                log.info("keeping pre-fail snapshot at %s",
+                         checkpoint_path)
+            else:
+                ckpt.save(engine, checkpoint_path)
             threading.Thread(target=httpd.shutdown, daemon=True).start()
 
         try:

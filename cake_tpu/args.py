@@ -99,6 +99,10 @@ class Args:
     # progress for this many seconds with active requests; must exceed
     # the worst-case first-request compile time (parallel/health.py)
     stall_timeout: float = 600.0
+    # multi-host serving: fail when a follower's heartbeat lapses this
+    # many seconds (parallel/health.HeartbeatMonitor stale window) —
+    # pre-fail snapshot + 503s instead of a wedged collective
+    heartbeat_timeout: float = 15.0
     # --auto-prefix: the API engine KV-caches each distinct system
     # prompt's rendered head once (serve/engine.register_prefix), so
     # conversations sharing it prefill only their own turns

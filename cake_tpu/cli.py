@@ -76,7 +76,8 @@ def _serve_multihost(master, args) -> int:
                                stall_after_s=args.stall_timeout)
         hb_addr = health.expect_workers(
             [f"proc{i}" for i in range(1, jax.process_count())],
-            bind_host=bind_host)
+            bind_host=bind_host,
+            stale_after_s=args.heartbeat_timeout)
         hb_adv = f"{adv}:{hb_addr.rsplit(':', 1)[1]}"
         broadcast_control_address(
             f"{adv}:{control.port}|{token}|{hb_adv}")

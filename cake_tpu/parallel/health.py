@@ -300,7 +300,12 @@ class ServingHealth:
             self._failed_at_tokens = self.engine.stats.tokens_generated
         log.error("serving health: FAILED — %s", reason)
         try:
-            self.engine._fail_all(RuntimeError(f"serving failed: {reason}"))
+            # non-recoverable failures (dead host) are fatal: snapshot
+            # the in-flight requests for restart-and-resume before
+            # failing them. Recoverable stalls may clear — no snapshot.
+            self.engine._fail_all(
+                RuntimeError(f"serving failed: {reason}"),
+                snapshot=not recoverable)
         except Exception:  # noqa: BLE001
             log.exception("failing in-flight requests failed")
 

@@ -67,6 +67,19 @@ def _params_digest(params) -> str:
 
 
 def _fingerprint(engine) -> Dict:
+    # memoized on the engine: weights are immutable during serving, and
+    # the digest's D2H sample must NOT run on the failure path — with a
+    # dead host mid-mesh the local device stream can be wedged behind
+    # the failed collective, stalling exactly the pre-fail snapshot that
+    # exists to survive that failure. warm_fingerprint() computes it at
+    # startup while the mesh is healthy.
+    import copy
+    fp = getattr(engine, "_ckpt_fingerprint", None)
+    if fp is not None:
+        # deep copy: snapshots embed this dict, and a caller mutating a
+        # snapshot must not silently edit the cache (which would make a
+        # doctored fingerprint compare equal to itself)
+        return copy.deepcopy(fp)
     import dataclasses
     c = engine.config
     cfg = (dataclasses.asdict(c) if dataclasses.is_dataclass(c)
@@ -74,7 +87,7 @@ def _fingerprint(engine) -> Dict:
     # JSON round-trip normalisation (tuples -> lists) so a saved+loaded
     # fingerprint compares equal to a freshly computed one
     cfg = json.loads(json.dumps(cfg))
-    return {
+    fp = {
         "config": cfg,
         "max_seq_len": engine.max_seq_len,
         # ring width shapes penalty reconstruction; a mismatch silently
@@ -82,11 +95,45 @@ def _fingerprint(engine) -> Dict:
         "repeat_last_n": engine.defaults.repeat_last_n,
         "params": _params_digest(engine.params),
     }
+    engine._ckpt_fingerprint = fp
+    return copy.deepcopy(fp)
 
 
-def snapshot(engine) -> Dict:
-    """Capture engine request state. Call with the engine stopped (or at
-    least quiesced): the engine thread mutates request state per step."""
+def warm_fingerprint(engine) -> None:
+    """Compute and cache the engine fingerprint now, while the mesh is
+    healthy — so a later pre-fail snapshot needs no device work."""
+    _fingerprint(engine)
+
+
+def is_resumable(rec: Dict) -> bool:
+    """Whether a snapshot record represents an interrupted generation
+    that resume() would resubmit — THE resumability predicate, shared by
+    resume(), the pre-fail writer, and the shutdown keep-or-save rule so
+    they cannot diverge."""
+    return (not rec.get("finished") and not rec.get("error")
+            and rec.get("remaining", 0) > 0)
+
+
+def has_resumable(path: Optional[str]) -> bool:
+    """True when `path` holds a checkpoint with resumable records (the
+    shutdown save preserves such a file when it was written by the
+    pre-fail path — api/server.py save_and_exit)."""
+    if not path or not os.path.exists(path):
+        return False
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+        return any(is_resumable(r) for r in snap.get("requests", []))
+    except (OSError, ValueError):
+        return False
+
+
+def snapshot_requests(engine) -> List[Dict]:
+    """Capture the request records alone — pure Python, no device work,
+    so it is safe and fast even when the mesh is wedged. The engine
+    loop's fatal path captures these BEFORE _fail_all empties the
+    registry, then writes them after the clients are released
+    (engine._snapshot_before_fail(requests=...))."""
     requests: List[Dict] = []
     for rid, req in sorted(dict(engine._requests).items()):
         finished = req.done.is_set()
@@ -105,21 +152,37 @@ def snapshot(engine) -> Dict:
             "finished": finished,
             "error": str(req.error) if req.error else None,
         })
+    return requests
+
+
+def snapshot(engine, requests: Optional[List[Dict]] = None) -> Dict:
+    """Capture engine request state. Call with the engine stopped (or at
+    least quiesced): the engine thread mutates request state per step.
+    requests: pre-captured snapshot_requests() records (pre-fail path)."""
     return {
         "version": SNAPSHOT_VERSION,
         "engine": _fingerprint(engine),
-        "requests": requests,
+        "requests": (snapshot_requests(engine) if requests is None
+                     else requests),
     }
+
+
+def write(snap: Dict, path: str) -> None:
+    """Write a snapshot to `path` (atomic replace). The tmp name is
+    thread-unique: a pre-fail snapshot (health-monitor thread) and a
+    shutdown save can overlap in one process."""
+    import uuid
+    tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, path)
+    log.info("checkpoint: %d request(s) -> %s", len(snap["requests"]), path)
 
 
 def save(engine, path: str) -> Dict:
     """Snapshot the engine and write it to `path` (atomic replace)."""
     snap = snapshot(engine)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(snap, f)
-    os.replace(tmp, path)
-    log.info("checkpoint: %d request(s) -> %s", len(snap["requests"]), path)
+    write(snap, path)
     return snap
 
 
@@ -152,7 +215,7 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
         try:
             # field reads stay inside the try: one malformed record must
             # not abort the loop after earlier requests were resubmitted
-            if rec["finished"] or rec["remaining"] <= 0 or rec["error"]:
+            if not is_resumable(rec):
                 finished.append(rec)
                 continue
             handles.append(engine.submit(

@@ -843,14 +843,39 @@ class InferenceEngine:
                         self._do_decode(decode_plan)
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
+                # capture the request records FIRST (cheap, pure
+                # Python), fail the clients immediately (the reset
+                # publish below can block for minutes against a
+                # network-partitioned follower's full TCP buffer — the
+                # waiters must not wait behind it), and only if the
+                # publish proves the failure fatal write the captured
+                # records as the pre-fail snapshot. Transient
+                # reset-and-continue errors write nothing: a stale
+                # snapshot would resurrect long-errored requests after
+                # a later unclean exit.
+                recs = None
+                if getattr(self, "snapshot_path", None):
+                    from cake_tpu.serve import checkpoint
+                    recs = checkpoint.snapshot_requests(self)
+                    # stash for the heartbeat monitor: a dead follower
+                    # often looks transient HERE (the reset publish can
+                    # land in the dead peer's TCP buffer) and only the
+                    # heartbeat loss seconds later proves it fatal — by
+                    # then the registry is empty, so the monitor's
+                    # snapshot falls back to this capture
+                    self._fail_recs = (time.monotonic(), recs)
                 self._fail_all(e)
+                fatal = False
                 try:
                     self._publish({"op": "reset"})
                 except Exception:  # noqa: BLE001
                     # followers unreachable: the SPMD mesh is no longer
-                    # fully driven — stop serving instead of hanging the
-                    # next collective
+                    # fully driven — stop serving instead of hanging
+                    # the next collective
                     log.exception("control publish failed; stopping")
+                    fatal = True
+                if fatal:
+                    self._snapshot_before_fail(requests=recs)
                     self._stop.set()
                     return
                 self._reset_after_error()
@@ -1316,7 +1341,19 @@ class InferenceEngine:
             self.tokenizer, ids, req._pending_text, final=final)
         return new
 
-    def _fail_all(self, err: Exception) -> None:
+    def _fail_all(self, err: Exception, snapshot: bool = False) -> None:
+        # beat-the-reference failure handling (the reference is fail-stop
+        # with total state loss, client.rs:50-59): on a FATAL failure,
+        # snapshot the in-flight requests BEFORE failing them, so a
+        # restarted cluster resumes every interrupted generation
+        # token-exact (serve/checkpoint resume semantics) instead of
+        # losing them with the process. snapshot=True only from fatal
+        # paths (heartbeat loss, a failure the engine cannot reset from)
+        # — a transient reset-and-continue error must not leave a stale
+        # snapshot that resurrects long-errored requests after a later
+        # unclean exit.
+        if snapshot:
+            self._snapshot_before_fail()
         for rid, req in list(self._requests.items()):
             req.error = err
             self.scheduler.cancel(rid)
@@ -1324,6 +1361,50 @@ class InferenceEngine:
                 self._slot_req[req.slot] = None
             self._requests.pop(rid, None)
             req.done.set()
+
+    def _snapshot_before_fail(self, requests=None) -> None:
+        """Best-effort pre-fail checkpoint (no-op unless api.start armed
+        `snapshot_path`). Inline and device-free by construction: arming
+        pairs with checkpoint.warm_fingerprint, so the fingerprint is
+        memoized and the snapshot is pure Python plus one local write —
+        safe even with the mesh wedged on a dead host. The guard below
+        keeps it that way if the arming contract ever drifts.
+
+        requests: records captured with checkpoint.snapshot_requests
+        BEFORE the registry was emptied — the engine loop's fatal path
+        fails its clients first (fast) and writes the snapshot after,
+        from this capture. Sets `_prefail_written`, which the shutdown
+        save consults to avoid clobbering this file (api/server.py
+        save_and_exit)."""
+        path = getattr(self, "snapshot_path", None)
+        if not path:
+            return
+        if requests is None and not self._requests:
+            # fatal declared after the registry was already emptied by
+            # an engine-loop failure (the same event, seen twice): use
+            # that failure's capture if it is fresh — requests from an
+            # old, genuinely recovered error must not resurrect
+            stash = getattr(self, "_fail_recs", None)
+            if stash is not None and time.monotonic() - stash[0] < 60.0:
+                requests = stash[1]
+            else:
+                return
+        if getattr(self, "_ckpt_fingerprint", None) is None:
+            log.warning("pre-fail snapshot skipped: fingerprint was not "
+                        "warmed at arming time (would touch a possibly "
+                        "wedged device)")
+            return
+        try:
+            from cake_tpu.serve import checkpoint
+            snap = checkpoint.snapshot(self, requests=requests)
+            if not any(checkpoint.is_resumable(r)
+                       for r in snap["requests"]):
+                return   # nothing worth preserving
+            checkpoint.write(snap, path)
+            self._prefail_written = True
+            log.info("pre-fail snapshot saved to %s", path)
+        except Exception:  # noqa: BLE001
+            log.exception("pre-fail snapshot failed")
 
 
 class QueueFullError(Exception):

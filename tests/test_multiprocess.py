@@ -136,6 +136,7 @@ API_WORKER = textwrap.dedent("""
     jax.config.update("jax_default_matmul_precision", "highest")
 
     pid, port, topo, api_addr, ckpt, model = sys.argv[1:7]
+    extra = sys.argv[7:]
     os.environ["CAKE_COORDINATOR"] = f"127.0.0.1:{port}"
     os.environ["CAKE_NUM_PROCESSES"] = "2"
     os.environ["CAKE_PROCESS_ID"] = pid
@@ -146,7 +147,7 @@ API_WORKER = textwrap.dedent("""
         "--repeat-penalty", "1.0", "--no-flash-attention",
         "--max-slots", "2", "--api", api_addr, "--checkpoint", ckpt,
         "--decode-scan", "4", "--auto-prefix",
-    ]))
+    ] + extra))
 """)
 
 MESSAGES = [
@@ -155,9 +156,11 @@ MESSAGES = [
 ]
 
 
-def _oracle_chat_text(tiny_config, model_dir) -> str:
+def _oracle_chat(tiny_config, model_dir, max_new_tokens=8,
+                 max_seq_len=256):
     """Single-process engine result for MESSAGES — what the multi-host
-    deployment must reproduce token for token."""
+    deployment must reproduce token for token. Returns
+    (text, prompt_ids, out_tokens)."""
     from cake_tpu.models.chat import Message
     from cake_tpu.models.llama.generator import ByteTokenizer
     from cake_tpu.ops.sampling import SamplingConfig
@@ -168,13 +171,19 @@ def _oracle_chat_text(tiny_config, model_dir) -> str:
     params = load_text_params(tiny_config, model_dir, resolve_dtype("bf16"))
     eng = InferenceEngine(
         tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
-        max_slots=2, max_seq_len=256,
+        max_slots=2, max_seq_len=max_seq_len,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0))
     with eng:
         h = eng.chat([Message.from_json(m) for m in MESSAGES],
-                     max_new_tokens=8, temperature=0.0, top_p=1.0)
-        assert h.wait(timeout=120)
-        return h.text()
+                     max_new_tokens=max_new_tokens, temperature=0.0,
+                     top_p=1.0)
+        assert h.wait(timeout=300)
+        return (h.text(), list(h._req.prompt_ids),
+                list(h._req.out_tokens))
+
+
+def _oracle_chat_text(tiny_config, model_dir) -> str:
+    return _oracle_chat(tiny_config, model_dir)[0]
 
 
 def _http_json(method: str, url: str, body=None, timeout=10.0):
@@ -288,7 +297,7 @@ def test_multihost_api_serving(tmp_path, tiny_config):
                     if ln.startswith("cake_engine_prefix_hits_total"))
         assert hits > 0, "no prefix hit on the shared system prompt"
 
-        # graceful shutdown: SIGTERM to the coordinator saves the
+        # graceful shutdown (happy path): SIGTERM to the coordinator saves the
         # checkpoint, publishes the stop op (follower exits 0), then
         # chains the default handler (so the coordinator dies by SIGTERM,
         # rc -15 — api/server.py's documented chaining behavior)
@@ -303,3 +312,188 @@ def test_multihost_api_serving(tmp_path, tiny_config):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+
+
+@pytest.mark.slow
+def test_multihost_failover_snapshot_and_resume(tmp_path, tiny_config):
+    """Beat-the-reference failure handling (the reference is fail-stop
+    with total state loss, client.rs:50-59): kill a follower mid-stream,
+    assert the coordinator snapshots the interrupted request BEFORE
+    failing it (engine._snapshot_before_fail), then restart the cluster
+    and assert the request resumes and completes TOKEN-EXACT vs the
+    uninterrupted single-process oracle."""
+    import signal
+    import time
+    import urllib.request
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text(TOPOLOGY)
+    from test_stream_load import write_tiny_hf_checkpoint
+    model_dir = write_tiny_hf_checkpoint(tmp_path / "model", tiny_config)
+    # long request at per-token dispatch (decode-scan 1) so the follower
+    # kill lands mid-generation with plenty of transcript left, not in a
+    # race with completion
+    N = 200
+    _, want_prompt, want_out = _oracle_chat(tiny_config, model_dir,
+                                            max_new_tokens=N,
+                                            max_seq_len=512)
+    assert len(want_out) == N  # long deterministic transcript, no early EOS
+
+    ckpt = str(tmp_path / "ckpt.msgpack")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    launch_n = [0]
+
+    def launch(extra):
+        port, api_port = _free_port(), _free_port()
+        api_addr = f"127.0.0.1:{api_port}"
+        launch_n[0] += 1
+        ps = [subprocess.Popen(
+            [sys.executable, "-c", API_WORKER, str(i), str(port),
+             str(topo), api_addr, ckpt, model_dir] + extra,
+            stdout=open(tmp_path / f"leg{launch_n[0]}_p{i}.log", "w"),
+            stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+            for i in range(2)]
+        return ps, f"http://{api_addr}"
+
+    def log_tail(i, n=3000):
+        p = tmp_path / f"leg{launch_n[0]}_p{i}.log"
+        return p.read_text()[-n:] if p.exists() else "<no log>"
+
+    def wait_up(ps, base, deadline_s=300):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in ps):
+                raise AssertionError(
+                    f"worker died during startup:\n{log_tail(0)}\n"
+                    f"---\n{log_tail(1)}")
+            try:
+                if _http_json("GET", base + "/api/v1/health",
+                              timeout=2.0)["status"] == "ok":
+                    return
+            except OSError:
+                time.sleep(0.5)
+        raise AssertionError("API never came up")
+
+    procs, base = launch(["--heartbeat-timeout", "3",
+                          "--decode-scan", "1", "--max-seq-len", "512"])
+    try:
+        wait_up(procs, base)
+        # leg 1: stream, kill the follower after the first content chunks
+        body = {"messages": MESSAGES, "max_tokens": N,
+                "temperature": 0.0, "top_p": 1.0, "stream": True}
+        req = urllib.request.Request(
+            base + "/api/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        killed = False
+        try:
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                chunks = 0
+                for raw in resp:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    delta = json.loads(line[6:])["choices"][0]["delta"]
+                    if delta.get("content"):
+                        chunks += 1
+                        if chunks == 2 and not killed:
+                            procs[1].kill()   # follower dies mid-stream
+                            killed = True
+        except OSError:
+            pass  # stream torn down by the failure — expected
+        assert killed, "stream finished before the follower was killed"
+
+        # the pre-fail snapshot must appear with the interrupted request
+        # recorded as unfinished (resumable)
+        deadline = time.monotonic() + 120
+        snap = None
+        while time.monotonic() < deadline:
+            if os.path.exists(ckpt):
+                try:
+                    with open(ckpt) as f:
+                        snap = json.load(f)
+                except ValueError:
+                    snap = None  # mid-write; retry
+                if snap and any(not r["finished"] and not r["error"]
+                                for r in snap["requests"]):
+                    break
+            time.sleep(0.5)
+        assert snap is not None, (
+            f"pre-fail snapshot never written\n{log_tail(0)}")
+        live = [r for r in snap["requests"]
+                if not r["finished"] and not r["error"]]
+        assert len(live) == 1, (snap["requests"], log_tail(0))
+        leg1 = live[0]
+        assert 1 <= len(leg1["out_tokens"]) < N, leg1["out_tokens"]
+        assert leg1["prompt_ids"] == want_prompt
+        # interrupted mid-transcript, token-exact so far
+        assert leg1["out_tokens"] == want_out[:len(leg1["out_tokens"])]
+
+        # the standard operator flow: SIGTERM the (failed) coordinator
+        # before restarting. Its shutdown save must PRESERVE the
+        # pre-fail snapshot (registry is empty after the failure), not
+        # clobber it with an empty one.
+        procs[0].send_signal(signal.SIGTERM)
+        try:
+            procs[0].communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            pass  # teardown may wait on the dead follower; kill below
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    with open(ckpt) as f:
+        kept = json.load(f)
+    assert [r for r in kept["requests"]
+            if not r["finished"] and not r["error"]], (
+        "SIGTERM shutdown clobbered the pre-fail snapshot")
+
+    # leg 2: restart the cluster on the same checkpoint; restore
+    # resubmits the interrupted request (prompt = original + leg-1
+    # tokens) and it decodes on. SIGTERM mid-decode: the shutdown
+    # snapshot then records the still-running request, proving the
+    # resume point and the token-exact continuation in one record
+    # (finished requests retire from the registry, so a completed one
+    # would leave no trace to assert on).
+    # max_seq_len is part of the checkpoint fingerprint — must match
+    procs, base = launch(["--heartbeat-timeout", "60",
+                          "--decode-scan", "1", "--max-seq-len", "512"])
+    try:
+        wait_up(procs, base)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            m = urllib.request.urlopen(base + "/metrics",
+                                       timeout=10).read().decode()
+            toks = next(float(ln.rsplit(" ", 1)[1])
+                        for ln in m.splitlines()
+                        if ln.startswith("cake_engine_tokens_generated"))
+            if toks >= 5:   # leg 2 is decoding; stop it mid-flight
+                break
+            time.sleep(1.0)
+        procs[0].send_signal(signal.SIGTERM)
+        for p in procs:
+            p.communicate(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    with open(ckpt) as f:
+        final = json.load(f)
+    recs = [r for r in final["requests"] if not r["error"]]
+    assert len(recs) == 1, (final["requests"], log_tail(0))
+    rec = recs[0]
+    # resumed exactly from the snapshot point...
+    assert rec["prompt_ids"] == want_prompt + leg1["out_tokens"]
+    got = rec["prompt_ids"] + rec["out_tokens"]
+    # ...made real progress past it...
+    assert len(rec["out_tokens"]) >= 1, rec
+    # ...and the whole transcript is token-exact vs the uninterrupted
+    # oracle (greedy resume determinism, serve/checkpoint.py contract)
+    assert got == (want_prompt + want_out)[:len(got)], (
+        len(got), got[-8:], (want_prompt + want_out)[len(got) - 8:len(got)])
