@@ -71,6 +71,12 @@ ENGINE_TIERS = [
     # vs 215 at 8 slots and 151 at 32 (32-slot cache + weights thrash HBM)
     ("engine_8b_int8", dict(model="8b", quant=True, max_seq=512, slots=16)),
     ("engine_1b", dict(model="1b", quant=False, max_seq=512, slots=16)),
+    # speculation INSIDE the engine (spec_step_slot rounds per slot):
+    # the spec tier merged into the engine tier — acceptance + batched
+    # tok/s with concurrent speculating streams. Random weights make
+    # the measured acceptance a FLOOR (see SPEC_TIERS note).
+    ("engine_spec_8b_draft1b", dict(model="8b", quant=True, max_seq=512,
+                                    slots=8, draft="1b", gamma=4)),
 ]
 
 # SD tier (BASELINE config #4 analog on one chip): per-denoise-step
@@ -105,6 +111,9 @@ SMOKE_TIERS = {
                       prompt_len=16, gen_tokens=8),
     "engine_tiny": dict(model="tiny", quant=False, max_seq=128,
                         slots=2, prompt_len=16, gen_tokens=8),
+    "engine_spec_tiny": dict(model="tiny", quant=False, max_seq=256,
+                             slots=2, prompt_len=16, gen_tokens=8,
+                             draft="tiny", gamma=3),
     # steps_b - steps_a must dwarf timing noise: with a tiny unet the
     # fixed CLIP/VAE/PNG overhead dominates a 2-step delta
     "sd_tiny": dict(version="tiny", steps_a=2, steps_b=12),
@@ -238,12 +247,15 @@ def run_tier(name: str, model: str, quant, max_seq: int,
 
 def run_engine_tier(name: str, model: str, quant, max_seq: int,
                     slots: int = 8, prompt_len: int = 128,
-                    gen_tokens: int = 64) -> dict:
+                    gen_tokens: int = 64, draft: str | None = None,
+                    gamma: int = 4) -> dict:
     """p50 TTFT + decode tok/s through InferenceEngine (the API path).
 
     `slots` concurrent streaming requests share the batched KV cache;
     TTFT includes prefill but not compile (a warmup request triggers the
-    prefill-bucket and decode compilations first)."""
+    prefill-bucket and decode compilations first). draft: run the engine
+    in speculative mode (per-slot draft/verify rounds) and report the
+    acceptance rate alongside the throughput."""
     from functools import partial
 
     import jax
@@ -258,14 +270,23 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
     init, _ = _init_fn(quant)
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
+    spec_kw = {}
+    if draft is not None:
+        d_cfg = make_config(draft)
+        d_params = jax.jit(partial(init, d_cfg))(jax.random.PRNGKey(1))
+        jax.block_until_ready(d_params)
+        spec_kw = dict(draft_params=d_params, draft_config=d_cfg,
+                       spec_gamma=gamma)
 
     engine = InferenceEngine(
         cfg, params, ByteTokenizer(cfg.vocab_size), max_slots=slots,
         max_seq_len=max_seq,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
         # 8 tokens per host round-trip once all streams are admitted —
-        # the dispatch-amortized serving configuration
-        decode_scan_steps=8,
+        # the dispatch-amortized serving configuration (spec rounds
+        # amortize gamma+1 tokens per dispatch instead)
+        decode_scan_steps=1 if draft is not None else 8,
+        **spec_kw,
     )
     prompt = list(range(3, 3 + prompt_len))
     with engine:
@@ -296,7 +317,7 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
     log(f"engine: {tokens} tokens, decode {decode_s:.2f}s -> "
         f"{tok_s:.1f} tok/s aggregate; TTFT p50 {p50 * 1e3:.1f}ms "
         f"({slots} concurrent streams)")
-    return {
+    out = {
         "metric": f"{name}_ttft_and_throughput",
         "value": round(tok_s, 2),
         "unit": "tokens/s",
@@ -305,6 +326,12 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
         "engine_decode_tok_s": round(tok_s, 2),
         "engine_streams": slots,
     }
+    if draft is not None:
+        out["spec_acceptance"] = round(engine.stats.spec_acceptance, 4)
+        out["spec_gamma"] = gamma
+        log(f"spec: acceptance {engine.stats.spec_acceptance:.3f} "
+            f"(gamma={gamma}, random-weight floor)")
+    return out
 
 
 def run_sd_tier(name: str, version: str, height: int | None = None,
@@ -446,7 +473,8 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in dict(ENGINE_TIERS) or name == "engine_tiny":
+    if name in dict(ENGINE_TIERS) or name in ("engine_tiny",
+                                              "engine_spec_tiny"):
         kwargs = {**dict(ENGINE_TIERS), **SMOKE_TIERS}[name]
         result = run_engine_tier(name, **kwargs)
     elif name in dict(SD_TIERS) or name == "sd_tiny":

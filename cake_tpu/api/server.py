@@ -532,22 +532,12 @@ def start(master, address: str = "127.0.0.1:10128",
             # helper thread — called from the serve_forever thread (the
             # block=True signal path) it deadlocks.
             engine.stop()
-            if (getattr(engine, "_prefail_written", False)
-                    and ckpt.has_resumable(checkpoint_path)):
-                # the standard operator flow after a fatal failure is
-                # SIGTERM-and-restart: THIS process's pre-fail snapshot
-                # is the authoritative failure-time state (serving was
-                # over — no new work was admitted after it was written),
-                # while the live registry is empty or mid-teardown; an
-                # unconditional save here would clobber the file and
-                # lose resumable generations. A checkpoint left by a
-                # PREVIOUS process and already consumed by this one's
-                # restore is NOT kept (prefail_written is false), so
-                # completed resumes don't replay forever.
-                log.info("keeping pre-fail snapshot at %s",
-                         checkpoint_path)
-            else:
-                ckpt.save(engine, checkpoint_path)
+            # keep-or-save decision lives in the engine (shutdown_save),
+            # under the same lock as the pre-fail writer: a pre-fail
+            # snapshot written by THIS process is authoritative and
+            # kept; a checkpoint consumed by this process's restore is
+            # overwritten so completed resumes don't replay forever
+            engine.shutdown_save(checkpoint_path)
             threading.Thread(target=httpd.shutdown, daemon=True).start()
 
         try:

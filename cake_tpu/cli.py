@@ -30,25 +30,35 @@ def _serve_multihost(master, args) -> int:
         ControlClient, ControlServer, broadcast_control_address,
     )
 
-    if master.llm is None:
-        raise ValueError(
-            "multi-host API serving is text-only: the SD pipeline places "
-            "whole components per device (place_components) and needs no "
-            "cross-process step dispatch — run --model-type image on one "
-            "host, or shard a text model with a topology")
-    # every process builds the identical engine (the shared-cache zeros
-    # allocation is a global computation, so construction order matters
-    # and must match across hosts)
-    engine = master.make_engine()
-    if engine is None:
-        raise ValueError(
-            "this serving mode (--sp / --draft-model) has no batching "
-            "engine and no multi-host step replay; serve it on one host")
+    image_mode = master.llm is None
+    if image_mode:
+        # SD multi-host: Context.load_image_model sharded the whole
+        # pipeline over a process-spanning ("dp",) mesh, so every
+        # process must dispatch each generation's jit sequence. A
+        # generation is deterministic from its request args (seed and
+        # scheduler ride in them), so ONE op per request suffices:
+        # the coordinator publishes the args, followers replay
+        # master.generate_image with them (_run_image_follower).
+        engine = None
+    else:
+        # every process builds the identical engine (the shared-cache
+        # zeros allocation is a global computation, so construction
+        # order matters and must match across hosts)
+        engine = master.make_engine()
+        if engine is None:
+            raise ValueError(
+                "this serving mode (--sp / --draft-model) has no "
+                "batching engine and no multi-host step replay; serve "
+                "it on one host")
+        # the pre-fail capture must outlive the heartbeat stale window
+        # (the monitor is exactly the late-arriving consumer)
+        engine.fail_recs_ttl = args.heartbeat_timeout + 60.0
     # a model without a cross-process placement (no topology/tp/dp) runs
     # entirely inside the coordinator: no step replay needed — followers
     # just idle on the control channel until the stop op, preserving the
     # pre-existing behavior for this configuration
-    replayed = getattr(master.llm, "parallel", None) is not None
+    replayed = (image_mode
+                or getattr(master.llm, "parallel", None) is not None)
     if is_coordinator():
         import os
         import secrets
@@ -71,18 +81,25 @@ def _serve_multihost(master, args) -> int:
             bind_host = ""
         # failure detection (SURVEY §5): follower heartbeats feed the
         # serving health — a dead host 503s the API instead of letting
-        # the next collective hang forever
-        health = ServingHealth(engine,
-                               stall_after_s=args.stall_timeout)
-        hb_addr = health.expect_workers(
-            [f"proc{i}" for i in range(1, jax.process_count())],
-            bind_host=bind_host,
-            stale_after_s=args.heartbeat_timeout)
-        hb_adv = f"{adv}:{hb_addr.rsplit(':', 1)[1]}"
+        # the next collective hang forever. Image mode serves through
+        # the locked path (no engine to watch): no heartbeats, a dead
+        # follower surfaces as the next generation's publish error.
+        health = None
+        hb_adv = ""
+        if engine is not None:
+            health = ServingHealth(engine,
+                                   stall_after_s=args.stall_timeout)
+            hb_addr = health.expect_workers(
+                [f"proc{i}" for i in range(1, jax.process_count())],
+                bind_host=bind_host,
+                stale_after_s=args.heartbeat_timeout)
+            hb_adv = f"{adv}:{hb_addr.rsplit(':', 1)[1]}"
         broadcast_control_address(
             f"{adv}:{control.port}|{token}|{hb_adv}")
         control.accept_followers()
-        if replayed:
+        if image_mode:
+            master.attach_image_control(control)
+        elif replayed:
             engine.attach_control(control)
 
         done = threading.Event()
@@ -100,13 +117,15 @@ def _serve_multihost(master, args) -> int:
                 return
             done.set()
             try:
-                health.close()
+                if health is not None:
+                    health.close()
             except Exception:  # noqa: BLE001
                 pass
-            engine.stop()
-            if not replayed:
-                # idle followers never got a stop from the (local-only)
-                # engine; release them explicitly
+            if engine is not None:
+                engine.stop()
+            if engine is None or not replayed:
+                # image followers / idle followers never get a stop from
+                # an engine; release them explicitly
                 try:
                     control.publish({"op": "stop"})
                 except Exception:  # noqa: BLE001
@@ -141,10 +160,13 @@ def _serve_multihost(master, args) -> int:
         beat = (HeartbeatSender(hb_addr, f"proc{jax.process_index()}")
                 if hb_addr else None)
         try:
-            # with a cross-process placement this replays every engine
-            # step; without one no step ops ever arrive and the loop just
-            # blocks until the coordinator's stop
-            engine.run_follower_loop(client)
+            if image_mode:
+                _run_image_follower(master, client)
+            else:
+                # with a cross-process placement this replays every
+                # engine step; without one no step ops ever arrive and
+                # the loop just blocks until the coordinator's stop
+                engine.run_follower_loop(client)
         finally:
             if beat is not None:
                 beat.close()
@@ -162,6 +184,39 @@ def _serve_multihost(master, args) -> int:
             client.close()
             _distributed_shutdown()
     return 0
+
+
+def _run_image_follower(master, client) -> None:
+    """Image-mode follower: replay whole-generation ops. A generation is
+    deterministic from its request args (seed + scheduler ride in them),
+    so executing master.generate_image with the coordinator's args
+    dispatches the identical jit sequence — the SPMD analog of the
+    reference's per-component SD workers (sd.rs:198-302)."""
+    import logging as _logging
+
+    from cake_tpu.args import ImageGenerationArgs
+    log = _logging.getLogger(__name__)
+    log.info("image follower: replaying generation ops")
+    while True:
+        op = client.recv()
+        if op is None or op.get("op") == "stop":
+            log.info("image follower: coordinator %s",
+                     "stopped" if op else "closed the channel")
+            return
+        if op.get("op") != "image":
+            log.error("image follower: unknown op %r", op.get("op"))
+            continue
+        try:
+            master.generate_image(
+                ImageGenerationArgs.from_json(op["args"]),
+                lambda _pngs: None)
+        except Exception:  # noqa: BLE001
+            # a failed replay desyncs the SPMD dispatch; disconnecting
+            # makes the coordinator's next publish fail loudly instead
+            # of wedging a collective
+            log.exception("image follower: generation replay failed; "
+                          "disconnecting")
+            return
 
 
 def _distributed_shutdown() -> None:

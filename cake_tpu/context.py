@@ -383,4 +383,41 @@ class Context:
 
     def load_image_model(self):
         from cake_tpu.models.sd.sd import SDGenerator
-        return SDGenerator.load(self)
+        gen = SDGenerator.load(self)
+        a = self.args
+        if a.dp > 1 or jax.process_count() > 1:
+            # whole-pipeline SPMD over a ("dp",) mesh: --dp N splits the
+            # UNet batch (guidance pair / multi-image) over N devices;
+            # under multi-host every process must dispatch, so the mesh
+            # spans ALL devices and cli._serve_multihost replays
+            # generation ops to the followers
+            if self.topology is not None:
+                why = ("--dp" if a.dp > 1
+                       else "multi-host image serving (which meshes the "
+                            "whole pipeline)")
+                raise ValueError(
+                    f"{why} and an SD component topology are mutually "
+                    "exclusive: one SPMD program cannot mix mesh-sharded "
+                    "and committed-to-device components")
+            import numpy as np
+            from jax.sharding import Mesh
+            devices = jax.devices()
+            if jax.process_count() > 1:
+                # multi-host: the mesh MUST span every process (each one
+                # dispatches the same SPMD program); a --dp that asks
+                # for anything else is an error, not silently ignored
+                if a.dp > 1 and a.dp != len(devices):
+                    raise ValueError(
+                        f"multi-host image serving meshes over ALL "
+                        f"{len(devices)} devices; --dp {a.dp} cannot be "
+                        "honored (drop the flag or set it to the total "
+                        "device count)")
+                n = len(devices)
+            else:
+                n = a.dp
+                if n > len(devices):
+                    raise ValueError(
+                        f"--dp {n} needs {n} devices, have "
+                        f"{len(devices)}")
+            gen.shard_for_mesh(Mesh(np.array(devices[:n]), ("dp",)))
+        return gen

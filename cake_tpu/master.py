@@ -58,13 +58,28 @@ class Master:
         g = self.llm
         from cake_tpu.models.llama.speculative import SpeculativeGenerator
         if isinstance(g, SpeculativeGenerator):
-            # the batched engine has no draft/verify step contract;
-            # serve through the legacy locked path instead — batch-1
-            # speculative decoding behind --api (the latency mode the
-            # draft exists for), one request at a time
-            log.info("no batching engine for --draft-model: the API "
-                     "serves speculative requests one at a time")
-            return None
+            import jax
+            if jax.process_count() > 1:
+                # the spec engine's per-slot rounds are single-device;
+                # no multi-host step replay exists for them
+                log.info("no multi-host engine for --draft-model")
+                return None
+            # round-5: speculation inside the batching engine — the
+            # draft/verify round runs per slot (spec_step_slot), so
+            # concurrent API requests all speculate, stream, and
+            # checkpoint like any other engine request
+            slots = max_slots or getattr(self.args, "max_slots", 8)
+            return InferenceEngine(
+                g.config, g.params, g.tokenizer,
+                max_slots=slots,
+                max_seq_len=g.max_seq_len,
+                sampling=g.sampling,
+                seed=self.args.seed,
+                cache_dtype=g.cache.k.dtype,
+                draft_params=g.draft_params,
+                draft_config=g.draft_config,
+                spec_gamma=g.gamma,
+            )
         if getattr(g, "_forward_fn", None) is not None and g.parallel is None:
             # a custom forward without a (plan, mesh) — e.g. the --sp
             # adapter — has no engine-step contract. Returning None makes
@@ -166,9 +181,27 @@ class Master:
 
     # -- image ---------------------------------------------------------------
 
+    def attach_image_control(self, control) -> None:
+        """Multi-host image serving: publish each generation's args
+        before dispatching it, so follower processes replay the
+        identical jit sequence (cli._run_image_follower)."""
+        self._image_control = control
+
     def generate_image(self, image_args, callback) -> None:
         if self.image is None:
             raise RuntimeError("no image generator loaded")
+        control = getattr(self, "_image_control", None)
+        if control is not None:
+            if image_args.sd_img2img:
+                # the path is coordinator-local; a follower replaying it
+                # would fail AFTER its first collectives and desync the
+                # SPMD dispatch, wedging the cluster — reject up front
+                # with a clean client error instead
+                raise ValueError(
+                    "img2img is unavailable under multi-host serving: "
+                    "the init image exists on the coordinator only; "
+                    "serve img2img on one host")
+            control.publish({"op": "image", "args": image_args.to_json()})
         self.image.generate_image(image_args, callback)
 
     def run(self) -> None:

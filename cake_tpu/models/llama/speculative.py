@@ -72,6 +72,46 @@ def spec_step(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
     Returns (tokens [1, gamma+1] — first n_emit valid, rest -1,
     n_emit scalar, t_cache, d_cache, rng).
     """
+    return _spec_round(t_params, d_params, t_cache, d_cache, last_tok,
+                       pos, t_rope, d_rope, rng, temperature,
+                       t_cfg, d_cfg, gamma, greedy)
+
+
+@partial(jax.jit,
+         static_argnames=("t_cfg", "d_cfg", "gamma", "greedy"),
+         donate_argnames=("t_cache", "d_cache"))
+def spec_step_slot(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
+                   last_tok, pos, slot, t_rope: RopeTables,
+                   d_rope: RopeTables, rng, temperature,
+                   t_cfg: LlamaConfig, d_cfg: LlamaConfig,
+                   gamma: int, greedy: bool):
+    """spec_step against ONE slot of multi-slot engine caches
+    ([L, slots, T, KV, hd]): slice the slot out, run the round, scatter
+    the updated KV back. `slot` is traced (one compiled program serves
+    every slot). The engine's draft/verify step contract — batch-1 per
+    round, but the ENGINE interleaves rounds across slots so concurrent
+    API requests all speculate."""
+    def pick(c: KVCache) -> KVCache:
+        return KVCache(
+            jax.lax.dynamic_slice_in_dim(c.k, slot, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(c.v, slot, 1, axis=1))
+
+    def put(c: KVCache, s: KVCache) -> KVCache:
+        return KVCache(
+            jax.lax.dynamic_update_slice_in_dim(c.k, s.k, slot, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(c.v, s.v, slot, axis=1))
+
+    out, n_emit, tc, dc, rng = _spec_round(
+        t_params, d_params, pick(t_cache), pick(d_cache), last_tok, pos,
+        t_rope, d_rope, rng, temperature, t_cfg, d_cfg, gamma, greedy)
+    return out, n_emit, put(t_cache, tc), put(d_cache, dc), rng
+
+
+def _spec_round(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
+                last_tok, pos, t_rope: RopeTables, d_rope: RopeTables,
+                rng, temperature,
+                t_cfg: LlamaConfig, d_cfg: LlamaConfig,
+                gamma: int, greedy: bool):
     B = last_tok.shape[0]
 
     def draft_body(carry, i):

@@ -89,6 +89,57 @@ class SDGenerator:
         self.tokenizers = tokenizers  # [tok] or [tok, tok2] for XL
         self.dtype = dtype
         self._unet_step = None
+        self._mesh = None             # set by shard_for_mesh
+
+    # -- multi-device / multi-host sharding -----------------------------------
+
+    def shard_for_mesh(self, mesh) -> None:
+        """Run the whole pipeline as ONE SPMD program over `mesh` (axis
+        "dp"): component params replicate across every device, and the
+        jitted denoise step shards its batch axis — with guidance the
+        cond/uncond pair runs on different devices concurrently, and
+        multi-image batches split dp-ways. This is the TPU-native form
+        of the reference's SD distribution (clip/vae/unet on different
+        machines, sd.rs:198-302): instead of shipping activations over
+        TCP between per-component hosts, every process dispatches the
+        same program and XLA moves the (tiny, latent-sized) activations
+        over ICI/DCN. On a process-spanning mesh the followers replay
+        whole-generation ops (cli._serve_multihost image mode).
+
+        Mutually exclusive with per-component placement
+        (place_components) — one program cannot mix committed-to-device
+        and mesh-sharded operands."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        rep = NamedSharding(mesh, P())
+        self.params = jax.tree.map(
+            lambda x: jax.device_put(x, rep), self.params)
+        self._mesh = mesh
+        self._unet_step = None   # recompile against the mesh
+        log.info("sd: sharded for mesh %s (dp=%d)", mesh.axis_names,
+                 mesh.shape.get("dp", 1))
+
+    def _replicated(self, tree):
+        """Host values -> mesh-replicated global arrays (identical on
+        every process by construction: same seed / same request args)."""
+        if self._mesh is None:
+            return tree
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        rep = NamedSharding(self._mesh, P())
+        return jax.tree.map(
+            lambda x: (jax.device_put(jnp.asarray(x), rep)
+                       if hasattr(x, "shape") or isinstance(x, (int, float))
+                       else x), tree)
+
+    def _host(self, x) -> np.ndarray:
+        """Device -> host, including process-spanning arrays (replicated
+        shardings are not fully addressable under multi-controller; the
+        local shard of a replicated array IS the full value)."""
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(x)
 
     # -- loading -------------------------------------------------------------
 
@@ -173,6 +224,10 @@ class SDGenerator:
             log.info("sd: %s -> %s (node %s)", name, dev, node_name)
 
     def _component_device(self, name):
+        if self._mesh is not None:
+            # mesh mode: every component lives (replicated) on the mesh;
+            # activations flow inside one SPMD program, no transfers
+            return None
         params = self.params.get(name)
         if params is None:
             return None
@@ -181,13 +236,15 @@ class SDGenerator:
         if devs and len(devs) == 1:
             return next(iter(devs))
         if devs and len(devs) > 1:
-            # a multi-device (sharded) component needs a sharding-aware
-            # transfer of activations; silently skipping would resurface
-            # jit's incompatible-devices error with no hint why
+            # a manually multi-device component outside mesh mode needs a
+            # sharding-aware transfer of activations; silently skipping
+            # would resurface jit's incompatible-devices error with no
+            # hint why
             raise NotImplementedError(
                 f"SD component '{name}' is sharded over {len(devs)} "
-                "devices; per-component placement currently supports one "
-                "device per component (use device_put / place_components)")
+                "devices without mesh mode; use shard_for_mesh for a "
+                "whole-pipeline mesh, or place_components for one device "
+                "per component")
         return None
 
     def _to_component(self, name, tree):
@@ -213,7 +270,8 @@ class SDGenerator:
         added = None
 
         def encode_with(tok, clip_params, clip_cfg, text, skip):
-            ids = jnp.asarray([tok.encode(text)], dtype=jnp.int32)
+            ids = self._replicated(
+                jnp.asarray([tok.encode(text)], dtype=jnp.int32))
             out = clip_encode(clip_params, clip_cfg, ids,
                               output_hidden_state=skip)
             # hand the embeddings to the UNet's device right away: the two
@@ -262,21 +320,45 @@ class SDGenerator:
 
     def _make_unet_step(self, guidance_scale: float, use_guidance: bool):
         # memoized so repeated requests reuse the compiled program
-        key = (guidance_scale, use_guidance)
+        key = (guidance_scale, use_guidance, self._mesh)
         if self._unet_step is not None and self._unet_step[0] == key:
             return self._unet_step[1]
         ucfg = self.config.unet
+        mesh = self._mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            dp_s = NamedSharding(mesh, P("dp"))
+            rep_s = NamedSharding(mesh, P())
 
         @jax.jit
         def step(unet_params, latents, t, context, added):
             inp = (jnp.concatenate([latents, latents], axis=0)
                    if use_guidance else latents)
             ts = jnp.full((inp.shape[0],), t, jnp.float32)
+            if (mesh is not None
+                    and inp.shape[0] % mesh.shape["dp"] == 0):
+                # shard the UNet batch over dp: with guidance the
+                # cond/uncond halves denoise on different devices (the
+                # UNet math is per-sample, so the only cross-device
+                # traffic is the eps-sized guidance combine below).
+                # Non-divisible batches stay replicated (still correct,
+                # just not parallel)
+                inp = jax.lax.with_sharding_constraint(inp, dp_s)
+                ts = jax.lax.with_sharding_constraint(ts, dp_s)
+                context = jax.lax.with_sharding_constraint(context, dp_s)
+                if added is not None:
+                    added = jax.tree.map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, dp_s), added)
             eps = unet_forward(unet_params, ucfg, inp, ts, context,
                                added_cond=added)
             if use_guidance:
                 eps_u, eps_c = jnp.split(eps, 2, axis=0)
                 eps = eps_u + guidance_scale * (eps_c - eps_u)
+            if mesh is not None:
+                # the host-side scheduler reads eps; keep it replicated
+                eps = jax.lax.with_sharding_constraint(eps, rep_s)
             return eps
 
         self._unet_step = (key, step)
@@ -327,31 +409,36 @@ class SDGenerator:
             rng, sub = jax.random.split(rng)
             init_latent = self._to_component("unet", vae_encode(
                 self.params["vae"], cfg.vae,
-                jnp.asarray(image, self.dtype)[None], rng=sub))
+                self._replicated(jnp.asarray(image, self.dtype)[None]),
+                rng=self._replicated(sub)))
             t_start = max(steps - int(args.sd_img2img_strength * steps), 0)
 
         for sample_idx in range(args.sd_num_samples):
             rng, sub = jax.random.split(rng)
-            noise = jax.random.normal(
-                sub, (bsize, lat_h, lat_w, lat_c), self.dtype)
+            noise = self._replicated(jax.random.normal(
+                sub, (bsize, lat_h, lat_w, lat_c), self.dtype))
             if init_latent is not None:
                 latents = sched.add_noise(
                     jnp.tile(init_latent, (bsize, 1, 1, 1)), noise, t_start)
             else:
                 latents = noise * sched.init_noise_sigma
 
-            ctx_b = (jnp.repeat(context, bsize, axis=0)
-                     if bsize > 1 else context)
+            ctx_b = self._replicated(
+                jnp.repeat(context, bsize, axis=0)
+                if bsize > 1 else context)
             added_b = added
             if added is not None and bsize > 1:
                 added_b = {k: jnp.repeat(v, bsize, axis=0)
                            for k, v in added.items()}
+            added_b = self._replicated(added_b)
 
             for i in range(t_start, steps):
                 t0 = time.perf_counter()
                 scaled = sched.scale_model_input(latents, i)
                 eps = unet_step(self.params["unet"], scaled,
-                                float(sched.timesteps[i]), ctx_b, added_b)
+                                self._replicated(
+                                    jnp.float32(sched.timesteps[i])),
+                                ctx_b, added_b)
                 latents = sched.step(eps, i, latents)
                 log.info("sample %d step %d/%d (%.2fs)", sample_idx + 1,
                          i + 1, steps, time.perf_counter() - t0)
@@ -365,7 +452,7 @@ class SDGenerator:
         sd.rs:535-565)."""
         imgs = vae_decode(self.params["vae"], self.config.vae,
                           self._to_component("vae", latents))
-        imgs = np.asarray(((jnp.clip(imgs, -1, 1) + 1.0) * 127.5)
+        imgs = self._host(((jnp.clip(imgs, -1, 1) + 1.0) * 127.5)
                           .astype(jnp.uint8))
         out = []
         from PIL import Image

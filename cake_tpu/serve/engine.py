@@ -154,6 +154,14 @@ class EngineStats:
     prefix_hits: int = 0     # prefills served from a registered prefix
     errors: int = 0
     last_error: str = ""
+    # speculative engine mode: drafts offered / kept across all slots
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+
+    @property
+    def spec_acceptance(self) -> float:
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
 
     @property
     def decode_tokens_per_s(self) -> float:
@@ -184,6 +192,9 @@ class InferenceEngine:
         prefill_chunk: Optional[int] = None,
         top_logprobs_cap: int = 20,
         ring: Optional[bool] = None,
+        draft_params=None,
+        draft_config=None,
+        spec_gamma: int = 4,
     ):
         self.config = config
         self.params = params
@@ -287,10 +298,46 @@ class InferenceEngine:
                 + (" (ring/sliding-window serving requires a chunk that "
                    "divides max_seq_len; pass --prefill-chunk)"
                    if self.ring else ""))
+        # speculative decoding INSIDE the engine (round-5: the former
+        # single-request island now composes with API batching and
+        # checkpointing): a draft model proposes spec_gamma tokens per
+        # slot round, the target verifies them in one pass
+        # (speculative.spec_step_slot), and the engine interleaves
+        # rounds across slots — each round emits 1..gamma+1 tokens.
+        self._spec = draft_params is not None
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.spec_gamma = spec_gamma
+        if self._spec:
+            if step_fns is not None or self.ring:
+                raise ValueError(
+                    "the speculative engine requires the built-in dense "
+                    "single-device path (no topology/ring step fns)")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    "draft and target must share a vocabulary")
+            if prefill_chunk is not None:
+                log.warning("prefill_chunk ignored in speculative mode "
+                            "(whole-prompt prefill keeps the draft cache "
+                            "aligned)")
+                prefill_chunk = None
+            if self._decode_scan > 1:
+                log.warning("decode_scan ignored in speculative mode "
+                            "(each spec round already amortizes up to "
+                            "gamma+1 tokens per dispatch)")
+                self._decode_scan = 1
+            # a prefix-cached target prefill would leave the draft cache
+            # cold at those positions — acceptance would silently
+            # collapse; keep the caches aligned instead
+            self._prefix_capable = False
+            self.d_rope = RopeTables.create(draft_config, max_seq_len)
         self.prefill_chunk = prefill_chunk
         cache_len = (config.sliding_window if self.ring else max_seq_len)
         self.cache = cache if cache is not None else KVCache.create(
             config, max_slots, cache_len, dtype=cache_dtype)
+        if self._spec:
+            self.d_cache = KVCache.create(draft_config, max_slots,
+                                          cache_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
         # an identically-sharded cache even after donation freed the buffers
         self._cache_shardings = KVCache(k=self.cache.k.sharding,
@@ -342,6 +389,11 @@ class InferenceEngine:
         # device work is a cross-process collective, so it must dispatch
         # in the engine thread's program order — see _run_on_engine_thread)
         self._cmd_q: list = []
+        # serializes pre-fail snapshot writes (health-monitor thread)
+        # against the shutdown keep-or-save decision (signal/serve
+        # thread) — without it a SIGTERM landing mid-failure could read
+        # _prefail_written before the pre-fail write and clobber it
+        self._ckpt_lock = threading.Lock()
         self._requests = {}
         # rids whose callers gave up (client disconnect): drained by the
         # ENGINE thread at the top of its loop, so request/slot teardown
@@ -526,6 +578,21 @@ class InferenceEngine:
         d = self.defaults
         eff_temp = temperature if temperature is not None else d.temperature
         eff_top_p = top_p if top_p is not None else d.top_p
+        if self._spec:
+            # the accept/resample identity assumes the unfiltered
+            # temperature softmax, and the verify pass scores the burst
+            # in parallel (no within-burst penalty ring) — reject
+            # incompatible sampling with a clean client error
+            eff_pen = (d.repeat_penalty if repeat_penalty is None
+                       else repeat_penalty)
+            if (eff_top_p or 1.0) < 1.0 or eff_pen != 1.0:
+                raise ValueError(
+                    "speculative serving supports temperature-only "
+                    "sampling (top_p=1, repeat_penalty=1)")
+            if want_top_logprobs:
+                raise ValueError(
+                    "logprobs are unavailable in speculative serving "
+                    "(accepted drafts are not sampled step-by-step)")
         req = _Request(
             rid=rid, prompt_ids=ids, max_new_tokens=max_new,
             temperature=eff_temp if eff_temp is not None else 0.0,
@@ -836,11 +903,21 @@ class InferenceEngine:
                 for rid, slot in prefill_plan:
                     self._do_prefill(rid, slot)
                 if decode_plan:
-                    n = self._scan_steps_for(decode_plan)
-                    if n > 1:
-                        self._do_decode_scan(decode_plan, n)
+                    if self._spec:
+                        self._do_decode_spec(decode_plan)
                     else:
-                        self._do_decode(decode_plan)
+                        n = self._scan_steps_for(decode_plan)
+                        if n > 1:
+                            self._do_decode_scan(decode_plan, n)
+                        else:
+                            self._do_decode(decode_plan)
+                if getattr(self, "_fail_recs", None) is not None:
+                    # a successful iteration (real device work incl.
+                    # collectives) proves the mesh recovered: the
+                    # earlier failure was genuinely transient, so its
+                    # capture must not resurrect already-errored
+                    # requests in a later fatal's snapshot
+                    self._fail_recs = None
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
                 # capture the request records FIRST (cheap, pure
@@ -875,7 +952,8 @@ class InferenceEngine:
                     log.exception("control publish failed; stopping")
                     fatal = True
                 if fatal:
-                    self._snapshot_before_fail(requests=recs)
+                    with self._ckpt_lock:
+                        self._snapshot_before_fail(requests=recs)
                     self._stop.set()
                     return
                 self._reset_after_error()
@@ -887,6 +965,10 @@ class InferenceEngine:
         # may already be deleted — rebuild so the engine survives
         # (transient OOM/XLA error must not brick serving)
         self.cache = self._fresh_cache()
+        if self._spec:
+            self.d_cache = KVCache.create(
+                self.draft_config, self.max_slots,
+                self.cache.max_seq_len, dtype=self._cache_dtype)
         self._pos[:] = 0
         self._last_tok[:] = 0
         self._steps[:] = 0
@@ -1038,6 +1120,13 @@ class InferenceEngine:
             self.params, toks, plen, jnp.int32(slot), self.cache,
             self.rope, self.config,
         )
+        if self._spec:
+            # the draft's KV must cover the prompt too (its proposals
+            # attend the same positions the target verifies)
+            _, self.d_cache = self._prefill_slot(
+                self.draft_params, toks, plen, jnp.int32(slot),
+                self.d_cache, self.d_rope, self.draft_config,
+            )
         return logits
 
     def _prefill_device(self, ids, slot: int, temp: float, top_p: float,
@@ -1110,6 +1199,84 @@ class InferenceEngine:
                 self.config,
             )
         return logits
+
+    def _do_decode_spec(self, decode_plan) -> None:
+        """One propose-verify-accept round per planned slot
+        (speculative.spec_step_slot): each round advances its request by
+        1..gamma+1 tokens in a single target pass. Phase 1 dispatches
+        every slot's round (async — the device programs chain on the
+        shared cache and pipeline behind one sync); phase 2 reads the
+        results and emits. Speculation stays a latency feature; the
+        engine's win is CONCURRENCY — many clients speculate interleaved
+        — plus API streaming and checkpoint/resume composition."""
+        from cake_tpu.models.llama.speculative import spec_step_slot
+
+        t0 = time.perf_counter()
+        g = self.spec_gamma
+        pending = []
+        for rid, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            if self._pos[slot] + g + 1 >= self.max_seq_len:
+                # the round writes g+1 cache positions; too close to the
+                # window end, finish at the cap (loses at most gamma
+                # tokens of an already maxed-out context)
+                self._force_finish(req)
+                continue
+            greedy = self._temp[slot] <= 0.0
+            out, n_emit, self.cache, self.d_cache, key = spec_step_slot(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                jnp.asarray([[self._last_tok[slot]]], jnp.int32),
+                jnp.int32(self._pos[slot]), jnp.int32(slot),
+                self.rope, self.d_rope, self._keys[slot],
+                jnp.float32(self._temp[slot] if not greedy else 1.0),
+                self.config, self.draft_config, g, greedy)
+            self._keys = self._keys.at[slot].set(key)
+            pending.append((req, slot, out, n_emit))
+        for req, slot, out, n_emit in pending:
+            n = int(n_emit[0])             # first host sync of the batch
+            toks = [int(t) for t in np.asarray(out[0, :n])]
+            self.stats.spec_proposed += g
+            self.stats.spec_accepted += n - 1
+            pos0 = int(self._pos[slot])
+            self._last_tok[slot] = toks[-1]
+            self._steps[slot] += n
+            for j, tok in enumerate(toks):
+                # per-token position so _emit's cap check sees the value
+                # a single-step loop would have had (_do_decode_scan
+                # precedent — the post-burst frontier would cap-finish
+                # the FIRST token of a window-filling burst)
+                self._pos[slot] = pos0 + j + 1
+                self._emit(req, tok)
+                if req.done.is_set():
+                    break   # EOS / budget mid-burst: drop the tail
+            # cache frontier for the next round: the burst wrote n
+            # accepted positions regardless of the emission budget;
+            # stale positions past it are masked like padding
+            self._pos[slot] = pos0 + n
+        self.stats.steps += 1
+        self.stats.decode_time_s += time.perf_counter() - t0
+
+    def _force_finish(self, req: _Request) -> None:
+        """Finish a request that cannot receive another token (spec
+        window cap): the _emit finish tail, minus the token."""
+        self.scheduler.report(req.rid, 0, True)
+        req.finish_t = time.perf_counter()
+        if req.slot >= 0 and self._slot_req[req.slot] is req:
+            self._slot_req[req.slot] = None
+        self._requests.pop(req.rid, None)
+        self.stats.requests_completed += 1
+        if req.stream is not None:
+            try:
+                delta = self._incremental_text(req, final=True)
+                if req.stream_wants_count:
+                    req.stream(delta, True, len(req.out_tokens))
+                else:
+                    req.stream(delta, True)
+            except Exception:  # noqa: BLE001
+                log.exception("stream callback failed rid=%d", req.rid)
+        req.done.set()
 
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()
@@ -1352,23 +1519,41 @@ class InferenceEngine:
         # — a transient reset-and-continue error must not leave a stale
         # snapshot that resurrects long-errored requests after a later
         # unclean exit.
-        if snapshot:
-            self._snapshot_before_fail()
-        for rid, req in list(self._requests.items()):
-            req.error = err
-            self.scheduler.cancel(rid)
-            if req.slot >= 0:
-                self._slot_req[req.slot] = None
-            self._requests.pop(rid, None)
-            req.done.set()
+        with self._ckpt_lock:
+            if snapshot:
+                self._snapshot_before_fail()
+            for rid, req in list(self._requests.items()):
+                req.error = err
+                self.scheduler.cancel(rid)
+                if req.slot >= 0:
+                    self._slot_req[req.slot] = None
+                self._requests.pop(rid, None)
+                req.done.set()
+
+    def shutdown_save(self, path: str) -> None:
+        """Clean-shutdown checkpoint: save the live registry — UNLESS
+        this process wrote a pre-fail snapshot and it still holds
+        resumable records, in which case that file is the authoritative
+        failure-time state (serving was over; saving the emptied
+        registry would clobber it). Holds the same lock as _fail_all so
+        a SIGTERM racing a heartbeat failure cannot read
+        _prefail_written before the pre-fail write lands."""
+        from cake_tpu.serve import checkpoint
+        with self._ckpt_lock:
+            if (getattr(self, "_prefail_written", False)
+                    and checkpoint.has_resumable(path)):
+                log.info("keeping pre-fail snapshot at %s", path)
+                return
+            checkpoint.write(checkpoint.snapshot(self), path)
 
     def _snapshot_before_fail(self, requests=None) -> None:
         """Best-effort pre-fail checkpoint (no-op unless api.start armed
-        `snapshot_path`). Inline and device-free by construction: arming
-        pairs with checkpoint.warm_fingerprint, so the fingerprint is
-        memoized and the snapshot is pure Python plus one local write —
-        safe even with the mesh wedged on a dead host. The guard below
-        keeps it that way if the arming contract ever drifts.
+        `snapshot_path`). Caller must hold _ckpt_lock. Inline and
+        device-free by construction: arming pairs with
+        checkpoint.warm_fingerprint, so the fingerprint is memoized and
+        the snapshot is pure Python plus one local write — safe even
+        with the mesh wedged on a dead host. The guard below keeps it
+        that way if the arming contract ever drifts.
 
         requests: records captured with checkpoint.snapshot_requests
         BEFORE the registry was emptied — the engine loop's fatal path
@@ -1385,7 +1570,11 @@ class InferenceEngine:
             # that failure's capture if it is fresh — requests from an
             # old, genuinely recovered error must not resurrect
             stash = getattr(self, "_fail_recs", None)
-            if stash is not None and time.monotonic() - stash[0] < 60.0:
+            # the window must cover the heartbeat stale interval (the
+            # monitor is exactly the thread that arrives late) — cli
+            # sets fail_recs_ttl from --heartbeat-timeout
+            ttl = getattr(self, "fail_recs_ttl", 60.0)
+            if stash is not None and time.monotonic() - stash[0] < ttl:
                 requests = stash[1]
             else:
                 return

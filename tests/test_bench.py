@@ -58,6 +58,18 @@ def test_engine_smoke_tier_reports_ttft():
     assert result["engine_streams"] == 2
 
 
+def test_engine_spec_smoke_tier_reports_acceptance():
+    """Speculation merged into the engine tier: the tier runs the engine
+    in per-slot draft/verify mode and reports acceptance. The smoke
+    draft IS the target (same init seed path? no — same 'tiny' config,
+    same seed 1 vs 0), so acceptance is just bounded-sane here."""
+    result = _run_tier("engine_spec_tiny")
+    assert result["value"] > 0
+    assert result["ttft_p50_ms"] > 0
+    assert 0.0 <= result["spec_acceptance"] <= 1.0
+    assert result["spec_gamma"] == 3
+
+
 def test_probe_reports_device():
     proc = subprocess.run(
         [sys.executable, BENCH], env=_base_env(CAKE_BENCH_PROBE="1"),

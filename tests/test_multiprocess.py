@@ -497,3 +497,130 @@ def test_multihost_failover_snapshot_and_resume(tmp_path, tiny_config):
     # oracle (greedy resume determinism, serve/checkpoint.py contract)
     assert got == (want_prompt + want_out)[:len(got)], (
         len(got), got[-8:], (want_prompt + want_out)[len(got) - 8:len(got)])
+
+
+IMAGE_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+    import jax.numpy as jnp
+
+    pid, port, api_addr = sys.argv[1:4]
+    os.environ["CAKE_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["CAKE_NUM_PROCESSES"] = "2"
+    os.environ["CAKE_PROCESS_ID"] = pid
+
+    # tiny SD stand-in: the test's subject is the control replay and the
+    # process-spanning SPMD dispatch, not checkpoint loading
+    from cake_tpu.models.sd import sd as sd_mod
+    from cake_tpu.models.sd.config import tiny_sd_config
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    def tiny_load(cls, ctx, rng_seed=0):
+        cfg = tiny_sd_config()
+        params = {
+            "clip": init_clip_params(cfg.clip, jax.random.PRNGKey(0)),
+            "unet": init_unet_params(cfg.unet, jax.random.PRNGKey(1)),
+            "vae": init_vae_params(cfg.vae, jax.random.PRNGKey(2)),
+        }
+        return cls(cfg, params,
+                   [sd_mod.SimpleClipTokenizer(cfg.clip.vocab_size)])
+
+    sd_mod.SDGenerator.load = classmethod(tiny_load)
+
+    from cake_tpu import cli
+    sys.exit(cli.main([
+        "--model-type", "image", "--api", api_addr,
+    ]))
+""")
+
+
+@pytest.mark.slow
+def test_multihost_image_serving(tmp_path, tiny_config):
+    """Multi-host SD (round-4 verdict item 6): the UNet batch spans BOTH
+    processes' devices (4-device dp mesh over a 2-process cluster), the
+    coordinator serves /api/v1/image, the follower replays generation
+    ops — and the pixels equal the single-process unsharded oracle."""
+    import base64
+    import io
+    import signal
+    import time
+
+    # oracle: unsharded tiny SD in this process, same seeds as tiny_load
+    import jax
+    from PIL import Image
+
+    from cake_tpu.args import ImageGenerationArgs
+    from cake_tpu.models.sd.clip import init_clip_params
+    from cake_tpu.models.sd.config import tiny_sd_config
+    from cake_tpu.models.sd.sd import SDGenerator, SimpleClipTokenizer
+    from cake_tpu.models.sd.unet import init_unet_params
+    from cake_tpu.models.sd.vae import init_vae_params
+
+    cfg = tiny_sd_config()
+    oracle = SDGenerator(cfg, {
+        "clip": init_clip_params(cfg.clip, jax.random.PRNGKey(0)),
+        "unet": init_unet_params(cfg.unet, jax.random.PRNGKey(1)),
+        "vae": init_vae_params(cfg.vae, jax.random.PRNGKey(2)),
+    }, [SimpleClipTokenizer(cfg.clip.vocab_size)])
+    body = {"image_prompt": "a robot", "sd_n_steps": 2,
+            "sd_num_samples": 1, "sd_seed": 7, "sd_guidance_scale": 7.5}
+    want = []
+    oracle.generate_image(ImageGenerationArgs.from_json(body),
+                          lambda imgs: want.extend(imgs))
+
+    port, api_port = _free_port(), _free_port()
+    api_addr = f"127.0.0.1:{api_port}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", IMAGE_WORKER, str(i), str(port), api_addr],
+        stdout=open(tmp_path / f"img_p{i}.log", "w"),
+        stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)))
+        for i in range(2)]
+    base = f"http://{api_addr}"
+    try:
+        deadline = time.monotonic() + 300
+        up = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                logs = [(tmp_path / f"img_p{i}.log").read_text()[-3000:]
+                        for i in range(2)]
+                raise AssertionError(
+                    f"worker died during startup:\n{logs[0]}\n---\n{logs[1]}")
+            try:
+                if _http_json("GET", base + "/api/v1/health",
+                              timeout=2.0)["status"] == "ok":
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.5)
+        assert up, "API never came up"
+
+        resp = _http_json("POST", base + "/api/v1/image", body,
+                          timeout=600.0)
+        assert len(resp["images"]) == 1
+        got = base64.b64decode(resp["images"][0])
+        import numpy as np
+        np.testing.assert_array_equal(
+            np.asarray(Image.open(io.BytesIO(want[0]))),
+            np.asarray(Image.open(io.BytesIO(got))))
+
+        # clean shutdown: stop op releases the image follower
+        procs[0].send_signal(signal.SIGTERM)
+        out_deadline = time.monotonic() + 120
+        for p in procs:
+            p.wait(timeout=max(1, out_deadline - time.monotonic()))
+        assert procs[1].returncode == 0, (
+            (tmp_path / "img_p1.log").read_text()[-3000:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
