@@ -107,6 +107,12 @@ class Args:
     # prompt's rendered head once (serve/engine.register_prefix), so
     # conversations sharing it prefill only their own turns
     auto_prefix: bool = False
+    # --kv-pages N: paged KV for the serving engine — KV lives in a pool
+    # of N pages of --kv-page-size tokens; slot admission is gated by
+    # free pages, so resident KV is bounded by the pool instead of
+    # max_slots x max_seq_len (models/llama/paged.py)
+    kv_pages: Optional[int] = None
+    kv_page_size: int = 128
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):

@@ -134,6 +134,8 @@ class Master:
             # pass through unconditionally: the engine's own step_fns
             # guard warns when a pipelined path ignores the knob
             prefill_chunk=getattr(self.args, "prefill_chunk", None),
+            kv_pages=getattr(self.args, "kv_pages", None),
+            kv_page_size=getattr(self.args, "kv_page_size", 128),
             **kwargs,
         )
 

@@ -195,6 +195,8 @@ class InferenceEngine:
         draft_params=None,
         draft_config=None,
         spec_gamma: int = 4,
+        kv_pages: Optional[int] = None,
+        kv_page_size: int = 128,
     ):
         self.config = config
         self.params = params
@@ -331,10 +333,57 @@ class InferenceEngine:
             # collapse; keep the caches aligned instead
             self._prefix_capable = False
             self.d_rope = RopeTables.create(draft_config, max_seq_len)
+        # paged KV (round-5, the 32-slot HBM-thrash fix): KV lives in a
+        # shared pool of kv_pages fixed-size pages; slots map position
+        # ranges through a table and the page ALLOCATOR gates admission,
+        # so resident KV is bounded by the pool, not slots x max_seq_len
+        # (models/llama/paged.py).
+        self.paged = kv_pages is not None
+        if self.paged:
+            if kv_pages < 1 or kv_page_size < 1:
+                raise ValueError(
+                    f"--kv-pages {kv_pages} / --kv-page-size "
+                    f"{kv_page_size} must be >= 1")
+            if step_fns is not None or self.ring or self._spec:
+                raise ValueError(
+                    "--kv-pages requires the built-in dense single-"
+                    "device path (no topology/ring/speculative mode)")
+            if cache is not None:
+                raise ValueError(
+                    "--kv-pages builds its own page pool; a pre-placed "
+                    "cache= cannot apply")
+            if prefill_chunk is not None:
+                log.warning("prefill_chunk ignored with --kv-pages "
+                            "(paged prompts prefill whole-window)")
+                prefill_chunk = None
+            if self._decode_scan > 1:
+                log.warning("decode_scan ignored with --kv-pages "
+                            "(no paged scan variant yet)")
+                self._decode_scan = 1
+            self._prefix_capable = False
+            from cake_tpu.models.llama.paged import (
+                PageAllocator, PagedKVCache, decode_step_ragged_paged,
+                prefill_slot_paged,
+            )
+            self._prefill_slot = prefill_slot_paged
+            self._decode_step = decode_step_ragged_paged
+            self._prefill_chunk_step = None
+            self._pager = PageAllocator(kv_pages, kv_page_size)
+            self._slot_pages: dict = {}
+            self.cache = PagedKVCache.create(
+                config, max_slots, kv_pages, kv_page_size, max_seq_len,
+                dtype=cache_dtype)
+            log.info("paged KV: %d pages x %d tokens (%.2f GiB pool; "
+                     "dense %d-slot equivalent would be %.2f GiB)",
+                     kv_pages, kv_page_size,
+                     self.cache.memory_bytes() / 2**30, max_slots,
+                     self.cache.memory_bytes() / 2**30
+                     * max_slots * max_seq_len / (kv_pages * kv_page_size))
         self.prefill_chunk = prefill_chunk
         cache_len = (config.sliding_window if self.ring else max_seq_len)
-        self.cache = cache if cache is not None else KVCache.create(
-            config, max_slots, cache_len, dtype=cache_dtype)
+        if not self.paged:
+            self.cache = cache if cache is not None else KVCache.create(
+                config, max_slots, cache_len, dtype=cache_dtype)
         if self._spec:
             self.d_cache = KVCache.create(draft_config, max_slots,
                                           cache_len, dtype=cache_dtype)
@@ -442,11 +491,12 @@ class InferenceEngine:
         program. Reference behavior analog: the master streaming work to
         workers (worker.rs:289-303). Must be called before start()."""
         from cake_tpu.models.llama.model import prefill_slot as _builtin
-        if self._prefill_slot is _builtin:
+        if self._prefill_slot is _builtin or self.paged:
             raise ValueError(
                 "multi-host control requires pipelined step fns (a mesh "
-                "spanning processes); the single-device engine has no "
-                "cross-process computation to coordinate")
+                "spanning processes); the single-device engine (incl. "
+                "--kv-pages) has no cross-process computation to "
+                "coordinate")
         if self._prefixes:
             raise ValueError(
                 "multi-host control cannot be attached after prefix "
@@ -572,6 +622,15 @@ class InferenceEngine:
                 f"prompt length {len(ids)} exceeds max_seq_len "
                 f"{self.max_seq_len}")
         max_new = min(max_new_tokens, self.max_seq_len - len(ids))
+        if self.paged and (self._pager.pages_for(len(ids) + max_new)
+                           > self.cache.n_pages):
+            # can NEVER be admitted (need exceeds the whole pool) —
+            # fail fast instead of requeueing forever
+            raise ValueError(
+                f"request needs "
+                f"{self._pager.pages_for(len(ids) + max_new)} kv pages; "
+                f"the pool has {self.cache.n_pages} total (raise "
+                "--kv-pages or lower max_tokens)")
         with self._rid_lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -866,6 +925,7 @@ class InferenceEngine:
             self.scheduler.cancel(rid)
             if req.slot >= 0 and self._slot_req[req.slot] is req:
                 self._slot_req[req.slot] = None
+                self._release_slot_pages(req.slot)
             req.finish_t = time.perf_counter()
             req.done.set()
 
@@ -899,6 +959,14 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            if getattr(self, "_page_starved", False):
+                # a page-starved prefill was requeued last iteration; if
+                # nothing can retire pages this round (no decode work),
+                # back off instead of spin-planning the same admission
+                self._page_starved = False
+                if not decode_plan:
+                    self._wake.wait(timeout=0.02)
+                    self._wake.clear()
             try:
                 for rid, slot in prefill_plan:
                     self._do_prefill(rid, slot)
@@ -974,6 +1042,19 @@ class InferenceEngine:
         self._steps[:] = 0
 
     def _fresh_cache(self) -> KVCache:
+        if self.paged:
+            from cake_tpu.models.llama.paged import (
+                PageAllocator, PagedKVCache,
+            )
+            # the rebuild loses every slot's KV; reset the allocator and
+            # table bookkeeping with it
+            self._pager = PageAllocator(self.cache.n_pages,
+                                        self.cache.page_size)
+            self._slot_pages = {}
+            return PagedKVCache.create(
+                self.config, self.max_slots, self.cache.n_pages,
+                self.cache.page_size, self.max_seq_len,
+                dtype=self._cache_dtype)
         fresh = KVCache.create(self.config, self.max_slots,
                                self.cache.max_seq_len
                                if self.ring else self.max_seq_len,
@@ -983,6 +1064,58 @@ class InferenceEngine:
             v=jax.device_put(fresh.v, self._cache_shardings.v),
         )
 
+    def _release_slot_pages(self, slot: int) -> None:
+        if not self.paged or slot < 0:
+            return
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self._pager.free(pages)
+
+    def _alloc_slot_pages(self, req: _Request, slot: int) -> bool:
+        """Admission by pages: map the slot's table row when the pool
+        can cover prompt + budget; otherwise requeue the request (it is
+        planned again as retiring requests free pages).
+
+        FIFO fairness: a page-starved request becomes the BLOCKING head
+        — younger requests requeue behind it instead of being admitted
+        past it, or a steady stream of small requests could starve a
+        large one forever (the requeue path re-enters the scheduler's
+        FIFO at the tail, preserving relative order across cycles)."""
+        from cake_tpu.models.llama.paged import table_set_slot
+        blocked = getattr(self, "_page_blocked_rid", None)
+        if blocked is not None and blocked not in self._requests:
+            blocked = self._page_blocked_rid = None  # cancelled/failed
+        if blocked is not None and req.rid != blocked:
+            return self._requeue_for_pages(req, slot, starved=False)
+        need = len(req.prompt_ids) + req.max_new_tokens
+        pages = self._pager.alloc(need)
+        if pages is not None:
+            self._slot_pages[slot] = pages
+            self.cache = self.cache._replace(
+                table=table_set_slot(self.cache.table, slot, pages))
+            if req.rid == blocked:
+                self._page_blocked_rid = None
+            return True
+        return self._requeue_for_pages(req, slot, starved=True)
+
+    def _requeue_for_pages(self, req: _Request, slot: int,
+                           starved: bool) -> bool:
+        self.scheduler.cancel(req.rid)
+        self._slot_req[slot] = None
+        req.slot = -1
+        self._page_starved = True
+        if starved and getattr(self, "_page_blocked_rid", None) is None:
+            self._page_blocked_rid = req.rid
+        if not self.scheduler.submit(req.rid, len(req.prompt_ids),
+                                     req.max_new_tokens):
+            req.error = RuntimeError(
+                "kv page pool exhausted and admission queue full")
+            self._requests.pop(req.rid, None)
+            if getattr(self, "_page_blocked_rid", None) == req.rid:
+                self._page_blocked_rid = None
+            req.done.set()
+        return False
+
     def _do_prefill(self, rid: int, slot: int) -> None:
         req = self._requests.get(rid)
         if req is None:  # cancelled between plan and here
@@ -991,6 +1124,8 @@ class InferenceEngine:
         t0 = time.perf_counter()
         req.slot = slot
         self._slot_req[slot] = req
+        if self.paged and not self._alloc_slot_pages(req, slot):
+            return   # pool exhausted: requeued (or failed) inside
         ids = req.prompt_ids
         hit = (self._match_and_validate_prefix(ids)
                if self._prefix_capable else None)
@@ -1497,6 +1632,7 @@ class InferenceEngine:
         if finished:
             req.finish_t = now
             self._slot_req[req.slot] = None
+            self._release_slot_pages(req.slot)
             self._requests.pop(req.rid, None)
             self.stats.requests_completed += 1
             req.done.set()
@@ -1527,6 +1663,7 @@ class InferenceEngine:
                 self.scheduler.cancel(rid)
                 if req.slot >= 0:
                     self._slot_req[req.slot] = None
+                    self._release_slot_pages(req.slot)
                 self._requests.pop(rid, None)
                 req.done.set()
 
