@@ -298,6 +298,22 @@ class ApiServer:
                 ("cake_engine_decode_tokens_per_second", "gauge",
                  round(st.decode_tokens_per_s, 2)),
             ]
+            if getattr(self.engine, "_spec", False):
+                pairs += [
+                    ("cake_engine_spec_proposed_total", "counter",
+                     st.spec_proposed),
+                    ("cake_engine_spec_accepted_total", "counter",
+                     st.spec_accepted),
+                    ("cake_engine_spec_acceptance", "gauge",
+                     round(st.spec_acceptance, 4)),
+                ]
+            if getattr(self.engine, "paged", False):
+                pairs += [
+                    ("cake_engine_kv_pages_total", "gauge",
+                     self.engine.cache.n_pages),
+                    ("cake_engine_kv_pages_free", "gauge",
+                     self.engine._pager.free_pages),
+                ]
             for name, typ, val in pairs:
                 lines.append(f"# TYPE {name} {typ}")
                 lines.append(f"{name} {val}")

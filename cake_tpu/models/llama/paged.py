@@ -141,63 +141,46 @@ def write_prompt_pages(pool_k, pool_v, k, v, table_row):
     dense padding. UNMAPPED pages (id -1) must not be written — page 0
     would alias another slot — so those windows write their page's
     current contents back (masked write)."""
-    P = pool_k.shape[1]
+    N, P = pool_k.shape[0], pool_k.shape[1]
     S = k.shape[1]
+    KV, hd = k.shape[2], k.shape[3]
     n_win = -(-S // P)
     pad = n_win * P - S
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-
-    def body(i, pools):
-        pk, pv = pools
-        page = table_row[i]
-        valid = page >= 0
-        idx = jnp.where(valid, page, 0)
-        kw = lax.dynamic_slice_in_dim(k, i * P, P, axis=1)[0]
-        vw = lax.dynamic_slice_in_dim(v, i * P, P, axis=1)[0]
-        cur_k = lax.dynamic_index_in_dim(pk, idx, axis=0,
-                                         keepdims=False)
-        cur_v = lax.dynamic_index_in_dim(pv, idx, axis=0,
-                                         keepdims=False)
-        pk = lax.dynamic_update_index_in_dim(
-            pk, jnp.where(valid, kw.astype(pk.dtype), cur_k), idx, axis=0)
-        pv = lax.dynamic_update_index_in_dim(
-            pv, jnp.where(valid, vw.astype(pv.dtype), cur_v), idx, axis=0)
-        return pk, pv
-
-    return lax.fori_loop(0, n_win, body, (pool_k, pool_v))
+    # one parallel scatter: unmapped windows route to the out-of-bounds
+    # index N and mode="drop" skips them (no dummy-page read-back)
+    pages = table_row[:n_win]
+    idx = jnp.where(pages >= 0, pages, N)
+    kw = k[0].reshape(n_win, P, KV, hd)
+    vw = v[0].reshape(n_win, P, KV, hd)
+    pk = pool_k.at[idx].set(kw.astype(pool_k.dtype), mode="drop")
+    pv = pool_v.at[idx].set(vw.astype(pool_v.dtype), mode="drop")
+    return pk, pv
 
 
 def update_pool_per_row(pool_k, pool_v, k, v, pos, active, table):
     """Write one decode token per row into its page (per layer).
 
     pool_k/v: [N_pages, page, KV, hd]; k/v: [B, 1, KV, hd]; pos: [B];
-    active: [B] bool; table: [slots(=B), max_pages]. Inactive rows (and
-    rows whose position lands on an unmapped page) leave the pool
-    untouched by writing their page's current contents back."""
-    P = pool_k.shape[1]
+    active: [B] bool; table: [slots(=B), max_pages]. One vectorized
+    scatter (distinct slots own distinct pages, so the B targets are
+    disjoint); inactive rows — and rows whose position lands on an
+    unmapped page — route to the out-of-bounds index and mode="drop"
+    skips them."""
+    N, P = pool_k.shape[0], pool_k.shape[1]
     B = k.shape[0]
-
-    def body(i, pools):
-        pk, pv = pools
-        page = table[i, pos[i] // P]
-        off = pos[i] % P
-        valid = jnp.logical_and(active[i], page >= 0)
-        idx = jnp.where(valid, page, 0)
-        cur_k = lax.dynamic_slice(pk, (idx, off, 0, 0),
-                                  (1, 1) + pk.shape[2:])
-        cur_v = lax.dynamic_slice(pv, (idx, off, 0, 0),
-                                  (1, 1) + pv.shape[2:])
-        nk = jnp.where(valid, k[i, 0].astype(pk.dtype)[None, None],
-                       cur_k)
-        nv = jnp.where(valid, v[i, 0].astype(pv.dtype)[None, None],
-                       cur_v)
-        pk = lax.dynamic_update_slice(pk, nk, (idx, off, 0, 0))
-        pv = lax.dynamic_update_slice(pv, nv, (idx, off, 0, 0))
-        return pk, pv
-
-    return lax.fori_loop(0, B, body, (pool_k, pool_v))
+    rows = jnp.arange(B)
+    pages = table[rows, pos // P]
+    offs = pos % P
+    valid = jnp.logical_and(active, pages >= 0)
+    idx = jnp.where(valid, pages, N)
+    pk = pool_k.at[idx, offs].set(k[:, 0].astype(pool_k.dtype),
+                                  mode="drop")
+    pv = pool_v.at[idx, offs].set(v[:, 0].astype(pool_v.dtype),
+                                  mode="drop")
+    return pk, pv
 
 
 def paged_attention(q, pool_k, pool_v, table, pos):
@@ -304,15 +287,13 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
     from cake_tpu.ops.attention import causal_mask, gqa_attention
     from cake_tpu.ops.norms import rms_norm
     from cake_tpu.ops.quant import qmatmul
-    from cake_tpu.ops.rope import rope_rows
+    from cake_tpu.ops.rope import apply_rope, rope_rows
 
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, jnp.int32(0), S)
     table_row = jnp.take(cache.table, slot, axis=0)
     mask = causal_mask(S)
-
-    from cake_tpu.ops.rope import apply_rope
 
     def body(h, xs):
         lp, pk, pv = xs
