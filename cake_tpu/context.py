@@ -134,10 +134,14 @@ class Context:
             # (prompt sharded over chips, optionally with Megatron head
             # sharding within each shard and/or layer ranges over stages
             # for models too big for one chip's HBM)
-            if plan.dp > 1:
+            if plan.dp > 1 and plan.stages > 1:
                 raise ValueError(
-                    "--sp does not compose with --dp in this release; "
-                    "combine with --tp and/or topology stages")
+                    "--sp composes with --dp OR topology stages, not "
+                    "both in one mesh")
+            if plan.dp > 1 and a.batch_size % plan.dp != 0:
+                raise ValueError(
+                    f"--batch-size {a.batch_size} must be divisible by "
+                    f"--dp {plan.dp}")
             if plan.tp > 1 and a.quant == "int4":
                 # int4 group-wise weights CAN shard their contract dim
                 # over tp (wo/w_down are contract-sharded Megatron-style)
@@ -171,12 +175,13 @@ class Context:
             from cake_tpu.parallel.context_parallel import SPGeneratorForward
             devices = jax.devices()
             tp = plan.tp
+            dp = plan.dp
             stages = plan.stages
-            need = stages * a.sp * tp
+            need = stages * dp * a.sp * tp
             if need > len(devices):
                 raise ValueError(
-                    f"stages {stages} x --sp {a.sp} x --tp {tp} needs "
-                    f"{need} devices, have {len(devices)}")
+                    f"stages {stages} x --dp {dp} x --sp {a.sp} x --tp "
+                    f"{tp} needs {need} devices, have {len(devices)}")
             if tp > 1 and cfg.num_key_value_heads % tp != 0:
                 raise ValueError(
                     f"--tp {tp} must divide kv heads "
@@ -209,20 +214,27 @@ class Context:
                     params = self._maybe_quantize(params)
                 params = place_sp_stage_params(mesh, cfg, params,
                                                tp=tp > 1)
-            elif tp > 1:
-                mesh = Mesh(np.array(devices[:a.sp * tp]).reshape(a.sp, tp),
-                            ("sp", "tp"))
-                # place the block params on their tp shards up front so
-                # every sp call doesn't pay a reshard from replicated
-                from cake_tpu.parallel.context_parallel import (
-                    place_sp_params,
-                )
-                params = place_sp_params(mesh, cfg, params, tp=True)
+            elif dp > 1 or tp > 1:
+                # ("dp",)? x "sp" x ("tp",)? — batch over dp groups, each
+                # running its own sp ring (collectives name "sp"/"tp"
+                # only, so shard_map scopes them per group)
+                shape = (((dp,) if dp > 1 else ())
+                         + (a.sp,) + ((tp,) if tp > 1 else ()))
+                axes = ((("dp",) if dp > 1 else ())
+                        + ("sp",) + (("tp",) if tp > 1 else ()))
+                mesh = Mesh(np.array(devices[:need]).reshape(shape), axes)
+                if tp > 1:
+                    # place the block params on their tp shards up front
+                    # so every sp call doesn't pay a reshard
+                    from cake_tpu.parallel.context_parallel import (
+                        place_sp_params,
+                    )
+                    params = place_sp_params(mesh, cfg, params, tp=True)
             else:
                 mesh = Mesh(np.array(devices[:a.sp]), ("sp",))
             fwd = SPGeneratorForward(
                 mesh, cfg, ctx_len, max_seq - ctx_len, kv_dtype=kv_dtype,
-                tp=tp > 1, params=params, stages=stages)
+                tp=tp > 1, params=params, stages=stages, dp=dp > 1)
             # placeholder cache: the SP prefill allocates its own sharded
             # SPCache; the generator's default dense [L,B,max_seq,...]
             # buffer would be dead weight at exactly the context lengths

@@ -253,12 +253,12 @@ def run_blocks_ragged_paged(blocks, x, cache: PagedKVCache, pos, active,
     return x, PagedKVCache(k_new, v_new, cache.table)
 
 
-@_partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def decode_step_ragged_paged(params, tokens, pos, active,
-                             cache: PagedKVCache, rope,
-                             config: LlamaConfig):
-    """decode_step_ragged signature over a paged cache — the engine's
-    drop-in decode step fn for --kv-pages serving."""
+def forward_ragged_paged(params, tokens, cache: PagedKVCache, pos,
+                         active, rope, config: LlamaConfig):
+    """model.forward_ragged's signature over a paged cache — un-jitted,
+    so serve.engine.make_decode_scan can build the K-step paged decode
+    scan from it (dispatch amortization works for paged serving exactly
+    like dense)."""
     from cake_tpu.models.llama.model import rope_rows_per_row
     from cake_tpu.ops.norms import rms_norm
     from cake_tpu.ops.quant import qmatmul
@@ -270,6 +270,16 @@ def decode_step_ragged_paged(params, tokens, pos, active,
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     logits = qmatmul(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, cache
+
+
+@_partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step_ragged_paged(params, tokens, pos, active,
+                             cache: PagedKVCache, rope,
+                             config: LlamaConfig):
+    """decode_step_ragged signature over a paged cache — the engine's
+    drop-in decode step fn for --kv-pages serving."""
+    return forward_ragged_paged(params, tokens, cache, pos, active,
+                                rope, config)
 
 
 @_partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))

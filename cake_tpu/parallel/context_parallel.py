@@ -337,14 +337,19 @@ def sp_block_specs(config: LlamaConfig, tp: bool, params=None):
 
 def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
                     tail_len: int, kv_dtype=None, tp: bool = False,
-                    params=None):
+                    params=None, dp: bool = False):
     """Build (sp_prefill, sp_decode) jitted over the mesh's "sp" axis.
 
     tp: the mesh also carries a "tp" axis — attention/ffn heads shard
     Megatron-style within each sequence shard (block_skeleton's tp
     psums), so ring attention rotates KV chunks of LOCAL heads: sp x tp
-    composes sequence and tensor parallelism on one mesh (round-3
-    verdict #6; the stage x sp composition remains future work).
+    composes sequence and tensor parallelism on one mesh. dp: the mesh
+    also carries a "dp" axis — the BATCH shards over it and each dp
+    group runs its own sp ring (no cross-group collectives: the ring
+    ppermutes and the last-token psum name only "sp", so shard_map
+    scopes them per group). Long-context batched serving: dp x sp(x tp)
+    on one mesh. (stage x sp lives in parallel/sp_pipeline; stage x dp
+    remains excluded.)
 
     kv_dtype: storage dtype for the SPCache (fp8 halves the sharded
     long-context cache — the dominant allocation of this mode); values
@@ -393,22 +398,27 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
         logits = qmatmul(x[:, -1], lm_head).astype(jnp.float32)
         return logits, tk_new, tv_new
 
-    ctx_spec = P(None, None, "sp", tp_axis, None)
-    tail_spec = P(None, None, None, tp_axis, None) if tp else P()
+    dp_axis = "dp" if dp else None
+    ctx_spec = P(None, dp_axis, "sp", tp_axis, None)
+    tail_spec = (P(None, dp_axis, None, tp_axis, None) if (tp or dp)
+                 else P())
+    batch = P(dp_axis)                       # plen / logits rows
     rep = P()
     blocks_spec = sp_block_specs(config, tp, params)
 
     prefill_sm = jax.shard_map(
         prefill_body, mesh=mesh,
-        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep, rep, rep),
-        out_specs=(rep, ctx_spec, ctx_spec),
+        in_specs=(blocks_spec, rep, rep, rep, P(dp_axis, "sp"), batch,
+                  rep, rep),
+        out_specs=(batch, ctx_spec, ctx_spec),
         check_vma=False,
     )
     decode_sm = jax.shard_map(
         decode_body, mesh=mesh,
-        in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
-                  ctx_spec, ctx_spec, tail_spec, tail_spec, rep, rep),
-        out_specs=(rep, tail_spec, tail_spec),
+        in_specs=(blocks_spec, rep, rep, rep, P(dp_axis, None), rep,
+                  batch, ctx_spec, ctx_spec, tail_spec, tail_spec, rep,
+                  rep),
+        out_specs=(batch, tail_spec, tail_spec),
         check_vma=False,
     )
 
@@ -496,11 +506,13 @@ class SPGeneratorForward:
 
     def __init__(self, mesh: Mesh, config: LlamaConfig, ctx_len: int,
                  tail_len: int, kv_dtype=None, tp: bool = False,
-                 params=None, stages: int = 1):
+                 params=None, stages: int = 1, dp: bool = False):
         if ctx_len % mesh.shape["sp"] != 0:
             raise ValueError(
                 f"sp context window {ctx_len} must divide over sp="
                 f"{mesh.shape['sp']}")
+        if dp and stages > 1:
+            raise ValueError("sp x dp does not compose with stages")
         self.ctx_len = ctx_len
         self.tail_len = tail_len
         # bounds the generator enforces: inclusive prompt length at encode
@@ -516,12 +528,13 @@ class SPGeneratorForward:
             # sequence over "sp" (parallel/sp_pipeline) — same call
             # contract, so everything below is factory-agnostic
             from cake_tpu.parallel.sp_pipeline import make_sp_stage_forward
-            factory = make_sp_stage_forward
+            self._prefill, self._decode = make_sp_stage_forward(
+                mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype,
+                tp=tp, params=params)
         else:
-            factory = make_sp_forward
-        self._prefill, self._decode = factory(
-            mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype, tp=tp,
-            params=params)
+            self._prefill, self._decode = make_sp_forward(
+                mesh, config, ctx_len, tail_len, kv_dtype=kv_dtype,
+                tp=tp, params=params, dp=dp)
 
     def __call__(self, params, tokens, cache, pos, rope,
                  last_idx=None, is_prefill: bool = False):

@@ -356,10 +356,6 @@ class InferenceEngine:
                 log.warning("prefill_chunk ignored with --kv-pages "
                             "(paged prompts prefill whole-window)")
                 prefill_chunk = None
-            if self._decode_scan > 1:
-                log.warning("decode_scan ignored with --kv-pages "
-                            "(no paged scan variant yet)")
-                self._decode_scan = 1
             self._prefix_capable = False
             from cake_tpu.models.llama.paged import (
                 PageAllocator, PagedKVCache, decode_step_ragged_paged,
@@ -367,6 +363,7 @@ class InferenceEngine:
             )
             self._prefill_slot = prefill_slot_paged
             self._decode_step = decode_step_ragged_paged
+            self._decode_scan_impl = _decode_scan_paged
             self._prefill_chunk_step = None
             self._pager = PageAllocator(kv_pages, kv_page_size)
             self._slot_pages: dict = {}
@@ -1840,3 +1837,15 @@ def _ring_forward_ragged(params, tokens, cache, pos, active, rope, config):
 
 
 _decode_scan_ring = make_decode_scan(_ring_forward_ragged)
+
+
+def _paged_forward_ragged(params, tokens, cache, pos, active, rope,
+                          config):
+    from cake_tpu.models.llama.paged import forward_ragged_paged
+    return forward_ragged_paged(params, tokens, cache, pos, active, rope,
+                                config)
+
+
+# module-level like its dense/ring siblings so the jit cache is shared
+# across engine instances (restart flows, test suites)
+_decode_scan_paged = make_decode_scan(_paged_forward_ragged)
