@@ -401,8 +401,11 @@ def run_sd_tier(name: str, version: str, height: int | None = None,
 
 def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
                   gamma: int = 4, prompt_len: int = 128,
-                  gen_tokens: int = 128) -> dict:
-    """Speculative decoding vs target-only: acceptance rate + tok/s."""
+                  gen_tokens: int = 128, quant="int8") -> dict:
+    """Speculative decoding vs target-only: acceptance rate + tok/s.
+
+    quant applies to the TARGET only (8B bf16 + draft would blow the
+    16 GiB v5e HBM: ~15 + 2.5 GiB; int8 target + bf16 draft fits)."""
     from functools import partial
 
     import jax
@@ -416,7 +419,9 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
     dev = jax.devices()[0]
     log(f"device: {dev.platform}/{dev.device_kind}")
     t_cfg, d_cfg = make_config(target), make_config(draft)
-    t_params = jax.jit(partial(init_params, t_cfg))(jax.random.PRNGKey(0))
+    t_init, t_desc = _init_fn(quant)
+    log(f"target weights: {t_desc}")
+    t_params = jax.jit(partial(t_init, t_cfg))(jax.random.PRNGKey(0))
     d_params = jax.jit(partial(init_params, d_cfg))(jax.random.PRNGKey(1))
     jax.block_until_ready((t_params, d_params))
     sampling = SamplingConfig(temperature=0.0, repeat_penalty=1.0)

@@ -340,8 +340,11 @@ class SpeculativeGenerator:
             self.rng,
             jnp.float32(self.sampling.temperature or 1.0),
             self.config, self.draft_config, self.gamma, self._greedy)
-        n = int(n_emit[0])
-        self._buffer.extend(int(t) for t in np.asarray(out[0, :n]))
+        # one batched fetch (a remote-dispatch tunnel charges ~100ms per
+        # round-trip; int(n_emit) then asarray(out) would pay it twice)
+        n_emit_h, out_h = jax.device_get((n_emit, out))
+        n = int(n_emit_h[0])
+        self._buffer.extend(int(t) for t in out_h[0, :n])
         self.proposed += self.gamma
         self.accepted += n - 1
         self.index_pos += n
@@ -381,10 +384,11 @@ class SpeculativeGenerator:
                 jnp.int32(pos), self.rope, self.d_rope, rng,
                 jnp.float32(self.sampling.temperature or 1.0),
                 self.config, self.draft_config, self.gamma, self._greedy)
-            n = int(n_emit[0])
+            n_emit_h, burst_h = jax.device_get((n_emit, burst))
+            n = int(n_emit_h[0])
             self.proposed += self.gamma
             self.accepted += n - 1
-            out.extend(int(t) for t in np.asarray(burst[0, :n]))
+            out.extend(int(t) for t in burst_h[0, :n])
             pos += n
         # persist the advanced PRNG stream: repeated sampled calls must
         # differ, matching LlamaGenerator.generate_on_device

@@ -402,6 +402,7 @@ class InferenceEngine:
         self._top_p = np.ones(B, np.float32)
         self._penalty = np.full(B, self.defaults.repeat_penalty, np.float32)
         self._ring = jnp.full((B, self.defaults.repeat_last_n), -1, jnp.int32)
+        self._key_seed = seed                        # for _reset_after_error
         root = jax.random.PRNGKey(seed)
         self._keys = jax.random.split(root, B)       # [B] keys
         self._slot_req: List[Optional[_Request]] = [None] * B
@@ -550,9 +551,13 @@ class InferenceEngine:
                     self._decode_device(op["rows"],
                                         n_top=op.get("n_top", 0))
                 elif kind == "decode_scan":
+                    budget = np.asarray(
+                        op.get("budget", [op["n"]] * self.max_slots),
+                        np.int32)
                     toks, _lps, _ti, _tl = self._decode_scan_device(
-                        op["rows"], op["n"], op["n_top"])
-                    self._finalize_scan_mirrors(op["rows"], op["n"], toks)
+                        op["rows"], op["n"], op["n_top"], budget=budget)
+                    self._finalize_scan_mirrors(op["rows"], op["n"], toks,
+                                                budget)
                 elif kind == "register_prefix":
                     ids = list(op["ids"])
                     P = len(ids)
@@ -912,6 +917,14 @@ class InferenceEngine:
                                     or not self._thread.is_alive()):
             self._drain_cancellations()
 
+    def _cancel_pending(self) -> bool:
+        with self._rid_lock:
+            return bool(self._cancel_q)
+
+    def _commands_pending(self) -> bool:
+        with self._rid_lock:
+            return bool(self._cmd_q)
+
     def _drain_cancellations(self) -> None:
         with self._rid_lock:
             rids, self._cancel_q = self._cancel_q, []
@@ -972,7 +985,9 @@ class InferenceEngine:
                         self._do_decode_spec(decode_plan)
                     else:
                         n = self._scan_steps_for(decode_plan)
-                        if n > 1:
+                        if n > 1 and not self._multihost:
+                            self._decode_burst(decode_plan, n)
+                        elif n > 1:
                             self._do_decode_scan(decode_plan, n)
                         else:
                             self._do_decode(decode_plan)
@@ -1026,9 +1041,9 @@ class InferenceEngine:
                 self.stats.last_error = f"{type(e).__name__}: {e}"
 
     def _reset_after_error(self) -> None:
-        # the jitted steps donate the cache buffer; after a failed call it
-        # may already be deleted — rebuild so the engine survives
-        # (transient OOM/XLA error must not brick serving)
+        # the jitted steps donate the cache/keys/ring buffers; after a
+        # failed call they may already be deleted — rebuild so the engine
+        # survives (transient OOM/XLA error must not brick serving)
         self.cache = self._fresh_cache()
         if self._spec:
             self.d_cache = KVCache.create(
@@ -1037,6 +1052,11 @@ class InferenceEngine:
         self._pos[:] = 0
         self._last_tok[:] = 0
         self._steps[:] = 0
+        B = self.max_slots
+        self._ring = jnp.full((B, self.defaults.repeat_last_n), -1,
+                              jnp.int32)
+        self._keys = jax.random.split(
+            jax.random.PRNGKey(self._key_seed), B)
 
     def _fresh_cache(self) -> KVCache:
         if self.paged:
@@ -1366,9 +1386,13 @@ class InferenceEngine:
                 self.config, self.draft_config, g, greedy)
             self._keys = self._keys.at[slot].set(key)
             pending.append((req, slot, out, n_emit))
-        for req, slot, out, n_emit in pending:
-            n = int(n_emit[0])             # first host sync of the batch
-            toks = [int(t) for t in np.asarray(out[0, :n])]
+        # ONE batched fetch for every slot's round: per-slot int()/
+        # np.asarray() would pay 2 host<->device round-trips per slot
+        # (~100ms each over a remote-dispatch tunnel, measured)
+        fetched = jax.device_get([(o, ne) for _, _, o, ne in pending])
+        for (req, slot, _, _), (out_h, n_emit_h) in zip(pending, fetched):
+            n = int(n_emit_h[0])
+            toks = [int(t) for t in out_h[0, :n]]
             self.stats.spec_proposed += g
             self.stats.spec_accepted += n - 1
             pos0 = int(self._pos[slot])
@@ -1453,113 +1477,226 @@ class InferenceEngine:
     def _scan_steps_for(self, decode_plan) -> int:
         """Fixed scan length when multi-step decode is safe right now:
         nobody queued (a waiting request must not see its admission
-        delayed by a whole scan), every active row has >= K tokens of
-        budget left (no overshoot past max_new_tokens), and K more cache
-        writes fit every row's window."""
+        delayed by a whole scan) and K more cache writes fit every
+        row's window. Rows with under K tokens of max_new_tokens budget
+        are fine — the device program freezes each row at its per-row
+        budget (make_decode_scan), so the scan cannot overshoot."""
         n = self._decode_scan
         if n <= 1 or self.scheduler.queue_depth > 0:
             return 1
+        max_left = 0
         for _, slot in decode_plan:
             req = self._slot_req[slot]
             if req is None:
                 return 1
-            if req.max_new_tokens - len(req.out_tokens) < n:
-                return 1
+            max_left = max(max_left,
+                           req.max_new_tokens - len(req.out_tokens))
             if self._pos[slot] + n >= self.max_seq_len:
                 return 1
+        # per-row budget freeze (make_decode_scan) makes a scan safe for
+        # rows with < n budget; only when EVERY row is on its last token
+        # is the single-step program the cheaper dispatch
+        if max_left <= 1:
+            return 1
         return n
 
+    def _scan_budget(self, decode_plan, n: int,
+                     shipped: Optional[dict] = None) -> np.ndarray:
+        """Per-row token allowance for one n-step scan: the request's
+        remaining max_new_tokens budget, minus tokens already dispatched
+        in not-yet-fetched chained scans (`shipped`), capped at n. Rows
+        with 0 allowance are frozen by the device program."""
+        budget = np.zeros(self.max_slots, np.int32)
+        for _, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            left = req.max_new_tokens - len(req.out_tokens)
+            if shipped:
+                left -= shipped.get(slot, 0)
+            budget[slot] = max(0, min(n, left))
+        return budget
+
     def _do_decode_scan(self, decode_plan, n: int) -> None:
-        """n ragged decode steps + sampling as one compiled program."""
+        """n ragged decode steps + sampling as one compiled program
+        (synchronous: dispatch, fetch, emit — the multi-host lockstep
+        path; single-host serving uses _decode_burst instead)."""
         t0 = time.perf_counter()
         rows = [s for _, s in decode_plan]
         n_top = self._n_top_for(rows)
+        budget = self._scan_budget(decode_plan, n)
         # n_top must ride the op: in a multi-host scan the sampling is
         # INSIDE the mesh program, so a follower compiling the n_top=0
         # variant while the coordinator runs n_top=20 would dispatch a
-        # different program and wedge the collective
+        # different program and wedge the collective. budget rides it
+        # for the same reason followers cannot derive it (no requests).
         self._publish({"op": "decode_scan", "rows": rows, "n": n,
-                       "n_top": n_top})
-        (toks_host, lps_host, tops_i_host,
-         tops_l_host) = self._decode_scan_device(rows, n, n_top)
+                       "n_top": n_top, "budget": budget.tolist()})
+        outs, _state = self._dispatch_scan_device(rows, n, n_top, budget)
+        fetched = self._fetch_scan(outs)
         self.stats.steps += n
         self.stats.decode_time_s += time.perf_counter() - t0
-        self._step_stats.step(bytes_out=len(decode_plan) * n)
+        self._complete_scan(decode_plan, n, fetched, budget)
+
+    def _decode_burst(self, decode_plan, n: int) -> None:
+        """Double-buffered chained scans: dispatch scan k+1 (its inputs
+        chained on device from scan k's final carry — zero host
+        round-trips between scans) BEFORE fetching scan k's tokens, so
+        the ~100ms d2h fetch latency of a remote-dispatch tunnel hides
+        under scan k+1's device compute. Single-host only: a follower
+        rebuilds scan inputs from its mirrors, which match the chained
+        carry for live rows but diverge for rows that froze (EOS) inside
+        an earlier not-yet-fetched scan — lockstep multi-host serving
+        keeps the synchronous _do_decode_scan path instead."""
+        t0 = time.perf_counter()
+        rows = [s for _, s in decode_plan]
+        n_top = self._n_top_for(rows)
+        # tokens dispatched in not-yet-fetched scans, per slot: added at
+        # dispatch, removed at fetch — budget math and the window guard
+        # below both project the device state past the stale host
+        # mirrors by exactly this amount
+        shipped: dict = {}
+        inflight: list = []        # [(outs, budget)]
+        state = None
+        while True:
+            budget = self._scan_budget(decode_plan, n, shipped)
+            # keep dispatching while there is real work and nothing on
+            # the host side needs the loop back (admissions, cancels,
+            # commands, shutdown). The window guard uses the PROJECTED
+            # device position (host mirror + unfetched in-flight
+            # tokens): the mirror lags the device by the in-flight
+            # scans, and the device program has no max_seq freeze.
+            dispatch = (budget.any() and not self._stop.is_set()
+                        and self.scheduler.queue_depth == 0
+                        and not self._cancel_pending()
+                        and not self._commands_pending()
+                        and all(self._pos[s] + shipped.get(s, 0) + n
+                                < self.max_seq_len for s in rows))
+            if dispatch:
+                outs, state = self._dispatch_scan_device(
+                    rows, n, n_top, budget, state=state)
+                for _, slot in decode_plan:
+                    shipped[slot] = shipped.get(slot, 0) + int(budget[slot])
+                self.stats.steps += n
+                inflight.append((outs, budget))
+            if not inflight:
+                break
+            if not dispatch or len(inflight) >= 2:
+                outs_k, budget_k = inflight.pop(0)
+                fetched = self._fetch_scan(outs_k)
+                self._complete_scan(decode_plan, n, fetched, budget_k)
+                for _, slot in decode_plan:
+                    shipped[slot] = (shipped.get(slot, 0)
+                                     - int(budget_k[slot]))
+        self.stats.decode_time_s += time.perf_counter() - t0
+
+    def _complete_scan(self, decode_plan, n: int, fetched,
+                       budget) -> None:
+        """Emit one fetched scan's tokens and advance the host mirrors.
+        A row emits min(its budget, EOS cut) tokens; the device program
+        froze it at exactly that point (budget freeze + EOS freeze in
+        make_decode_scan), so mirrors advance by the emitted count."""
+        toks_host, lps_host, tops_i_host, tops_l_host = fetched
+        self._step_stats.step(bytes_out=int(budget.sum()))
         for rid, slot in decode_plan:
             req = self._slot_req[slot]
             if req is None or req.rid != rid:
                 continue
             pos0 = int(self._pos[slot])
-            self._steps[slot] += n
-            self._last_tok[slot] = toks_host[slot, -1]
-            for j in range(n):
+            b = int(budget[slot])
+            emitted = 0
+            for j in range(b):
                 # per-token position so _emit's cap check sees the value a
                 # single-step loop would have had
                 self._pos[slot] = pos0 + j + 1
+                emitted = j + 1
+                self._last_tok[slot] = toks_host[slot, j]
                 self._emit(req, int(toks_host[slot, j]),
                            logprob=float(lps_host[slot, j]),
                            top=(list(zip(tops_i_host[slot, j].tolist(),
                                          tops_l_host[slot, j].tolist()))
                                 if tops_i_host.size else []))
                 if req.done.is_set():
-                    # EOS/budget mid-scan: later tokens are overshoot; the
-                    # slot's cache garbage is overwritten by the next
-                    # prefill into this slot
+                    # EOS/budget: the device froze the row here too
                     break
-            else:
-                self._pos[slot] = pos0 + n
+            self._steps[slot] += emitted
+            self._pos[slot] = pos0 + emitted
 
-    def _decode_scan_device(self, rows, n: int, n_top: int) -> tuple:
-        """Device half of the K-step scan, shared verbatim with
-        multi-host followers. In multi-host mode keys/ring are localized
-        around the call (host numpy in, replicated output localized), so
-        the surrounding single-step ops keep their process-local
-        sampling while the scan itself runs sampling inside the mesh
-        program identically on every process."""
+    def _dispatch_scan_device(self, rows, n: int, n_top: int, budget,
+                              state=None):
+        """Device dispatch half of a K-step scan, shared verbatim with
+        multi-host followers (via _decode_scan_device). In multi-host
+        mode keys/ring are localized around the call (host numpy in,
+        replicated output localized), so the surrounding single-step ops
+        keep their process-local sampling while the scan itself runs
+        sampling inside the mesh program identically on every process.
+        state: a previous scan's final carry to chain from (single-host
+        bursts); None rebuilds the inputs from the host mirrors."""
         B = self.max_slots
-        active = np.zeros(B, bool)
-        for slot in rows:
-            active[slot] = True
+        if state is None:
+            active = np.zeros(B, bool)
+            for slot in rows:
+                active[slot] = True
+            last_tok = jnp.asarray(self._last_tok, jnp.int32)
+            pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                              jnp.int32)
+            steps = jnp.asarray(self._steps, jnp.int32)
+            active = jnp.asarray(active)
+        else:
+            last_tok, pos, steps, active = state
         keys, ring = self._keys, self._ring
         if self._multihost:
             keys, ring = np.asarray(keys), np.asarray(ring)
-        (toks, lps, tops_i, tops_l, self.cache, keys_o,
-         ring_o) = self._decode_scan_impl(
-            self.params,
-            jnp.asarray(self._last_tok, jnp.int32),
-            jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
-                        jnp.int32),
-            jnp.asarray(active), self.cache, self.rope, self.config,
-            keys, ring,
-            jnp.asarray(self._steps, jnp.int32),
+        (toks, lps, tops_i, tops_l, self.cache, keys_o, ring_o,
+         state_o) = self._decode_scan_impl(
+            self.params, last_tok, pos, active, self.cache, self.rope,
+            self.config, keys, ring, steps,
             jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._penalty),
+            jnp.asarray(self._penalty), jnp.asarray(budget, jnp.int32),
             num_steps=n, top_k=self.defaults.top_k, n_top=n_top,
         )
         if self._multihost:
-            keys_o = jnp.asarray(np.asarray(keys_o))
-            ring_o = jnp.asarray(np.asarray(ring_o))
+            keys_h, ring_h = jax.device_get((keys_o, ring_o))
+            keys_o, ring_o = jnp.asarray(keys_h), jnp.asarray(ring_h)
         self._keys, self._ring = keys_o, ring_o
-        return (np.asarray(toks), np.asarray(lps), np.asarray(tops_i),
-                np.asarray(tops_l))
+        return (toks, lps, tops_i, tops_l), state_o
 
-    def _finalize_scan_mirrors(self, rows, n: int, toks_host) -> None:
+    @staticmethod
+    def _fetch_scan(outs) -> tuple:
+        # ONE batched fetch: sequential np.asarray calls each pay a full
+        # host<->device round-trip (~100ms over a remote-dispatch
+        # tunnel, measured), so four of them would quadruple the
+        # per-scan dispatch overhead
+        return jax.device_get(outs)
+
+    def _decode_scan_device(self, rows, n: int, n_top: int,
+                            budget=None) -> tuple:
+        """Synchronous dispatch+fetch (follower replay path)."""
+        if budget is None:
+            budget = np.full(self.max_slots, n, np.int32)
+        outs, _state = self._dispatch_scan_device(
+            rows, n, n_top, np.asarray(budget, np.int32))
+        return self._fetch_scan(outs)
+
+    def _finalize_scan_mirrors(self, rows, n: int, toks_host,
+                               budget=None) -> None:
         """Follower-side mirror advance after a replayed scan. MUST
-        agree with the coordinator's emit loop in _do_decode_scan: a row
-        that emitted EOS at step j ends at pos0+j+1 (the loop breaks
-        there); otherwise pos0+n. Budget exhaustion can only land on the
-        last step (_scan_steps_for guarantees >= n budget), which equals
-        the no-EOS endpoint."""
+        agree with the coordinator's emit loop in _complete_scan: a row
+        ends at min(its budget, EOS cut) — exactly where the device
+        program froze it (budget freeze + EOS freeze in
+        make_decode_scan)."""
         eos = self.config.eos_token_ids
         for slot in rows:
             pos0 = int(self._pos[slot])
-            self._steps[slot] += n
-            self._last_tok[slot] = toks_host[slot, -1]
-            end = n
-            for j in range(n):
+            b = n if budget is None else int(budget[slot])
+            end = b
+            for j in range(b):
                 if int(toks_host[slot, j]) in eos:
                     end = j + 1
                     break
+            self._steps[slot] += end
+            if end:
+                self._last_tok[slot] = toks_host[slot, end - 1]
             self._pos[slot] = pos0 + end
 
     def _n_top_for(self, rows) -> int:
@@ -1592,12 +1729,14 @@ class InferenceEngine:
             jnp.asarray(self._penalty), top_k=self.defaults.top_k,
             n_top=self._n_top_for(rows) if n_top is None else n_top,
         )
-        nxt_host = np.asarray(nxt)
+        # one batched fetch, not four sequential round-trips (see
+        # _decode_scan_device)
+        nxt_host, lp_h, tids_h, tlps_h = jax.device_get(
+            (nxt, lp, top_ids, top_lps))
         for r in rows:
             self._steps[r] += 1
             self._last_tok[r] = nxt_host[r]
-        return (nxt_host, np.asarray(lp), np.asarray(top_ids),
-                np.asarray(top_lps))
+        return (nxt_host, lp_h, tids_h, tlps_h)
 
     # -- token plumbing -------------------------------------------------------
 
@@ -1778,21 +1917,33 @@ def make_decode_scan(forward_fn, out_sharding=None):
     emits EOS mid-scan freezes for the remaining steps — in single-step
     mode the scheduler frees the slot immediately, so without freezing
     the slot's PRNG/ring stream would diverge between the two modes.
+    A row also freezes once it has emitted `budget[row]` tokens within
+    this call, so a scan may be dispatched past a request's
+    max_new_tokens (or chained speculatively, _decode_burst) without
+    writing a single token beyond the budget.
     Returns ([B, num_steps] tokens, [B, num_steps] logprobs,
-    [B, num_steps, n_top] x2, cache, keys, ring); the host mirrors
+    [B, num_steps, n_top] x2, cache, keys, ring, state) where state =
+    (tok, pos, steps, live) is the final carry — feeding it back as
+    (last_tok, pos, steps, active) chains a follow-up scan entirely on
+    device (no host round-trip between scans). The host mirrors
     (_pos/_steps/_last_tok) are advanced by the caller.
     """
 
     @partial(jax.jit, static_argnames=("config", "num_steps", "top_k",
                                        "n_top"),
-             donate_argnames=("cache",))
+             donate_argnames=("cache", "keys", "ring"))
     def decode_scan(params, last_tok, pos, active, cache: KVCache, rope,
                     config, keys, ring, steps, temp, top_p, penalty,
-                    num_steps: int, top_k, n_top: int = 0):
+                    budget, num_steps: int, top_k, n_top: int = 0):
         eos_ids = jnp.asarray(config.eos_token_ids, jnp.int32)
+        steps_in = steps
 
         def body(carry, _):
             tok, pos, cache, keys, ring, steps, live = carry
+            # per-row budget freeze: emitted-so-far = steps - steps_in
+            # (both advance only while live), so a row stops producing
+            # the moment its allowance for this call is used up
+            live = live & ((steps - steps_in) < budget)
             logits, cache = forward_fn(params, tok[:, None], cache, pos,
                                        live, rope, config)
             nxt, keys, ring, lp, t_i, t_l = _masked_sample(
@@ -1816,7 +1967,8 @@ def make_decode_scan(forward_fn, out_sharding=None):
             outs = tuple(jax.lax.with_sharding_constraint(o, out_sharding)
                          for o in outs)
         toks_o, lps_o, ti_o, tl_o, keys_o, ring_o = outs
-        return toks_o, lps_o, ti_o, tl_o, cache, keys_o, ring_o
+        state = (tok, pos, steps, live)
+        return toks_o, lps_o, ti_o, tl_o, cache, keys_o, ring_o, state
 
     return decode_scan
 

@@ -258,6 +258,54 @@ def test_decode_scan_with_stochastic_rows(setup):
     assert cold.token_ids == want
 
 
+def test_burst_single_pass_no_double_count(setup):
+    """A long request must finish inside ONE chained burst: `shipped`
+    tracks dispatched-but-unfetched tokens only, so fetched tokens must
+    not count twice against the budget (once in out_tokens, once in
+    shipped) — double counting would freeze rows at ~half their real
+    allowance and re-pay the per-burst round-trips repeatedly."""
+    want = sequential_ids(setup, "hello world", 24)
+    with make_engine(setup, max_slots=2, decode_scan_steps=4) as eng:
+        dispatches = []
+        orig = eng._dispatch_scan_device
+
+        def spy(rows, n, n_top, budget, state=None):
+            dispatches.append(np.asarray(budget).copy())
+            return orig(rows, n, n_top, budget, state=state)
+
+        eng._dispatch_scan_device = spy
+        h = eng.chat([Message.user("hello world")], max_new_tokens=24)
+        assert h.wait(120)
+    assert h.token_ids == want
+    # 24 tokens, first from prefill -> 23 decode tokens in scans of 4:
+    # every dispatched scan must carry a full-or-remainder budget; total
+    # dispatched budget must not overshoot the remaining 23 by more
+    # than one speculative chained scan (the double-count bug made the
+    # budgets collapse to 0 mid-request and the burst restart instead)
+    total = sum(int(b.sum()) for b in dispatches)
+    assert total >= 23, f"budgets collapsed: {dispatches}"
+    nonzero = [b for b in dispatches if b.sum() > 0]
+    assert all(int(b.sum()) in (3, 4) for b in nonzero), dispatches
+
+
+def test_burst_respects_window_cap_with_inflight(setup):
+    """The burst's max_seq_len guard must project the device position by
+    in-flight (unfetched) tokens: with a tiny window, chained scans must
+    never advance a row past max_seq_len (stale-mirror overshoot would
+    clamp KV writes onto the last cache position)."""
+    cfg, params, tok = setup
+    eng = InferenceEngine(cfg, params, tok, max_slots=2, max_seq_len=48,
+                          sampling=SamplingConfig(temperature=0.0),
+                          cache_dtype=jnp.float32, decode_scan_steps=4)
+    with eng:
+        # raw 8-token prompt; budget far beyond the window so the cap
+        # is what ends the request
+        h = eng.submit(list(range(3, 11)), max_new_tokens=1000)
+        assert h.wait(120)
+    assert int(np.max(eng._pos)) <= 48
+    assert len(h.token_ids) >= 1
+
+
 def test_cancel_frees_slot_and_stops_decode(setup):
     """engine.cancel (client disconnect): the request finishes early, the
     slot frees for new work, and decode stops burning steps on it."""

@@ -1,0 +1,142 @@
+"""On-chip engine dispatch profiler.
+
+Times the pieces the aggregate engine number is made of, to attribute
+throughput between device compute and host<->device dispatch latency
+(the axon tunnel adds a round-trip per engine dispatch; the batch-1
+tier's on-device `lax.scan` loop pays it once, the engine pays it per
+step/scan):
+
+  - raw dispatch RTT: a trivial jitted op, timed per round-trip
+  - per-prefill dispatch time
+  - per-scan (K-step) and per-single-step decode dispatch time
+  - decode token accounting: how many tokens came from scans vs singles
+
+Usage:  python tools/engine_profile.py [model] [slots] [gen_tokens]
+"""
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from cake_tpu.models.llama.generator import ByteTokenizer
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve.engine import InferenceEngine
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    model = sys.argv[1] if len(sys.argv) > 1 else "8b"
+    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    gen_tokens = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+
+    # --- raw dispatch RTT ---
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    x = f(x)
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    n_rtt = 20
+    for _ in range(n_rtt):
+        x = f(x)
+        jax.block_until_ready(x)
+    rtt = (time.perf_counter() - t0) / n_rtt
+    log(f"raw dispatch RTT (tiny jit, block each): {rtt * 1e3:.1f} ms")
+
+    # async dispatch depth: issue 20 without blocking, then block once
+    t0 = time.perf_counter()
+    for _ in range(n_rtt):
+        x = f(x)
+    jax.block_until_ready(x)
+    async_rtt = (time.perf_counter() - t0) / n_rtt
+    log(f"async chained dispatch (block once): {async_rtt * 1e3:.1f} ms/op")
+
+    cfg = bench.make_config(model)
+    init, _ = bench._init_fn("int8" if model == "8b" else False)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    engine = InferenceEngine(
+        cfg, params, ByteTokenizer(cfg.vocab_size), max_slots=slots,
+        max_seq_len=512,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        decode_scan_steps=8,
+    )
+
+    times = {"prefill": [], "scan": [], "single": []}
+    counts = {"scan_tokens": 0, "single_tokens": 0}
+
+    orig_prefill = engine._do_prefill
+    orig_scan = engine._do_decode_scan
+    orig_dec = engine._do_decode
+
+    def prefill(rid, slot):
+        t = time.perf_counter()
+        r = orig_prefill(rid, slot)
+        times["prefill"].append(time.perf_counter() - t)
+        return r
+
+    def scan(plan, n):
+        t = time.perf_counter()
+        r = orig_scan(plan, n)
+        times["scan"].append(time.perf_counter() - t)
+        counts["scan_tokens"] += n * len(plan)
+        return r
+
+    def dec(plan):
+        t = time.perf_counter()
+        r = orig_dec(plan)
+        times["single"].append(time.perf_counter() - t)
+        counts["single_tokens"] += len(plan)
+        return r
+
+    engine._do_prefill = prefill
+    engine._do_decode_scan = scan
+    engine._do_decode = dec
+
+    prompt = list(range(3, 3 + 64))
+    with engine:
+        t0 = time.perf_counter()
+        warm = engine.submit(prompt, max_new_tokens=32)
+        assert warm.wait(timeout=900)
+        log(f"warmup: {time.perf_counter() - t0:.1f}s")
+        for k in times:
+            times[k].clear()
+        counts["scan_tokens"] = counts["single_tokens"] = 0
+        base = engine.stats.tokens_generated
+        t0 = time.perf_counter()
+        handles = [engine.submit(prompt, max_new_tokens=gen_tokens)
+                   for _ in range(slots)]
+        assert all(h.wait(timeout=900) for h in handles)
+        wall = time.perf_counter() - t0
+        toks = engine.stats.tokens_generated - base
+
+    for k, v in times.items():
+        if not v:
+            log(f"{k:8s}: 0 dispatches")
+            continue
+        tot = sum(v)
+        log(f"{k:8s}: {len(v):4d} dispatches, total {tot:6.2f}s, "
+            f"mean {tot / len(v) * 1e3:7.1f} ms, "
+            f"min {min(v) * 1e3:7.1f} ms, max {max(v) * 1e3:7.1f} ms")
+    log(f"tokens: {toks} ({counts['scan_tokens']} scanned, "
+        f"{counts['single_tokens']} single)")
+    log(f"wall: {wall:.2f}s -> {toks / wall:.1f} tok/s incl. prefill")
+    ttfts = sorted(h.ttft for h in handles)
+    log(f"TTFT p50 {ttfts[len(ttfts) // 2] * 1e3:.0f} ms")
+
+
+if __name__ == "__main__":
+    main()
