@@ -186,6 +186,43 @@ def _spec_round(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
     return out, n_emit, t_cache, d_cache, rng
 
 
+@partial(jax.jit,
+         static_argnames=("t_cfg", "d_cfg", "gamma", "greedy",
+                          "num_rounds"),
+         donate_argnames=("t_cache", "d_cache"))
+def spec_scan(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
+              last_tok, pos, t_rope: RopeTables, d_rope: RopeTables,
+              rng, temperature,
+              t_cfg: LlamaConfig, d_cfg: LlamaConfig,
+              gamma: int, greedy: bool, num_rounds: int):
+    """num_rounds propose-verify-accept rounds chained on device
+    (lax.scan over _spec_round), so the host pays ONE dispatch + fetch
+    per num_rounds rounds instead of per round — the host-stepped loop
+    is fetch-bound (~100ms/round over a remote-dispatch tunnel), which
+    caps batch-1 speculation at ~10 tok/s regardless of acceptance.
+
+    Caller must guarantee pos + num_rounds*(gamma+1) <= max_seq_len
+    (every round writes up to gamma+1 cache positions at its dynamic
+    offset). Returns (outs [num_rounds, gamma+1] — per round the first
+    n valid, rest -1; ns [num_rounds]; t_cache; d_cache; rng). Tokens
+    after an EOS inside the window are overshoot for the caller to
+    discard (same contract as the engine's budget-frozen scans)."""
+
+    def body(carry, _):
+        t_cache, d_cache, tok, p, rng = carry
+        out, n, t_cache, d_cache, rng = _spec_round(
+            t_params, d_params, t_cache, d_cache, tok, p,
+            t_rope, d_rope, rng, temperature, t_cfg, d_cfg, gamma,
+            greedy)
+        last = out[:, n[0] - 1][:, None]    # [1, 1] for the next round
+        return (t_cache, d_cache, last, p + n[0], rng), (out[0], n[0])
+
+    (t_cache, d_cache, _tok, _pos, rng), (outs, ns) = jax.lax.scan(
+        body, (t_cache, d_cache, last_tok, pos, rng), None,
+        length=num_rounds)
+    return outs, ns, t_cache, d_cache, rng
+
+
 class SpeculativeGenerator:
     """TextGenerator with draft-model speculation (batch 1).
 
@@ -200,9 +237,12 @@ class SpeculativeGenerator:
                  draft_config: LlamaConfig, draft_params,
                  tokenizer, *, gamma: int = 4, max_seq_len: int = 4096,
                  sampling: Optional[SamplingConfig] = None,
-                 seed: int = 299792458, cache_dtype=jnp.bfloat16):
+                 seed: int = 299792458, cache_dtype=jnp.bfloat16,
+                 spec_rounds: int = 4):
         if gamma < 1:
             raise ValueError("gamma must be >= 1")
+        if spec_rounds < 1:
+            raise ValueError("spec_rounds must be >= 1")
         sampling = sampling or SamplingConfig()
         if sampling.repeat_penalty != 1.0:
             raise ValueError(
@@ -229,6 +269,7 @@ class SpeculativeGenerator:
                                       dtype=cache_dtype)
         self.history = History(config.chat_template)
         self.rng = jax.random.PRNGKey(seed)
+        self.spec_rounds = spec_rounds
         self.proposed = 0        # drafts offered to the verifier
         self.accepted = 0        # drafts kept
         self._reset_session()
@@ -334,6 +375,37 @@ class SpeculativeGenerator:
             raise RuntimeError(
                 "next_token(index>0) called before the index==0 prefill")
         last = jnp.asarray([[self.tokens[-1]]], jnp.int32)
+        R = self.spec_rounds
+        if (R > 1 and self.index_pos + R * (self.gamma + 1)
+                <= self.max_seq_len):
+            # R rounds per dispatch+fetch (spec_scan): the host-stepped
+            # loop is fetch-bound over a remote-dispatch tunnel, so
+            # chaining rounds on device multiplies batch-1 throughput
+            # by ~R. Near the window end fall back to single rounds
+            # (two compiled programs total: R-round and 1-round).
+            outs, ns, self.cache, self.d_cache, self.rng = spec_scan(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                last, jnp.int32(self.index_pos), self.rope, self.d_rope,
+                self.rng,
+                jnp.float32(self.sampling.temperature or 1.0),
+                self.config, self.draft_config, self.gamma,
+                self._greedy, R)
+            ns_h, outs_h = jax.device_get((ns, outs))
+            eos = set(self.config.eos_token_ids)
+            for k in range(R):
+                n = int(ns_h[k])
+                toks = [int(t) for t in outs_h[k, :n]]
+                self._buffer.extend(toks)
+                self.proposed += self.gamma
+                self.accepted += n - 1
+                self.index_pos += n
+                if any(t in eos for t in toks):
+                    # rounds past EOS ran on device (overshoot by
+                    # design) but condition on post-EOS garbage — they
+                    # must pollute neither the stream nor the
+                    # acceptance stats
+                    break
+            return
         out, n_emit, self.cache, self.d_cache, self.rng = spec_step(
             self.params, self.draft_params, self.cache, self.d_cache,
             last, jnp.int32(self.index_pos), self.rope, self.d_rope,
@@ -375,10 +447,26 @@ class SpeculativeGenerator:
                 sub, logits / self.sampling.temperature)[0])
         out = [first]
         pos = int(np.asarray(plen)[0])
+        R = self.spec_rounds
         while len(out) < num_tokens:
             if pos + self.gamma + 1 >= self.max_seq_len:
                 raise ValueError("speculation window exceeds max_seq_len")
             last = jnp.asarray([[out[-1]]], jnp.int32)
+            if R > 1 and pos + R * (self.gamma + 1) <= self.max_seq_len:
+                outs_d, ns_d, cache, d_cache, rng = spec_scan(
+                    self.params, self.draft_params, cache, d_cache, last,
+                    jnp.int32(pos), self.rope, self.d_rope, rng,
+                    jnp.float32(self.sampling.temperature or 1.0),
+                    self.config, self.draft_config, self.gamma,
+                    self._greedy, R)
+                ns_h, outs_h = jax.device_get((ns_d, outs_d))
+                for k in range(R):
+                    n = int(ns_h[k])
+                    self.proposed += self.gamma
+                    self.accepted += n - 1
+                    out.extend(int(t) for t in outs_h[k, :n])
+                    pos += n
+                continue
             burst, n_emit, cache, d_cache, rng = spec_step(
                 self.params, self.draft_params, cache, d_cache, last,
                 jnp.int32(pos), self.rope, self.d_rope, rng,

@@ -403,6 +403,7 @@ class InferenceEngine:
         self._penalty = np.full(B, self.defaults.repeat_penalty, np.float32)
         self._ring = jnp.full((B, self.defaults.repeat_last_n), -1, jnp.int32)
         self._key_seed = seed                        # for _reset_after_error
+        self._reset_count = 0
         root = jax.random.PRNGKey(seed)
         self._keys = jax.random.split(root, B)       # [B] keys
         self._slot_req: List[Optional[_Request]] = [None] * B
@@ -978,8 +979,11 @@ class InferenceEngine:
                     self._wake.wait(timeout=0.02)
                     self._wake.clear()
             try:
-                for rid, slot in prefill_plan:
-                    self._do_prefill(rid, slot)
+                if prefill_plan and not self._multihost:
+                    self._do_prefill_batch(prefill_plan)
+                else:
+                    for rid, slot in prefill_plan:
+                        self._do_prefill(rid, slot)
                 if decode_plan:
                     if self._spec:
                         self._do_decode_spec(decode_plan)
@@ -1055,8 +1059,15 @@ class InferenceEngine:
         B = self.max_slots
         self._ring = jnp.full((B, self.defaults.repeat_last_n), -1,
                               jnp.int32)
+        # fold a reset counter into the rebuild key: restoring the
+        # STARTUP keys would replay already-consumed sampling streams
+        # (duplicate "random" completions after a transient error).
+        # The counter advances identically on every process (followers
+        # replay the reset op), so multi-host keys stay in lockstep.
+        self._reset_count += 1
         self._keys = jax.random.split(
-            jax.random.PRNGKey(self._key_seed), B)
+            jax.random.fold_in(jax.random.PRNGKey(self._key_seed),
+                               self._reset_count), B)
 
     def _fresh_cache(self) -> KVCache:
         if self.paged:
@@ -1133,16 +1144,22 @@ class InferenceEngine:
             req.done.set()
         return False
 
-    def _do_prefill(self, rid: int, slot: int) -> None:
+    def _do_prefill(self, rid: int, slot: int, defer: bool = False):
+        """Prefill one admission. defer=False: dispatch, fetch, emit —
+        the multi-host lockstep path. defer=True: dispatch only; returns
+        (req, t0, slot, dev) for _do_prefill_batch, which fetches every
+        admission's first token in ONE host round-trip (a per-admission
+        fetch costs ~100ms over a remote-dispatch tunnel — the dominant
+        term in TTFT when a wave of requests arrives together)."""
         req = self._requests.get(rid)
         if req is None:  # cancelled between plan and here
             self.scheduler.cancel(rid)
-            return
+            return None
         t0 = time.perf_counter()
         req.slot = slot
         self._slot_req[slot] = req
         if self.paged and not self._alloc_slot_pages(req, slot):
-            return   # pool exhausted: requeued (or failed) inside
+            return None   # pool exhausted: requeued (or failed) inside
         ids = req.prompt_ids
         hit = (self._match_and_validate_prefix(ids)
                if self._prefix_capable else None)
@@ -1159,10 +1176,10 @@ class InferenceEngine:
                 "top_p": req.top_p, "penalty": req.repeat_penalty,
                 "prime": list(req.prime_tokens), "n_top": n_top,
             })
-            tok, lp, top = self._prefixed_prefill_device(
+            out = self._prefixed_prefill_device(
                 hit_pid, ids, slot, req.temperature, req.top_p,
                 req.repeat_penalty, req.prime_tokens, n_top=n_top,
-                entry=entry)
+                entry=entry, defer=defer)
             self.stats.prefix_hits += 1
         else:
             # covers whole-prompt AND chunked prefill — _prefill_device
@@ -1175,11 +1192,53 @@ class InferenceEngine:
                 "penalty": req.repeat_penalty,
                 "prime": list(req.prime_tokens), "n_top": n_top,
             })
-            tok, lp, top = self._prefill_device(
+            out = self._prefill_device(
                 ids, slot, req.temperature, req.top_p,
-                req.repeat_penalty, req.prime_tokens, n_top=n_top)
+                req.repeat_penalty, req.prime_tokens, n_top=n_top,
+                defer=defer)
+        if defer:
+            return (req, t0, slot, out)
+        tok, lp, top = out
         self.stats.prefill_time_s += time.perf_counter() - t0
         self._emit(req, tok, logprob=lp, top=top)
+        return None
+
+    # admissions per first-token fetch in _do_prefill_batch: a fetch
+    # costs one host round-trip (~100ms over a remote-dispatch tunnel),
+    # a prefill dispatch ~tens of ms — groups of 4 amortize the fetch
+    # 4x while early arrivals in a big wave still stream their first
+    # token after ~4 prefills instead of after the whole wave (p50 TTFT)
+    PREFILL_FLUSH = 4
+
+    def _do_prefill_batch(self, prefill_plan) -> None:
+        """Admit a wave of requests with one first-token fetch per
+        PREFILL_FLUSH admissions: each group's prefills + first-token
+        samples are dispatched back to back (the device chains them
+        through the donated cache), then a single jax.device_get
+        collects the group's first tokens. Single-host only — a
+        follower replays per-admission ops synchronously."""
+        pend = []
+
+        def flush():
+            hosts = jax.device_get([dev for (_, _, _, dev) in pend])
+            # one wall-clock interval per GROUP: the admissions overlap
+            # (dispatched back to back, fetched together), so summing
+            # per-request spans would count the same wall time up to
+            # PREFILL_FLUSH times
+            self.stats.prefill_time_s += time.perf_counter() - pend[0][1]
+            for (req, t0, slot, _), host in zip(pend, hosts):
+                tok, lp, top = self._finish_prefill_complete(slot, host)
+                self._emit(req, tok, logprob=lp, top=top)
+            pend.clear()
+
+        for rid, slot in prefill_plan:
+            p = self._do_prefill(rid, slot, defer=True)
+            if p is not None:
+                pend.append(p)
+            if len(pend) >= self.PREFILL_FLUSH:
+                flush()
+        if pend:
+            flush()
 
     def _match_and_validate_prefix(self, ids: List[int]):
         """(pid, (p_ids, k, v)) of the longest matching registered prefix
@@ -1226,7 +1285,7 @@ class InferenceEngine:
     def _prefixed_prefill_device(self, pid: int, ids, slot: int,
                                  temp: float, top_p: float, penalty: float,
                                  prime, n_top: int = 0,
-                                 entry=None) -> tuple:
+                                 entry=None, defer: bool = False) -> tuple:
         """Prefix-hit prefill: install the cached prefix KV, prefill only
         the suffix, sample the first token. Runs identically on the
         coordinator (which passes the matched `entry` so a concurrent
@@ -1259,7 +1318,8 @@ class InferenceEngine:
                 pk, pv, self.cache, self.rope, self.config,
             )
         return self._finish_prefill(logits, slot, len(ids), temp,
-                                    top_p, penalty, prime, n_top=n_top)
+                                    top_p, penalty, prime, n_top=n_top,
+                                    defer=defer)
 
     def _prefill_raw(self, ids, slot: int):
         """Whole-prompt prefill device call (no sampling-state changes)."""
@@ -1282,7 +1342,8 @@ class InferenceEngine:
         return logits
 
     def _prefill_device(self, ids, slot: int, temp: float, top_p: float,
-                        penalty: float, prime, n_top: int = 0) -> tuple:
+                        penalty: float, prime, n_top: int = 0,
+                        defer: bool = False) -> tuple:
         """Prefill one slot (whole-prompt or chunked, decided from
         shared config + prompt length) + first-token sample: the
         device-and-mirror sequence of _do_prefill's non-prefix branch,
@@ -1297,13 +1358,17 @@ class InferenceEngine:
         else:
             logits = self._prefill_raw(ids, slot)
         return self._finish_prefill(logits, slot, len(ids), temp,
-                                    top_p, penalty, prime, n_top=n_top)
+                                    top_p, penalty, prime, n_top=n_top,
+                                    defer=defer)
 
     def _finish_prefill(self, logits, slot: int, prompt_len: int,
                         temp: float, top_p: float, penalty: float,
-                        prime, n_top: Optional[int] = None) -> tuple:
+                        prime, n_top: Optional[int] = None,
+                        defer: bool = False) -> tuple:
         """Configure the slot's sampling state and sample its first
-        token. Returns (token_id, logprob, top-N alternatives)."""
+        token. Returns (token_id, logprob, top-N alternatives), or the
+        deferred device tuple when defer=True (_do_prefill_batch fetches
+        it together with the whole admission wave's)."""
         if self._multihost:
             # replicated logits -> local host copy, so sampling is a
             # process-local computation (identical on every process by
@@ -1328,9 +1393,21 @@ class InferenceEngine:
             self._ring = self._ring.at[slot].set(jnp.asarray(row))
             self._steps[slot] = len(prime)
         # sample the first token with the slot's own key/options
-        first, first_lp, tids, tlps = self._sample_rows(
+        sampled = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),
-            rows=[slot], n_top=n_top)
+            rows=[slot], n_top=n_top, defer=defer)
+        if defer:
+            return sampled          # device tuple for _do_prefill_batch
+        return self._finish_prefill_complete(slot, sampled,
+                                             mirrors_done=True)
+
+    def _finish_prefill_complete(self, slot: int, host,
+                                 mirrors_done: bool = False) -> tuple:
+        """Host half of _finish_prefill: mirror advance (unless
+        _sample_rows already did it) + first-token unpack."""
+        if not mirrors_done:
+            host = self._sample_complete([slot], host)
+        first, first_lp, tids, tlps = host
         top = (list(zip(tids[slot].tolist(), tlps[slot].tolist()))
                if tids.size else [])
         return int(first[slot]), float(first_lp[slot]), top
@@ -1711,13 +1788,15 @@ class InferenceEngine:
         return 0
 
     def _sample_rows(self, logits, rows: List[int],
-                     n_top: Optional[int] = None):
+                     n_top: Optional[int] = None, defer: bool = False):
         """Sample all B rows; advance keys/ring only for `rows` (so an
         inactive slot's PRNG stream is untouched). n_top: explicit value
         in multi-host replay (it rides every op so coordinator and
         followers compile the SAME sampling program — different n_top
         variants may fuse differently and flip a sampled token near a
-        top-p boundary); None derives it from the rows' requests."""
+        top-p boundary); None derives it from the rows' requests.
+        defer=True returns the device tuple without fetching (the
+        caller batches the fetch and runs _sample_complete itself)."""
         B = self.max_slots
         row_mask = np.zeros(B, bool)
         for r in rows:
@@ -1729,10 +1808,17 @@ class InferenceEngine:
             jnp.asarray(self._penalty), top_k=self.defaults.top_k,
             n_top=self._n_top_for(rows) if n_top is None else n_top,
         )
+        dev = (nxt, lp, top_ids, top_lps)
+        if defer:
+            return dev
         # one batched fetch, not four sequential round-trips (see
         # _decode_scan_device)
-        nxt_host, lp_h, tids_h, tlps_h = jax.device_get(
-            (nxt, lp, top_ids, top_lps))
+        return self._sample_complete(rows, jax.device_get(dev))
+
+    def _sample_complete(self, rows: List[int], host) -> tuple:
+        """Host half of _sample_rows: advance the sampled rows' step and
+        last-token mirrors from the (already fetched) host tuple."""
+        nxt_host, lp_h, tids_h, tlps_h = host
         for r in rows:
             self._steps[r] += 1
             self._last_tok[r] = nxt_host[r]

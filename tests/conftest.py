@@ -7,8 +7,14 @@ a host-simulated mesh per SURVEY.md §4's implication — no pod required.
 import os
 
 # Force-override: the session env pins JAX_PLATFORMS to the real accelerator;
-# tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# tests always run on the virtual CPU mesh. CAKE_TESTS_TPU=1 keeps the real
+# accelerator instead: single-device test files then exercise the REAL Pallas
+# kernels (interpret=False) on silicon — the on-chip validation lane for
+# ops/flash_attention.py and ops/int4_matmul.py (multi-device mesh tests
+# still need the CPU lane; run them separately).
+_ON_TPU = os.environ.get("CAKE_TESTS_TPU") == "1"
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 # hermetic: never attempt HF-hub downloads from tests (zero-egress CI
 # would stall through network retries); cache hits still resolve
 os.environ.setdefault("HF_HUB_OFFLINE", "1")
@@ -26,7 +32,8 @@ import jax  # noqa: E402
 # Something in the test environment imports jax before conftest runs, so the
 # env vars alone may be read too late — set the config directly as well
 # (safe as long as no backend has been initialised yet).
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402

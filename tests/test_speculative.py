@@ -66,6 +66,42 @@ def test_greedy_equivalence_perfect_draft(tiny_config, target):
     assert spec.acceptance_rate == 1.0
 
 
+def test_spec_scan_rounds_match_single_round(tiny_config, target, draft):
+    """spec_rounds=4 (on-device chained rounds, one fetch per 4) must
+    emit the same greedy stream as spec_rounds=1 (host-stepped) and the
+    oracle — the scan chains _spec_round with the identical rng
+    sequence, so this is exact, not approximate."""
+    prompt = np.full((1, 9), 5, np.int32)
+    plen = np.full((1,), 9, np.int32)
+    want = _oracle(tiny_config, target).generate_on_device(prompt, plen, 20)
+    one = _spec(tiny_config, target, draft, spec_rounds=1)
+    scan = _spec(tiny_config, target, draft, spec_rounds=4)
+    np.testing.assert_array_equal(
+        one.generate_on_device(prompt, plen, 20), want)
+    np.testing.assert_array_equal(
+        scan.generate_on_device(prompt, plen, 20), want)
+
+
+def test_spec_scan_window_edge_falls_back(tiny_config, target, draft):
+    """Near max_seq_len the R-round window does not fit; the generator
+    must fall back to single rounds and still emit the same stream a
+    spec_rounds=1 generator does. (Comparison is spec-vs-spec, not
+    vs the oracle: both paths trace the identical _spec_round, so the
+    equality is bitwise — an oracle comparison can flake on fp
+    near-ties between the batched verify pass and step-by-step decode,
+    e.g. a 0.005 logit gap on this prompt.)"""
+    def make(R):
+        return SpeculativeGenerator(
+            tiny_config, target, tiny_config, draft,
+            ByteTokenizer(tiny_config.vocab_size),
+            gamma=3, max_seq_len=48, sampling=GREEDY, spec_rounds=R)
+    prompt = np.full((1, 20), 5, np.int32)
+    plen = np.full((1,), 20, np.int32)
+    want = make(1).generate_on_device(prompt, plen, 8)
+    got = make(4).generate_on_device(prompt, plen, 8)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_interactive_session_matches_oracle(tiny_config, target, draft):
     """next_token protocol (the CLI/API path) equals the oracle stream.
 

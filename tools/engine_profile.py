@@ -11,7 +11,8 @@ step/scan):
   - per-scan (K-step) and per-single-step decode dispatch time
   - decode token accounting: how many tokens came from scans vs singles
 
-Usage:  python tools/engine_profile.py [model] [slots] [gen_tokens]
+Usage:  python tools/engine_profile.py [model] [slots] [gen_tokens] \
+            [int8|int4|bf16]      # weight quant; default int8 for 8b
 """
 from __future__ import annotations
 
@@ -38,6 +39,11 @@ def main():
     model = sys.argv[1] if len(sys.argv) > 1 else "8b"
     slots = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     gen_tokens = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    quant_s = sys.argv[4] if len(sys.argv) > 4 else (
+        "int8" if model == "8b" else "bf16")
+    if quant_s not in ("int8", "int4", "bf16"):
+        raise SystemExit(f"quant must be int8|int4|bf16, got {quant_s!r}")
+    quant = False if quant_s == "bf16" else quant_s
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform}/{dev.device_kind}")
@@ -64,7 +70,8 @@ def main():
     log(f"async chained dispatch (block once): {async_rtt * 1e3:.1f} ms/op")
 
     cfg = bench.make_config(model)
-    init, _ = bench._init_fn("int8" if model == "8b" else False)
+    init, desc = bench._init_fn(quant)
+    log(f"weights: {desc}")
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
 
@@ -75,24 +82,36 @@ def main():
         decode_scan_steps=8,
     )
 
-    times = {"prefill": [], "scan": [], "single": []}
+    # spy on the DISPATCH/FETCH primitives, not the high-level wrappers:
+    # single-host multi-step decode routes through _decode_burst (which
+    # calls _dispatch_scan_device/_fetch_scan directly), and prefill
+    # admission goes through _do_prefill(..., defer=True)
+    times = {"prefill": [], "scan_dispatch": [], "scan_fetch": [],
+             "single": []}
     counts = {"scan_tokens": 0, "single_tokens": 0}
 
     orig_prefill = engine._do_prefill
-    orig_scan = engine._do_decode_scan
+    orig_dispatch = engine._dispatch_scan_device
+    orig_fetch = engine._fetch_scan
     orig_dec = engine._do_decode
 
-    def prefill(rid, slot):
+    def prefill(rid, slot, defer=False):
         t = time.perf_counter()
-        r = orig_prefill(rid, slot)
+        r = orig_prefill(rid, slot, defer=defer)
         times["prefill"].append(time.perf_counter() - t)
         return r
 
-    def scan(plan, n):
+    def dispatch(rows, n, n_top, budget, state=None):
         t = time.perf_counter()
-        r = orig_scan(plan, n)
-        times["scan"].append(time.perf_counter() - t)
-        counts["scan_tokens"] += n * len(plan)
+        r = orig_dispatch(rows, n, n_top, budget, state=state)
+        times["scan_dispatch"].append(time.perf_counter() - t)
+        counts["scan_tokens"] += int(sum(budget))
+        return r
+
+    def fetch(outs):
+        t = time.perf_counter()
+        r = orig_fetch(outs)
+        times["scan_fetch"].append(time.perf_counter() - t)
         return r
 
     def dec(plan):
@@ -103,7 +122,8 @@ def main():
         return r
 
     engine._do_prefill = prefill
-    engine._do_decode_scan = scan
+    engine._dispatch_scan_device = dispatch
+    engine._fetch_scan = fetch
     engine._do_decode = dec
 
     prompt = list(range(3, 3 + 64))
