@@ -132,8 +132,11 @@ SMOKE_TIERS = {
     # fixed CLIP/VAE/PNG overhead dominates a 2-step delta
     "sd_tiny": dict(version="tiny", steps_a=2, steps_b=12),
     # chat-template overhead is ~115 tokens; keep headroom
+    # int8 target like the production spec_8b_draft1b tier, so the CPU
+    # smoke lane keeps exercising the quantized-target verify path
     "spec_tiny": dict(target="tiny", draft="tiny", max_seq=256,
-                      gamma=4, prompt_len=8, gen_tokens=24),
+                      gamma=4, prompt_len=8, gen_tokens=24,
+                      quant="int8"),
 }
 
 # HBM bandwidth (bytes/s) by device_kind substring; conservative defaults.

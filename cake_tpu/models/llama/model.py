@@ -151,7 +151,7 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             return attn, (kc, vc)
         kc, vc = update_layer_cache(k_cache, v_cache, k, v, pos)
         use_flash = is_prefill and config.use_flash_attention
-        if use_flash and not chunked and flash_supported(S, S, H, KV):
+        if use_flash and not chunked and flash_supported(S, S, H, KV, hd=config.head_dim):
             # Fresh prompt at pos=0 with an empty cache: causal attention
             # over the in-window k/v IS the cached-decode mask, so the
             # kernel reads only the S fresh keys — no cache traffic.
@@ -159,7 +159,7 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
             # window key blocks are skipped entirely).
             attn = flash_attention(q, k, v, causal=True,
                                    window=config.sliding_window)
-        elif (use_flash and chunked and flash_supported(S, T, H, KV)
+        elif (use_flash and chunked and flash_supported(S, T, H, KV, hd=config.head_dim)
                 and kc.dtype == q.dtype):
             # (dtype guard: the Pallas kernel reads the cache directly, so
             # fp8-stored KV takes the einsum path, which upcasts on read)
@@ -170,7 +170,7 @@ def block_forward(lp, x, k_cache, v_cache, pos, rope_c, rope_s, mask,
                                           window=config.sliding_window)
         else:
             if use_flash:
-                if (chunked and flash_supported(S, T, H, KV)
+                if (chunked and flash_supported(S, T, H, KV, hd=config.head_dim)
                         and kc.dtype != q.dtype):
                     # intended fallback, not a shape problem
                     log.debug(

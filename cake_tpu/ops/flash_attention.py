@@ -342,7 +342,7 @@ def flash_attention_cached(q, k_cache, v_cache, pos, *,
     return jnp.swapaxes(out, 1, 2)
 
 
-def flash_supported(S: int, T: int, H: int, KV: int,
+def flash_supported(S: int, T: int, H: int, KV: int, hd: int,
                     block_q: int = 128, block_k: int = 128) -> bool:
     """Static shape check for the flash path (S = query window, T = KV
     length — equal for fresh-prompt prefill, T > S for the cache-aware
@@ -351,9 +351,17 @@ def flash_supported(S: int, T: int, H: int, KV: int,
     Beyond divisibility, the clamped blocks must be Mosaic-tileable: the
     second-minor dim of a bf16 tile is 16, so unaligned blocks (e.g. S=100
     -> block_q=100) compile only in interpret mode and must fall back to
-    the einsum path on hardware.
+    the einsum path on hardware. The minor (lane) dim is the head dim:
+    on real TPU it must fill 128-wide lanes, or Mosaic rejects the
+    kernel (found running the tiny-shape suite on silicon: hd=16
+    compiles in interpret mode, HTTP-500s out of the hardware compiler).
+    Callers that know the head dim pass it; production configs (hd=128)
+    pass the gate, tiny test configs fall back to the einsum path on
+    hardware and keep exercising the kernel in interpret mode on CPU.
     """
     bq = min(block_q, S)
     bk = min(block_k, T)
+    if hd % 128 != 0 and jax.default_backend() == "tpu":
+        return False
     return (S > 1 and S % bq == 0 and T % bk == 0 and H % KV == 0
             and bq % 16 == 0 and bk % 16 == 0)

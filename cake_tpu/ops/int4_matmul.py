@@ -123,6 +123,12 @@ def _pick_k_groups(n_groups: int, g: int) -> int:
 
 
 def kernel_supported(m: int, in_dim: int, g: int, out: int) -> bool:
+    if in_dim % 128 != 0 and jax.default_backend() == "tpu":
+        # the x block's minor (lane) dim is in_dim: sub-128 lanes
+        # compile in interpret mode but Mosaic rejects them on real
+        # silicon (found running the tiny-shape suite on chip) — fall
+        # back to the dequant-matmul path there
+        return False
     return (m <= MAX_KERNEL_M and in_dim % g == 0 and g % 2 == 0
             and (g // 2) % 8 == 0 and _pick_block_out(out) > 0)
 

@@ -28,6 +28,25 @@ def test_flash_matches_einsum_causal(H, KV):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_flash_real_backend_production_shapes():
+    """The REAL (non-interpret) kernel at lane-aligned production
+    shapes (head_dim 128). On the CPU lane interpret=None resolves to
+    interpret mode; under CAKE_TESTS_TPU=1 this compiles and runs the
+    actual Mosaic kernel on silicon — coverage the interpret=True tests
+    above cannot give (their tiny head dims are gated off hardware by
+    flash_supported)."""
+    B, S, H, KV, hd = 1, 256, 8, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand(ks[0], (B, S, H, hd))
+    k = _rand(ks[1], (B, S, KV, hd))
+    v = _rand(ks[2], (B, S, KV, hd))
+    assert flash_supported(S, S, H, KV, hd)
+    ref = gqa_attention(q, k, v, mask=causal_mask(S))
+    got = flash_attention(q, k, v, causal=True)     # interpret=None: real
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
 def test_flash_non_causal():
     B, S, H, KV, hd = 1, 64, 4, 2, 16
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
@@ -85,11 +104,15 @@ def test_prefill_flash_matches_default(tiny_config, tiny_params):
 
 
 def test_flash_supported_gate():
-    assert flash_supported(256, 256, 8, 4)
-    assert flash_supported(64, 64, 8, 4)            # bq clamps to 64
-    assert not flash_supported(1, 1024, 8, 4)       # decode step
-    assert not flash_supported(100, 100, 8, 4)      # 100 not Mosaic-tileable
-    assert not flash_supported(130, 130, 8, 4, block_q=128)
+    assert flash_supported(256, 256, 8, 4, 128)
+    assert flash_supported(64, 64, 8, 4, 128)       # bq clamps to 64
+    assert not flash_supported(1, 1024, 8, 4, 128)  # decode step
+    assert not flash_supported(100, 100, 8, 4, 128)  # not Mosaic-tileable
+    assert not flash_supported(130, 130, 8, 4, 128, block_q=128)
+    if jax.default_backend() == "tpu":
+        # sub-128-lane head dims compile in interpret mode but Mosaic
+        # rejects them on silicon — the gate must route them to einsum
+        assert not flash_supported(256, 256, 8, 4, 16)
 
 
 # -- cache-aware kernel (chunked / continued prefill, pos > 0) ----------------

@@ -77,6 +77,27 @@ def test_pallas_kernel_matches_fallback():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_pallas_kernel_real_backend_production_shapes():
+    """The REAL (non-interpret) kernel at production-like lane-aligned
+    shapes. On the CPU lane interpret=None resolves to interpret mode;
+    under CAKE_TESTS_TPU=1 this compiles and runs the actual Mosaic
+    kernel on silicon — the coverage the interpret=True test above
+    cannot give (tiny sub-128-lane shapes are gated off hardware by
+    kernel_supported instead)."""
+    rng = np.random.default_rng(7)
+    In, Out, g = 512, 256, 128
+    w = jnp.asarray(rng.normal(size=(In, Out)).astype(np.float32))
+    qt = quantize_group(w, 0, group=g)
+    x = jnp.asarray(rng.normal(size=(4, In)).astype(np.float32))
+    assert kernel_supported(4, In, g, Out)
+    got = int4_matmul(x, qt.q, qt.scale, g=g)   # interpret=None: real
+    vals = unpack_int4(qt.q, g).astype(jnp.float32)
+    G = qt.scale.shape[0]
+    deq = (vals.reshape(G, g, Out) * qt.scale[:, None, :]).reshape(In, Out)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x @ deq),
+                               rtol=5e-3, atol=5e-3)
+
+
 def test_quantize_params_int4_structure_matches_direct_init(tiny_config):
     from cake_tpu.models.llama.params import (
         init_params, init_params_quantized,
