@@ -41,13 +41,21 @@ def _serve_multihost(master, args) -> int:
         # master.generate_image with them (_run_image_follower).
         engine = None
     else:
+        if getattr(master.llm, "_forward_fn", None) is not None:
+            # the sp engine exists (single-host) but its step ops are
+            # not replayed over the control channel; without the replay
+            # a cross-process shard_map dispatch would hang in the
+            # collective instead of failing cleanly here
+            raise ValueError(
+                "--sp serving has no multi-host step replay; serve "
+                "it on one host")
         # every process builds the identical engine (the shared-cache
         # zeros allocation is a global computation, so construction
         # order matters and must match across hosts)
         engine = master.make_engine()
         if engine is None:
             raise ValueError(
-                "this serving mode (--sp / --draft-model) has no "
+                "this serving mode (--draft-model multi-host) has no "
                 "batching engine and no multi-host step replay; serve "
                 "it on one host")
         # the pre-fail capture must outlive the heartbeat stale window

@@ -80,16 +80,39 @@ class Master:
                 draft_config=g.draft_config,
                 spec_gamma=g.gamma,
             )
-        if getattr(g, "_forward_fn", None) is not None and g.parallel is None:
-            # a custom forward without a (plan, mesh) — e.g. the --sp
-            # adapter — has no engine-step contract. Returning None makes
-            # the REST layer serve through the legacy locked path (one
-            # generation at a time) instead: long-context one-shot
-            # requests work behind --api, they just don't batch.
-            log.info("no batching engine for this serving mode (--sp): "
-                     "the API serves requests one at a time through the "
-                     "generator")
-            return None
+        fwd = getattr(g, "_forward_fn", None)
+        if fwd is not None and g.parallel is None:
+            # custom forward without a (plan, mesh): the --sp adapter.
+            # Round-5: plain sp and sp x tp get a REAL engine contract
+            # (ring slot-prefill + merged-stats ragged decode,
+            # context_parallel.make_sp_engine_step_fns) — long-context
+            # serving batches concurrent requests instead of serialising
+            # on the legacy locked path. stage x sp / dp x sp still lock.
+            slots = max_slots or getattr(self.args, "max_slots", 8)
+            pieces = None
+            engine_pieces = getattr(fwd, "engine_pieces", None)
+            if engine_pieces is not None:
+                pieces = engine_pieces(slots, g.params)
+            if pieces is None:
+                log.info("no batching engine for this serving mode: "
+                         "the API serves requests one at a time through "
+                         "the generator")
+                return None
+            fns, cache, ctx_len, tail_len = pieces
+            log.info("sp engine: %d slots, ctx window %d + decode tail "
+                     "%d", slots, ctx_len, tail_len)
+            return InferenceEngine(
+                g.config, g.params, g.tokenizer,
+                max_slots=slots, max_seq_len=ctx_len + tail_len,
+                sampling=g.sampling, seed=self.args.seed,
+                decode_scan_steps=self.args.decode_scan,
+                step_fns=fns, cache=cache,
+                prompt_limit=ctx_len, decode_budget=tail_len,
+                # passed through so the engine's no-chunk-fn guard WARNS
+                # that --prefill-chunk has no sp variant, instead of the
+                # flag silently vanishing
+                prefill_chunk=getattr(self.args, "prefill_chunk", None),
+            )
         slots = max_slots or getattr(self.args, "max_slots", 8)
         kwargs = {}
         if getattr(g, "parallel", None) is not None:

@@ -212,19 +212,31 @@ def sp_prefill_layer(config: LlamaConfig, rope_c, rope_s, kv_dtype,
 
 
 def sp_decode_layer(config: LlamaConfig, rope_c, rope_s, t_slot,
-                    ctx_valid, tail_valid, tp_axis):
+                    ctx_valid, tail_valid, tp_axis, tail_update=None):
     """lax.scan layer fn for merged-stats decode:
-    h, (lp, ck, cv, tk, tv) -> h, (tk', tv')."""
+    h, (lp, ck, cv, tk, tv) -> h, (tk', tv').
+
+    tail_update(tk, tv, k, v) -> (tk', tv') writes the step's KV into
+    the tail cache; the default is the lockstep batch write at scalar
+    slot `t_slot` (the --sp generator adapter). The continuous-batching
+    engine passes a per-row active-masked writer instead — everything
+    else (rope, merged-stats attention, block skeleton) is THIS single
+    implementation for both."""
+    if tail_update is None:
+        def tail_update(tk, tv, k, v):
+            tk2 = lax.dynamic_update_slice_in_dim(
+                tk, k.astype(tk.dtype), t_slot, axis=1)
+            tv2 = lax.dynamic_update_slice_in_dim(
+                tv, v.astype(tv.dtype), t_slot, axis=1)
+            return tk2, tv2
+
     def layer(h, xs):
         lp, ck, cv, tk, tv = xs
 
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            tk2 = lax.dynamic_update_slice_in_dim(
-                tk, k.astype(tk.dtype), t_slot, axis=1)
-            tv2 = lax.dynamic_update_slice_in_dim(
-                tv, v.astype(tv.dtype), t_slot, axis=1)
+            tk2, tv2 = tail_update(tk, tv, k, v)
             out = sp_merged_attention(q, ck, cv, tk2, tv2,
                                       ctx_valid, tail_valid, "sp")
             return out, (tk2, tv2)
@@ -236,13 +248,40 @@ def sp_decode_layer(config: LlamaConfig, rope_c, rope_s, t_slot,
 def sp_decode_masks(idx, Sl: int, plen, tail_T: int, t_slot, B: int):
     """(ctx_valid, tail_valid) for one decode step: context slots below
     each row's prompt length (global slot ids from this device's sp
-    index), tail slots up to and including the one being written."""
+    index), tail slots up to and including the one being written.
+    t_slot: scalar (lockstep batch — the --sp generator adapter) or [B]
+    per-row (the continuous-batching sp engine's ragged decode)."""
     slot_g = idx * Sl + jnp.arange(Sl)
     ctx_valid = (slot_g[None] < plen[:, None])[:, None, None, None, :]
-    tail_valid = (jnp.arange(tail_T)[None] <= t_slot)
+    t = jnp.asarray(t_slot)
+    if t.ndim == 0:
+        t = t[None]
+    tail_valid = jnp.arange(tail_T)[None] <= t[:, None]
     tail_valid = jnp.broadcast_to(
         tail_valid, (B, tail_T))[:, None, None, None, :]
     return ctx_valid, tail_valid
+
+
+def make_sp_prefill_body(config: LlamaConfig, kv_dtype, tp_axis,
+                         Sl: int):
+    """THE ring-prefill shard_map body — single source for
+    make_sp_forward (the --sp generator adapter, [B, Sl] rows) and
+    make_sp_engine_step_fns (the continuous-batching engine, [1, Sl]
+    per-slot prefill), so a layer/mask fix to one cannot miss the
+    other."""
+    def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
+                     cos, sin):
+        idx = lax.axis_index("sp")
+        x = jnp.take(embed, tokens, axis=0)             # [B, Sl, D]
+        rope_c = lax.dynamic_slice_in_dim(cos, idx * Sl, Sl, axis=0)
+        rope_s = lax.dynamic_slice_in_dim(sin, idx * Sl, Sl, axis=0)
+        layer = sp_prefill_layer(config, rope_c, rope_s, kv_dtype,
+                                 tp_axis)
+        x, (ks, vs) = lax.scan(layer, x, blocks)
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+        logits = sp_select_last(x, plen, idx, Sl, lm_head)
+        return logits, ks, vs
+    return prefill_body
 
 
 def sp_select_last(x, plen, idx, Sl: int, lm_head):
@@ -367,18 +406,7 @@ def make_sp_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
     Sl = ctx_len // sp_size
     tp_axis = "tp" if tp else None
 
-    def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
-                     cos, sin):
-        idx = lax.axis_index("sp")
-        x = jnp.take(embed, tokens, axis=0)                 # [B, Sl, D]
-        rope_c = lax.dynamic_slice_in_dim(cos, idx * Sl, Sl, axis=0)
-        rope_s = lax.dynamic_slice_in_dim(sin, idx * Sl, Sl, axis=0)
-        layer = sp_prefill_layer(config, rope_c, rope_s, kv_dtype,
-                                 tp_axis)
-        x, (ks, vs) = lax.scan(layer, x, blocks)
-        x = rms_norm(x, final_norm, config.rms_norm_eps)
-        logits = sp_select_last(x, plen, idx, Sl, lm_head)
-        return logits, ks, vs
+    prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
 
     def decode_body(blocks, embed, final_norm, lm_head, token, pos, plen,
                     ctx_k, ctx_v, tail_k, tail_v, cos, sin):
@@ -523,6 +551,14 @@ class SPGeneratorForward:
         # the prefill allocates its own SPCache and ignores the passed-in
         # cache (generator skips its fresh() copy accordingly)
         self.allocates_cache = True
+        # kept for engine_pieces (master.make_engine builds the sp
+        # continuous-batching engine from the same mesh/window layout)
+        self._mesh = mesh
+        self._config = config
+        self._kv_dtype = kv_dtype
+        self._tp = tp
+        self._stages = stages
+        self._dp = dp
         if stages > 1:
             # sp x pipeline-stage composition: layers sharded over "stage",
             # sequence over "sp" (parallel/sp_pipeline) — same call
@@ -559,6 +595,23 @@ class SPGeneratorForward:
                                    cache.sp, rope)
         return logits, SPSessionCache(spc, cache.plen)
 
+    def engine_pieces(self, slots: int, params):
+        """(step_fns, cache, ctx_len, tail_len) for the continuous-
+        batching engine over this adapter's mesh, or None when the
+        composition has no engine contract (stage x sp, dp x sp keep
+        the locked path)."""
+        if self._stages > 1 or self._dp:
+            return None
+        dtype = (self._kv_dtype if self._kv_dtype is not None
+                 else params["embed"].dtype)
+        fns = make_sp_engine_step_fns(
+            self._mesh, self._config, self.ctx_len, self.tail_len,
+            kv_dtype=self._kv_dtype, tp=self._tp, params=params)
+        cache = create_sp_engine_cache(
+            self._mesh, self._config, slots, self.ctx_len,
+            self.tail_len, kv_dtype=dtype, tp=self._tp)
+        return fns, cache, self.ctx_len, self.tail_len
+
     def decode_scan(self, params, token, k0: int, cache, rope, rng, ring,
                     num_steps: int, sampling):
         """num_steps on-device decode+sample steps (see sp_decode_scan).
@@ -569,3 +622,177 @@ class SPGeneratorForward:
             cache.sp, rope, rng, ring, num_steps=num_steps,
             sampling=sampling)
         return toks, SPSessionCache(spc, cache.plen), ring, rng
+
+
+# -- continuous-batching engine over the sp mesh ------------------------------
+
+
+class SPEngineCache(NamedTuple):
+    """SPCache plus the per-slot prompt lengths, so the engine's generic
+    step-fn contract (which passes only pos/active) still reaches the
+    per-row window layout: ctx region [0, plen[b]) holds slot b's ring-
+    prefilled prompt, tail slot t holds its (plen[b]+t)-positioned
+    generated token. plen rides the cache pytree through donated decode
+    dispatches and chained scans unchanged."""
+    ctx_k: jnp.ndarray          # [L, B, S_ctx, KV, hd] seq-sharded "sp"
+    ctx_v: jnp.ndarray
+    tail_k: jnp.ndarray         # [L, B, T_tail, KV, hd] replicated
+    tail_v: jnp.ndarray
+    plen: jnp.ndarray           # [B] int32
+
+    def fresh(self) -> "SPEngineCache":
+        return SPEngineCache(*(jnp.zeros_like(x) for x in self))
+
+
+def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
+                           ctx_len: int, tail_len: int,
+                           kv_dtype=jnp.bfloat16,
+                           tp: bool = False) -> SPEngineCache:
+    """Allocate the engine's multi-slot sp cache with the shardings
+    make_sp_engine_step_fns' shard_maps expect."""
+    KV, hd = config.num_key_value_heads, config.head_dim
+    L = config.num_hidden_layers
+    tp_axis = "tp" if tp else None
+    ctx = NamedSharding(mesh, P(None, None, "sp", tp_axis, None))
+    tail = NamedSharding(mesh, P(None, None, None, tp_axis, None)
+                         if tp else P())
+    rep = NamedSharding(mesh, P())
+    z = lambda shape, sh: jax.device_put(jnp.zeros(shape, kv_dtype), sh)
+    return SPEngineCache(
+        ctx_k=z((L, slots, ctx_len, KV, hd), ctx),
+        ctx_v=z((L, slots, ctx_len, KV, hd), ctx),
+        tail_k=z((L, slots, tail_len, KV, hd), tail),
+        tail_v=z((L, slots, tail_len, KV, hd), tail),
+        plen=jax.device_put(jnp.zeros((slots,), jnp.int32), rep),
+    )
+
+
+def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
+                            ctx_len: int, tail_len: int,
+                            kv_dtype=None, tp: bool = False,
+                            params=None):
+    """Engine step-fn contract over the sp(x tp) mesh: long-context
+    CONTINUOUS-BATCHING serving — every slot's prompt ring-prefills over
+    the sequence shards and concurrent requests decode together with
+    merged-stats attention, instead of the single-tenant locked path the
+    --sp adapter served through before.
+
+    Returns (prefill_slot_fn, decode_ragged_fn, decode_scan_fn): the
+    same signatures as model.prefill_slot / decode_step_ragged /
+    engine.make_decode_scan's product, over an SPEngineCache.
+
+    Unlike the batch-1 SPGeneratorForward (whose tail positions start at
+    ctx_len, leaving a documented rope gap for short prompts), the
+    engine layout is position-contiguous: row b's generated token t sits
+    at rope position plen[b]+t and tail slot t, so outputs match the
+    dense engine exactly for any prompt length. Composition: sp alone or
+    sp x tp (stages/dp keep the locked path)."""
+    sp_size = mesh.shape["sp"]
+    assert ctx_len % sp_size == 0, (ctx_len, sp_size)
+    Sl = ctx_len // sp_size
+    tp_axis = "tp" if tp else None
+    blocks_spec = sp_block_specs(config, tp, params)
+    rep = P()
+
+    # -- ragged decode over [B] per-row positions -------------------------
+    def decode_body(blocks, embed, final_norm, lm_head, token, pos,
+                    active, ctx_k, ctx_v, tail_k, tail_v, plen, cos, sin):
+        idx = lax.axis_index("sp")
+        B = token.shape[0]
+        tail_T = tail_k.shape[2]
+        x = jnp.take(embed, token, axis=0)               # [B, 1, D]
+        from cake_tpu.ops.rope import rope_rows_per_row
+        rope_c, rope_s = rope_rows_per_row(cos, sin, pos)
+        # contiguous positions: tail slot = generated index = pos - plen
+        t_slot = jnp.clip(pos - plen, 0, tail_T - 1)     # [B]
+        ctx_valid, tail_valid = sp_decode_masks(idx, Sl, plen, tail_T,
+                                                t_slot, B)
+
+        from cake_tpu.models.llama.cache import update_layer_cache_per_row
+
+        def tail_update(tk, tv, k, v):
+            # per-row active-masked write (ragged slots), vs the
+            # lockstep scalar-slot default
+            return update_layer_cache_per_row(tk, tv, k, v, t_slot,
+                                              active)
+
+        layer = sp_decode_layer(config, rope_c, rope_s, None, ctx_valid,
+                                tail_valid, tp_axis,
+                                tail_update=tail_update)
+        x, (tk_new, tv_new) = lax.scan(
+            layer, x, (blocks, ctx_k, ctx_v, tail_k, tail_v))
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+        logits = qmatmul(x[:, -1], lm_head).astype(jnp.float32)
+        return logits, tk_new, tv_new
+
+    ctx_spec = P(None, None, "sp", tp_axis, None)
+    tail_spec = P(None, None, None, tp_axis, None) if tp else P()
+    decode_sm = jax.shard_map(
+        decode_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
+                  ctx_spec, ctx_spec, tail_spec, tail_spec, rep, rep,
+                  rep),
+        out_specs=(rep, tail_spec, tail_spec),
+        check_vma=False,
+    )
+
+    def decode_ragged_forward(params, tokens, cache: SPEngineCache, pos,
+                              active, rope: RopeTables,
+                              config_: LlamaConfig):
+        logits, tk, tv = decode_sm(
+            params["blocks"], params["embed"], params["final_norm"],
+            params["lm_head"], tokens, pos.astype(jnp.int32),
+            active, cache.ctx_k, cache.ctx_v, cache.tail_k,
+            cache.tail_v, cache.plen, rope.cos, rope.sin)
+        return logits, SPEngineCache(cache.ctx_k, cache.ctx_v, tk, tv,
+                                     cache.plen)
+
+    @partial(jax.jit, static_argnames=("config_",),
+             donate_argnames=("cache",))
+    def decode_ragged_fn(params, tokens, pos, active,
+                         cache: SPEngineCache, rope: RopeTables,
+                         config_: LlamaConfig):
+        return decode_ragged_forward(params, tokens, cache, pos, active,
+                                     rope, config_)
+
+    # -- slot prefill: ring-prefill one prompt, scatter into the slot -----
+    prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
+
+    prefill_sm = jax.shard_map(
+        prefill_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep,
+                  rep, rep),
+        out_specs=(rep, ctx_spec, ctx_spec),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, static_argnames=("config_",),
+             donate_argnames=("cache",))
+    def prefill_slot_fn(params, tokens, prompt_len, slot,
+                        cache: SPEngineCache, rope: RopeTables,
+                        config_: LlamaConfig):
+        """[1, bucket] prompt -> ring prefill at [1, ctx_len] -> scatter
+        the slot's ctx shard + plen. Bucket padding beyond ctx_len is
+        trimmed (real tokens are capped at ctx_len by the engine's
+        prompt_limit); shorter buckets zero-pad up to the window."""
+        S = tokens.shape[1]
+        if S >= ctx_len:
+            toks = tokens[:, :ctx_len]
+        else:
+            toks = jnp.pad(tokens, ((0, 0), (0, ctx_len - S)))
+        logits, ks, vs = prefill_sm(
+            params["blocks"], params["embed"], params["final_norm"],
+            params["lm_head"], toks, prompt_len.astype(jnp.int32),
+            rope.cos, rope.sin)
+        ctx_k = lax.dynamic_update_slice_in_dim(
+            cache.ctx_k, ks.astype(cache.ctx_k.dtype), slot, axis=1)
+        ctx_v = lax.dynamic_update_slice_in_dim(
+            cache.ctx_v, vs.astype(cache.ctx_v.dtype), slot, axis=1)
+        plen = cache.plen.at[slot].set(prompt_len[0].astype(jnp.int32))
+        return logits, SPEngineCache(ctx_k, ctx_v, cache.tail_k,
+                                     cache.tail_v, plen)
+
+    from cake_tpu.serve.engine import make_decode_scan
+    decode_scan_fn = make_decode_scan(decode_ragged_forward)
+
+    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn

@@ -218,8 +218,18 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
             if not is_resumable(rec):
                 finished.append(rec)
                 continue
+            ids = rec["prompt_ids"] + rec["out_tokens"]
+            limit = getattr(engine, "prompt_limit", None)
+            if limit is not None and len(ids) > limit:
+                # windowed serving modes (the sp engine's ctx+tail
+                # layout) re-prefill a resumed request's whole
+                # transcript into the prompt window; a transcript past
+                # the window has no replay path — documented limitation
+                raise ValueError(
+                    f"resumed context {len(ids)} exceeds this serving "
+                    f"mode's prompt window {limit}")
             handles.append(engine.submit(
-                rec["prompt_ids"] + rec["out_tokens"],
+                ids,
                 max_new_tokens=rec["remaining"],
                 temperature=rec["temperature"],
                 top_p=rec["top_p"],

@@ -197,12 +197,19 @@ class InferenceEngine:
         spec_gamma: int = 4,
         kv_pages: Optional[int] = None,
         kv_page_size: int = 128,
+        prompt_limit: Optional[int] = None,
+        decode_budget: Optional[int] = None,
     ):
         self.config = config
         self.params = params
         self.tokenizer = tokenizer
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
+        # windowed layouts (the sp engine: prompt region + decode tail)
+        # bound prompts and per-request generation separately from
+        # max_seq_len; None = the classic single-window rules
+        self.prompt_limit = prompt_limit
+        self.decode_budget = decode_budget
         self.defaults = sampling or SamplingConfig()
         # alternatives computed per sample step for OpenAI `top_logprobs`
         # (requests slice their n <= cap host-side; 20 is the API maximum;
@@ -386,9 +393,18 @@ class InferenceEngine:
                                           cache_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
         # an identically-sharded cache even after donation freed the buffers
-        self._cache_shardings = KVCache(k=self.cache.k.sharding,
-                                        v=self.cache.v.sharding)
-        self._cache_dtype = self.cache.k.dtype
+        if isinstance(self.cache, KVCache):
+            self._cache_shardings = KVCache(k=self.cache.k.sharding,
+                                            v=self.cache.v.sharding)
+            self._cache_dtype = self.cache.k.dtype
+        else:
+            # custom cache pytree (e.g. the sp engine's SPEngineCache):
+            # capture (shape, dtype, sharding) NOW — donation frees the
+            # buffers, and a post-error rebuild cannot read them then
+            self._cache_shardings = jax.tree.map(
+                lambda x: (x.shape, x.dtype, x.sharding), self.cache,
+                is_leaf=lambda x: hasattr(x, "sharding"))
+            self._cache_dtype = self.cache[0].dtype
         self.scheduler = make_scheduler(max_slots, max_queue)
         self.stats = EngineStats()
         from cake_tpu.utils.profiling import StepStats
@@ -624,7 +640,15 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {len(ids)} exceeds max_seq_len "
                 f"{self.max_seq_len}")
+        if self.prompt_limit is not None and len(ids) > self.prompt_limit:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds this serving mode's "
+                f"prompt window {self.prompt_limit}")
         max_new = min(max_new_tokens, self.max_seq_len - len(ids))
+        if self.decode_budget is not None:
+            # windowed layouts cap generation by the tail capacity, not
+            # by max_seq - prompt
+            max_new = min(max_new, self.decode_budget)
         if self.paged and (self._pager.pages_for(len(ids) + max_new)
                            > self.cache.n_pages):
             # can NEVER be admitted (need exceeds the whole pool) —
@@ -1070,6 +1094,16 @@ class InferenceEngine:
                                self._reset_count), B)
 
     def _fresh_cache(self) -> KVCache:
+        if not isinstance(self.cache, KVCache) and not self.paged:
+            # custom cache pytree (sp engine): rebuild zeros from the
+            # (shape, dtype, sharding) captured at init — the donated
+            # buffers themselves may already be freed. PagedKVCache is
+            # also not a KVCache but MUST take its own branch below: a
+            # zeros rebuild would map every slot to page 0 (create()
+            # fills the table with -1) and leak the allocator's pages.
+            return type(self.cache)(*(
+                jax.device_put(jnp.zeros(shape, dtype), sharding)
+                for (shape, dtype, sharding) in self._cache_shardings))
         if self.paged:
             from cake_tpu.models.llama.paged import (
                 PageAllocator, PagedKVCache,

@@ -253,17 +253,27 @@ def test_sp_scratch_generation_does_not_clobber_session():
     assert first + rest == want
 
 
-def test_sp_has_no_engine_but_serves_via_locked_path():
-    """--sp + --api: no batching engine (the sp adapter has no engine
-    step contract) — make_engine returns None and the REST layer serves
-    one-shot long-prompt requests through the legacy locked path
-    (round-3 verdict #6)."""
+def test_sp_engine_and_dp_sp_locked_path():
+    """Round-5: plain --sp + --api gets a REAL batching engine
+    (context_parallel.make_sp_engine_step_fns; covered in depth by
+    tests/test_sp_engine.py); the dp x sp composition still has no
+    engine contract — make_engine returns None there and the REST layer
+    serves one-shot requests through the legacy locked path."""
     import json
     import urllib.request
 
     from cake_tpu.api.server import start
     from cake_tpu.master import Master
-    args = _mk_args(sp=4, max_seq_len=256, sample_len=8)
+
+    sp_args = _mk_args(sp=4, max_seq_len=256, sample_len=8)
+    sp_master = Master(sp_args, text_generator=_ctx(sp_args)
+                       .load_text_model())
+    eng = sp_master.make_engine()
+    assert eng is not None, "--sp should serve through the engine now"
+    eng.stop()
+
+    args = _mk_args(sp=4, dp=2, batch_size=2, max_seq_len=256,
+                    sample_len=8)
     gen = _ctx(args).load_text_model()
     master = Master(args, text_generator=gen)
     assert master.make_engine() is None
