@@ -41,10 +41,13 @@ def _serve_multihost(master, args) -> int:
         # master.generate_image with them (_run_image_follower).
         engine = None
     else:
-        if getattr(master.llm, "_forward_fn", None) is not None:
-            # the sp engine exists (single-host) but its step ops are
-            # not replayed over the control channel; without the replay
-            # a cross-process shard_map dispatch would hang in the
+        if (getattr(master.llm, "_forward_fn", None) is not None
+                and getattr(master.llm, "parallel", None) is None):
+            # the sp adapter (custom forward WITHOUT a (plan, mesh) —
+            # topology models have both and replay fine): its engine
+            # exists single-host, but its step ops are not replayed
+            # over the control channel; without the replay a
+            # cross-process shard_map dispatch would hang in the
             # collective instead of failing cleanly here
             raise ValueError(
                 "--sp serving has no multi-host step replay; serve "

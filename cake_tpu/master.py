@@ -99,6 +99,15 @@ class Master:
                          "the generator")
                 return None
             fns, cache, ctx_len, tail_len = pieces
+            if getattr(self.args, "kv_pages", None):
+                log.warning("--kv-pages ignored: the sp engine's "
+                            "ctx/tail cache is not paged (the ctx "
+                            "region is sequence-sharded, not "
+                            "slot-paged)")
+            if getattr(self.args, "auto_prefix", False):
+                log.warning("--auto-prefix ignored: prefix caching is "
+                            "not implemented for the sp engine's "
+                            "sequence-sharded ctx cache")
             log.info("sp engine: %d slots, ctx window %d + decode tail "
                      "%d", slots, ctx_len, tail_len)
             return InferenceEngine(
