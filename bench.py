@@ -71,7 +71,7 @@ ENGINE_TIERS = [
     # vs 215 at 8 slots and 151 at 32 (32-slot cache + weights thrash HBM)
     ("engine_8b_int8", dict(model="8b", quant=True, max_seq=512, slots=16)),
     ("engine_1b", dict(model="1b", quant=False, max_seq=512, slots=16)),
-    # speculation INSIDE the engine (spec_step_slot rounds per slot):
+    # speculation INSIDE the engine (spec_round_batched: all slots per round):
     # the spec tier merged into the engine tier — acceptance + batched
     # tok/s with concurrent speculating streams. Random weights make
     # the measured acceptance a FLOOR (see SPEC_TIERS note).

@@ -65,9 +65,18 @@ class Master:
                 log.info("no multi-host engine for --draft-model")
                 return None
             # round-5: speculation inside the batching engine — the
-            # draft/verify round runs per slot (spec_step_slot), so
+            # draft/verify rounds run BATCHED across slots (spec_round_batched), so
             # concurrent API requests all speculate, stream, and
             # checkpoint like any other engine request
+            if getattr(self.args, "kv_pages", None):
+                log.warning("--kv-pages ignored with --draft-model: the "
+                            "spec engine's target+draft caches are not "
+                            "paged")
+            if getattr(self.args, "auto_prefix", False):
+                log.warning("--auto-prefix ignored with --draft-model: "
+                            "prefix caching is not implemented for the "
+                            "spec engine (draft cache has no prefix "
+                            "install path)")
             slots = max_slots or getattr(self.args, "max_slots", 8)
             return InferenceEngine(
                 g.config, g.params, g.tokenizer,

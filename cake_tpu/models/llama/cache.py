@@ -123,3 +123,29 @@ def update_layer_cache_per_row_ring(k_cache, v_cache, new_k, new_v, pos,
     W = k_cache.shape[1]
     return update_layer_cache_per_row(k_cache, v_cache, new_k, new_v,
                                       jnp.mod(pos, W), active)
+
+
+def update_layer_cache_window_per_row(k_cache, v_cache, new_k, new_v,
+                                      pos0, active):
+    """Write a W-token window per row at that row's own start position
+    (the batched speculative verify: row b's tokens j land at absolute
+    positions pos0[b]+j).
+
+    k_cache/v_cache: [B, S_max, KV, hd]
+    new_k/new_v:     [B, W, KV, hd]
+    pos0:            [B] absolute start positions
+    active:          [B] bool; inactive rows keep their cache lines.
+    Indices clamp at S_max-1 (callers bound pos0+W <= S_max; the clamp
+    only protects inactive rows' stale pos0)."""
+    B, W = new_k.shape[:2]
+    b = jnp.arange(B)[:, None]
+    idx = jnp.clip(pos0[:, None] + jnp.arange(W)[None],
+                   0, k_cache.shape[1] - 1)
+    sel = active[:, None, None, None]
+    old_k = k_cache[b, idx]
+    old_v = v_cache[b, idx]
+    k_cache = k_cache.at[b, idx].set(
+        jnp.where(sel, new_k.astype(k_cache.dtype), old_k))
+    v_cache = v_cache.at[b, idx].set(
+        jnp.where(sel, new_v.astype(v_cache.dtype), old_v))
+    return k_cache, v_cache

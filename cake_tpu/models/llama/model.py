@@ -300,31 +300,44 @@ def run_blocks_ragged(blocks, x, cache: KVCache, pos, active,
                       rope_c, rope_s, mask, config: LlamaConfig,
                       tp_axis: Optional[str] = None,
                       ep_axis: Optional[str] = None,
-                      ring: bool = False
+                      ring: bool = False,
+                      cache_update=None
                       ) -> Tuple[jnp.ndarray, KVCache]:
-    """Scan the stacked blocks for per-row-position single-token decode.
+    """Scan the stacked blocks for per-row-position ragged decode.
 
-    x: [B, 1, D]; pos/active: [B]; rope_c/rope_s: [B, 1, hd/2] per-row
-    rows; mask: [B, 1, T]. Inactive rows compute garbage but leave their
-    cache lines untouched. Shared by the single-device ragged decode and
-    the pipelined engine step (parallel/pipeline.py), where the blocks/cache
-    views are stage-local shards.
-    """
+    x: [B, S, D]; pos/active: [B]; rope_c/rope_s: [B, S, hd/2] per-row
+    rows; mask: [B, S, T]. S = 1 for single-token decode; the batched
+    speculative verify passes S = gamma+1 windows with its own
+    cache_update. Inactive rows compute garbage but leave their cache
+    lines untouched. Shared by the single-device ragged decode, the
+    pipelined engine step (parallel/pipeline.py — stage-local
+    blocks/cache views), and forward_window_ragged, so the block-scan
+    attention wiring exists exactly once.
+
+    cache_update(kc, vc, k, v) -> (kc', vc'): override the per-layer KV
+    write; default = single-token per-row write (ring-modular when
+    ring=True)."""
+    if cache_update is None:
+        if ring:
+            from cake_tpu.models.llama.cache import (
+                update_layer_cache_per_row_ring,
+            )
+
+            def cache_update(kc, vc, k, v):
+                return update_layer_cache_per_row_ring(kc, vc, k, v,
+                                                       pos, active)
+        else:
+            def cache_update(kc, vc, k, v):
+                return update_layer_cache_per_row(kc, vc, k, v, pos,
+                                                  active)
+
     def body(h, xs):
         lp, kc, vc = xs
 
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            if ring:
-                from cake_tpu.models.llama.cache import (
-                    update_layer_cache_per_row_ring,
-                )
-                kc2, vc2 = update_layer_cache_per_row_ring(kc, vc, k, v,
-                                                           pos, active)
-            else:
-                kc2, vc2 = update_layer_cache_per_row(kc, vc, k, v, pos,
-                                                      active)
+            kc2, vc2 = cache_update(kc, vc, k, v)
             return gqa_attention(q, kc2, vc2, mask=mask), (kc2, vc2)
 
         h, (kc, vc) = block_skeleton(lp, h, config, attn_fn,
@@ -383,6 +396,47 @@ def decode_step_ragged(params, tokens, pos, active, cache: KVCache,
                        rope: RopeTables, config: LlamaConfig):
     """Jitted ragged decode step (compiles once per batch size)."""
     return forward_ragged(params, tokens, cache, pos, active, rope, config)
+
+
+def forward_window_ragged(params, tokens, cache: KVCache, pos0, active,
+                          rope: RopeTables, config: LlamaConfig):
+    """Score a W-token window per row, each row at its OWN start
+    position — the batched speculative verify (one target pass scores
+    every slot's [last_tok, drafts] burst concurrently, where the
+    per-slot engine path ran B separate batch-1 passes, streaming the
+    weights B times per round).
+
+    tokens: [B, W]; pos0: [B] absolute start positions; active: [B].
+    Row b's token j sits at position pos0[b]+j, attends cache slots
+    <= pos0[b]+j, and writes its KV there. Returns
+    (logits [B, W, V] f32, cache). Sliding-window configs are not
+    supported (speculation is gated off them upstream)."""
+    B, W = tokens.shape
+    T = cache.max_seq_len
+    x = jnp.take(params["embed"], tokens, axis=0)          # [B, W, D]
+    # per-(row, offset) rope rows: [B, W, hd/2]
+    p = pos0[:, None] + jnp.arange(W)[None]                # [B, W]
+    p = jnp.clip(p, 0, T - 1)
+    rope_c = jnp.take(rope.cos, p, axis=0)
+    rope_s = jnp.take(rope.sin, p, axis=0)
+    # [B, W, T]: query j of row b sees cache slots <= pos0[b]+j
+    kj = jax.lax.broadcasted_iota(jnp.int32, (B, W, T), 2)
+    mask = kj <= p[:, :, None]
+
+    from cake_tpu.models.llama.cache import (
+        update_layer_cache_window_per_row,
+    )
+
+    def window_update(kc, vc, k, v):
+        return update_layer_cache_window_per_row(kc, vc, k, v, pos0,
+                                                 active)
+
+    x, cache = run_blocks_ragged(params["blocks"], x, cache, pos0,
+                                 active, rope_c, rope_s, mask, config,
+                                 cache_update=window_update)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, cache
 
 
 def forward_ragged_ring(params, tokens, cache: KVCache, pos, active,

@@ -77,34 +77,136 @@ def spec_step(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
                        t_cfg, d_cfg, gamma, greedy)
 
 
+def _advance_row_keys(keys, advance_mask):
+    """Per-row PRNG split: returns (keys', subs [B, 2]) where keys'
+    advanced only for rows in advance_mask (idle slots and greedy rows
+    keep their stream untouched — concurrency must not change a
+    request's sampled tokens)."""
+    new_keys, subs = jax.vmap(jax.random.split, out_axes=1)(keys)
+    return jnp.where(advance_mask[:, None], new_keys, keys), subs
+
+
+def _greedy_accept(drafts, targets):
+    """Accepted-draft count per row under exact-match (greedy)
+    acceptance: the longest prefix where draft == target argmax."""
+    match = drafts == targets[:, : drafts.shape[1]]
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+
+
+def _rejection_accept(drafts, d_probs, t_probs, u, gamma: int):
+    """Leviathan accept/reject over a [B, gamma] draft burst, plus the
+    leftover-residual distribution at the first rejected position r —
+    norm(max(0, p_t - p_d)); at r == gamma (all accepted) the bonus
+    token samples from the target's own distribution.
+    Returns (n_acc [B], resid [B, V]). Shared verbatim by the batch-1
+    round (_spec_round) and the engine's batched round
+    (spec_round_batched) so the subtle acceptance arithmetic exists
+    exactly once."""
+    B = drafts.shape[0]
+    idx = drafts[..., None]                            # [B, gamma, 1]
+    p_t = jnp.take_along_axis(t_probs[:, :gamma], idx, axis=-1)[..., 0]
+    p_d = jnp.take_along_axis(d_probs, idx, axis=-1)[..., 0]
+    accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                    axis=1)
+    r = jnp.minimum(n_acc, gamma)
+    row = jnp.arange(B)
+    p_t_r = t_probs[row, r]                            # [B, V]
+    p_d_r = jnp.where((r < gamma)[:, None],
+                      d_probs[row, jnp.minimum(r, gamma - 1)], 0.0)
+    resid = jnp.maximum(p_t_r - p_d_r, 0.0)
+    resid = resid / jnp.maximum(jnp.sum(resid, -1, keepdims=True),
+                                1e-20)
+    return n_acc, resid
+
+
+def _assemble_sampled(drafts, correction, n_acc, gamma: int):
+    """Per-row output burst for the sampled path: accepted drafts, then
+    the correction/bonus token at position n_acc, tail padded with the
+    last draft (masked off by the caller's n_emit mask)."""
+    return jnp.where(jnp.arange(gamma + 1)[None] ==
+                     jnp.minimum(n_acc, gamma)[:, None],
+                     correction[:, None],
+                     jnp.concatenate([drafts, drafts[:, -1:]], axis=1))
+
+
 @partial(jax.jit,
-         static_argnames=("t_cfg", "d_cfg", "gamma", "greedy"),
+         static_argnames=("t_cfg", "d_cfg", "gamma"),
          donate_argnames=("t_cache", "d_cache"))
-def spec_step_slot(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
-                   last_tok, pos, slot, t_rope: RopeTables,
-                   d_rope: RopeTables, rng, temperature,
-                   t_cfg: LlamaConfig, d_cfg: LlamaConfig,
-                   gamma: int, greedy: bool):
-    """spec_step against ONE slot of multi-slot engine caches
-    ([L, slots, T, KV, hd]): slice the slot out, run the round, scatter
-    the updated KV back. `slot` is traced (one compiled program serves
-    every slot). The engine's draft/verify step contract — batch-1 per
-    round, but the ENGINE interleaves rounds across slots so concurrent
-    API requests all speculate."""
-    def pick(c: KVCache) -> KVCache:
-        return KVCache(
-            jax.lax.dynamic_slice_in_dim(c.k, slot, 1, axis=1),
-            jax.lax.dynamic_slice_in_dim(c.v, slot, 1, axis=1))
+def spec_round_batched(t_params, d_params, t_cache: KVCache,
+                       d_cache: KVCache, last_tok, pos, active, keys,
+                       temp, t_rope: RopeTables, d_rope: RopeTables,
+                       t_cfg: LlamaConfig, d_cfg: LlamaConfig,
+                       gamma: int):
+    """One propose-verify-accept round for EVERY active slot in one
+    compiled program: gamma+1 batched ragged draft steps + one batched
+    windowed verify. The per-slot engine path (spec_step_slot) ran B
+    separate batch-1 rounds, streaming the weights B times per round —
+    this streams them once, which is the whole cost model of batched
+    decode.
 
-    def put(c: KVCache, s: KVCache) -> KVCache:
-        return KVCache(
-            jax.lax.dynamic_update_slice_in_dim(c.k, s.k, slot, axis=1),
-            jax.lax.dynamic_update_slice_in_dim(c.v, s.v, slot, axis=1))
+    last_tok [B, 1] at per-row absolute `pos` (KV not yet written);
+    active [B]; keys [B, 2] per-slot PRNG keys (advanced only for
+    active sampled rows); temp [B] (<= 0 -> greedy row: argmax drafts,
+    exact-match acceptance; > 0 -> leftover-residual rejection
+    sampling, per row).
+    Returns (out [B, gamma+1] — first n_emit[b] valid, rest -1;
+    n_emit [B] (0 for inactive rows); t_cache; d_cache; keys)."""
+    from cake_tpu.models.llama.model import (
+        forward_ragged, forward_window_ragged,
+    )
 
-    out, n_emit, tc, dc, rng = _spec_round(
-        t_params, d_params, pick(t_cache), pick(d_cache), last_tok, pos,
-        t_rope, d_rope, rng, temperature, t_cfg, d_cfg, gamma, greedy)
-    return out, n_emit, put(t_cache, tc), put(d_cache, dc), rng
+    B = last_tok.shape[0]
+    greedy = temp <= 0.0
+    temp_eff = jnp.where(greedy, 1.0, temp)[:, None]
+
+    def draft_body(carry, _):
+        cache, tok, p, keys = carry
+        logits, cache = forward_ragged(d_params, tok, cache, p, active,
+                                       d_rope, d_cfg)
+        probs = jax.nn.softmax(logits / temp_eff, axis=-1)
+        nxt_g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys, subs = _advance_row_keys(keys, active & ~greedy)
+        nxt_s = jax.vmap(jax.random.categorical)(
+            subs, logits / temp_eff).astype(jnp.int32)
+        nxt = jnp.where(greedy, nxt_g, nxt_s)
+        return ((cache, nxt[:, None], p + active, keys),
+                (nxt, probs))
+
+    (d_cache, _, _, keys), (drafts_all, d_probs_all) = jax.lax.scan(
+        draft_body, (d_cache, last_tok, pos, keys), None,
+        length=gamma + 1)
+    drafts = drafts_all[:gamma].T                      # [B, gamma]
+    d_probs = jnp.swapaxes(d_probs_all[:gamma], 0, 1)  # [B, gamma, V]
+
+    tokens_v = jnp.concatenate([last_tok, drafts], axis=1)
+    t_logits, t_cache = forward_window_ragged(
+        t_params, tokens_v, t_cache, pos, active, t_rope, t_cfg)
+
+    # greedy rows: exact-match acceptance against the target argmax
+    targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    n_acc_g = _greedy_accept(drafts, targets)
+
+    # sampled rows: leftover-residual rejection sampling (per row),
+    # the same _rejection_accept/_assemble_sampled math as _spec_round.
+    # Greedy rows' residual/correction are computed but unused (their
+    # out comes from `targets`) and their keys never advance.
+    t_probs = jax.nn.softmax(t_logits / temp_eff[..., None], axis=-1)
+    keys, subs = _advance_row_keys(keys, active & ~greedy)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(subs)
+    n_acc_s, resid = _rejection_accept(drafts, d_probs, t_probs, u,
+                                       gamma)
+    keys, subs = _advance_row_keys(keys, active & ~greedy)
+    correction = jax.vmap(jax.random.categorical)(
+        subs, jnp.log(jnp.maximum(resid, 1e-20))).astype(jnp.int32)
+    out_s = _assemble_sampled(drafts, correction, n_acc_s, gamma)
+
+    n_acc = jnp.where(greedy, n_acc_g, n_acc_s)
+    out = jnp.where(greedy[:, None], targets, out_s)
+    n_emit = jnp.where(active, n_acc + 1, 0)
+    mask = jnp.arange(gamma + 1)[None] < n_emit[:, None]
+    out = jnp.where(mask, out, -1)
+    return out, n_emit, t_cache, d_cache, keys
 
 
 def _spec_round(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
@@ -143,42 +245,21 @@ def _spec_round(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
 
     if greedy:
         targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-        match = (drafts == targets[:, :gamma])             # [B, gamma]
-        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
-        n_acc = jnp.sum(acc, axis=1)                       # [B]
         # emitted = targets[:, :n_acc+1] (accepted drafts equal targets;
         # position n_acc is the correction / bonus token)
+        n_acc = _greedy_accept(drafts, targets)
         out = targets
         n_emit = n_acc + 1
     else:
         t_probs = jax.nn.softmax(t_logits / temperature, axis=-1)
-        idx = drafts[..., None]                            # [B, gamma, 1]
-        p_t = jnp.take_along_axis(t_probs[:, :gamma], idx, axis=-1)[..., 0]
-        p_d = jnp.take_along_axis(d_probs, idx, axis=-1)[..., 0]
         rng, sub = jax.random.split(rng)
-        u = jax.random.uniform(sub, p_t.shape)
-        accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
-        acc = jnp.cumprod(accept.astype(jnp.int32), axis=1)
-        n_acc = jnp.sum(acc, axis=1)
-        # residual distribution at the first rejected position r:
-        # norm(max(0, p_t - p_d)); at r == gamma (all accepted) the bonus
-        # token samples from the target's own distribution
-        r = jnp.minimum(n_acc, gamma)
-        row = jnp.arange(B)
-        p_t_r = t_probs[row, r]                            # [B, V]
-        p_d_r = jnp.where((r < gamma)[:, None],
-                          d_probs[row, jnp.minimum(r, gamma - 1)], 0.0)
-        resid = jnp.maximum(p_t_r - p_d_r, 0.0)
-        resid = resid / jnp.maximum(jnp.sum(resid, -1, keepdims=True),
-                                    1e-20)
+        u = jax.random.uniform(sub, drafts.shape)
+        n_acc, resid = _rejection_accept(drafts, d_probs, t_probs, u,
+                                         gamma)
         rng, sub = jax.random.split(rng)
         correction = jax.random.categorical(
             sub, jnp.log(jnp.maximum(resid, 1e-20))).astype(jnp.int32)
-        out = jnp.where(jnp.arange(gamma + 1)[None] ==
-                        jnp.minimum(n_acc, gamma)[:, None],
-                        correction[:, None],
-                        jnp.concatenate(
-                            [drafts, drafts[:, -1:]], axis=1))
+        out = _assemble_sampled(drafts, correction, n_acc, gamma)
         n_emit = n_acc + 1
 
     mask = jnp.arange(gamma + 1)[None] < n_emit[:, None]

@@ -271,6 +271,32 @@ def test_engine_spec_matches_plain_engine(tiny_config, target):
     assert stats.spec_acceptance >= 0.9, stats.spec_acceptance
 
 
+def test_engine_spec_mixed_sampling_isolation(tiny_config, target, draft):
+    """The batched round runs greedy and temperature>0 rows in ONE
+    program; a hot row sharing rounds with a greedy row must not change
+    the greedy row's stream (per-row key masks: greedy rows never
+    advance their PRNG, sampled rows draw per-row uniforms)."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    def run(with_hot):
+        eng = InferenceEngine(
+            tiny_config, target, ByteTokenizer(tiny_config.vocab_size),
+            max_slots=2, max_seq_len=256, sampling=GREEDY,
+            draft_params=draft, draft_config=tiny_config, spec_gamma=3)
+        with eng:
+            cold = eng.submit([5] * 9, max_new_tokens=10,
+                              temperature=0.0, repeat_penalty=1.0)
+            hot = (eng.submit([11] * 7, max_new_tokens=10,
+                              temperature=0.9, repeat_penalty=1.0)
+                   if with_hot else None)
+            assert cold.wait(300)
+            if hot is not None:
+                assert hot.wait(300)
+            return list(cold._req.out_tokens)
+
+    assert run(with_hot=False) == run(with_hot=True)
+
+
 def test_engine_spec_bad_draft_still_exact(tiny_config, target, draft):
     """A wrong draft must never change the engine's output — only the
     acceptance rate."""
