@@ -95,6 +95,10 @@ class Args:
     # verifies spec_gamma drafted tokens at once. Batch-1, single-device.
     draft_model: Optional[str] = None
     spec_gamma: int = 4
+    # batch-1 CLI speculation: propose-verify rounds chained on device
+    # per host fetch (spec_scan); the engine path batches across slots
+    # instead and ignores this
+    spec_rounds: int = 4
     # serving watchdog: fail (recoverably) when the engine makes no
     # progress for this many seconds with active requests; must exceed
     # the worst-case first-request compile time (parallel/health.py)
@@ -126,7 +130,8 @@ class Args:
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
-                     "max_slots", "decode_scan", "spec_gamma"):
+                     "max_slots", "decode_scan", "spec_gamma",
+                     "spec_rounds"):
             if getattr(self, knob) < 1:
                 raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self

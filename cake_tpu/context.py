@@ -391,6 +391,7 @@ class Context:
             cfg, params, d_cfg, d_params, tokenizer,
             gamma=a.spec_gamma, max_seq_len=max_seq, sampling=sampling,
             seed=a.seed, cache_dtype=kv_dtype,
+            spec_rounds=getattr(a, "spec_rounds", 4),
         )
 
     def load_image_model(self):

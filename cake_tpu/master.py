@@ -60,7 +60,7 @@ class Master:
         if isinstance(g, SpeculativeGenerator):
             import jax
             if jax.process_count() > 1:
-                # the spec engine's per-slot rounds are single-device;
+                # the spec engine's batched rounds are single-device;
                 # no multi-host step replay exists for them
                 log.info("no multi-host engine for --draft-model")
                 return None
@@ -88,6 +88,11 @@ class Master:
                 draft_params=g.draft_params,
                 draft_config=g.draft_config,
                 spec_gamma=g.gamma,
+                # passed through so the engine's own guard WARNS that
+                # multi-step scans don't apply in speculative mode
+                # (each round already advances up to gamma+1 tokens),
+                # instead of the flag silently vanishing
+                decode_scan_steps=self.args.decode_scan,
             )
         fwd = getattr(g, "_forward_fn", None)
         if fwd is not None and g.parallel is None:
