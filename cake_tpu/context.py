@@ -391,7 +391,7 @@ class Context:
             cfg, params, d_cfg, d_params, tokenizer,
             gamma=a.spec_gamma, max_seq_len=max_seq, sampling=sampling,
             seed=a.seed, cache_dtype=kv_dtype,
-            spec_rounds=getattr(a, "spec_rounds", 4),
+            spec_rounds=a.spec_rounds,
         )
 
     def load_image_model(self):

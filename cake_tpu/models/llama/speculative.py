@@ -151,7 +151,11 @@ def spec_round_batched(t_params, d_params, t_cache: KVCache,
     exact-match acceptance; > 0 -> leftover-residual rejection
     sampling, per row).
     Returns (out [B, gamma+1] — first n_emit[b] valid, rest -1;
-    n_emit [B] (0 for inactive rows); t_cache; d_cache; keys)."""
+    n_emit [B] (0 for inactive rows); t_cache; d_cache; keys;
+    state = (last_tok [B, 1], pos [B]) — each active row's final
+    emitted token at its advanced frontier, fed straight back as the
+    next round's (last_tok, pos) by the engine's double-buffered spec
+    burst without a host round-trip)."""
     from cake_tpu.models.llama.model import (
         forward_ragged, forward_window_ragged,
     )
@@ -206,7 +210,16 @@ def spec_round_batched(t_params, d_params, t_cache: KVCache,
     n_emit = jnp.where(active, n_acc + 1, 0)
     mask = jnp.arange(gamma + 1)[None] < n_emit[:, None]
     out = jnp.where(mask, out, -1)
-    return out, n_emit, t_cache, d_cache, keys
+    # chained-round state (the engine's double-buffered spec burst
+    # feeds this straight back as (last_tok, pos) without a host
+    # round-trip): each active row continues from its final emitted
+    # token at its advanced frontier
+    last = jnp.take_along_axis(
+        out, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    last_out = jnp.where(active, last, last_tok[:, 0])[:, None]
+    pos_out = pos + n_emit
+    state = (last_out, pos_out)
+    return out, n_emit, t_cache, d_cache, keys, state
 
 
 def _spec_round(t_params, d_params, t_cache: KVCache, d_cache: KVCache,

@@ -942,6 +942,48 @@ class InferenceEngine:
                                     or not self._thread.is_alive()):
             self._drain_cancellations()
 
+    def _host_attention_pending(self) -> bool:
+        """Something on the host side needs the run loop back: stop,
+        admissions waiting, cancellations, or commands."""
+        return (self._stop.is_set()
+                or self.scheduler.queue_depth > 0
+                or self._cancel_pending()
+                or self._commands_pending())
+
+    def _drive_burst(self, dispatch, complete, can_chain,
+                     first_unconditional: bool = False) -> None:
+        """THE double-buffered dispatch/fetch driver, shared by the
+        decode burst and the speculative burst: dispatch k+1 (chained
+        from k's on-device state, zero host round-trips between
+        dispatches) BEFORE completing k, so the ~100ms d2h fetch
+        latency of a remote-dispatch tunnel hides under k+1's device
+        compute.
+
+        dispatch(state) -> (devs, state'): device dispatch, no fetch.
+        complete(devs): fetch + emit one dispatch's results.
+        can_chain(n_inflight) -> bool: burst-specific budget/window
+        gating (called after the shared host-attention gate);
+        n_inflight = dispatched-but-unfetched count, for projecting
+        the device frontier past the stale host mirrors.
+        first_unconditional: guarantee one dispatch per call even when
+        the gates say stop — a caller whose planning loop has no other
+        progress path would otherwise spin forever (the spec burst with
+        full slots and a waiting queue)."""
+        inflight: list = []
+        state = None
+        first = first_unconditional
+        while True:
+            chain = first or (not self._host_attention_pending()
+                              and can_chain(len(inflight)))
+            first = False
+            if chain:
+                devs, state = dispatch(state)
+                inflight.append(devs)
+            if not inflight:
+                break
+            if not chain or len(inflight) >= 2:
+                complete(inflight.pop(0))
+
     def _cancel_pending(self) -> bool:
         with self._rid_lock:
             return bool(self._cancel_q)
@@ -1497,42 +1539,78 @@ class InferenceEngine:
         active = np.zeros(B, bool)
         for _, slot in plan:
             active[slot] = True
-        out, n_emit, self.cache, self.d_cache, self._keys = (
-            spec_round_batched(
+        active_dev = jnp.asarray(active)
+        temp_dev = jnp.asarray(self._temp)
+
+        def dispatch(state):
+            if state is None:
+                last = jnp.asarray(self._last_tok[:, None], jnp.int32)
+                pos = jnp.asarray(
+                    np.minimum(self._pos, self.max_seq_len - 1),
+                    jnp.int32)
+            else:
+                last, pos = state
+            (out, n_emit, self.cache, self.d_cache, self._keys,
+             state_o) = spec_round_batched(
                 self.params, self.draft_params, self.cache,
-                self.d_cache,
-                jnp.asarray(self._last_tok[:, None], jnp.int32),
-                jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
-                            jnp.int32),
-                jnp.asarray(active), self._keys,
-                jnp.asarray(self._temp),
-                self.rope, self.d_rope, self.config, self.draft_config,
-                g))
-        # ONE batched fetch for every slot's round (a remote-dispatch
-        # tunnel charges ~100ms per round-trip)
-        out_h, n_emit_h = jax.device_get((out, n_emit))
-        for req, slot in plan:
-            n = int(n_emit_h[slot])
-            toks = [int(t) for t in out_h[slot, :n]]
-            self.stats.spec_proposed += g
-            self.stats.spec_accepted += n - 1
-            pos0 = int(self._pos[slot])
-            self._last_tok[slot] = toks[-1]
-            self._steps[slot] += n
-            for j, tok in enumerate(toks):
-                # per-token position so _emit's cap check sees the value
-                # a single-step loop would have had (_do_decode_scan
-                # precedent — the post-burst frontier would cap-finish
-                # the FIRST token of a window-filling burst)
-                self._pos[slot] = pos0 + j + 1
-                self._emit(req, tok)
+                self.d_cache, last, pos, active_dev, self._keys,
+                temp_dev, self.rope, self.d_rope, self.config,
+                self.draft_config, g)
+            return (out, n_emit), state_o
+
+        def complete(devs):
+            # ONE batched fetch for every slot's round (a
+            # remote-dispatch tunnel charges ~100ms per round-trip)
+            out_h, n_emit_h = jax.device_get(devs)
+            for req, slot in plan:
                 if req.done.is_set():
-                    break   # EOS / budget mid-burst: drop the tail
-            # cache frontier for the next round: the burst wrote n
-            # accepted positions regardless of the emission budget;
-            # stale positions past it are masked like padding
-            self._pos[slot] = pos0 + n
-        self.stats.steps += 1
+                    # chained round dispatched before this req's EOS /
+                    # budget end was known — discard its junk (stats
+                    # too: post-EOS rounds condition on garbage)
+                    continue
+                n = int(n_emit_h[slot])
+                toks = [int(t) for t in out_h[slot, :n]]
+                self.stats.spec_proposed += g
+                self.stats.spec_accepted += n - 1
+                pos0 = int(self._pos[slot])
+                self._last_tok[slot] = toks[-1]
+                self._steps[slot] += n
+                for j, tok in enumerate(toks):
+                    # per-token position so _emit's cap check sees the
+                    # value a single-step loop would have had
+                    # (_do_decode_scan precedent — the post-burst
+                    # frontier would cap-finish the FIRST token of a
+                    # window-filling burst)
+                    self._pos[slot] = pos0 + j + 1
+                    self._emit(req, tok)
+                    if req.done.is_set():
+                        break   # EOS / budget mid-burst: drop the tail
+                # cache frontier for the next round: the burst wrote n
+                # accepted positions regardless of the emission budget;
+                # stale positions past it are masked like padding
+                self._pos[slot] = pos0 + n
+            self.stats.steps += 1
+
+        # double-buffered chained rounds (single-host; multi-host spec
+        # has no engine), via the shared _drive_burst driver: round k+1
+        # is dispatched from round k's on-device state before round k's
+        # tokens are fetched. The window guard projects the device
+        # frontier by the worst case (g+1 per unfetched round); a round
+        # chained past a row's EOS computes junk the emit loop
+        # discards. The first round is unconditional: every planned row
+        # was admitted with room for >= 1 round (the force-finish guard
+        # above), and skipping it would leave the run loop spinning
+        # with full slots and a waiting queue.
+        def can_chain(n_inflight: int) -> bool:
+            return (all(not req.done.is_set()
+                        and (req.max_new_tokens - len(req.out_tokens)
+                             - n_inflight * (g + 1)) > 0
+                        for req, _ in plan)
+                    and all(self._pos[s] + (n_inflight + 1) * (g + 1)
+                            < self.max_seq_len for _, s in plan))
+
+        self._drive_burst(dispatch, complete, can_chain,
+                          first_unconditional=True)
         self.stats.decode_time_s += time.perf_counter() - t0
 
     def _force_finish(self, req: _Request) -> None:
@@ -1674,41 +1752,45 @@ class InferenceEngine:
         n_top = self._n_top_for(rows)
         # tokens dispatched in not-yet-fetched scans, per slot: added at
         # dispatch, removed at fetch — budget math and the window guard
-        # below both project the device state past the stale host
-        # mirrors by exactly this amount
+        # both project the device state past the stale host mirrors by
+        # exactly this amount
         shipped: dict = {}
-        inflight: list = []        # [(outs, budget)]
-        state = None
-        while True:
-            budget = self._scan_budget(decode_plan, n, shipped)
-            # keep dispatching while there is real work and nothing on
-            # the host side needs the loop back (admissions, cancels,
-            # commands, shutdown). The window guard uses the PROJECTED
-            # device position (host mirror + unfetched in-flight
-            # tokens): the mirror lags the device by the in-flight
+        staged: dict = {}
+
+        def can_chain(_n_inflight) -> bool:
+            # real work remains, and the PROJECTED device position
+            # (host mirror + unfetched in-flight tokens) still fits the
+            # window: the mirror lags the device by the in-flight
             # scans, and the device program has no max_seq freeze.
-            dispatch = (budget.any() and not self._stop.is_set()
-                        and self.scheduler.queue_depth == 0
-                        and not self._cancel_pending()
-                        and not self._commands_pending()
-                        and all(self._pos[s] + shipped.get(s, 0) + n
-                                < self.max_seq_len for s in rows))
-            if dispatch:
-                outs, state = self._dispatch_scan_device(
-                    rows, n, n_top, budget, state=state)
-                for _, slot in decode_plan:
-                    shipped[slot] = shipped.get(slot, 0) + int(budget[slot])
-                self.stats.steps += n
-                inflight.append((outs, budget))
-            if not inflight:
-                break
-            if not dispatch or len(inflight) >= 2:
-                outs_k, budget_k = inflight.pop(0)
-                fetched = self._fetch_scan(outs_k)
-                self._complete_scan(decode_plan, n, fetched, budget_k)
-                for _, slot in decode_plan:
-                    shipped[slot] = (shipped.get(slot, 0)
-                                     - int(budget_k[slot]))
+            # (The per-slot `shipped` dict is finer-grained than the
+            # driver's in-flight count, so the latter goes unused.)
+            budget = self._scan_budget(decode_plan, n, shipped)
+            if not budget.any():
+                return False
+            if not all(self._pos[s] + shipped.get(s, 0) + n
+                       < self.max_seq_len for s in rows):
+                return False
+            staged["budget"] = budget
+            return True
+
+        def dispatch(state):
+            budget = staged["budget"]
+            outs, state = self._dispatch_scan_device(
+                rows, n, n_top, budget, state=state)
+            for _, slot in decode_plan:
+                shipped[slot] = shipped.get(slot, 0) + int(budget[slot])
+            self.stats.steps += n
+            return (outs, budget), state
+
+        def complete(devs):
+            outs_k, budget_k = devs
+            fetched = self._fetch_scan(outs_k)
+            self._complete_scan(decode_plan, n, fetched, budget_k)
+            for _, slot in decode_plan:
+                shipped[slot] = (shipped.get(slot, 0)
+                                 - int(budget_k[slot]))
+
+        self._drive_burst(dispatch, complete, can_chain)
         self.stats.decode_time_s += time.perf_counter() - t0
 
     def _complete_scan(self, decode_plan, n: int, fetched,

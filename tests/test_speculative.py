@@ -271,6 +271,48 @@ def test_engine_spec_matches_plain_engine(tiny_config, target):
     assert stats.spec_acceptance >= 0.9, stats.spec_acceptance
 
 
+def test_engine_spec_burst_chains_and_queue_progress(tiny_config, target):
+    """The double-buffered spec burst must (a) chain rounds device-side
+    for a long request — more than one dispatch per _do_decode_spec
+    call — and (b) still make progress when a request is QUEUED behind
+    full slots (the chain gate must not suppress the first round, or
+    the loop spins forever: regression for the burst deadlock)."""
+    from cake_tpu.serve.engine import InferenceEngine
+
+    eng = InferenceEngine(
+        tiny_config, target, ByteTokenizer(tiny_config.vocab_size),
+        max_slots=2, max_seq_len=256, sampling=GREEDY,
+        draft_params=target, draft_config=tiny_config, spec_gamma=3)
+    calls = {"rounds": 0, "bursts": 0}
+    orig = eng._do_decode_spec
+    from cake_tpu.models.llama import speculative as spec_mod
+    orig_round = spec_mod.spec_round_batched
+
+    def count_round(*a, **k):
+        calls["rounds"] += 1
+        return orig_round(*a, **k)
+
+    def count_burst(plan):
+        calls["bursts"] += 1
+        return orig(plan)
+
+    spec_mod.spec_round_batched = count_round
+    eng._do_decode_spec = count_burst
+    try:
+        with eng:
+            # 3 requests, 2 slots: the third queues until a slot frees
+            hs = [eng.submit([5] * 9, max_new_tokens=30)
+                  for _ in range(3)]
+            assert all(h.wait(timeout=300) for h in hs), "burst deadlock"
+    finally:
+        spec_mod.spec_round_batched = orig_round
+    # perfect draft (target==draft): 30 tokens at gamma=3 -> ~8 rounds
+    # per request; chaining means fewer burst calls than rounds
+    assert calls["rounds"] > calls["bursts"], calls
+    for h in hs:
+        assert len(h._req.out_tokens) == 30
+
+
 def test_engine_spec_mixed_sampling_isolation(tiny_config, target, draft):
     """The batched round runs greedy and temperature>0 rows in ONE
     program; a hot row sharing rounds with a greedy row must not change
