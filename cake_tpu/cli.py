@@ -41,35 +41,28 @@ def _serve_multihost(master, args) -> int:
         # master.generate_image with them (_run_image_follower).
         engine = None
     else:
-        if (getattr(master.llm, "_forward_fn", None) is not None
-                and getattr(master.llm, "parallel", None) is None):
-            # the sp adapter (custom forward WITHOUT a (plan, mesh) —
-            # topology models have both and replay fine): its engine
-            # exists single-host, but its step ops are not replayed
-            # over the control channel; without the replay a
-            # cross-process shard_map dispatch would hang in the
-            # collective instead of failing cleanly here
-            raise ValueError(
-                "--sp serving has no multi-host step replay; serve "
-                "it on one host")
         # every process builds the identical engine (the shared-cache
         # zeros allocation is a global computation, so construction
         # order matters and must match across hosts)
         engine = master.make_engine()
         if engine is None:
             raise ValueError(
-                "this serving mode (--draft-model multi-host) has no "
-                "batching engine and no multi-host step replay; serve "
-                "it on one host")
+                "this serving mode (--draft-model multi-host, or an "
+                "sp composition without an engine contract) has no "
+                "multi-host step replay; serve it on one host")
         # the pre-fail capture must outlive the heartbeat stale window
         # (the monitor is exactly the late-arriving consumer)
         engine.fail_recs_ttl = args.heartbeat_timeout + 60.0
-    # a model without a cross-process placement (no topology/tp/dp) runs
-    # entirely inside the coordinator: no step replay needed — followers
-    # just idle on the control channel until the stop op, preserving the
-    # pre-existing behavior for this configuration
+    # a model without a cross-process placement (no topology/tp/dp/sp)
+    # runs entirely inside the coordinator: no step replay needed —
+    # followers just idle on the control channel until the stop op,
+    # preserving the pre-existing behavior for this configuration. An
+    # sp-engined model (custom forward, no (plan, mesh)) IS
+    # cross-process: its shard_maps span the global mesh, so every
+    # process must replay each step op.
     replayed = (image_mode
-                or getattr(master.llm, "parallel", None) is not None)
+                or getattr(master.llm, "parallel", None) is not None
+                or getattr(master.llm, "_forward_fn", None) is not None)
     if is_coordinator():
         import os
         import secrets

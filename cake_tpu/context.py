@@ -182,6 +182,15 @@ class Context:
                 raise ValueError(
                     f"stages {stages} x --dp {dp} x --sp {a.sp} x --tp "
                     f"{tp} needs {need} devices, have {len(devices)}")
+            if jax.process_count() > 1 and need != len(devices):
+                # multi-host: a mesh over a device subset could land
+                # entirely on one process; the other processes would
+                # replay programs with no addressable shards. Spanning
+                # ALL global devices keeps every process a participant.
+                raise ValueError(
+                    f"multi-host --sp meshes must span every device: "
+                    f"sp x tp (x dp/stages) = {need} != "
+                    f"{len(devices)} global devices")
             if tp > 1 and cfg.num_key_value_heads % tp != 0:
                 raise ValueError(
                     f"--tp {tp} must divide kv heads "

@@ -649,22 +649,34 @@ def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
                            kv_dtype=jnp.bfloat16,
                            tp: bool = False) -> SPEngineCache:
     """Allocate the engine's multi-slot sp cache with the shardings
-    make_sp_engine_step_fns' shard_maps expect."""
+    make_sp_engine_step_fns' shard_maps expect. jit-with-out_shardings
+    (not device_put): each shard allocates in place — no full-buffer
+    transient, and it works over a multi-process mesh, where device_put
+    to non-addressable devices is invalid (create_sharded_cache
+    precedent)."""
     KV, hd = config.num_key_value_heads, config.head_dim
     L = config.num_hidden_layers
     tp_axis = "tp" if tp else None
-    ctx = NamedSharding(mesh, P(None, None, "sp", tp_axis, None))
-    tail = NamedSharding(mesh, P(None, None, None, tp_axis, None)
-                         if tp else P())
-    rep = NamedSharding(mesh, P())
-    z = lambda shape, sh: jax.device_put(jnp.zeros(shape, kv_dtype), sh)
-    return SPEngineCache(
-        ctx_k=z((L, slots, ctx_len, KV, hd), ctx),
-        ctx_v=z((L, slots, ctx_len, KV, hd), ctx),
-        tail_k=z((L, slots, tail_len, KV, hd), tail),
-        tail_v=z((L, slots, tail_len, KV, hd), tail),
-        plen=jax.device_put(jnp.zeros((slots,), jnp.int32), rep),
+    shardings = SPEngineCache(
+        ctx_k=NamedSharding(mesh, P(None, None, "sp", tp_axis, None)),
+        ctx_v=NamedSharding(mesh, P(None, None, "sp", tp_axis, None)),
+        tail_k=NamedSharding(mesh, P(None, None, None, tp_axis, None)
+                             if tp else P()),
+        tail_v=NamedSharding(mesh, P(None, None, None, tp_axis, None)
+                             if tp else P()),
+        plen=NamedSharding(mesh, P()),
     )
+    make = jax.jit(
+        lambda: SPEngineCache(
+            ctx_k=jnp.zeros((L, slots, ctx_len, KV, hd), kv_dtype),
+            ctx_v=jnp.zeros((L, slots, ctx_len, KV, hd), kv_dtype),
+            tail_k=jnp.zeros((L, slots, tail_len, KV, hd), kv_dtype),
+            tail_v=jnp.zeros((L, slots, tail_len, KV, hd), kv_dtype),
+            plen=jnp.zeros((slots,), jnp.int32),
+        ),
+        out_shardings=shardings,
+    )
+    return make()
 
 
 def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,

@@ -1755,7 +1755,6 @@ class InferenceEngine:
         # both project the device state past the stale host mirrors by
         # exactly this amount
         shipped: dict = {}
-        staged: dict = {}
 
         def can_chain(_n_inflight) -> bool:
             # real work remains, and the PROJECTED device position
@@ -1764,17 +1763,16 @@ class InferenceEngine:
             # scans, and the device program has no max_seq freeze.
             # (The per-slot `shipped` dict is finer-grained than the
             # driver's in-flight count, so the latter goes unused.)
-            budget = self._scan_budget(decode_plan, n, shipped)
-            if not budget.any():
-                return False
-            if not all(self._pos[s] + shipped.get(s, 0) + n
-                       < self.max_seq_len for s in rows):
-                return False
-            staged["budget"] = budget
-            return True
+            return (self._scan_budget(decode_plan, n, shipped).any()
+                    and all(self._pos[s] + shipped.get(s, 0) + n
+                            < self.max_seq_len for s in rows))
 
         def dispatch(state):
-            budget = staged["budget"]
+            # recomputed rather than smuggled out of can_chain: nothing
+            # host-side changes between the gate and the dispatch (same
+            # thread), and an explicit recompute keeps _drive_burst's
+            # can_chain a pure gate
+            budget = self._scan_budget(decode_plan, n, shipped)
             outs, state = self._dispatch_scan_device(
                 rows, n, n_top, budget, state=state)
             for _, slot in decode_plan:

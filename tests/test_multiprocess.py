@@ -624,3 +624,104 @@ def test_multihost_image_serving(tmp_path, tiny_config):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+
+
+SP_API_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    pid, port, api_addr, model = sys.argv[1:5]
+    os.environ["CAKE_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["CAKE_NUM_PROCESSES"] = "2"
+    os.environ["CAKE_PROCESS_ID"] = pid
+    from cake_tpu import cli
+    sys.exit(cli.main([
+        "--model", model, "--sp", "8",
+        "--max-seq-len", "256", "--sample-len", "32",
+        "--temperature", "0.0",
+        "--repeat-penalty", "1.0", "--no-flash-attention",
+        "--max-slots", "2", "--api", api_addr,
+        "--decode-scan", "4",
+    ]))
+""")
+
+
+@pytest.mark.slow
+def test_multihost_sp_api_serving(tmp_path, tiny_config):
+    """Long-context sp serving across PROCESSES (round-5): the sp
+    engine's ring-prefill/merged-decode shard_maps span a 2-process
+    8-device mesh; process 0 runs the REST server, process 1 replays
+    the coordinator's step stream — tokens match the single-process
+    dense engine exactly (the sp engine layout is position-contiguous).
+    This is the deployment the framework's long-context axis exists
+    for: sequence shards on every host, requests batched."""
+    import time
+    import urllib.request
+
+    from test_stream_load import write_tiny_hf_checkpoint
+    model_dir = write_tiny_hf_checkpoint(tmp_path / "model", tiny_config)
+    want = _oracle_chat_text(tiny_config, model_dir)
+    assert want
+
+    port = _free_port()
+    api_addr = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", SP_API_WORKER, str(i), str(port),
+             api_addr, model_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+        for i in range(2)
+    ]
+    try:
+        base = f"http://{api_addr}"
+        deadline = time.monotonic() + 300
+        up = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                outs = [p.communicate()[0] for p in procs]
+                raise AssertionError(
+                    f"worker died during startup:\n{outs[0][-3000:]}\n"
+                    f"---\n{outs[1][-3000:]}")
+            try:
+                if _http_json("GET", base + "/api/v1/health",
+                              timeout=2.0)["status"] == "ok":
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.5)
+        assert up, "API never came up"
+
+        body = {"messages": MESSAGES, "max_tokens": 8,
+                "temperature": 0.0, "top_p": 1.0}
+        resp = _http_json("POST", base + "/api/v1/chat/completions",
+                          body, timeout=300.0)
+        got = resp["choices"][0]["message"]["content"]
+        assert got == want, (got, want)
+
+        # a second concurrent-ish request exercises slot reuse over the
+        # replayed sp cache
+        body2 = {"messages": [MESSAGES[0],
+                              {"role": "user", "content": "Say more"}],
+                 "max_tokens": 6, "temperature": 0.0, "top_p": 1.0}
+        resp2 = _http_json("POST", base + "/api/v1/chat/completions",
+                           body2, timeout=300.0)
+        assert resp2["choices"][0]["message"]["content"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=60)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+    assert all(p.returncode is not None for p in procs)
