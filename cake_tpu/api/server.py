@@ -484,7 +484,7 @@ def start(master, address: str = "127.0.0.1:10128",
     if engine is None and master.llm is not None:
         engine = master.make_engine()
     if engine is None and master.llm is not None:
-        # locked-path serving (stage x sp / dp x sp): these modes gate on
+        # locked-path serving (dp x sp only, round-5): this mode gates on
         # the engine and silently doing nothing would surprise operators
         if checkpoint_path:
             log.warning("--checkpoint does not apply to engine-less "

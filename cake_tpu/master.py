@@ -101,7 +101,7 @@ class Master:
             # (ring slot-prefill + merged-stats ragged decode,
             # context_parallel.make_sp_engine_step_fns) — long-context
             # serving batches concurrent requests instead of serialising
-            # on the legacy locked path. stage x sp / dp x sp still lock.
+            # on the legacy locked path. Only dp x sp still locks.
             slots = max_slots or getattr(self.args, "max_slots", 8)
             pieces = None
             engine_pieces = getattr(fwd, "engine_pieces", None)

@@ -598,12 +598,25 @@ class SPGeneratorForward:
     def engine_pieces(self, slots: int, params):
         """(step_fns, cache, ctx_len, tail_len) for the continuous-
         batching engine over this adapter's mesh, or None when the
-        composition has no engine contract (stage x sp, dp x sp keep
-        the locked path)."""
-        if self._stages > 1 or self._dp:
+        composition has no engine contract (dp x sp keeps the locked
+        path). stage x sp routes to sp_pipeline's stage-chained
+        factory — the long-context 70B pod config, served batched."""
+        if self._dp:
             return None
         dtype = (self._kv_dtype if self._kv_dtype is not None
                  else params["embed"].dtype)
+        if self._stages > 1:
+            from cake_tpu.parallel.sp_pipeline import (
+                create_sp_stage_engine_cache,
+                make_sp_stage_engine_step_fns,
+            )
+            fns = make_sp_stage_engine_step_fns(
+                self._mesh, self._config, self.ctx_len, self.tail_len,
+                kv_dtype=self._kv_dtype, tp=self._tp, params=params)
+            cache = create_sp_stage_engine_cache(
+                self._mesh, self._config, slots, self.ctx_len,
+                self.tail_len, kv_dtype=dtype, tp=self._tp)
+            return fns, cache, self.ctx_len, self.tail_len
         fns = make_sp_engine_step_fns(
             self._mesh, self._config, self.ctx_len, self.tail_len,
             kv_dtype=self._kv_dtype, tp=self._tp, params=params)
@@ -647,23 +660,28 @@ class SPEngineCache(NamedTuple):
 def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
                            ctx_len: int, tail_len: int,
                            kv_dtype=jnp.bfloat16,
-                           tp: bool = False) -> SPEngineCache:
+                           tp: bool = False,
+                           stage: bool = False) -> SPEngineCache:
     """Allocate the engine's multi-slot sp cache with the shardings
-    make_sp_engine_step_fns' shard_maps expect. jit-with-out_shardings
-    (not device_put): each shard allocates in place — no full-buffer
-    transient, and it works over a multi-process mesh, where device_put
-    to non-addressable devices is invalid (create_sharded_cache
-    precedent)."""
+    make_sp_engine_step_fns' shard_maps expect (stage=True: the layer
+    dim additionally shards over "stage" for the stage x sp engine).
+    jit-with-out_shardings (not device_put): each shard allocates in
+    place — no full-buffer transient, and it works over a multi-process
+    mesh, where device_put to non-addressable devices is invalid
+    (create_sharded_cache precedent)."""
     KV, hd = config.num_key_value_heads, config.head_dim
     L = config.num_hidden_layers
     tp_axis = "tp" if tp else None
+    stage_axis = "stage" if stage else None
+    tail = (P(stage_axis, None, None, tp_axis, None)
+            if (tp or stage) else P())
     shardings = SPEngineCache(
-        ctx_k=NamedSharding(mesh, P(None, None, "sp", tp_axis, None)),
-        ctx_v=NamedSharding(mesh, P(None, None, "sp", tp_axis, None)),
-        tail_k=NamedSharding(mesh, P(None, None, None, tp_axis, None)
-                             if tp else P()),
-        tail_v=NamedSharding(mesh, P(None, None, None, tp_axis, None)
-                             if tp else P()),
+        ctx_k=NamedSharding(mesh, P(stage_axis, None, "sp", tp_axis,
+                                    None)),
+        ctx_v=NamedSharding(mesh, P(stage_axis, None, "sp", tp_axis,
+                                    None)),
+        tail_k=NamedSharding(mesh, tail),
+        tail_v=NamedSharding(mesh, tail),
         plen=NamedSharding(mesh, P()),
     )
     make = jax.jit(
@@ -697,8 +715,10 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     ctx_len, leaving a documented rope gap for short prompts), the
     engine layout is position-contiguous: row b's generated token t sits
     at rope position plen[b]+t and tail slot t, so outputs match the
-    dense engine exactly for any prompt length. Composition: sp alone or
-    sp x tp (stages/dp keep the locked path)."""
+    dense engine exactly for any prompt length. Composition: sp alone,
+    sp x tp, or — via sp_pipeline.make_sp_stage_engine_step_fns, which
+    shares this layout — stage x sp; only dp x sp keeps the locked
+    path."""
     sp_size = mesh.shape["sp"]
     assert ctx_len % sp_size == 0, (ctx_len, sp_size)
     Sl = ctx_len // sp_size
@@ -707,35 +727,11 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     rep = P()
 
     # -- ragged decode over [B] per-row positions -------------------------
-    def decode_body(blocks, embed, final_norm, lm_head, token, pos,
-                    active, ctx_k, ctx_v, tail_k, tail_v, plen, cos, sin):
-        idx = lax.axis_index("sp")
-        B = token.shape[0]
-        tail_T = tail_k.shape[2]
-        x = jnp.take(embed, token, axis=0)               # [B, 1, D]
-        from cake_tpu.ops.rope import rope_rows_per_row
-        rope_c, rope_s = rope_rows_per_row(cos, sin, pos)
-        # contiguous positions: tail slot = generated index = pos - plen
-        t_slot = jnp.clip(pos - plen, 0, tail_T - 1)     # [B]
-        ctx_valid, tail_valid = sp_decode_masks(idx, Sl, plen, tail_T,
-                                                t_slot, B)
+    def chain(x, layer, blocks, ctx_k, ctx_v, tail_k, tail_v):
+        return lax.scan(layer, x, (blocks, ctx_k, ctx_v, tail_k,
+                                   tail_v))
 
-        from cake_tpu.models.llama.cache import update_layer_cache_per_row
-
-        def tail_update(tk, tv, k, v):
-            # per-row active-masked write (ragged slots), vs the
-            # lockstep scalar-slot default
-            return update_layer_cache_per_row(tk, tv, k, v, t_slot,
-                                              active)
-
-        layer = sp_decode_layer(config, rope_c, rope_s, None, ctx_valid,
-                                tail_valid, tp_axis,
-                                tail_update=tail_update)
-        x, (tk_new, tv_new) = lax.scan(
-            layer, x, (blocks, ctx_k, ctx_v, tail_k, tail_v))
-        x = rms_norm(x, final_norm, config.rms_norm_eps)
-        logits = qmatmul(x[:, -1], lm_head).astype(jnp.float32)
-        return logits, tk_new, tv_new
+    decode_body = make_sp_engine_decode_body(config, tp_axis, Sl, chain)
 
     ctx_spec = P(None, None, "sp", tp_axis, None)
     tail_spec = P(None, None, None, tp_axis, None) if tp else P()
@@ -747,6 +743,106 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         out_specs=(rep, tail_spec, tail_spec),
         check_vma=False,
     )
+
+    decode_ragged_forward, decode_ragged_fn = make_decode_ragged_fns(
+        decode_sm)
+
+    # -- slot prefill: ring-prefill one prompt, scatter into the slot -----
+    prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
+
+    prefill_sm = jax.shard_map(
+        prefill_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep,
+                  rep, rep),
+        out_specs=(rep, ctx_spec, ctx_spec),
+        check_vma=False,
+    )
+    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len)
+
+    from cake_tpu.serve.engine import make_decode_scan
+    decode_scan_fn = make_decode_scan(decode_ragged_forward)
+
+    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
+
+
+def make_slot_prefill_fn(prefill_sm, ctx_len: int):
+    """The engine's slot-prefill wrapper, shared by the plain-sp and
+    stage x sp factories (only their prefill shard_maps differ):
+    [1, bucket] prompt -> trim/pad to [1, ctx_len] -> ring prefill ->
+    scatter the slot's ctx shards + plen. Bucket padding beyond ctx_len
+    is trimmed (real tokens are capped at ctx_len by the engine's
+    prompt_limit); shorter buckets zero-pad up to the window."""
+
+    @partial(jax.jit, static_argnames=("config_",),
+             donate_argnames=("cache",))
+    def prefill_slot_fn(params, tokens, prompt_len, slot,
+                        cache: SPEngineCache, rope: RopeTables,
+                        config_: LlamaConfig):
+        S = tokens.shape[1]
+        if S >= ctx_len:
+            toks = tokens[:, :ctx_len]
+        else:
+            toks = jnp.pad(tokens, ((0, 0), (0, ctx_len - S)))
+        logits, ks, vs = prefill_sm(
+            params["blocks"], params["embed"], params["final_norm"],
+            params["lm_head"], toks, prompt_len.astype(jnp.int32),
+            rope.cos, rope.sin)
+        ctx_k = lax.dynamic_update_slice_in_dim(
+            cache.ctx_k, ks.astype(cache.ctx_k.dtype), slot, axis=1)
+        ctx_v = lax.dynamic_update_slice_in_dim(
+            cache.ctx_v, vs.astype(cache.ctx_v.dtype), slot, axis=1)
+        plen = cache.plen.at[slot].set(prompt_len[0].astype(jnp.int32))
+        return logits, SPEngineCache(ctx_k, ctx_v, cache.tail_k,
+                                     cache.tail_v, plen)
+
+    return prefill_slot_fn
+
+
+def make_sp_engine_decode_body(config: LlamaConfig, tp_axis, Sl: int,
+                               chain):
+    """THE ragged engine decode shard_map body — single source for the
+    plain-sp and stage x sp engine factories, which differ only in how
+    the blocks run: chain(x, layer, blocks, ctx_k, ctx_v, tail_k,
+    tail_v) -> (x', (tail_k', tail_v')) is lax.scan for plain sp and
+    sp_pipeline._stage_chain for the stage pipeline."""
+    from cake_tpu.models.llama.cache import update_layer_cache_per_row
+    from cake_tpu.ops.rope import rope_rows_per_row
+
+    def decode_body(blocks, embed, final_norm, lm_head, token, pos,
+                    active, ctx_k, ctx_v, tail_k, tail_v, plen, cos,
+                    sin):
+        idx = lax.axis_index("sp")
+        B = token.shape[0]
+        tail_T = tail_k.shape[2]
+        x = jnp.take(embed, token, axis=0)               # [B, 1, D]
+        rope_c, rope_s = rope_rows_per_row(cos, sin, pos)
+        # contiguous positions: tail slot = generated index = pos - plen
+        t_slot = jnp.clip(pos - plen, 0, tail_T - 1)     # [B]
+        ctx_valid, tail_valid = sp_decode_masks(idx, Sl, plen, tail_T,
+                                                t_slot, B)
+
+        def tail_update(tk, tv, k, v):
+            # per-row active-masked write (ragged slots), vs the
+            # lockstep scalar-slot default
+            return update_layer_cache_per_row(tk, tv, k, v, t_slot,
+                                              active)
+
+        layer = sp_decode_layer(config, rope_c, rope_s, None, ctx_valid,
+                                tail_valid, tp_axis,
+                                tail_update=tail_update)
+        x, (tk_new, tv_new) = chain(x, layer, blocks, ctx_k, ctx_v,
+                                    tail_k, tail_v)
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+        logits = qmatmul(x[:, -1], lm_head).astype(jnp.float32)
+        return logits, tk_new, tv_new
+
+    return decode_body
+
+
+def make_decode_ragged_fns(decode_sm):
+    """(decode_ragged_forward, jitted decode_ragged_fn) over a ragged
+    sp decode shard_map — shared by the plain-sp and stage x sp engine
+    factories."""
 
     def decode_ragged_forward(params, tokens, cache: SPEngineCache, pos,
                               active, rope: RopeTables,
@@ -767,44 +863,4 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         return decode_ragged_forward(params, tokens, cache, pos, active,
                                      rope, config_)
 
-    # -- slot prefill: ring-prefill one prompt, scatter into the slot -----
-    prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
-
-    prefill_sm = jax.shard_map(
-        prefill_body, mesh=mesh,
-        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep,
-                  rep, rep),
-        out_specs=(rep, ctx_spec, ctx_spec),
-        check_vma=False,
-    )
-
-    @partial(jax.jit, static_argnames=("config_",),
-             donate_argnames=("cache",))
-    def prefill_slot_fn(params, tokens, prompt_len, slot,
-                        cache: SPEngineCache, rope: RopeTables,
-                        config_: LlamaConfig):
-        """[1, bucket] prompt -> ring prefill at [1, ctx_len] -> scatter
-        the slot's ctx shard + plen. Bucket padding beyond ctx_len is
-        trimmed (real tokens are capped at ctx_len by the engine's
-        prompt_limit); shorter buckets zero-pad up to the window."""
-        S = tokens.shape[1]
-        if S >= ctx_len:
-            toks = tokens[:, :ctx_len]
-        else:
-            toks = jnp.pad(tokens, ((0, 0), (0, ctx_len - S)))
-        logits, ks, vs = prefill_sm(
-            params["blocks"], params["embed"], params["final_norm"],
-            params["lm_head"], toks, prompt_len.astype(jnp.int32),
-            rope.cos, rope.sin)
-        ctx_k = lax.dynamic_update_slice_in_dim(
-            cache.ctx_k, ks.astype(cache.ctx_k.dtype), slot, axis=1)
-        ctx_v = lax.dynamic_update_slice_in_dim(
-            cache.ctx_v, vs.astype(cache.ctx_v.dtype), slot, axis=1)
-        plen = cache.plen.at[slot].set(prompt_len[0].astype(jnp.int32))
-        return logits, SPEngineCache(ctx_k, ctx_v, cache.tail_k,
-                                     cache.tail_v, plen)
-
-    from cake_tpu.serve.engine import make_decode_scan
-    decode_scan_fn = make_decode_scan(decode_ragged_forward)
-
-    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
+    return decode_ragged_forward, decode_ragged_fn

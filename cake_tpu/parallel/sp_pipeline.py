@@ -85,6 +85,37 @@ def _stage_chain(h, run_my_blocks, init_state):
     return out, state
 
 
+def make_sp_stage_prefill_body(config: LlamaConfig, kv_store, tp_axis,
+                               Sl: int, nstages: int, tp_size: int):
+    """THE stage-chained ring-prefill shard_map body — single source for
+    make_sp_stage_forward (the generator adapter) and
+    make_sp_stage_engine_step_fns (the batching engine), mirroring
+    context_parallel.make_sp_prefill_body's role for the plain-sp
+    factories."""
+    def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
+                     cos, sin):
+        isp = lax.axis_index("sp")
+        B = tokens.shape[0]
+        KV_local = config.num_key_value_heads // tp_size
+        Ll = config.num_hidden_layers // nstages
+        x = jnp.take(embed, tokens, axis=0)                 # [B, Sl, D]
+        rope_c = lax.dynamic_slice_in_dim(cos, isp * Sl, Sl, axis=0)
+        rope_s = lax.dynamic_slice_in_dim(sin, isp * Sl, Sl, axis=0)
+        layer = sp_prefill_layer(config, rope_c, rope_s, kv_store,
+                                 tp_axis)
+
+        def run_my_blocks(h):
+            return lax.scan(layer, h, blocks)
+
+        store = kv_store or x.dtype
+        ks0 = jnp.zeros((Ll, B, Sl, KV_local, config.head_dim), store)
+        x, (ks, vs) = _stage_chain(x, run_my_blocks, (ks0, ks0))
+        x = rms_norm(x, final_norm, config.rms_norm_eps)
+        logits = sp_select_last(x, plen, isp, Sl, lm_head)
+        return logits, ks, vs
+    return prefill_body
+
+
 def make_sp_stage_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
                           tail_len: int, kv_dtype=None, tp: bool = False,
                           params=None):
@@ -106,28 +137,9 @@ def make_sp_stage_forward(mesh: Mesh, config: LlamaConfig, ctx_len: int,
     tp_axis = "tp" if tp else None
     kv_store = kv_dtype
 
-    def prefill_body(blocks, embed, final_norm, lm_head, tokens, plen,
-                     cos, sin):
-        isp = lax.axis_index("sp")
-        B = tokens.shape[0]
-        KV_local = (config.num_key_value_heads // (mesh.shape["tp"] if tp
-                                                   else 1))
-        Ll = config.num_hidden_layers // nstages
-        x = jnp.take(embed, tokens, axis=0)                 # [B, Sl, D]
-        rope_c = lax.dynamic_slice_in_dim(cos, isp * Sl, Sl, axis=0)
-        rope_s = lax.dynamic_slice_in_dim(sin, isp * Sl, Sl, axis=0)
-        layer = sp_prefill_layer(config, rope_c, rope_s, kv_store,
-                                 tp_axis)
-
-        def run_my_blocks(h):
-            return lax.scan(layer, h, blocks)
-
-        store = kv_store or x.dtype
-        ks0 = jnp.zeros((Ll, B, Sl, KV_local, config.head_dim), store)
-        x, (ks, vs) = _stage_chain(x, run_my_blocks, (ks0, ks0))
-        x = rms_norm(x, final_norm, config.rms_norm_eps)
-        logits = sp_select_last(x, plen, isp, Sl, lm_head)
-        return logits, ks, vs
+    prefill_body = make_sp_stage_prefill_body(
+        config, kv_store, tp_axis, Sl, nstages,
+        mesh.shape["tp"] if tp else 1)
 
     def decode_body(blocks, embed, final_norm, lm_head, token, pos, plen,
                     ctx_k, ctx_v, tail_k, tail_v, cos, sin):
@@ -218,3 +230,91 @@ def place_sp_stage_params(mesh: Mesh, config: LlamaConfig, params,
     specs = pipeline_param_specs(params["blocks"].keys(),
                                  "tp" if tp else None)
     return tree_shard(params, mesh, specs)
+
+
+# -- continuous-batching engine over the ("stage","sp"[,"tp"]) mesh -----------
+
+
+def create_sp_stage_engine_cache(mesh: Mesh, config: LlamaConfig,
+                                 slots: int, ctx_len: int, tail_len: int,
+                                 kv_dtype=jnp.bfloat16,
+                                 tp: bool = False):
+    """SPEngineCache over the stage x sp mesh — the shared factory with
+    the layer dim additionally sharded over "stage" (each stage holds
+    only its block range's KV)."""
+    from cake_tpu.parallel.context_parallel import create_sp_engine_cache
+    return create_sp_engine_cache(mesh, config, slots, ctx_len,
+                                  tail_len, kv_dtype=kv_dtype, tp=tp,
+                                  stage=True)
+
+
+def make_sp_stage_engine_step_fns(mesh: Mesh, config: LlamaConfig,
+                                  ctx_len: int, tail_len: int,
+                                  kv_dtype=None, tp: bool = False,
+                                  params=None):
+    """Engine step-fn contract over the ("stage","sp"[,"tp"]) mesh —
+    the long-context 70B POD deployment (layer ranges over stages, ring
+    attention within each stage's sp group), now serving CONCURRENT
+    requests through the batching engine instead of the locked path.
+    Same signatures/semantics as context_parallel
+    .make_sp_engine_step_fns (position-contiguous per-row layout); the
+    stage pipeline rides _stage_chain exactly as the generator
+    adapter's forward does."""
+    nstages = mesh.shape["stage"]
+    sp_size = mesh.shape["sp"]
+    assert ctx_len % sp_size == 0, (ctx_len, sp_size)
+    assert config.num_hidden_layers % nstages == 0, (
+        config.num_hidden_layers, nstages)
+    Sl = ctx_len // sp_size
+    tp_axis = "tp" if tp else None
+    kv_store = kv_dtype
+
+    from cake_tpu.parallel.pipeline import _blocks_in_specs
+    blocks_spec = _blocks_in_specs(config, tp_axis, params)
+    ctx_spec = P("stage", None, "sp", tp_axis, None)
+    tail_spec = P("stage", None, None, tp_axis, None)
+    rep = P()
+
+    def chain(x, layer, blocks, ctx_k, ctx_v, tail_k, tail_v):
+        def run_my_blocks(h):
+            return lax.scan(layer, h, (blocks, ctx_k, ctx_v,
+                                       tail_k, tail_v))
+        return _stage_chain(x, run_my_blocks, (tail_k, tail_v))
+
+    from cake_tpu.parallel.context_parallel import (
+        make_sp_engine_decode_body,
+    )
+    decode_body = make_sp_engine_decode_body(config, tp_axis, Sl, chain)
+
+    decode_sm = jax.shard_map(
+        decode_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
+                  ctx_spec, ctx_spec, tail_spec, tail_spec, rep, rep,
+                  rep),
+        out_specs=(rep, tail_spec, tail_spec),
+        check_vma=False,
+    )
+
+    from cake_tpu.parallel.context_parallel import make_decode_ragged_fns
+    decode_ragged_forward, decode_ragged_fn = make_decode_ragged_fns(
+        decode_sm)
+
+    prefill_body = make_sp_stage_prefill_body(
+        config, kv_store, tp_axis, Sl, nstages,
+        mesh.shape["tp"] if tp else 1)
+
+    prefill_sm = jax.shard_map(
+        prefill_body, mesh=mesh,
+        in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep, rep,
+                  rep),
+        out_specs=(rep, ctx_spec, ctx_spec),
+        check_vma=False,
+    )
+
+    from cake_tpu.parallel.context_parallel import make_slot_prefill_fn
+    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len)
+
+    from cake_tpu.serve.engine import make_decode_scan
+    decode_scan_fn = make_decode_scan(decode_ragged_forward)
+
+    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
