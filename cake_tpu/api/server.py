@@ -484,7 +484,10 @@ def start(master, address: str = "127.0.0.1:10128",
     if engine is None and master.llm is not None:
         engine = master.make_engine()
     if engine is None and master.llm is not None:
-        # locked-path serving (dp x sp only, round-5): this mode gates on
+        # engine-less locked-path serving: unreachable for the built-in
+        # compositions as of round-5 (every sp mode has an engine
+        # contract), kept for custom forward adapters that provide no
+        # engine_pieces. These flags gate on
         # the engine and silently doing nothing would surprise operators
         if checkpoint_path:
             log.warning("--checkpoint does not apply to engine-less "

@@ -41,6 +41,16 @@ def _serve_multihost(master, args) -> int:
         # master.generate_image with them (_run_image_follower).
         engine = None
     else:
+        fwd = getattr(master.llm, "_forward_fn", None)
+        if fwd is not None and getattr(fwd, "_dp", False):
+            # dp x sp shards the SLOT axis over dp, so decode outputs
+            # (logits/tokens) are dp-sharded — not fully addressable
+            # per process, which the engine's multi-host fetch path
+            # (replicated-logits localization) cannot consume
+            raise ValueError(
+                "dp x sp serving is single-host only (dp-sharded "
+                "decode outputs are process-local); drop --dp or "
+                "serve on one host")
         # every process builds the identical engine (the shared-cache
         # zeros allocation is a global computation, so construction
         # order matters and must match across hosts)

@@ -101,7 +101,8 @@ class Master:
             # (ring slot-prefill + merged-stats ragged decode,
             # context_parallel.make_sp_engine_step_fns) — long-context
             # serving batches concurrent requests instead of serialising
-            # on the legacy locked path. Only dp x sp still locks.
+            # on the legacy locked path. dp x sp shards slots over dp;
+            # no text serving mode locks anymore.
             slots = max_slots or getattr(self.args, "max_slots", 8)
             pieces = None
             engine_pieces = getattr(fwd, "engine_pieces", None)

@@ -597,14 +597,17 @@ class SPGeneratorForward:
 
     def engine_pieces(self, slots: int, params):
         """(step_fns, cache, ctx_len, tail_len) for the continuous-
-        batching engine over this adapter's mesh, or None when the
-        composition has no engine contract (dp x sp keeps the locked
-        path). stage x sp routes to sp_pipeline's stage-chained
-        factory — the long-context 70B pod config, served batched."""
-        if self._dp:
-            return None
+        batching engine over this adapter's mesh. stage x sp routes to
+        sp_pipeline's stage-chained factory (the long-context 70B pod
+        config, served batched); dp x sp shards the slot axis over dp
+        (requires max_slots divisible by dp)."""
         dtype = (self._kv_dtype if self._kv_dtype is not None
                  else params["embed"].dtype)
+        if self._dp and slots % self._mesh.shape["dp"] != 0:
+            raise ValueError(
+                f"--max-slots {slots} must be divisible by --dp "
+                f"{self._mesh.shape['dp']} (the sp engine shards "
+                f"slots over dp)")
         if self._stages > 1:
             from cake_tpu.parallel.sp_pipeline import (
                 create_sp_stage_engine_cache,
@@ -619,10 +622,12 @@ class SPGeneratorForward:
             return fns, cache, self.ctx_len, self.tail_len
         fns = make_sp_engine_step_fns(
             self._mesh, self._config, self.ctx_len, self.tail_len,
-            kv_dtype=self._kv_dtype, tp=self._tp, params=params)
+            kv_dtype=self._kv_dtype, tp=self._tp, params=params,
+            dp=bool(self._dp))
         cache = create_sp_engine_cache(
             self._mesh, self._config, slots, self.ctx_len,
-            self.tail_len, kv_dtype=dtype, tp=self._tp)
+            self.tail_len, kv_dtype=dtype, tp=self._tp,
+            dp=bool(self._dp))
         return fns, cache, self.ctx_len, self.tail_len
 
     def decode_scan(self, params, token, k0: int, cache, rope, rng, ring,
@@ -661,10 +666,12 @@ def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
                            ctx_len: int, tail_len: int,
                            kv_dtype=jnp.bfloat16,
                            tp: bool = False,
-                           stage: bool = False) -> SPEngineCache:
+                           stage: bool = False,
+                           dp: bool = False) -> SPEngineCache:
     """Allocate the engine's multi-slot sp cache with the shardings
     make_sp_engine_step_fns' shard_maps expect (stage=True: the layer
-    dim additionally shards over "stage" for the stage x sp engine).
+    dim additionally shards over "stage" for the stage x sp engine;
+    dp=True: the SLOT dim shards over "dp" — requires slots % dp == 0).
     jit-with-out_shardings (not device_put): each shard allocates in
     place — no full-buffer transient, and it works over a multi-process
     mesh, where device_put to non-addressable devices is invalid
@@ -673,16 +680,19 @@ def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
     L = config.num_hidden_layers
     tp_axis = "tp" if tp else None
     stage_axis = "stage" if stage else None
-    tail = (P(stage_axis, None, None, tp_axis, None)
-            if (tp or stage) else P())
+    dp_axis = "dp" if dp else None
+    if dp:
+        assert slots % mesh.shape["dp"] == 0, (slots, mesh.shape["dp"])
+    tail = (P(stage_axis, dp_axis, None, tp_axis, None)
+            if (tp or stage or dp) else P())
     shardings = SPEngineCache(
-        ctx_k=NamedSharding(mesh, P(stage_axis, None, "sp", tp_axis,
+        ctx_k=NamedSharding(mesh, P(stage_axis, dp_axis, "sp", tp_axis,
                                     None)),
-        ctx_v=NamedSharding(mesh, P(stage_axis, None, "sp", tp_axis,
+        ctx_v=NamedSharding(mesh, P(stage_axis, dp_axis, "sp", tp_axis,
                                     None)),
         tail_k=NamedSharding(mesh, tail),
         tail_v=NamedSharding(mesh, tail),
-        plen=NamedSharding(mesh, P()),
+        plen=NamedSharding(mesh, P(dp_axis)),
     )
     make = jax.jit(
         lambda: SPEngineCache(
@@ -700,7 +710,7 @@ def create_sp_engine_cache(mesh: Mesh, config: LlamaConfig, slots: int,
 def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                             ctx_len: int, tail_len: int,
                             kv_dtype=None, tp: bool = False,
-                            params=None):
+                            params=None, dp: bool = False):
     """Engine step-fn contract over the sp(x tp) mesh: long-context
     CONTINUOUS-BATCHING serving — every slot's prompt ring-prefills over
     the sequence shards and concurrent requests decode together with
@@ -716,9 +726,11 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     engine layout is position-contiguous: row b's generated token t sits
     at rope position plen[b]+t and tail slot t, so outputs match the
     dense engine exactly for any prompt length. Composition: sp alone,
-    sp x tp, or — via sp_pipeline.make_sp_stage_engine_step_fns, which
-    shares this layout — stage x sp; only dp x sp keeps the locked
-    path."""
+    sp x tp, dp x sp(x tp) — dp shards the SLOT axis, each dp group
+    running its own sp ring (the body's collectives name only "sp"/
+    "tp", so shard_map scopes them per group; decode throughput scales
+    with dp at long context) — or, via sp_pipeline
+    .make_sp_stage_engine_step_fns sharing this layout, stage x sp."""
     sp_size = mesh.shape["sp"]
     assert ctx_len % sp_size == 0, (ctx_len, sp_size)
     Sl = ctx_len // sp_size
@@ -733,14 +745,17 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
 
     decode_body = make_sp_engine_decode_body(config, tp_axis, Sl, chain)
 
-    ctx_spec = P(None, None, "sp", tp_axis, None)
-    tail_spec = P(None, None, None, tp_axis, None) if tp else P()
+    dp_axis = "dp" if dp else None
+    batch = P(dp_axis)                  # slot-axis sharding over dp
+    ctx_spec = P(None, dp_axis, "sp", tp_axis, None)
+    tail_spec = (P(None, dp_axis, None, tp_axis, None)
+                 if (tp or dp) else P())
     decode_sm = jax.shard_map(
         decode_body, mesh=mesh,
-        in_specs=(blocks_spec, rep, rep, rep, rep, rep, rep,
-                  ctx_spec, ctx_spec, tail_spec, tail_spec, rep, rep,
+        in_specs=(blocks_spec, rep, rep, rep, batch, batch, batch,
+                  ctx_spec, ctx_spec, tail_spec, tail_spec, batch, rep,
                   rep),
-        out_specs=(rep, tail_spec, tail_spec),
+        out_specs=(batch, tail_spec, tail_spec),
         check_vma=False,
     )
 
@@ -750,11 +765,16 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     # -- slot prefill: ring-prefill one prompt, scatter into the slot -----
     prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
 
+    # prefill output is a SINGLE slot ([L, 1, Sl, ...]) — its specs
+    # never carry the dp axis (a size-1 dim cannot shard over dp); the
+    # scatter into the dp-sharded cache happens in the jitted slot
+    # wrapper, where XLA reshards the one-slot update onto its owner
+    pf_ctx_spec = P(None, None, "sp", tp_axis, None)
     prefill_sm = jax.shard_map(
         prefill_body, mesh=mesh,
         in_specs=(blocks_spec, rep, rep, rep, P(None, "sp"), rep,
                   rep, rep),
-        out_specs=(rep, ctx_spec, ctx_spec),
+        out_specs=(rep, pf_ctx_spec, pf_ctx_spec),
         check_vma=False,
     )
     prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len)

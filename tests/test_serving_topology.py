@@ -253,12 +253,11 @@ def test_sp_scratch_generation_does_not_clobber_session():
     assert first + rest == want
 
 
-def test_sp_engine_and_dp_sp_locked_path():
-    """Round-5: plain --sp + --api gets a REAL batching engine
-    (context_parallel.make_sp_engine_step_fns; covered in depth by
-    tests/test_sp_engine.py); the dp x sp composition still has no
-    engine contract — make_engine returns None there and the REST layer
-    serves one-shot requests through the legacy locked path."""
+def test_sp_and_dp_sp_serve_through_engine():
+    """Round-5: EVERY sp composition behind --api serves through a real
+    batching engine — plain sp, and dp x sp (slot axis sharded over dp;
+    covered in depth by tests/test_sp_engine.py). The legacy locked
+    path has no remaining text serving mode."""
     import json
     import urllib.request
 
@@ -273,10 +272,12 @@ def test_sp_engine_and_dp_sp_locked_path():
     eng.stop()
 
     args = _mk_args(sp=4, dp=2, batch_size=2, max_seq_len=256,
-                    sample_len=8)
+                    sample_len=8, max_slots=4)
     gen = _ctx(args).load_text_model()
     master = Master(args, text_generator=gen)
-    assert master.make_engine() is None
+    probe = master.make_engine()
+    assert probe is not None, "dp x sp should serve through the engine now"
+    probe.stop()   # start() below builds its own engine
 
     httpd = start(master, address="127.0.0.1:0", block=False)
     base = "http://%s:%d" % httpd.server_address[:2]
