@@ -29,10 +29,21 @@ from cake_tpu.api.openai import (
     chunk_response, completion_response, parse_chat_request,
 )
 from cake_tpu.args import ImageGenerationArgs
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import tracing as obs_tracing
 
 log = logging.getLogger(__name__)
 
 MAX_WAITING = 16
+
+# routes worth a per-route counter series; anything else (scanners,
+# typos) collapses into "other" so a 404 spray cannot explode the label
+# cardinality
+KNOWN_ROUTES = frozenset({
+    "/api/v1/chat/completions", "/v1/chat/completions", "/api/v1/image",
+    "/api/v1/health", "/api/v1/cluster", "/v1/models", "/api/v1/models",
+    "/metrics", "/api/v1/metrics", "/api/v1/requests",
+})
 
 
 class ApiServer:
@@ -55,6 +66,16 @@ class ApiServer:
         self._waiting = 0
         self._waiting_lock = threading.Lock()
         self.started_at = int(time.time())  # /v1/models "created"
+        self._m_http = obs_metrics.counter(
+            "cake_http_requests_total",
+            "HTTP requests served, by route and status code",
+            labelnames=("route", "status"))
+
+    def _count(self, path: str, code: int) -> None:
+        route = path.split("?", 1)[0]
+        if route not in KNOWN_ROUTES:
+            route = "other"
+        self._m_http.labels(route=route, status=str(code)).inc()
 
     # -- text ---------------------------------------------------------------
 
@@ -80,6 +101,7 @@ class ApiServer:
                          "max_decode_tokens", None)
         if budget is not None:
             opts["max_tokens"] = min(opts["max_tokens"] or budget, budget)
+        t0 = time.perf_counter()
         with self._admission():
             with self._gen_lock:
                 m = self.master
@@ -93,6 +115,11 @@ class ApiServer:
                 if send_chunk is None:
                     text = m.generate_text(lambda t: None,
                                            sample_len=opts["max_tokens"])
+                    # locked-path e2e latency: the engine path records
+                    # this through its tracer; here the handler is the
+                    # only seam that sees the whole request
+                    obs_tracing.REQUEST_E2E.observe(
+                        time.perf_counter() - t0)
                     return completion_response(text, self.model_name)
                 if on_start is not None:
                     on_start()
@@ -103,6 +130,7 @@ class ApiServer:
                 )
                 send_chunk(chunk_response("", self.model_name,
                                           finish="stop", rid=rid))
+                obs_tracing.REQUEST_E2E.observe(time.perf_counter() - t0)
                 return None
 
     def _chat_engine(self, body: dict, send_chunk=None,
@@ -263,61 +291,91 @@ class ApiServer:
         return out
 
     def metrics(self) -> str:
-        """Prometheus text exposition of the serving counters (the
+        """Prometheus text exposition of the serving metrics (the
         observability face of the reference's periodic worker-stat logs,
-        worker.rs:254-283 — scrape-able instead of grep-able)."""
-        lines = [
-            "# TYPE cake_requests_waiting gauge",
-            f"cake_requests_waiting {self._waiting}",
-            "# TYPE cake_serving_healthy gauge",
-            "cake_serving_healthy %d" % (
-                0 if (self.health_state is not None
-                      and self.health_state.failed) else 1),
-        ]
+        worker.rs:254-283 — scrape-able instead of grep-able).
+
+        Rendered from the obs.metrics registry: the request-latency
+        histograms (TTFT / e2e / queue wait / prefill / inter-token)
+        and per-route counters accumulate where the work happens; the
+        engine's aggregate counters are synced here at scrape time (one
+        scrape = one consistent snapshot of EngineStats)."""
+        m = obs_metrics
+        m.gauge("cake_requests_waiting",
+                "Requests inside HTTP admission").set(self._waiting)
+        m.gauge("cake_serving_healthy",
+                "1 = serving, 0 = failed (parallel/health.py)").set(
+            0 if (self.health_state is not None
+                  and self.health_state.failed) else 1)
+        if self.health_state is not None and hasattr(
+                self.health_state, "observe_metrics"):
+            # heartbeat staleness gauge + watchdog counters
+            self.health_state.observe_metrics()
         if self.engine is not None:
             st = self.engine.stats
-            pairs = [
-                ("cake_engine_queue_depth", "gauge",
-                 self.engine.queue_depth),
-                ("cake_engine_active_requests", "gauge",
-                 self.engine.active),
-                ("cake_engine_decode_slots", "gauge",
-                 self.engine.max_slots),
-                ("cake_engine_requests_completed_total", "counter",
-                 st.requests_completed),
-                ("cake_engine_tokens_generated_total", "counter",
-                 st.tokens_generated),
-                ("cake_engine_decode_steps_total", "counter", st.steps),
-                ("cake_engine_decode_seconds_total", "counter",
-                 round(st.decode_time_s, 4)),
-                ("cake_engine_prefill_seconds_total", "counter",
-                 round(st.prefill_time_s, 4)),
-                ("cake_engine_prefix_hits_total", "counter",
-                 st.prefix_hits),
-                ("cake_engine_errors_total", "counter", st.errors),
-                ("cake_engine_decode_tokens_per_second", "gauge",
+            for name, help_, val in (
+                ("cake_engine_queue_depth",
+                 "Admission queue depth", self.engine.queue_depth),
+                ("cake_engine_active_requests",
+                 "Requests holding a decode slot", self.engine.active),
+                ("cake_engine_decode_slots",
+                 "Configured decode slots", self.engine.max_slots),
+                ("cake_engine_decode_tokens_per_second",
+                 "Aggregate decode throughput",
                  round(st.decode_tokens_per_s, 2)),
-            ]
+                ("cake_engine_trace_active_requests",
+                 "Requests with an open lifecycle trace",
+                 self.engine.tracer.active_count),
+            ):
+                m.gauge(name, help_).set(val)
+            for name, help_, val in (
+                ("cake_engine_requests_completed_total",
+                 "Requests retired by the engine",
+                 st.requests_completed),
+                ("cake_engine_tokens_generated_total",
+                 "Tokens generated across all requests",
+                 st.tokens_generated),
+                ("cake_engine_decode_steps_total",
+                 "Batched decode steps dispatched", st.steps),
+                ("cake_engine_decode_seconds_total",
+                 "Wall seconds inside decode dispatch",
+                 round(st.decode_time_s, 4)),
+                ("cake_engine_prefill_seconds_total",
+                 "Wall seconds inside prefill dispatch",
+                 round(st.prefill_time_s, 4)),
+                ("cake_engine_prefix_hits_total",
+                 "Prefills served from a registered prefix",
+                 st.prefix_hits),
+                ("cake_engine_errors_total",
+                 "Engine iterations that failed and reset", st.errors),
+            ):
+                m.counter(name, help_).set_total(val)
             if getattr(self.engine, "_spec", False):
-                pairs += [
-                    ("cake_engine_spec_proposed_total", "counter",
-                     st.spec_proposed),
-                    ("cake_engine_spec_accepted_total", "counter",
-                     st.spec_accepted),
-                    ("cake_engine_spec_acceptance", "gauge",
-                     round(st.spec_acceptance, 4)),
-                ]
+                m.counter("cake_engine_spec_proposed_total",
+                          "Draft tokens proposed").set_total(
+                    st.spec_proposed)
+                m.counter("cake_engine_spec_accepted_total",
+                          "Draft tokens accepted").set_total(
+                    st.spec_accepted)
+                m.gauge("cake_engine_spec_acceptance",
+                        "Lifetime draft acceptance ratio").set(
+                    round(st.spec_acceptance, 4))
             if getattr(self.engine, "paged", False):
-                pairs += [
-                    ("cake_engine_kv_pages_total", "gauge",
-                     self.engine.cache.n_pages),
-                    ("cake_engine_kv_pages_free", "gauge",
-                     self.engine._pager.free_pages),
-                ]
-            for name, typ, val in pairs:
-                lines.append(f"# TYPE {name} {typ}")
-                lines.append(f"{name} {val}")
-        return "\n".join(lines) + "\n"
+                m.gauge("cake_engine_kv_pages_total",
+                        "KV pages in the pool").set(
+                    self.engine.cache.n_pages)
+                m.gauge("cake_engine_kv_pages_free",
+                        "KV pages currently free").set(
+                    self.engine._pager.free_pages)
+        return m.REGISTRY.render()
+
+    def requests(self, limit: Optional[int] = None) -> dict:
+        """Per-request lifecycle traces (GET /api/v1/requests): active
+        requests first, then the finished ring, newest first."""
+        if self.engine is None:
+            return {"requests": [], "note": "engine-less serving has "
+                    "no request tracer"}
+        return {"requests": self.engine.tracer.dump(limit)}
 
     # -- admission -----------------------------------------------------------
 
@@ -360,6 +418,7 @@ def make_handler(api: ApiServer):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+            api._count(self.path, code)
 
         def _read_body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
@@ -375,6 +434,18 @@ def make_handler(api: ApiServer):
                 return self._json(200, api.health())
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
+            if self.path.split("?", 1)[0] == "/api/v1/requests":
+                # optional ?limit=N caps the dump (the ring itself is
+                # already bounded)
+                limit = None
+                if "?" in self.path:
+                    from urllib.parse import parse_qs
+                    q = parse_qs(self.path.split("?", 1)[1])
+                    try:
+                        limit = int(q.get("limit", [None])[0])
+                    except (TypeError, ValueError):
+                        limit = None
+                return self._json(200, api.requests(limit))
             if self.path in ("/v1/models", "/api/v1/models"):
                 # OpenAI client compatibility: SDKs list models on init
                 return self._json(200, {
@@ -383,7 +454,7 @@ def make_handler(api: ApiServer):
                               "created": api.started_at,
                               "owned_by": "cake-tpu"}],
                 })
-            if self.path == "/metrics":
+            if self.path in ("/metrics", "/api/v1/metrics"):
                 data = api.metrics().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -391,6 +462,7 @@ def make_handler(api: ApiServer):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+                api._count(self.path, 200)
                 return
             self._json(404, {"error": "not found"})  # api/mod.rs:19-21
 
@@ -430,6 +502,7 @@ def make_handler(api: ApiServer):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+                api._count(self.path, 503)
             except Exception as e:  # noqa: BLE001
                 log.exception("request failed")
                 if getattr(self, "_stream_started", False):
@@ -461,11 +534,13 @@ def make_handler(api: ApiServer):
             if outcome is DISCONNECTED:
                 # handled disconnect: the socket is dead, writing the
                 # trailer would only manufacture an error traceback
+                api._count(self.path, 200)
                 return
             done = b"data: [DONE]\n\n"
             self.wfile.write(hex(len(done))[2:].encode() + b"\r\n")
             self.wfile.write(done + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
+            api._count(self.path, 200)
 
     return Handler
 

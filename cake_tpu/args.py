@@ -117,6 +117,13 @@ class Args:
     # max_slots x max_seq_len (models/llama/paged.py)
     kv_pages: Optional[int] = None
     kv_page_size: int = 128
+    # --trace-events PATH: append every request-lifecycle span as one
+    # JSON line (obs/tracing.py) — the replayable audit log behind the
+    # in-memory ring served at GET /api/v1/requests
+    trace_events: Optional[str] = None
+    # --trace-ring N: finished request traces retained in memory for
+    # GET /api/v1/requests
+    trace_ring: int = 256
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -131,7 +138,7 @@ class Args:
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
                      "max_slots", "decode_scan", "spec_gamma",
-                     "spec_rounds"):
+                     "spec_rounds", "trace_ring"):
             if getattr(self, knob) < 1:
                 raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self

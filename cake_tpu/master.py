@@ -88,6 +88,7 @@ class Master:
                 draft_params=g.draft_params,
                 draft_config=g.draft_config,
                 spec_gamma=g.gamma,
+                **self._trace_kwargs(),
                 # passed through so the engine's own guard WARNS that
                 # multi-step scans don't apply in speculative mode
                 # (each round already advances up to gamma+1 tokens),
@@ -132,6 +133,7 @@ class Master:
                 decode_scan_steps=self.args.decode_scan,
                 step_fns=fns, cache=cache,
                 prompt_limit=ctx_len, decode_budget=tail_len,
+                **self._trace_kwargs(),
                 # passed through so the engine's no-chunk-fn guard WARNS
                 # that --prefill-chunk has no sp variant, instead of the
                 # flag silently vanishing
@@ -183,7 +185,16 @@ class Master:
             prefill_chunk=getattr(self.args, "prefill_chunk", None),
             kv_pages=getattr(self.args, "kv_pages", None),
             kv_page_size=getattr(self.args, "kv_page_size", 128),
+            **self._trace_kwargs(),
             **kwargs,
+        )
+
+    def _trace_kwargs(self) -> dict:
+        """Request-lifecycle tracing knobs, plumbed to every engine
+        flavor identically (--trace-events / --trace-ring)."""
+        return dict(
+            trace_events=getattr(self.args, "trace_events", None),
+            trace_ring=getattr(self.args, "trace_ring", 256),
         )
 
     # -- text ----------------------------------------------------------------

@@ -1,0 +1,23 @@
+"""Observability: metrics registry + request-lifecycle tracing.
+
+The reference's only observability is a windowed worker ops/s log line
+(worker.rs:254-283, SURVEY §5). This package gives the serving stack a
+real measurement substrate, dependency-free:
+
+  * `obs.metrics` — a Prometheus-style registry (`Counter`, `Gauge`,
+    `Histogram`, all with label support) rendering the text exposition
+    format; `ApiServer.metrics()` serves it at `/api/v1/metrics` and
+    `/metrics`.
+  * `obs.tracing` — per-request lifecycle traces: timestamped spans
+    (admitted → queued → prefill → first_token → decode → retired /
+    error / cancelled) with queue-wait, prefill seconds, TTFT,
+    inter-token gaps and e2e latency, kept in a bounded ring, dumpable
+    via `GET /api/v1/requests`, optionally streamed to a JSONL event
+    log (`--trace-events PATH`).
+"""
+
+from cake_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
+    histogram,
+)
+from cake_tpu.obs.tracing import RequestTracer, TraceRecord  # noqa: F401

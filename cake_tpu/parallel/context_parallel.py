@@ -34,11 +34,47 @@ from jax.sharding import PartitionSpec as P
 
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.model import RopeTables, block_skeleton
+from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.quant import qmatmul
 from cake_tpu.ops.rope import apply_rope
 
 NEG_INF = -1e30
+
+# host-side dispatch counters for the sp/stage-sp engine step fns: the
+# forwards themselves are jitted (no per-call Python), so counting wraps
+# the dispatch wrappers — one inc per device program launch, labeled by
+# op and serving mode. Shared with sp_pipeline via the fn factories.
+_SP_DISPATCH = obs_metrics.counter(
+    "cake_sp_dispatch_total",
+    "Device-program dispatches of the sp engine step fns",
+    labelnames=("op", "mode"))
+
+
+def _counted(fn, op: str, mode: str):
+    child = _SP_DISPATCH.labels(op=op, mode=mode)
+
+    def wrapper(*args, **kw):
+        child.inc()
+        return fn(*args, **kw)
+    return wrapper
+
+
+def instrument_sp_engine(decode_scan_fn, mode: str, ctx_len: int,
+                         tail_len: int):
+    """Shared observability tail of every sp-engine step-fn factory
+    (plain sp here, stage x sp in sp_pipeline): wrap the scan dispatch
+    with the op counter and publish the window-layout gauges — one
+    definition, so the two factories' metrics cannot drift."""
+    obs_metrics.gauge(
+        "cake_sp_ctx_window_tokens",
+        "Sequence-sharded prompt window of the sp engine",
+        labelnames=("mode",)).labels(mode=mode).set(ctx_len)
+    obs_metrics.gauge(
+        "cake_sp_tail_window_tokens",
+        "Replicated decode tail of the sp engine",
+        labelnames=("mode",)).labels(mode=mode).set(tail_len)
+    return _counted(decode_scan_fn, "decode_scan", mode)
 
 
 def _chunk_scores(q, k, *, scale):
@@ -735,6 +771,8 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     assert ctx_len % sp_size == 0, (ctx_len, sp_size)
     Sl = ctx_len // sp_size
     tp_axis = "tp" if tp else None
+    mode = "_".join((["dp"] if dp else []) + ["sp"]
+                    + (["tp"] if tp else []))
     blocks_spec = sp_block_specs(config, tp, params)
     rep = P()
 
@@ -760,7 +798,7 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
     )
 
     decode_ragged_forward, decode_ragged_fn = make_decode_ragged_fns(
-        decode_sm)
+        decode_sm, mode=mode)
 
     # -- slot prefill: ring-prefill one prompt, scatter into the slot -----
     prefill_body = make_sp_prefill_body(config, kv_dtype, tp_axis, Sl)
@@ -777,15 +815,17 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         out_specs=(rep, pf_ctx_spec, pf_ctx_spec),
         check_vma=False,
     )
-    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len)
+    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len,
+                                           mode=mode)
 
     from cake_tpu.serve.engine import make_decode_scan
-    decode_scan_fn = make_decode_scan(decode_ragged_forward)
+    decode_scan_fn = instrument_sp_engine(
+        make_decode_scan(decode_ragged_forward), mode, ctx_len, tail_len)
 
     return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
 
 
-def make_slot_prefill_fn(prefill_sm, ctx_len: int):
+def make_slot_prefill_fn(prefill_sm, ctx_len: int, mode: str = "sp"):
     """The engine's slot-prefill wrapper, shared by the plain-sp and
     stage x sp factories (only their prefill shard_maps differ):
     [1, bucket] prompt -> trim/pad to [1, ctx_len] -> ring prefill ->
@@ -815,7 +855,7 @@ def make_slot_prefill_fn(prefill_sm, ctx_len: int):
         return logits, SPEngineCache(ctx_k, ctx_v, cache.tail_k,
                                      cache.tail_v, plen)
 
-    return prefill_slot_fn
+    return _counted(prefill_slot_fn, "prefill", mode)
 
 
 def make_sp_engine_decode_body(config: LlamaConfig, tp_axis, Sl: int,
@@ -859,10 +899,12 @@ def make_sp_engine_decode_body(config: LlamaConfig, tp_axis, Sl: int,
     return decode_body
 
 
-def make_decode_ragged_fns(decode_sm):
+def make_decode_ragged_fns(decode_sm, mode: str = "sp"):
     """(decode_ragged_forward, jitted decode_ragged_fn) over a ragged
     sp decode shard_map — shared by the plain-sp and stage x sp engine
-    factories."""
+    factories. Only the jitted dispatch wrapper is dispatch-counted;
+    decode_ragged_forward also gets traced INSIDE decode scans, where a
+    host-side counter would be meaningless (and silently ignored)."""
 
     def decode_ragged_forward(params, tokens, cache: SPEngineCache, pos,
                               active, rope: RopeTables,
@@ -883,4 +925,5 @@ def make_decode_ragged_fns(decode_sm):
         return decode_ragged_forward(params, tokens, cache, pos, active,
                                      rope, config_)
 
-    return decode_ragged_forward, decode_ragged_fn
+    return decode_ragged_forward, _counted(decode_ragged_fn, "decode",
+                                           mode)

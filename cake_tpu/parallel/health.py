@@ -30,7 +30,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from cake_tpu.obs import metrics as obs_metrics
+
 log = logging.getLogger(__name__)
+
+# stall detections are rare and load-bearing (each one failed every
+# in-flight request): a counter so dashboards see them without log
+# spelunking
+_WATCHDOG_STALLS = obs_metrics.counter(
+    "cake_watchdog_stalls_total",
+    "Progress-watchdog stall detections (engine stopped advancing "
+    "with active requests)")
 
 
 # -- device probe ------------------------------------------------------------
@@ -155,6 +165,13 @@ class HeartbeatMonitor:
         with self._lock:
             return [n for n, t in self.last_seen.items() if now - t > thr]
 
+    def staleness(self) -> Dict[str, float]:
+        """Seconds since each tracked worker's last heartbeat (the
+        /metrics staleness gauge's source)."""
+        now = time.monotonic()
+        with self._lock:
+            return {n: now - t for n, t in self.last_seen.items()}
+
     def _sweep(self, interval: float) -> None:
         while not self._stop.wait(interval):
             for name in self.stale():
@@ -244,11 +261,28 @@ class ServingHealth:
         self._watchdog = Watchdog(
             self._progress_counter,
             stall_after_s,
-            on_stall=lambda: self.fail(
-                f"engine made no progress for {stall_after_s:.0f}s "
-                "with active requests", recoverable=True),
+            on_stall=self._on_stall,
             active=lambda: engine.active > 0,
         )
+        self._stall_after = stall_after_s
+
+    def _on_stall(self) -> None:
+        _WATCHDOG_STALLS.inc()
+        self.fail(
+            f"engine made no progress for {self._stall_after:.0f}s "
+            "with active requests", recoverable=True)
+
+    def observe_metrics(self) -> None:
+        """Sync health state into the metrics registry — called by
+        ApiServer.metrics() at scrape time, so the staleness gauge
+        reflects the instant of the scrape (not the last sweep)."""
+        if self.monitor is not None:
+            g = obs_metrics.gauge(
+                "cake_heartbeat_staleness_seconds",
+                "Seconds since each worker's last heartbeat",
+                labelnames=("worker",))
+            for name, age in self.monitor.staleness().items():
+                g.labels(worker=name).set(round(age, 3))
 
     def _progress_counter(self) -> int:
         """Watchdog counter; doubles as the recovery probe: a stall

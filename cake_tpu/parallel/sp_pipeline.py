@@ -295,9 +295,10 @@ def make_sp_stage_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         check_vma=False,
     )
 
+    mode = "stage_sp_tp" if tp else "stage_sp"
     from cake_tpu.parallel.context_parallel import make_decode_ragged_fns
     decode_ragged_forward, decode_ragged_fn = make_decode_ragged_fns(
-        decode_sm)
+        decode_sm, mode=mode)
 
     prefill_body = make_sp_stage_prefill_body(
         config, kv_store, tp_axis, Sl, nstages,
@@ -311,10 +312,14 @@ def make_sp_stage_engine_step_fns(mesh: Mesh, config: LlamaConfig,
         check_vma=False,
     )
 
-    from cake_tpu.parallel.context_parallel import make_slot_prefill_fn
-    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len)
+    from cake_tpu.parallel.context_parallel import (
+        instrument_sp_engine, make_slot_prefill_fn,
+    )
+    prefill_slot_fn = make_slot_prefill_fn(prefill_sm, ctx_len,
+                                           mode=mode)
 
     from cake_tpu.serve.engine import make_decode_scan
-    decode_scan_fn = make_decode_scan(decode_ragged_forward)
+    decode_scan_fn = instrument_sp_engine(
+        make_decode_scan(decode_ragged_forward), mode, ctx_len, tail_len)
 
     return prefill_slot_fn, decode_ragged_fn, decode_scan_fn

@@ -203,6 +203,7 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
     already finished (their transcripts survive the restart).
     strict: fingerprint mismatch raises instead of warning.
     """
+    from cake_tpu.obs import metrics as obs_metrics
     fp, want = _fingerprint(engine), snap.get("engine", {})
     if fp != want:
         msg = f"snapshot fingerprint {want} != engine {fp}"
@@ -210,6 +211,12 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
             raise ValueError(msg)
         log.warning("%s (resuming anyway)", msg)
 
+    resumed_c = obs_metrics.counter(
+        "cake_checkpoint_resumed_requests_total",
+        "Snapshot requests resubmitted into a restarted engine")
+    dropped_c = obs_metrics.counter(
+        "cake_checkpoint_dropped_requests_total",
+        "Snapshot requests that could not be resubmitted")
     handles, finished = [], []
     for rec in snap["requests"]:
         try:
@@ -228,7 +235,18 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
                 raise ValueError(
                     f"resumed context {len(ids)} exceeds this serving "
                     f"mode's prompt window {limit}")
-            handles.append(engine.submit(
+            budget = getattr(engine, "decode_budget", None)
+            truncated = budget is not None and rec["remaining"] > budget
+            if truncated:
+                # submit() clamps max_new_tokens to the tail capacity;
+                # that silently shortens the client's resumed
+                # generation, so make it loud and visible on the trace
+                log.warning(
+                    "resume: rid=%s has %d tokens remaining but this "
+                    "serving mode's decode budget is %d; the resumed "
+                    "generation will be truncated",
+                    rec.get("rid"), rec["remaining"], budget)
+            h = engine.submit(
                 ids,
                 max_new_tokens=rec["remaining"],
                 temperature=rec["temperature"],
@@ -236,11 +254,18 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
                 repeat_penalty=rec["repeat_penalty"],
                 prime_penalty_tokens=rec.get("penalty_context",
                                              rec["out_tokens"]),
-            ))
+            )
+            tracer = getattr(engine, "tracer", None)
+            if tracer is not None:
+                tracer.annotate(h._req.rid, resumed=True,
+                                truncated=truncated)
+            resumed_c.inc()
+            handles.append(h)
         except Exception as e:  # noqa: BLE001 — one bad record must not
             # crash-loop server startup (queue full, shrunk max_seq_len, …)
             log.warning("resume: dropping request rid=%s: %s",
                         rec.get("rid"), e)
+            dropped_c.inc()
             rec = dict(rec, error=f"resume failed: {e}")
             finished.append(rec)
     log.info("resume: %d request(s) resubmitted, %d already finished",

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from cake_tpu.models.chat import History, Message
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.tracing import RequestTracer
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import (
@@ -51,6 +53,12 @@ from cake_tpu.ops.sampling import (
 )
 
 log = logging.getLogger(__name__)
+
+# a failed post-error rebuild bricks the engine thread; the counter makes
+# that state visible on /api/v1/metrics instead of only in the logs
+_RESET_FAILURES = obs_metrics.counter(
+    "cake_engine_reset_failures_total",
+    "Post-error engine resets that themselves failed (engine stopped)")
 
 
 @dataclass
@@ -199,6 +207,8 @@ class InferenceEngine:
         kv_page_size: int = 128,
         prompt_limit: Optional[int] = None,
         decode_budget: Optional[int] = None,
+        trace_events: Optional[str] = None,
+        trace_ring: int = 256,
     ):
         self.config = config
         self.params = params
@@ -407,6 +417,13 @@ class InferenceEngine:
             self._cache_dtype = self.cache[0].dtype
         self.scheduler = make_scheduler(max_slots, max_queue)
         self.stats = EngineStats()
+        # request-lifecycle traces (obs/tracing.py): spans recorded at
+        # the submit/prefill/emit/retire seams below, so every serving
+        # mode (dense, paged, spec, pipelined, sp / stage x sp / dp x
+        # sp step fns) is traced identically. trace_events: optional
+        # JSONL event log path (--trace-events).
+        self.tracer = RequestTracer(capacity=trace_ring,
+                                    events_path=trace_events)
         from cake_tpu.utils.profiling import StepStats
         self._step_stats = StepStats(name="engine", window=100)
 
@@ -488,6 +505,7 @@ class InferenceEngine:
         # drain but before join returned (the cancel() dead-thread check
         # handles calls arriving later than this)
         self._drain_cancellations()
+        self.tracer.close()
         if self._control is not None:
             # published only after the engine thread has exited, so no
             # step op can be ordered after the stop on the wire
@@ -694,8 +712,13 @@ class InferenceEngine:
         # register BEFORE scheduler.submit: the engine thread may plan the
         # rid immediately, and _do_prefill treats an unknown rid as cancelled
         self._requests[rid] = req
+        # trace BEFORE scheduler.submit: the engine thread may plan the
+        # rid immediately, and prefill_start on an unknown rid would
+        # silently drop the span (no queue-wait/prefill observation)
+        self.tracer.admit(rid, len(ids), max_new)
         if not self.scheduler.submit(rid, len(ids), max_new):
             self._requests.pop(rid, None)
+            self.tracer.drop(rid)
             raise QueueFullError("engine queue full")
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
@@ -1004,6 +1027,8 @@ class InferenceEngine:
                 self._slot_req[req.slot] = None
                 self._release_slot_pages(req.slot)
             req.finish_t = time.perf_counter()
+            self.tracer.finish(rid, "cancelled",
+                               output_tokens=len(req.out_tokens))
             req.done.set()
 
     @property
@@ -1106,7 +1131,25 @@ class InferenceEngine:
                         self._snapshot_before_fail(requests=recs)
                     self._stop.set()
                     return
-                self._reset_after_error()
+                try:
+                    self._reset_after_error()
+                except Exception:  # noqa: BLE001
+                    # the rebuild itself failed (OOM rebuilding the
+                    # cache, a dead device): the engine cannot serve
+                    # again — snapshot what the first failure captured
+                    # and stop CLEANLY, instead of the raise silently
+                    # killing the thread with no checkpoint and no
+                    # metric (the API would 200 /health while every
+                    # request hangs in the queue forever)
+                    log.exception("post-error engine reset failed; "
+                                  "stopping the engine")
+                    _RESET_FAILURES.inc()
+                    self.stats.errors += 1
+                    self.stats.last_error = "reset failed"
+                    with self._ckpt_lock:
+                        self._snapshot_before_fail(requests=recs)
+                    self._stop.set()
+                    return
                 self.stats.errors += 1
                 self.stats.last_error = f"{type(e).__name__}: {e}"
 
@@ -1143,9 +1186,19 @@ class InferenceEngine:
             # also not a KVCache but MUST take its own branch below: a
             # zeros rebuild would map every slot to page 0 (create()
             # fills the table with -1) and leak the allocator's pages.
-            return type(self.cache)(*(
-                jax.device_put(jnp.zeros(shape, dtype), sharding)
-                for (shape, dtype, sharding) in self._cache_shardings))
+            # jit-with-out_shardings, NOT device_put: each shard zeros
+            # in place (no full-buffer host transient), and it is the
+            # only valid construction over a multi-process mesh, where
+            # device_put to non-addressable devices raises
+            # (create_sp_engine_cache precedent).
+            specs = list(self._cache_shardings)
+            make = jax.jit(
+                lambda: type(self.cache)(*(
+                    jnp.zeros(shape, dtype)
+                    for (shape, dtype, _s) in specs)),
+                out_shardings=type(self.cache)(*(
+                    s for (_shape, _dtype, s) in specs)))
+            return make()
         if self.paged:
             from cake_tpu.models.llama.paged import (
                 PageAllocator, PagedKVCache,
@@ -1217,7 +1270,10 @@ class InferenceEngine:
             self._requests.pop(req.rid, None)
             if getattr(self, "_page_blocked_rid", None) == req.rid:
                 self._page_blocked_rid = None
+            self.tracer.finish(req.rid, "error", error=str(req.error))
             req.done.set()
+        else:
+            self.tracer.span(req.rid, "requeued")
         return False
 
     def _do_prefill(self, rid: int, slot: int, defer: bool = False):
@@ -1231,6 +1287,7 @@ class InferenceEngine:
         if req is None:  # cancelled between plan and here
             self.scheduler.cancel(rid)
             return None
+        self.tracer.prefill_start(rid)
         t0 = time.perf_counter()
         req.slot = slot
         self._slot_req[slot] = req
@@ -1622,6 +1679,8 @@ class InferenceEngine:
             self._slot_req[req.slot] = None
         self._requests.pop(req.rid, None)
         self.stats.requests_completed += 1
+        self.tracer.finish(req.rid, "retired",
+                           output_tokens=len(req.out_tokens))
         if req.stream is not None:
             try:
                 delta = self._incremental_text(req, final=True)
@@ -1957,6 +2016,9 @@ class InferenceEngine:
         req.out_top.append(top or [])
         if not req.out_tokens:
             req.first_token_t = now
+            self.tracer.first_token(req.rid)
+        else:
+            self.tracer.token(req.rid)
         req.out_tokens.append(token_id)
         self.stats.tokens_generated += 1
         eos = token_id in self.config.eos_token_ids
@@ -1981,6 +2043,8 @@ class InferenceEngine:
             self._release_slot_pages(req.slot)
             self._requests.pop(req.rid, None)
             self.stats.requests_completed += 1
+            self.tracer.finish(req.rid, "retired",
+                               output_tokens=len(req.out_tokens))
             req.done.set()
 
     def _incremental_text(self, req: _Request, final: bool = False) -> str:
@@ -2011,6 +2075,8 @@ class InferenceEngine:
                     self._slot_req[req.slot] = None
                     self._release_slot_pages(req.slot)
                 self._requests.pop(rid, None)
+                self.tracer.finish(rid, "error", error=str(err),
+                                   output_tokens=len(req.out_tokens))
                 req.done.set()
 
     def shutdown_save(self, path: str) -> None:

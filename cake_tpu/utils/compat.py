@@ -1,0 +1,52 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current `jax.shard_map` API (top-level export,
+`check_vma=` kwarg). Older toolchains (<= 0.4.x) ship the same
+functionality as `jax.experimental.shard_map.shard_map` with the kwarg
+spelled `check_rep=`. Rather than pinning a minimum jax, install a
+translating alias when the top-level name is missing — every
+`jax.shard_map(...)` call site then works unchanged on both
+generations. Imported for its side effect from `cake_tpu/__init__.py`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _sm
+        except ImportError:  # pragma: no cover — no jax lacks both
+            _sm = None
+        if _sm is not None:
+            def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                          **kw):
+                if "check_vma" in kw:
+                    kw["check_rep"] = kw.pop("check_vma")
+                return _sm(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
+
+            jax.shard_map = shard_map
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if (not hasattr(pltpu, "CompilerParams")
+                and hasattr(pltpu, "TPUCompilerParams")):
+            # renamed upstream; alias so call sites use the new name
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pragma: no cover — pallas-less builds
+        pass
+
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of the literal 1 over a named axis constant-folds to a
+        # concrete Python int during tracing — the long-standing
+        # pre-axis_size idiom, so `range(axis_size(...))` keeps working
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+install()

@@ -32,7 +32,11 @@ def _run_tier(name: str) -> dict:
     return json.loads(line)
 
 
-@pytest.mark.parametrize("tier", ["tiny", "tiny_int8", "tiny_int4"])
+@pytest.mark.parametrize("tier", [
+    "tiny",
+    pytest.param("tiny_int8", marks=pytest.mark.slow),
+    pytest.param("tiny_int4", marks=pytest.mark.slow),
+])
 def test_smoke_tier_json_contract(tier):
     result = _run_tier(tier)
     for key in ("metric", "value", "unit", "vs_baseline"):
@@ -42,6 +46,7 @@ def test_smoke_tier_json_contract(tier):
     assert tier in result["metric"]
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_sd_smoke_tier_reports_step_latency():
     result = _run_tier("sd_tiny")
     assert result["value"] > 0
@@ -58,6 +63,7 @@ def test_engine_smoke_tier_reports_ttft():
     assert result["engine_streams"] == 2
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_engine_spec_smoke_tier_reports_acceptance():
     """Speculation merged into the engine tier: the tier runs the engine
     in per-slot draft/verify mode and reports acceptance. The smoke
@@ -99,6 +105,7 @@ def test_unreachable_backend_fails_fast_with_error_line():
     assert "--- tier" not in proc.stderr  # never reached the tier chain
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_spec_smoke_tier_reports_acceptance():
     result = _run_tier("spec_tiny")
     assert result["value"] > 0

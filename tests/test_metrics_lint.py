@@ -1,0 +1,119 @@
+"""tools/lint_metrics.py as a tier-1 gate: a malformed exposition (or a
+renderer regression) can never ship, because the linter itself is
+validated here and the live registry output is linted in
+tests/test_obs_api.py."""
+
+import importlib.util
+import pathlib
+
+TOOLS = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", TOOLS / "lint_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_clean_exposition_passes():
+    lm = _load()
+    text = "\n".join([
+        "# HELP a_total ok",
+        "# TYPE a_total counter",
+        'a_total{route="/x",status="200"} 3',
+        "# TYPE lat_seconds histogram",
+        'lat_seconds_bucket{le="0.1"} 1',
+        'lat_seconds_bucket{le="1"} 2',
+        'lat_seconds_bucket{le="+Inf"} 4',
+        "lat_seconds_sum 7.5",
+        "lat_seconds_count 4",
+        "# TYPE g gauge",
+        "g 1.5",
+        "",
+    ])
+    assert lm.lint(text) == []
+
+
+def test_sample_without_type_is_flagged():
+    lm = _load()
+    assert any("no preceding # TYPE" in e for e in lm.lint("orphan 1\n"))
+
+
+def test_bad_names_and_labels_flagged():
+    lm = _load()
+    errs = lm.lint("# TYPE ok counter\nok{bad-label=\"x\"} 1\n")
+    assert errs
+    errs = lm.lint("# TYPE 1bad counter\n")
+    assert any("invalid metric name" in e for e in errs)
+
+
+def test_histogram_monotonicity_enforced():
+    lm = _load()
+    text = "\n".join([
+        "# TYPE h histogram",
+        'h_bucket{le="1"} 5',
+        'h_bucket{le="2"} 3',       # decreases
+        'h_bucket{le="+Inf"} 5',
+        "h_sum 1",
+        "h_count 5",
+    ])
+    assert any("decrease" in e for e in lm.lint(text))
+
+
+def test_histogram_count_must_match_inf_bucket():
+    lm = _load()
+    text = "\n".join([
+        "# TYPE h histogram",
+        'h_bucket{le="1"} 1',
+        'h_bucket{le="+Inf"} 2',
+        "h_sum 1",
+        "h_count 9",
+    ])
+    assert any("_count" in e for e in lm.lint(text))
+
+
+def test_histogram_must_end_at_inf():
+    lm = _load()
+    text = "\n".join([
+        "# TYPE h histogram",
+        'h_bucket{le="1"} 1',
+        "h_sum 1",
+        "h_count 1",
+    ])
+    assert any("+Inf" in e for e in lm.lint(text))
+
+
+def test_unterminated_label_value_flagged():
+    lm = _load()
+    errs = lm.lint('# TYPE a counter\na{l="x} 1\n')
+    assert errs
+
+
+def test_negative_counter_flagged():
+    lm = _load()
+    errs = lm.lint("# TYPE a_total counter\na_total -1\n")
+    assert any("negative" in e for e in errs)
+
+
+def test_duplicate_type_flagged():
+    lm = _load()
+    errs = lm.lint("# TYPE a counter\n# TYPE a counter\na 1\n")
+    assert any("duplicate TYPE" in e for e in errs)
+
+
+def test_registry_render_always_lints_clean():
+    """Renderer <-> linter contract, including edge-case label values."""
+    lm = _load()
+    from cake_tpu.obs import metrics as m
+    reg = m.Registry()
+    c = m.Counter("edge_total", "e", labelnames=("v",), registry=reg)
+    c.labels(v='quote" back\\slash\nnewline').inc()
+    h = m.Histogram("edge_seconds", "e", labelnames=("mode",),
+                    buckets=(0.5, 1.5), registry=reg)
+    h.labels(mode="x").observe(0.2)
+    h.labels(mode="y").observe(99)
+    g = m.Gauge("edge_gauge", "e", registry=reg)
+    g.set(-3.25)
+    assert lm.lint(reg.render()) == []

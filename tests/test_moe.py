@@ -267,6 +267,7 @@ def test_engine_serves_moe_matches_generator(params):
     assert len(got) >= min(eos_at + 1, 6)
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_engine_serves_moe_over_topology(tmp_path):
     """MoE + topology through make_engine: the pipelined engine step fns
     run the expert MLP inside each stage."""

@@ -1,0 +1,142 @@
+"""HTTP observability surface: /api/v1/metrics + /api/v1/requests.
+
+The acceptance contract: after a real engine generation the scrape
+exposes populated cake_request_{ttft,e2e,queue_wait}_seconds histograms
+(_bucket/_sum/_count series), the exposition passes the lint tool, and
+GET /api/v1/requests returns complete per-request span records."""
+
+import importlib.util
+import json
+import pathlib
+import re
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.api.server import start
+from cake_tpu.args import Args
+from cake_tpu.master import Master
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+
+TOOLS = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", TOOLS / "lint_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, params, ByteTokenizer(cfg.vocab_size),
+                         max_seq_len=256,
+                         sampling=SamplingConfig(temperature=0.0),
+                         cache_dtype=jnp.float32)
+    master = Master(Args(sample_len=4), text_generator=gen)
+    httpd = start(master, address="127.0.0.1:0", block=False)
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+
+
+def _chat(url, **extra):
+    body = {"messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, **extra}
+    req = urllib.request.Request(
+        url + "/api/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+
+def _scrape(url, path="/api/v1/metrics"):
+    return urllib.request.urlopen(url + path, timeout=10).read().decode()
+
+
+def _series(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
+def test_request_histograms_populate_after_generation(server_url):
+    assert _chat(server_url)["object"] == "chat.completion"
+    text = _scrape(server_url)
+    s = _series(text)
+    for fam in ("cake_request_ttft_seconds", "cake_request_e2e_seconds",
+                "cake_request_queue_wait_seconds"):
+        assert s[f"{fam}_count"] >= 1, fam
+        assert s[f"{fam}_sum"] > 0, fam
+        assert s[f'{fam}_bucket{{le="+Inf"}}'] == s[f"{fam}_count"]
+        # at least one finite bucket line exists for the family
+        assert any(k.startswith(f"{fam}_bucket{{le=") for k in s), fam
+        assert f"# TYPE {fam} histogram" in text
+    # engine aggregate counters still present under their old names
+    assert s["cake_engine_tokens_generated_total"] >= 3
+    assert "# TYPE cake_engine_decode_slots gauge" in text
+
+
+def test_metrics_served_on_both_paths_and_lints(server_url):
+    _chat(server_url)
+    lint = _load_lint()
+    for path in ("/metrics", "/api/v1/metrics"):
+        text = _scrape(server_url, path)
+        errs = lint.lint(text)
+        assert errs == [], errs
+
+
+def test_http_route_status_counters(server_url):
+    _chat(server_url)
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(server_url + "/nope", timeout=10)
+    s = _series(_scrape(server_url))
+    chat = 'cake_http_requests_total{route="/api/v1/chat/completions"' \
+        ',status="200"}'
+    assert s[chat] >= 1
+    assert s['cake_http_requests_total{route="other",status="404"}'] >= 1
+
+
+def test_requests_endpoint_full_lifecycle(server_url):
+    _chat(server_url)
+    obj = json.loads(urllib.request.urlopen(
+        server_url + "/api/v1/requests", timeout=10).read())
+    recs = [r for r in obj["requests"] if r["status"] == "retired"]
+    assert recs, obj
+    rec = recs[0]
+    names = [sp["name"] for sp in rec["spans"]]
+    assert names == ["admitted", "queued", "prefill", "first_token",
+                     "decode", "retired"]
+    offs = [sp["offset_s"] for sp in rec["spans"]]
+    assert offs == sorted(offs)
+    assert rec["output_tokens"] >= 1
+    assert rec["ttft_s"] > 0
+    assert rec["e2e_s"] >= rec["ttft_s"]
+    assert rec["queue_wait_s"] is not None and rec["queue_wait_s"] >= 0
+    # ?limit= caps the dump
+    capped = json.loads(urllib.request.urlopen(
+        server_url + "/api/v1/requests?limit=1", timeout=10).read())
+    assert len(capped["requests"]) == 1
+
+
+def test_exposition_names_are_prometheus_clean(server_url):
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for line in _scrape(server_url).splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name = re.split(r"[{ ]", line, 1)[0]
+        assert name_re.match(name), line

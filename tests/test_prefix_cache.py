@@ -203,6 +203,7 @@ def _topology_engine(tmp_path, decode_scan=1, prefill_chunk=None):
     return master.make_engine(max_slots=2)
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_pipelined_prefix_hit_matches_cold(tmp_path):
     """Prefix caching over the pipelined (topology+tp) engine: the
     stage-sharded prefix KV installs + the suffix windows at pos0=P,
@@ -221,6 +222,7 @@ def test_pipelined_prefix_hit_matches_cold(tmp_path):
     assert warm.stats.prefix_hits == len(prompts)
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_pipelined_prefix_with_chunked_suffix(tmp_path):
     """--prefill-chunk + prefix over the pipeline: long suffixes window
     through the pipelined chunk fn behind the installed prefix."""

@@ -474,6 +474,7 @@ def test_ring_cache_over_topology_matches_dense(cfg_w, tiny_params,
     assert got == want[:len(got)] and len(got) >= 1
 
 
+@pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
 def test_ring_over_topology_decode_scan(cfg_w, tmp_path):
     """K-step scanned decode over the ring pipelined path == K=1."""
     from cake_tpu.args import Args

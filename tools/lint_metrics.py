@@ -1,0 +1,244 @@
+#!/usr/bin/env python3
+"""Validate a Prometheus text exposition (the /api/v1/metrics contract).
+
+Checks, per tools-as-tests policy (tests/test_metrics_lint.py runs this
+against the live registry output in tier-1, so a malformed metric can
+never ship):
+
+  * every sample line parses as ``name{labels} value``;
+  * metric and label names match the Prometheus charsets;
+  * every sample's family has a preceding ``# TYPE`` line, and at most
+    one TYPE per family;
+  * label values are properly quoted/escaped;
+  * histogram families expose ``_bucket`` series with monotonically
+    non-decreasing cumulative counts in increasing ``le`` order, ending
+    at ``le="+Inf"``, plus ``_sum`` and ``_count`` with
+    ``_count == +Inf bucket``;
+  * counter samples are finite and non-negative.
+
+Usage:
+    python tools/lint_metrics.py FILE          # or '-' for stdin
+    python tools/lint_metrics.py --url http://HOST:PORT/api/v1/metrics
+
+Exit status 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+from typing import Dict, List, Tuple
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>\S+)$")
+LABEL_PAIR_RE = re.compile(
+    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _split_labels(raw: str) -> List[Tuple[str, str]]:
+    """Split a label body on unescaped commas; raises ValueError."""
+    parts: List[str] = []
+    i, cur, in_str = 0, "", False
+    while i < len(raw):
+        ch = raw[i]
+        if ch == "\\" and in_str:
+            cur += raw[i:i + 2]
+            i += 2
+            continue
+        if ch == '"':
+            in_str = not in_str
+        if ch == "," and not in_str:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+        i += 1
+    if in_str:
+        raise ValueError("unterminated label value")
+    if cur:
+        parts.append(cur)
+    pairs: List[Tuple[str, str]] = []
+    for part in parts:
+        m = LABEL_PAIR_RE.match(part)
+        if m is None:
+            raise ValueError(f"bad label pair {part!r}")
+        pairs.append((m.group("k"), m.group("v")))
+    return pairs
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    if s == "NaN":
+        return math.nan
+    return float(s)
+
+
+def _family_of(name: str) -> str:
+    for suf in HIST_SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def lint(text: str) -> List[str]:
+    """Return a list of human-readable violations (empty = clean)."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, int] = {}
+    # family -> labelkey (labels minus le) -> [(le, cum_count)]
+    buckets: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
+    sums: Dict[str, Dict[Tuple, float]] = {}
+    counts: Dict[str, Dict[Tuple, float]] = {}
+    seen_families: List[str] = []
+
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                errors.append(f"line {ln}: malformed TYPE line")
+                continue
+            _, _, name, typ = parts
+            if not NAME_RE.match(name):
+                errors.append(f"line {ln}: invalid metric name {name!r}")
+            if typ not in VALID_TYPES:
+                errors.append(f"line {ln}: invalid type {typ!r}")
+            if name in types:
+                errors.append(
+                    f"line {ln}: duplicate TYPE for {name!r}")
+            types[name] = typ
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                errors.append(f"line {ln}: malformed HELP line")
+                continue
+            name = parts[2]
+            if name in helps:
+                errors.append(f"line {ln}: duplicate HELP for {name!r}")
+            helps[name] = ln
+            if name in types:
+                errors.append(
+                    f"line {ln}: HELP for {name!r} after its TYPE "
+                    "(HELP must come first)")
+            continue
+        if line.startswith("#"):
+            continue  # comments are legal
+
+        m = SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {ln}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        fam = _family_of(name)
+        try:
+            pairs = _split_labels(m.group("labels") or "")
+        except ValueError as e:
+            errors.append(f"line {ln}: {e}")
+            continue
+        for k, _v in pairs:
+            if not LABEL_RE.match(k) or k.startswith("__"):
+                errors.append(f"line {ln}: invalid label name {k!r}")
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError:
+            errors.append(
+                f"line {ln}: unparseable value {m.group('value')!r}")
+            continue
+
+        typ = types.get(fam) or types.get(name)
+        if typ is None:
+            errors.append(
+                f"line {ln}: sample {name!r} has no preceding # TYPE")
+            continue
+        if fam not in seen_families:
+            seen_families.append(fam)
+
+        if typ == "counter":
+            if not (value >= 0):
+                errors.append(
+                    f"line {ln}: counter {name!r} is negative/NaN")
+        if typ == "histogram":
+            key = tuple(sorted((k, v) for k, v in pairs if k != "le"))
+            if name.endswith("_bucket"):
+                le = dict(pairs).get("le")
+                if le is None:
+                    errors.append(
+                        f"line {ln}: bucket sample without le label")
+                    continue
+                buckets.setdefault(fam, {}).setdefault(key, []).append(
+                    (_parse_value(le), value))
+            elif name.endswith("_sum"):
+                sums.setdefault(fam, {})[key] = value
+            elif name.endswith("_count"):
+                counts.setdefault(fam, {})[key] = value
+            else:
+                errors.append(
+                    f"line {ln}: histogram sample {name!r} is not "
+                    "_bucket/_sum/_count")
+
+    for fam, typ in types.items():
+        if typ != "histogram":
+            continue
+        for key, series in buckets.get(fam, {}).items():
+            lbl = dict(key)
+            les = [le for le, _ in series]
+            if les != sorted(les):
+                errors.append(
+                    f"{fam}{lbl}: bucket le values not increasing")
+            if not les or les[-1] != math.inf:
+                errors.append(
+                    f"{fam}{lbl}: bucket series does not end at +Inf")
+            cums = [c for _, c in series]
+            if any(b < a for a, b in zip(cums, cums[1:])):
+                errors.append(
+                    f"{fam}{lbl}: cumulative bucket counts decrease")
+            if key not in sums.get(fam, {}):
+                errors.append(f"{fam}{lbl}: missing _sum")
+            cnt = counts.get(fam, {}).get(key)
+            if cnt is None:
+                errors.append(f"{fam}{lbl}: missing _count")
+            elif cums and cnt != cums[-1]:
+                errors.append(
+                    f"{fam}{lbl}: _count {cnt} != +Inf bucket "
+                    f"{cums[-1]}")
+        if fam not in buckets and (fam in sums or fam in counts):
+            # a family with zero samples is legal (no children yet);
+            # _sum/_count without buckets is not
+            errors.append(f"{fam}: histogram with no _bucket samples")
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 1
+    if argv[0] == "--url":
+        import urllib.request
+        text = urllib.request.urlopen(argv[1], timeout=10).read().decode()
+    elif argv[0] == "-":
+        text = sys.stdin.read()
+    else:
+        with open(argv[0]) as f:
+            text = f.read()
+    errors = lint(text)
+    for e in errors:
+        print(e)
+    if not errors:
+        print("ok: exposition is well-formed")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
