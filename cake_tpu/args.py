@@ -117,6 +117,12 @@ class Args:
     # max_slots x max_seq_len (models/llama/paged.py)
     kv_pages: Optional[int] = None
     kv_page_size: int = 128
+    # --paged-attn: attention impl for the paged (--kv-pages) engine —
+    # "pallas" = the ragged paged-attention TPU kernel
+    # (ops/ragged_paged_attention.py), "fold" = the XLA online-softmax
+    # fold over all pages (the reference semantics; use for debugging
+    # or non-TPU backends); "auto" = pallas on TPU, fold elsewhere
+    paged_attn: str = "auto"
     # --trace-events PATH: append every request-lifecycle span as one
     # JSON line (obs/tracing.py) — the replayable audit log behind the
     # in-memory ring served at GET /api/v1/requests
@@ -130,6 +136,10 @@ class Args:
             raise ValueError(f"unsupported dtype '{self.dtype}'")
         if self.quant not in ("none", "int8", "int4"):
             raise ValueError(f"unsupported quant '{self.quant}'")
+        if self.paged_attn not in ("auto", "fold", "pallas"):
+            raise ValueError(
+                f"unsupported paged_attn '{self.paged_attn}' "
+                "(choose auto, fold or pallas)")
         if self.kv_dtype is not None:
             # single source of truth for storage dtypes
             from cake_tpu.utils.devices import resolve_kv_dtype

@@ -185,6 +185,7 @@ class Master:
             prefill_chunk=getattr(self.args, "prefill_chunk", None),
             kv_pages=getattr(self.args, "kv_pages", None),
             kv_page_size=getattr(self.args, "kv_page_size", 128),
+            paged_attn=getattr(self.args, "paged_attn", "auto"),
             **self._trace_kwargs(),
             **kwargs,
         )

@@ -183,10 +183,17 @@ def update_pool_per_row(pool_k, pool_v, k, v, pos, active, table):
     return pk, pv
 
 
-def paged_attention(q, pool_k, pool_v, table, pos):
-    """Ragged decode attention over paged KV: online-softmax accumulation
-    over each row's pages — every page is read ONCE and folded into
-    running (m, l, o) stats; no dense per-slot copy ever exists.
+def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
+    """Ragged decode attention over paged KV.
+
+    impl="fold" (the documented REFERENCE semantics): an XLA fori_loop
+    over all max_pages — online-softmax accumulation where every page is
+    read once and folded into running (m, l, o) stats; no dense per-slot
+    copy ever exists. impl="pallas": the TPU-native single kernel
+    (ops/ragged_paged_attention.py) — same math, but each row streams
+    only its LIVE pages through VMEM and exits at ceil((pos+1)/page)
+    instead of folding the whole pool; falls back to the fold on
+    hardware-untileable shapes (tiny test configs).
 
     q: [B, 1, H, hd] (rope already applied; the current token's KV must
     already be written to its page); pool_k/v: [N_pages, page, KV, hd];
@@ -198,6 +205,15 @@ def paged_attention(q, pool_k, pool_v, table, pos):
     max_pages = table.shape[1]
     KV = pool_k.shape[2]
 
+    if impl == "pallas":
+        from cake_tpu.ops.ragged_paged_attention import (
+            ragged_paged_attention, ragged_paged_supported,
+        )
+        if ragged_paged_supported(P, H, KV, hd):
+            return ragged_paged_attention(q, pool_k, pool_v, table, pos)
+    elif impl != "fold":
+        raise ValueError(f"unknown paged_attn impl {impl!r}")
+
     m0 = jnp.full((B, KV, H // KV, 1, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((B, KV, H // KV, 1, 1), jnp.float32)
     o0 = jnp.zeros((B, KV, H // KV, 1, hd), jnp.float32)
@@ -205,8 +221,17 @@ def paged_attention(q, pool_k, pool_v, table, pos):
     def fold(j, carry):
         m, l, o = carry
         pages = table[:, j]                          # [B]
-        kj = jnp.take(pool_k, jnp.maximum(pages, 0), axis=0)  # [B,P,KV,hd]
-        vj = jnp.take(pool_v, jnp.maximum(pages, 0), axis=0)
+        # unmapped slots route to the out-of-bounds index N with a zero
+        # fill instead of gathering page 0 (which aliases another
+        # slot's live data into the masked lanes). Whether the OOB row
+        # read is actually elided is up to the XLA gather lowering —
+        # the guarantee that dead pages cost NO bandwidth lives in the
+        # pallas kernel's index-map clamp, not here; the fold's masking
+        # (below) keeps the fill value out of the output either way.
+        idx = jnp.where(pages >= 0, pages, pool_k.shape[0])
+        kj = jnp.take(pool_k, idx, axis=0, mode="fill",
+                      fill_value=0)                  # [B,P,KV,hd]
+        vj = jnp.take(pool_v, idx, axis=0, mode="fill", fill_value=0)
         # validity: absolute slots j*P + t attend when <= pos (causal,
         # current token included) AND the page is mapped
         slots_abs = j * P + jnp.arange(P)            # [P]
@@ -229,9 +254,11 @@ def paged_attention(q, pool_k, pool_v, table, pos):
 
 
 def run_blocks_ragged_paged(blocks, x, cache: PagedKVCache, pos, active,
-                            rope_c, rope_s, config: LlamaConfig):
+                            rope_c, rope_s, config: LlamaConfig,
+                            attn: str = "fold"):
     """run_blocks_ragged over the page pool: write the token, attend the
-    pages. x: [B, 1, D]; pos/active: [B]."""
+    pages. x: [B, 1, D]; pos/active: [B]; attn: paged_attention impl
+    ({fold,pallas} — static under jit)."""
     from cake_tpu.models.llama.model import block_skeleton
     from cake_tpu.ops.rope import apply_rope
 
@@ -243,8 +270,8 @@ def run_blocks_ragged_paged(blocks, x, cache: PagedKVCache, pos, active,
             k = apply_rope(k, rope_c, rope_s)
             pk2, pv2 = update_pool_per_row(pk, pv, k, v, pos, active,
                                            cache.table)
-            return paged_attention(q, pk2, pv2, cache.table, pos), (pk2,
-                                                                    pv2)
+            return (paged_attention(q, pk2, pv2, cache.table, pos,
+                                    impl=attn), (pk2, pv2))
 
         h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)
         return h, (pk2, pv2)
@@ -254,7 +281,8 @@ def run_blocks_ragged_paged(blocks, x, cache: PagedKVCache, pos, active,
 
 
 def forward_ragged_paged(params, tokens, cache: PagedKVCache, pos,
-                         active, rope, config: LlamaConfig):
+                         active, rope, config: LlamaConfig,
+                         attn: str = "fold"):
     """model.forward_ragged's signature over a paged cache — un-jitted,
     so serve.engine.make_decode_scan can build the K-step paged decode
     scan from it (dispatch amortization works for paged serving exactly
@@ -266,25 +294,31 @@ def forward_ragged_paged(params, tokens, cache: PagedKVCache, pos,
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows_per_row(rope.cos, rope.sin, pos)
     x, cache = run_blocks_ragged_paged(params["blocks"], x, cache, pos,
-                                       active, rope_c, rope_s, config)
+                                       active, rope_c, rope_s, config,
+                                       attn=attn)
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     logits = qmatmul(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 
-@_partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+@_partial(jax.jit, static_argnames=("config", "attn"),
+          donate_argnames=("cache",))
 def decode_step_ragged_paged(params, tokens, pos, active,
                              cache: PagedKVCache, rope,
-                             config: LlamaConfig):
+                             config: LlamaConfig, attn: str = "fold"):
     """decode_step_ragged signature over a paged cache — the engine's
-    drop-in decode step fn for --kv-pages serving."""
+    drop-in decode step fn for --kv-pages serving. attn selects the
+    paged_attention impl ({fold,pallas}); static, so both variants are
+    separately compiled programs with the same traced signature."""
     return forward_ragged_paged(params, tokens, cache, pos, active,
-                                rope, config)
+                                rope, config, attn=attn)
 
 
-@_partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+@_partial(jax.jit, static_argnames=("config", "attn"),
+          donate_argnames=("cache",))
 def prefill_slot_paged(params, tokens, prompt_len, slot,
-                       cache: PagedKVCache, rope, config: LlamaConfig):
+                       cache: PagedKVCache, rope, config: LlamaConfig,
+                       attn: str = "fold"):
     """prefill_slot signature over a paged cache: ordinary causal
     prefill math on the fresh window (the window starts at position 0
     and covers the whole prompt, so no cache reads are needed), with
@@ -292,18 +326,31 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
     land in their mapped page as garbage and are overwritten by decode
     before they can be attended — the dense path's exact semantics.
     Windows beyond the slot's mapped pages (bucket padding past the
-    allocation) are dropped by the -1 guard in write_prompt_pages."""
+    allocation) are dropped by the -1 guard in write_prompt_pages.
+
+    attn="pallas" routes the fresh-window attention through the Pallas
+    flash kernel (the prompt window starts at position 0, so causal
+    flash over the in-window k/v is exact — no page reads are needed at
+    prefill); untileable shapes fall back to the einsum path like the
+    dense prefill."""
     from cake_tpu.models.llama.model import block_skeleton
     from cake_tpu.ops.attention import causal_mask, gqa_attention
+    from cake_tpu.ops.flash_attention import (
+        flash_attention, flash_supported,
+    )
     from cake_tpu.ops.norms import rms_norm
     from cake_tpu.ops.quant import qmatmul
     from cake_tpu.ops.rope import apply_rope, rope_rows
 
     B, S = tokens.shape
+    H = config.num_attention_heads
+    KV = config.num_key_value_heads
     x = jnp.take(params["embed"], tokens, axis=0)
     rope_c, rope_s = rope_rows(rope.cos, rope.sin, jnp.int32(0), S)
     table_row = jnp.take(cache.table, slot, axis=0)
-    mask = causal_mask(S)
+    use_flash = (attn == "pallas"
+                 and flash_supported(S, S, H, KV, hd=config.head_dim))
+    mask = None if use_flash else causal_mask(S)
 
     def body(h, xs):
         lp, pk, pv = xs
@@ -312,6 +359,8 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
             pk2, pv2 = write_prompt_pages(pk, pv, k, v, table_row)
+            if use_flash:
+                return flash_attention(q, k, v, causal=True), (pk2, pv2)
             return gqa_attention(q, k, v, mask=mask), (pk2, pv2)
 
         h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)

@@ -1,0 +1,211 @@
+"""Pallas TPU ragged paged attention for the paged serving path.
+
+The XLA reference (`models/llama/paged.py:paged_attention`, kept as the
+fold implementation) is a `lax.fori_loop` over ALL `max_pages` table
+columns: every decode step, every layer, every row folds the whole page
+axis, so a 3-page request pays the same gather traffic as a 32-page one
+and page reads never stay resident in VMEM. This kernel is the
+TPU-native formulation of the same online-softmax fold (the "Ragged
+Paged Attention" shape, PAPERS.md arxiv 2604.15464):
+
+  * grid (rows, pages) with the page axis innermost and sequential —
+    each grid step streams ONE page of the pool through VMEM and folds
+    it into f32 (m, l, acc) scratch carried across the page axis, the
+    flash-attention recurrence of `ops/flash_attention.py`;
+  * the page table and per-row positions ride as scalar-prefetched SMEM
+    operands, so the k/v BlockSpec index maps resolve `table[row, j]`
+    BEFORE the DMA is issued — the pool is indexed directly by physical
+    page id, no host-side gather and no dense per-row copy;
+  * per-row early exit: pages past the row's live count
+    `ceil((pos+1)/page)` clamp their index map to the last live page, so
+    Pallas elides the repeated DMA, and `pl.when` skips the compute —
+    a short row costs its own pages, not `max_pages`;
+  * causal + unmapped-page masking inside a live page (absolute slot
+    `j*page + t` attends iff `<= pos` and the page id is mapped);
+  * GQA without repeat_kv: the KV-head axis is unrolled statically
+    inside the kernel (KV is 2-8 in practice), so query group g of kv
+    head k reads exactly its own `hd`-wide lane slice of the page block
+    — each live page is streamed through VMEM ONCE for all H heads.
+
+Layout contract: the pool keeps `models/llama/paged.py`'s
+[N_pages, page, KV, hd] layout; the wrapper flattens the two minor axes
+to [N_pages, page, KV*hd] (free reshape of a contiguous array) so block
+tiles are (page, KV*hd) — lane-aligned when hd is a multiple of 128.
+
+CPU tests run the same kernel with interpret=True
+(tests/test_ragged_paged_attn.py), mirroring flash_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _rpa_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
+                acc_ref, m_ref, l_ref, *, scale: float, page_size: int,
+                kv_heads: int, group: int, head_dim: int):
+    """One (row, page) grid step of the ragged fold.
+
+    q_ref:   [1, 1, H, hd] — the row's single decode query, all heads
+    k_ref/v_ref: [1, page, KV*hd] — one physical page (flattened minor)
+    scratch: acc [H, hd] f32, m/l [H, 128] f32, carried across the page
+    axis (innermost, sequential) exactly like flash_attention's k axis.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    page = table_ref[b, j]
+    # page j is live iff it covers a position <= pos AND is mapped; dead
+    # pages cost neither compute (gated here) nor bandwidth (their index
+    # map repeats the last live page, so the DMA is elided)
+    live = jnp.logical_and(j * page_size <= pos, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0, 0]                        # [H, hd]
+        P = page_size
+        hd = head_dim
+        # causal mask over the page's absolute slots (current token
+        # included); every gated-in page has >= 1 valid column, so the
+        # online max below never sees a fully-masked row
+        col_valid = (j * P + jax.lax.broadcasted_iota(
+            jnp.int32, (1, P), 1)) <= pos      # [1, P]
+        # scores per kv head: query group g of kv head k against the
+        # page's k-lane slice (static unroll — KV is small)
+        parts = []
+        for kv in range(kv_heads):
+            kh = k_ref[0, :, kv * hd:(kv + 1) * hd]    # [P, hd]
+            qh = q[kv * group:(kv + 1) * group]        # [G, hd]
+            parts.append(jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = jnp.concatenate(parts, axis=0) * scale     # [H, P]
+        s = jnp.where(col_valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                  # [H, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                 # [H, P]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        outs = []
+        for kv in range(kv_heads):
+            vh = v_ref[0, :, kv * hd:(kv + 1) * hd]    # [P, hd]
+            ph = p[kv * group:(kv + 1) * group]        # [G, P]
+            outs.append(jax.lax.dot_general(
+                ph.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(outs, axis=0)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        # a row whose every page was dead (inactive slot / all-unmapped
+        # table) has l == 0: emit zeros, matching the fold reference's
+        # merge_attention_stats guard
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
+                           scale: float | None = None,
+                           interpret: bool | None = None):
+    """Ragged decode attention over a paged KV pool, one Pallas kernel.
+
+    q:            [B, 1, H, hd] — rope applied; the current token's KV
+                  must already be written to its page (the
+                  update_pool_per_row contract).
+    pool_k/pool_v:[N_pages, page, KV, hd]
+    table:        [B, max_pages] int32 page ids, -1 = unmapped
+    pos:          [B] int32 — position of the CURRENT token per row
+    Returns [B, 1, H, hd] in q.dtype. Numerically matches
+    `models/llama/paged.py:paged_attention` (the fold reference) to f32
+    tolerance — tests/test_ragged_paged_attn.py pins the parity.
+    """
+    B, S, H, hd = q.shape
+    if S != 1:
+        raise ValueError(f"decode kernel takes one query per row, got S={S}")
+    N, P, KV, _ = pool_k.shape
+    G = H // KV
+    max_pages = table.shape[1]
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kf = pool_k.reshape(N, P, KV * hd)
+    vf = pool_v.reshape(N, P, KV * hd)
+
+    def kv_index(b, j, pos_ref, table_ref):
+        # clamp dead pages (past the row's live count) to the LAST live
+        # page: the repeated block index elides the DMA, so a short row
+        # streams only its own pages. Unmapped holes inside the live
+        # range clamp to page 0 — one page of wasted bandwidth, masked
+        # out in compute.
+        jj = jnp.minimum(j, pos_ref[b] // P)
+        page = table_ref[b, jj]
+        return (jnp.maximum(page, 0), 0, 0)
+
+    kernel = functools.partial(
+        _rpa_kernel, scale=scale, page_size=P, kv_heads=KV, group=G,
+        head_dim=hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, P, KV * hd), kv_index),
+            pl.BlockSpec((1, P, KV * hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, hd),
+                               lambda b, j, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, hd), q.dtype),
+        # only the page axis carries scratch state; rows schedule freely
+        # across megacore
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32), jnp.asarray(table, jnp.int32),
+      q, kf, vf)
+
+
+def ragged_paged_supported(page_size: int, H: int, KV: int,
+                           hd: int) -> bool:
+    """Static shape gate for the hardware path (flash_supported
+    precedent): Mosaic wants the block's minor dim to fill 128-wide
+    lanes and the second-minor (page) dim to tile by 16. Production
+    configs (hd=128, 128-token pages) pass; tiny test configs fall back
+    to the fold on silicon and keep exercising the kernel in interpret
+    mode on CPU."""
+    if H % KV != 0:
+        return False
+    if jax.default_backend() != "tpu":
+        return True      # interpret mode imposes no tiling constraints
+    return hd % 128 == 0 and page_size % 16 == 0

@@ -60,6 +60,15 @@ _RESET_FAILURES = obs_metrics.counter(
     "cake_engine_reset_failures_total",
     "Post-error engine resets that themselves failed (engine stopped)")
 
+# paged-engine device-step wall latency (dispatch+fetch, sampling
+# included), split by path — the observable the fold->pallas kernel
+# switch moves; scan/burst decodes observe their per-step average so
+# fold and pallas histograms compare like for like at any decode_scan
+_PAGED_ATTN_STEP = obs_metrics.histogram(
+    "cake_paged_attn_step_seconds",
+    "Paged-engine step wall latency by path (prefill|decode)",
+    labelnames=("path",))
+
 
 @dataclass
 class _Request:
@@ -205,6 +214,7 @@ class InferenceEngine:
         spec_gamma: int = 4,
         kv_pages: Optional[int] = None,
         kv_page_size: int = 128,
+        paged_attn: Optional[str] = None,
         prompt_limit: Optional[int] = None,
         decode_budget: Optional[int] = None,
         trace_events: Optional[str] = None,
@@ -356,6 +366,7 @@ class InferenceEngine:
         # so resident KV is bounded by the pool, not slots x max_seq_len
         # (models/llama/paged.py).
         self.paged = kv_pages is not None
+        self.paged_attn: Optional[str] = None
         if self.paged:
             if kv_pages < 1 or kv_page_size < 1:
                 raise ValueError(
@@ -378,18 +389,36 @@ class InferenceEngine:
                 PageAllocator, PagedKVCache, decode_step_ragged_paged,
                 prefill_slot_paged,
             )
-            self._prefill_slot = prefill_slot_paged
-            self._decode_step = decode_step_ragged_paged
-            self._decode_scan_impl = _decode_scan_paged
+            # paged_attn: {fold,pallas} attention impl for the paged
+            # step fns; None/"auto" = pallas on a real TPU, fold
+            # elsewhere (interpret-mode pallas on CPU is slow). The
+            # choice rides the jitted steps as a STATIC arg, so both
+            # variants keep the same traced signature and the engine's
+            # dispatch plumbing is impl-blind.
+            impl = paged_attn or "auto"
+            if impl == "auto":
+                impl = ("pallas" if jax.default_backend() == "tpu"
+                        else "fold")
+            if impl not in ("fold", "pallas"):
+                raise ValueError(
+                    f"--paged-attn must be fold or pallas, got {impl!r}")
+            self.paged_attn = impl
+            self._prefill_slot = partial(prefill_slot_paged, attn=impl)
+            self._decode_step = partial(decode_step_ragged_paged,
+                                        attn=impl)
+            self._decode_scan_impl = (_decode_scan_paged
+                                      if impl == "fold"
+                                      else _decode_scan_paged_pallas)
             self._prefill_chunk_step = None
             self._pager = PageAllocator(kv_pages, kv_page_size)
             self._slot_pages: dict = {}
             self.cache = PagedKVCache.create(
                 config, max_slots, kv_pages, kv_page_size, max_seq_len,
                 dtype=cache_dtype)
-            log.info("paged KV: %d pages x %d tokens (%.2f GiB pool; "
-                     "dense %d-slot equivalent would be %.2f GiB)",
-                     kv_pages, kv_page_size,
+            log.info("paged KV: %d pages x %d tokens, %s attention "
+                     "(%.2f GiB pool; dense %d-slot equivalent would "
+                     "be %.2f GiB)",
+                     kv_pages, kv_page_size, impl,
                      self.cache.memory_bytes() / 2**30, max_slots,
                      self.cache.memory_bytes() / 2**30
                      * max_slots * max_seq_len / (kv_pages * kv_page_size))
@@ -1221,6 +1250,14 @@ class InferenceEngine:
             v=jax.device_put(fresh.v, self._cache_shardings.v),
         )
 
+    def _obs_paged_step(self, path: str, seconds: float) -> None:
+        """Observe one paged-engine step's wall latency (scan/burst
+        callers pass their per-step average). No-op for dense engines —
+        the histogram exists to compare the fold vs pallas paged
+        attention impls."""
+        if self.paged:
+            _PAGED_ATTN_STEP.labels(path=path).observe(seconds)
+
     def _release_slot_pages(self, slot: int) -> None:
         if not self.paged or slot < 0:
             return
@@ -1332,7 +1369,9 @@ class InferenceEngine:
         if defer:
             return (req, t0, slot, out)
         tok, lp, top = out
-        self.stats.prefill_time_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.prefill_time_s += dt
+        self._obs_paged_step("prefill", dt)
         self._emit(req, tok, logprob=lp, top=top)
         return None
 
@@ -1358,7 +1397,9 @@ class InferenceEngine:
             # (dispatched back to back, fetched together), so summing
             # per-request spans would count the same wall time up to
             # PREFILL_FLUSH times
-            self.stats.prefill_time_s += time.perf_counter() - pend[0][1]
+            dt = time.perf_counter() - pend[0][1]
+            self.stats.prefill_time_s += dt
+            self._obs_paged_step("prefill", dt / len(pend))
             for (req, t0, slot, _), host in zip(pend, hosts):
                 tok, lp, top = self._finish_prefill_complete(slot, host)
                 self._emit(req, tok, logprob=lp, top=top)
@@ -1699,7 +1740,9 @@ class InferenceEngine:
         self._publish({"op": "decode", "rows": rows, "n_top": n_top})
         nxt, lp, tids, tlps = self._decode_device(rows, n_top=n_top)
         self.stats.steps += 1
-        self.stats.decode_time_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.decode_time_s += dt
+        self._obs_paged_step("decode", dt)
         self._step_stats.step(bytes_out=len(decode_plan))
         for rid, slot in decode_plan:
             req = self._slot_req[slot]
@@ -1793,7 +1836,9 @@ class InferenceEngine:
         outs, _state = self._dispatch_scan_device(rows, n, n_top, budget)
         fetched = self._fetch_scan(outs)
         self.stats.steps += n
-        self.stats.decode_time_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.decode_time_s += dt
+        self._obs_paged_step("decode", dt / n)
         self._complete_scan(decode_plan, n, fetched, budget)
 
     def _decode_burst(self, decode_plan, n: int) -> None:
@@ -1847,8 +1892,12 @@ class InferenceEngine:
                 shipped[slot] = (shipped.get(slot, 0)
                                  - int(budget_k[slot]))
 
+        steps0 = self.stats.steps
         self._drive_burst(dispatch, complete, can_chain)
-        self.stats.decode_time_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.decode_time_s += dt
+        self._obs_paged_step("decode",
+                             dt / max(1, self.stats.steps - steps0))
 
     def _complete_scan(self, decode_plan, n: int, fetched,
                        budget) -> None:
@@ -2277,3 +2326,13 @@ def _paged_forward_ragged(params, tokens, cache, pos, active, rope,
 # module-level like its dense/ring siblings so the jit cache is shared
 # across engine instances (restart flows, test suites)
 _decode_scan_paged = make_decode_scan(_paged_forward_ragged)
+
+
+def _paged_forward_ragged_pallas(params, tokens, cache, pos, active,
+                                 rope, config):
+    from cake_tpu.models.llama.paged import forward_ragged_paged
+    return forward_ragged_paged(params, tokens, cache, pos, active,
+                                rope, config, attn="pallas")
+
+
+_decode_scan_paged_pallas = make_decode_scan(_paged_forward_ragged_pallas)

@@ -86,23 +86,53 @@ def test_probe_reports_device():
     assert json.loads(line)["platform"] == "cpu"
 
 
-def test_unreachable_backend_fails_fast_with_error_line():
+@pytest.mark.slow  # runs the real cpu-fallback tier -> slow lane
+def test_unreachable_backend_falls_back_to_cpu():
     # A bogus platform makes device init raise immediately in the probe
-    # child; the orchestrator must emit ONE diagnosable JSON line and
-    # exit nonzero without entering the tier chain (the round-3 rc=124
-    # failure mode was hours of per-tier timeouts against a hung tunnel).
+    # child; the orchestrator must NOT exit non-zero (the BENCH_r05
+    # failure mode: every probe dead -> rc=1, empty perf trajectory).
+    # Instead it re-probes with JAX_PLATFORMS=cpu, runs the tiny tier
+    # there, and emits one valid JSON line tagged backend=cpu_fallback.
     env = _base_env(JAX_PLATFORMS="no_such_platform",
                     CAKE_BENCH_PROBE_TIMEOUT="60")
     proc = subprocess.run(
         [sys.executable, BENCH], env=env,
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=600,
     )
-    assert proc.returncode == 1, proc.stderr[-2000:]
+    assert proc.returncode == 0, proc.stderr[-2000:]
     line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
     result = json.loads(line)
-    assert result["value"] == 0.0
-    assert "backend unreachable" in result["error"]
-    assert "--- tier" not in proc.stderr  # never reached the tier chain
+    assert result["backend"] == "cpu_fallback"
+    assert result["value"] > 0          # a real cpu measurement, not 0.0
+    assert result["unit"] == "tokens/s"
+
+
+@pytest.mark.slow  # bench subprocess + engine compile -> slow lane
+@pytest.mark.parametrize("impl", ["fold", "pallas"])
+def test_paged_attn_microbench_cli(impl):
+    # `bench.py --paged-attn fold|pallas`: the paged-decode microbench
+    # reports tokens/s for the chosen kernel path (cpu -> tiny tier).
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--paged-attn", impl], env=_base_env(),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
+    result = json.loads(line)
+    assert result["paged_attn"] == impl
+    assert result["value"] > 0
+    assert result["unit"] == "tokens/s"
+    assert result["kv_pages"] > 0
+
+
+def test_paged_attn_microbench_rejects_bad_impl():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--paged-attn", "nope"], env=_base_env(),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    line = next(ln for ln in proc.stdout.splitlines() if ln.startswith("{"))
+    assert "fold or pallas" in json.loads(line)["error"]
 
 
 @pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)

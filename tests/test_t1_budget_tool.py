@@ -1,0 +1,92 @@
+"""tools/check_t1_budget.py as a tier-1 gate (lint_metrics precedent):
+the budget linter itself is validated on fixture logs, so the fast lane
+can never silently drift past its 870s kill again."""
+
+import importlib.util
+import pathlib
+
+TOOLS = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_t1_budget", TOOLS / "check_t1_budget.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+GOOD_LOG = "\n".join([
+    "............                                             [100%]",
+    "============================= slowest 5 durations ==============",
+    "10.21s call     tests/test_engine.py::test_streams",
+    "3.50s setup    tests/test_paged.py::test_pool",
+    "0.80s call     tests/test_obs.py::test_render",
+    "===== 338 passed, 2 skipped in 729.36s (0:12:09) =====",
+])
+
+
+def test_within_budget_passes(capsys):
+    tool = _load()
+    assert tool.check(GOOD_LOG, 15.0, 840.0, 0.9) == 0
+    assert "BUDGET OK" in capsys.readouterr().out
+
+
+def test_slow_single_test_fails(capsys):
+    tool = _load()
+    log = GOOD_LOG.replace("10.21s call", "21.70s call")
+    assert tool.check(log, 15.0, 840.0, 0.9) == 1
+    out = capsys.readouterr().out
+    assert "BUDGET FAIL" in out
+    assert "test_streams" in out
+
+
+def test_over_total_fails(capsys):
+    tool = _load()
+    log = GOOD_LOG.replace("in 729.36s", "in 851.02s")
+    assert tool.check(log, 15.0, 840.0, 0.9) == 1
+    assert "suite total 851.0s" in capsys.readouterr().out
+
+
+def test_near_budget_warns(capsys):
+    tool = _load()
+    log = GOOD_LOG.replace("in 729.36s", "in 800.00s")
+    assert tool.check(log, 15.0, 840.0, 0.9) == 0
+    assert "BUDGET WARN" in capsys.readouterr().err
+
+
+def test_truncated_run_is_an_error(capsys):
+    # a lane killed by the 870s timeout has no summary line — that IS
+    # the failure the tool exists to catch
+    tool = _load()
+    assert tool.check("....\n5.0s call tests/t.py::x\n",
+                      15.0, 840.0, 0.9) == 2
+
+
+def test_no_durations_checks_total_only(capsys):
+    tool = _load()
+    log = "===== 10 passed in 12.00s =====\n"
+    assert tool.check(log, 15.0, 840.0, 0.9) == 0
+    assert "no --durations lines" in capsys.readouterr().err
+
+
+def test_quiet_mode_summary_parses(capsys):
+    # the tier-1 command runs `pytest -q`, whose summary line has no
+    # ===== decoration — exactly the log the tool exists to lint
+    tool = _load()
+    log = ("............F.......                              [100%]\n"
+           "4 failed, 356 passed, 23 deselected, 5 warnings "
+           "in 683.52s (0:11:23)\n")
+    assert tool.check(log, 15.0, 840.0, 0.9) == 0
+    assert "683.5s" in capsys.readouterr().out
+    over = log.replace("in 683.52s", "in 866.00s")
+    assert tool.check(over, 15.0, 840.0, 0.9) == 1
+
+
+def test_cli_on_fixture_file(tmp_path):
+    tool = _load()
+    p = tmp_path / "t1.log"
+    p.write_text(GOOD_LOG)
+    assert tool.main([str(p)]) == 0
+    assert tool.main([str(p), "--max-total", "700"]) == 1
+    assert tool.main([str(tmp_path / "missing.log")]) == 2

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Tier-1 wall-budget lint: catch a fast-lane timeout BEFORE it happens.
+
+The tier-1 verify command (ROADMAP.md) runs the fast lane under a hard
+870s `timeout`, and the suite already spends most of it — a timeout
+zeroes the entire run, so a single newly-heavy test can silently turn a
+green lane red. This tool parses a pytest log (the tee'd tier-1 log, or
+any run with ``--durations=N`` enabled) and enforces two budgets:
+
+  * no single fast-lane test phase (setup/call/teardown) may exceed
+    ``--max-test`` seconds (default 15);
+  * the suite total (the ``... in 729.36s ...`` summary line) may not
+    exceed ``--max-total`` seconds (default 840 — headroom under the
+    870s kill).
+
+A soft warning is printed (stderr) when the total passes
+``--warn-frac`` of the budget (default 0.9) so drift is visible before
+it fails. Durations lines are optional — without them only the total
+is checked (and their absence is noted).
+
+Usage:
+    python tools/check_t1_budget.py /tmp/_t1.log
+    python tools/check_t1_budget.py --max-test 15 --max-total 840 LOG
+
+Exit status: 0 = within budget, 1 = over budget, 2 = no parseable
+pytest summary in the log (a truncated/killed run is itself a failure:
+the 870s timeout produces exactly this shape).
+
+tests/test_t1_budget_tool.py lints this tool on fixture logs in tier-1,
+per the tools-as-tests policy (lint_metrics.py precedent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import List, Tuple
+
+# `1.23s call     tests/test_x.py::test_y` (pytest --durations output)
+DURATION_RE = re.compile(
+    r"^\s*(?P<secs>\d+(?:\.\d+)?)s\s+"
+    r"(?P<phase>call|setup|teardown)\s+"
+    r"(?P<test>\S+)\s*$")
+# `===== 338 passed, 2 skipped in 729.36s (0:12:09) =====`, and the
+# undecorated `pytest -q` form `4 failed, 356 passed in 683.52s
+# (0:11:23)` (the tier-1 command runs -q) — the wall number is what we
+# budget, from any passed/failed/error/skipped summary
+SUMMARY_RE = re.compile(
+    r"^(?:=+ )?.*\b(?:passed|failed|errors?|skipped|no tests ran)\b.*"
+    r"\bin (?P<secs>\d+(?:\.\d+)?)s(?: \([0-9:]+\))?(?: =+)?\s*$",
+    re.MULTILINE)
+
+
+def parse_log(text: str) -> Tuple[float | None, List[Tuple[float, str, str]]]:
+    """(total seconds | None, [(secs, phase, test), ...])."""
+    total = None
+    for m in SUMMARY_RE.finditer(text):
+        total = float(m.group("secs"))   # last summary wins (reruns)
+    durations = [
+        (float(m.group("secs")), m.group("phase"), m.group("test"))
+        for line in text.splitlines()
+        if (m := DURATION_RE.match(line))
+    ]
+    return total, durations
+
+
+def check(text: str, max_test: float, max_total: float,
+          warn_frac: float, out=sys.stdout, err=sys.stderr) -> int:
+    total, durations = parse_log(text)
+    if total is None:
+        print("BUDGET: no pytest summary line found — truncated or "
+              "killed run (the 870s timeout produces exactly this)",
+              file=err)
+        return 2
+    rc = 0
+    for secs, phase, test in durations:
+        if secs > max_test:
+            print(f"BUDGET FAIL: {test} {phase} took {secs:.1f}s "
+                  f"(> {max_test:.0f}s per-test cap)", file=out)
+            rc = 1
+    if total > max_total:
+        print(f"BUDGET FAIL: suite total {total:.1f}s exceeds "
+              f"{max_total:.0f}s (the lane is killed at 870s)",
+              file=out)
+        rc = 1
+    elif total > warn_frac * max_total:
+        print(f"BUDGET WARN: suite total {total:.1f}s is above "
+              f"{warn_frac:.0%} of the {max_total:.0f}s budget — "
+              "move heavy tests to -m slow before the lane times out",
+              file=err)
+    if not durations:
+        print("BUDGET: no --durations lines in the log; only the "
+              "suite total was checked (run pytest with --durations=25 "
+              "for per-test enforcement)", file=err)
+    if rc == 0:
+        n = len(durations)
+        print(f"BUDGET OK: total {total:.1f}s <= {max_total:.0f}s"
+              + (f"; slowest of {n} phases within {max_test:.0f}s"
+                 if n else ""), file=out)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="pytest log file, or '-' for stdin")
+    ap.add_argument("--max-test", type=float, default=15.0,
+                    help="per-test phase budget, seconds (default 15)")
+    ap.add_argument("--max-total", type=float, default=840.0,
+                    help="suite wall budget, seconds (default 840)")
+    ap.add_argument("--warn-frac", type=float, default=0.9,
+                    help="warn when total exceeds this fraction of "
+                         "--max-total (default 0.9)")
+    args = ap.parse_args(argv)
+    if args.log == "-":
+        text = sys.stdin.read()
+    else:
+        try:
+            with open(args.log, errors="replace") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"BUDGET: cannot read {args.log}: {e}",
+                  file=sys.stderr)
+            return 2
+    return check(text, args.max_test, args.max_total, args.warn_frac)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
