@@ -164,21 +164,14 @@ SMOKE_TIERS = {
                       quant="int8"),
 }
 
-# HBM bandwidth (bytes/s) by device_kind substring; conservative defaults.
-HBM_GBS = [
-    ("v5 lite", 819e9), ("v5e", 819e9),
-    ("v5p", 2765e9), ("v5", 2765e9),
-    ("v4", 1228e9), ("v6", 1640e9), ("v3", 900e9),
-]
-DEFAULT_HBM = 819e9
-
-
 def device_bandwidth(kind: str) -> float:
-    k = kind.lower()
-    for sub, bw in HBM_GBS:
-        if sub in k:
-            return bw
-    return DEFAULT_HBM
+    """HBM bytes/s for a device kind — delegates to the ONE table in
+    cake_tpu/obs/steps.py so the analytic rooflines here and the
+    flight recorder's measured hbm_util share hardware constants.
+    (Imported lazily: only tier children import cake_tpu/jax; the
+    orchestrator process never does.)"""
+    from cake_tpu.obs.steps import hbm_bps_for
+    return hbm_bps_for(kind)
 
 
 def make_config(model: str):
@@ -294,12 +287,22 @@ def run_tier(name: str, model: str, quant, max_seq: int,
     own_roofline = hbm_bps / resident
     log(f"steady state: {total} tokens in {dt:.2f}s -> {tok_s:.2f} tok/s "
         f"(bf16 roofline {bf16_roofline:.1f}, own roofline {own_roofline:.1f})")
+    # utilization (BENCH trajectory finally carries it, not just tok/s):
+    # analytic MFU for a batch-B decode = 2 FLOPs per param per token,
+    # and hbm_util = achieved fraction of this config's own bandwidth
+    # ceiling (= roofline_frac by construction)
+    from cake_tpu.obs.steps import peak_flops_for
+    peak = peak_flops_for(dev.device_kind)
+    mfu = min(1.0, tok_s * 2 * n_params / peak)
+    hbm_util = min(1.0, tok_s * resident / hbm_bps)
     return {
         "metric": f"{name}_decode_tok_s_per_chip",
         "value": round(tok_s, 2),
         "unit": "tokens/s",
         "vs_baseline": round(tok_s / bf16_roofline, 3),
         "roofline_frac": round(tok_s / own_roofline, 3),
+        "mfu": round(mfu, 6),
+        "hbm_util": round(hbm_util, 6),
         "device_kind": dev.device_kind,
     }
 
@@ -361,6 +364,9 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
         _settle_decode_stats(engine, 0.0)
         base_tokens = engine.stats.tokens_generated
         base_decode_s = engine.stats.decode_time_s
+        # utilization window starts AFTER warmup: compile-inflated step
+        # walls must not weight the reported mfu/hbm_util toward zero
+        warm_steps = engine.flight.summary()["recorded_steps"]
 
         handles = [engine.submit(prompt, max_new_tokens=gen_tokens)
                    for _ in range(slots)]
@@ -375,9 +381,15 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
     ttfts = sorted(h.ttft for h in handles)
     p50 = ttfts[len(ttfts) // 2]
     tok_s = tokens / decode_s if decode_s > 0 else 0.0
+    # decode-side utilization from the step flight recorder (obs/steps:
+    # cost_analysis FLOPs/bytes over measured step walls, warmup and
+    # compile steps excluded) — 0.0 when no record carried cost info,
+    # so the keys always exist for the trajectory parser
+    util = engine.flight.utilization(since_step=warm_steps)
     log(f"engine: {tokens} tokens, decode {decode_s:.2f}s -> "
         f"{tok_s:.1f} tok/s aggregate; TTFT p50 {p50 * 1e3:.1f}ms "
-        f"({slots} concurrent streams)")
+        f"({slots} concurrent streams); mfu {util['mfu']:.4f}, "
+        f"hbm_util {util['hbm_util']:.4f}")
     out = {
         "metric": f"{name}_ttft_and_throughput",
         "value": round(tok_s, 2),
@@ -386,6 +398,8 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
         "ttft_p50_ms": round(p50 * 1e3, 1),
         "engine_decode_tok_s": round(tok_s, 2),
         "engine_streams": slots,
+        "mfu": util["mfu"],
+        "hbm_util": util["hbm_util"],
     }
     if draft is not None:
         out["spec_acceptance"] = round(engine.stats.spec_acceptance, 4)
@@ -435,6 +449,7 @@ def run_paged_tier(name: str, model: str, quant, max_seq: int,
         _settle_decode_stats(engine, 0.0)
         base_tokens = engine.stats.tokens_generated
         base_decode_s = engine.stats.decode_time_s
+        warm_steps = engine.flight.summary()["recorded_steps"]
 
         handles = [engine.submit(prompt, max_new_tokens=gen_tokens)
                    for _ in range(slots)]
@@ -444,9 +459,11 @@ def run_paged_tier(name: str, model: str, quant, max_seq: int,
         decode_s = engine.stats.decode_time_s - base_decode_s
 
     tok_s = tokens / decode_s if decode_s > 0 else 0.0
+    util = engine.flight.utilization(since_step=warm_steps)
     log(f"paged[{paged_attn}]: {tokens} tokens, decode {decode_s:.2f}s "
         f"-> {tok_s:.1f} tok/s aggregate ({slots} streams, "
-        f"{kv_pages} x {kv_page_size}-token pages)")
+        f"{kv_pages} x {kv_page_size}-token pages); "
+        f"mfu {util['mfu']:.4f}, hbm_util {util['hbm_util']:.4f}")
     return {
         "metric": f"{name}_paged_decode_tok_s",
         "value": round(tok_s, 2),
@@ -457,6 +474,8 @@ def run_paged_tier(name: str, model: str, quant, max_seq: int,
         "paged_streams": slots,
         "kv_pages": kv_pages,
         "kv_page_size": kv_page_size,
+        "mfu": util["mfu"],
+        "hbm_util": util["hbm_util"],
         "device_kind": dev.device_kind,
     }
 

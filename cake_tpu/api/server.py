@@ -30,6 +30,7 @@ from cake_tpu.api.openai import (
 )
 from cake_tpu.args import ImageGenerationArgs
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import steps as obs_steps
 from cake_tpu.obs import tracing as obs_tracing
 
 log = logging.getLogger(__name__)
@@ -42,7 +43,8 @@ MAX_WAITING = 16
 KNOWN_ROUTES = frozenset({
     "/api/v1/chat/completions", "/v1/chat/completions", "/api/v1/image",
     "/api/v1/health", "/api/v1/cluster", "/v1/models", "/api/v1/models",
-    "/metrics", "/api/v1/metrics", "/api/v1/requests",
+    "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
+    "/api/v1/profile",
 })
 
 
@@ -66,6 +68,10 @@ class ApiServer:
         self._waiting = 0
         self._waiting_lock = threading.Lock()
         self.started_at = int(time.time())  # /v1/models "created"
+        # POST /api/v1/profile capture target (--profile-dir; None =
+        # a fresh temp dir per capture)
+        self._profile_dir = getattr(
+            getattr(master, "args", None), "profile_dir", None)
         self._m_http = obs_metrics.counter(
             "cake_http_requests_total",
             "HTTP requests served, by route and status code",
@@ -301,6 +307,14 @@ class ApiServer:
         engine's aggregate counters are synced here at scrape time (one
         scrape = one consistent snapshot of EngineStats)."""
         m = obs_metrics
+        if self.health_state is None or not hasattr(
+                self.health_state, "observe_metrics"):
+            # per-device HBM gauges fresh at scrape instant (graceful
+            # no-op on CPU backends); with a health state attached its
+            # observe_metrics() below does this refresh instead —
+            # calling both would pay Device.memory_stats() twice per
+            # scrape on a multi-device host
+            obs_steps.refresh_device_gauges()
         m.gauge("cake_requests_waiting",
                 "Requests inside HTTP admission").set(self._waiting)
         m.gauge("cake_serving_healthy",
@@ -360,13 +374,7 @@ class ApiServer:
                 m.gauge("cake_engine_spec_acceptance",
                         "Lifetime draft acceptance ratio").set(
                     round(st.spec_acceptance, 4))
-            if getattr(self.engine, "paged", False):
-                m.gauge("cake_engine_kv_pages_total",
-                        "KV pages in the pool").set(
-                    self.engine.cache.n_pages)
-                m.gauge("cake_engine_kv_pages_free",
-                        "KV pages currently free").set(
-                    self.engine._pager.free_pages)
+            obs_steps.refresh_page_gauges(self.engine)
         return m.REGISTRY.render()
 
     def requests(self, limit: Optional[int] = None) -> dict:
@@ -376,6 +384,33 @@ class ApiServer:
             return {"requests": [], "note": "engine-less serving has "
                     "no request tracer"}
         return {"requests": self.engine.tracer.dump(limit)}
+
+    def steps(self, limit: Optional[int] = None) -> dict:
+        """Step flight-recorder dump (GET /api/v1/steps): newest step
+        records first plus the aggregate summary (per-kind counts,
+        compile counts, decode-side MFU / HBM utilization)."""
+        if self.engine is None or not hasattr(self.engine, "flight"):
+            return {"steps": [], "summary": {},
+                    "note": "engine-less serving has no step recorder"}
+        return {"steps": self.engine.flight.dump(limit),
+                "summary": self.engine.flight.summary()}
+
+    def profile(self, body: dict) -> dict:
+        """On-demand profiler capture (POST /api/v1/profile
+        {"seconds": N}): grab a jax.profiler Perfetto trace of the next
+        N seconds of live execution and return the artifact paths.
+        Single-flight: a concurrent capture raises ProfileBusyError
+        (HTTP 409). The capture directory comes from --profile-dir
+        (never the request body — clients must not pick server paths)."""
+        if not isinstance(body, dict):
+            # valid JSON but not an object (e.g. `[2]`): client error,
+            # not a 500 + exception log
+            raise ValueError("body must be a JSON object")
+        seconds = body.get("seconds", 2.0)
+        if not isinstance(seconds, (int, float)) or isinstance(
+                seconds, bool):
+            raise ValueError("seconds must be a number")
+        return obs_steps.PROFILER.capture(seconds, self._profile_dir)
 
     # -- admission -----------------------------------------------------------
 
@@ -420,6 +455,18 @@ def make_handler(api: ApiServer):
             self.wfile.write(data)
             api._count(self.path, code)
 
+        def _limit_arg(self):
+            """Optional ?limit=N capping a ring dump (the rings are
+            already bounded; this just trims the response)."""
+            if "?" not in self.path:
+                return None
+            from urllib.parse import parse_qs
+            q = parse_qs(self.path.split("?", 1)[1])
+            try:
+                return int(q.get("limit", [None])[0])
+            except (TypeError, ValueError):
+                return None
+
         def _read_body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
             if n == 0:
@@ -435,17 +482,9 @@ def make_handler(api: ApiServer):
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
             if self.path.split("?", 1)[0] == "/api/v1/requests":
-                # optional ?limit=N caps the dump (the ring itself is
-                # already bounded)
-                limit = None
-                if "?" in self.path:
-                    from urllib.parse import parse_qs
-                    q = parse_qs(self.path.split("?", 1)[1])
-                    try:
-                        limit = int(q.get("limit", [None])[0])
-                    except (TypeError, ValueError):
-                        limit = None
-                return self._json(200, api.requests(limit))
+                return self._json(200, api.requests(self._limit_arg()))
+            if self.path.split("?", 1)[0] == "/api/v1/steps":
+                return self._json(200, api.steps(self._limit_arg()))
             if self.path in ("/v1/models", "/api/v1/models"):
                 # OpenAI client compatibility: SDKs list models on init
                 return self._json(200, {
@@ -471,6 +510,20 @@ def make_handler(api: ApiServer):
                 body = self._read_body()
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
+            # profiling must work on a FAILED server (a wedged mesh is
+            # exactly when an operator wants a live trace), so it
+            # dispatches before the health gate below
+            if self.path == "/api/v1/profile":
+                try:
+                    return self._json(200, api.profile(body))
+                except obs_steps.ProfileBusyError as e:
+                    return self._json(409, {"error": str(e)})
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    log.exception("profile capture failed")
+                    return self._json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
             # after the body read: responding early would leave unread
             # body bytes desyncing this keep-alive connection
             if api.health_state is not None and api.health_state.failed:

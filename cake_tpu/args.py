@@ -130,6 +130,17 @@ class Args:
     # --trace-ring N: finished request traces retained in memory for
     # GET /api/v1/requests
     trace_ring: int = 256
+    # --step-log PATH: append one JSON line per engine step (the
+    # obs/steps.py flight recorder: kind, occupancy, tokens, dispatch
+    # wall, MFU/HBM utilization, page-pool state) — the step-level
+    # audit log behind GET /api/v1/steps
+    step_log: Optional[str] = None
+    # --step-ring N: step flight-recorder records retained in memory
+    # for GET /api/v1/steps
+    step_ring: int = 512
+    # --profile-dir DIR: where POST /api/v1/profile writes its
+    # jax.profiler capture; None = a fresh temp dir per capture
+    profile_dir: Optional[str] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -148,7 +159,7 @@ class Args:
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",
                      "max_slots", "decode_scan", "spec_gamma",
-                     "spec_rounds", "trace_ring"):
+                     "spec_rounds", "trace_ring", "step_ring"):
             if getattr(self, knob) < 1:
                 raise ValueError(f"--{knob.replace('_', '-')} must be >= 1")
         return self

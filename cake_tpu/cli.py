@@ -292,6 +292,14 @@ def main(argv=None) -> int:
         start(master, address=args.api, checkpoint_path=args.checkpoint)
         return 0
 
+    if args.step_log:
+        # the step flight recorder lives in the serving engine; a
+        # one-shot generation has none — be loud instead of writing an
+        # empty file the operator then greps in vain
+        logging.getLogger(__name__).warning(
+            "--step-log applies to engine serving (--api); one-shot "
+            "generation records no step flight")
+
     if args.model_type.value == "image":
         count = [0]
 

@@ -191,11 +191,14 @@ class Master:
         )
 
     def _trace_kwargs(self) -> dict:
-        """Request-lifecycle tracing knobs, plumbed to every engine
-        flavor identically (--trace-events / --trace-ring)."""
+        """Request-lifecycle tracing + step-telemetry knobs, plumbed to
+        every engine flavor identically (--trace-events / --trace-ring
+        / --step-log / --step-ring)."""
         return dict(
             trace_events=getattr(self.args, "trace_events", None),
             trace_ring=getattr(self.args, "trace_ring", 256),
+            step_log=getattr(self.args, "step_log", None),
+            step_ring=getattr(self.args, "step_ring", 512),
         )
 
     # -- text ----------------------------------------------------------------

@@ -14,8 +14,16 @@ real measurement substrate, dependency-free:
     inter-token gaps and e2e latency, kept in a bounded ring, dumpable
     via `GET /api/v1/requests`, optionally streamed to a JSONL event
     log (`--trace-events PATH`).
+  * `obs.steps` — step-level performance telemetry: a bounded step
+    flight recorder (`GET /api/v1/steps`, `--step-log PATH` JSONL),
+    XLA cost-analysis MFU / HBM-utilization accounting, jit-recompile
+    counters, per-device HBM gauges, and the single-flight live
+    profiler capture behind `POST /api/v1/profile`.
+  * `obs.jsonl` — the shared append-only JSONL writer (fsync on close)
+    and corrupt-tail-tolerant reader both event logs use.
 """
 
+from cake_tpu.obs.jsonl import JsonlAppender, read_jsonl  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
     histogram,

@@ -201,6 +201,20 @@ class MetricFamily:
                     self, values)
         return child
 
+    def samples(self) -> Dict[Tuple[str, ...], float]:
+        """Snapshot of label-values -> current value for every child
+        (counters/gauges; histogram children, which have no scalar
+        value, are omitted). The public read path for tools that walk a
+        family's children without poking registry internals."""
+        with self._lock:
+            children = list(self._children.items())
+        out: Dict[Tuple[str, ...], float] = {}
+        for labelvalues, child in children:
+            value = getattr(child, "value", None)
+            if value is not None:
+                out[labelvalues] = value
+        return out
+
     def _single(self):
         if self.labelnames:
             raise ValueError(

@@ -1,0 +1,575 @@
+"""Step-level performance telemetry: flight recorder, MFU/cost
+accounting, recompile counters, device gauges, live profiler capture.
+
+PR 1 gave serving *request*-level observability; this module opens the
+engine's *step* loop — where all the throughput lives — with four
+pieces, all dependency-free:
+
+  * **Step flight recorder** (`StepTelemetry`): one bounded-ring record
+    per engine step (kind prefill/decode/decode_scan/spec, attention
+    impl, batch occupancy, tokens emitted, page-pool free/total,
+    dispatch wall seconds, device seconds, per-step MFU / HBM
+    utilization, whether the step compiled). Served at
+    `GET /api/v1/steps`, optionally appended as JSONL (`--step-log`,
+    via the shared obs/jsonl.py writer).
+
+  * **XLA cost accounting** (`JitAccountant` + `lower_cost`): the first
+    dispatch of each (step fn, signature) pair runs one extra *lowering*
+    (trace only — no XLA compile) and reads
+    ``Lowered.cost_analysis()`` FLOPs + bytes-accessed. Combined with
+    the measured step time this yields `cake_step_mfu{kind}` and
+    `cake_step_hbm_util{kind}`; every new signature also bumps
+    `cake_jit_compiles_total{fn}` and lands in the compile-seconds
+    histogram. A rising compile counter during steady-state decode is a
+    shape-leak recompilation storm — previously invisible.
+
+  * **Device gauges** (`refresh_device_gauges`): per-device HBM
+    live/peak/limit bytes from `Device.memory_stats()` — a graceful
+    no-op on backends without stats (CPU). Refreshed at scrape time and
+    on the serving heartbeat (parallel/health.py).
+
+  * **Live profiler capture** (`ProfileCapture` / module `PROFILER`):
+    `POST /api/v1/profile {"seconds": N}` grabs a jax.profiler
+    Perfetto trace from the *running* serving process
+    (utils/profiling.capture_trace), single-flight-guarded — a second
+    concurrent capture gets `ProfileBusyError` (HTTP 409).
+
+MFU here is model-FLOPs utilization: (program FLOPs from
+cost_analysis) / (peak chip FLOP/s x measured step seconds), clamped to
+1.0. On backends whose peak is unknown (CPU) a conservative fallback
+peak keeps the number well-defined — treat it as relative, not
+absolute, off-TPU. HBM utilization is bytes-accessed over the chip's
+HBM bandwidth the same way. Both are estimates from *unoptimized* HLO:
+fusion changes the real byte traffic, but the trend per step and the
+fold-vs-pallas/bucket-vs-bucket comparisons are exactly what they are
+for.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from cake_tpu.obs import metrics as _m
+from cake_tpu.obs.jsonl import JsonlAppender
+
+log = logging.getLogger(__name__)
+
+# Peak dense bf16 matmul FLOP/s by device_kind substring (public TPU
+# specs), first match wins. THE single table for the whole repo —
+# bench.py delegates here, so the measured (flight recorder) and
+# analytic (roofline) utilization numbers in one BENCH row can never
+# use different hardware constants. Unknown-TPU / CPU fallbacks differ:
+# an unknown accelerator gets a conservative TPU-class figure, a CPU
+# lane a host-class one (the CPU numbers are relative either way).
+PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+]
+DEFAULT_PEAK_FLOPS = 197e12        # unknown accelerator: v5e-class
+CPU_PEAK_FLOPS = 1e12
+
+# HBM bandwidth (bytes/s) by device_kind substring (same entries and
+# defaults bench.py historically used, now sourced from here only).
+HBM_BPS = [
+    ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v5p", 2765e9), ("v5", 2765e9),
+    ("v6", 1640e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+]
+DEFAULT_HBM_BPS = 819e9            # unknown accelerator: v5e-class
+CPU_HBM_BPS = 100e9
+
+
+def _is_cpu_kind(k: str) -> bool:
+    return not k or "cpu" in k
+
+
+def peak_flops_for(kind: str) -> float:
+    k = (kind or "").lower()
+    for sub, v in PEAK_FLOPS:
+        if sub in k:
+            return v
+    return CPU_PEAK_FLOPS if _is_cpu_kind(k) else DEFAULT_PEAK_FLOPS
+
+
+def hbm_bps_for(kind: str) -> float:
+    k = (kind or "").lower()
+    for sub, v in HBM_BPS:
+        if sub in k:
+            return v
+    return CPU_HBM_BPS if _is_cpu_kind(k) else DEFAULT_HBM_BPS
+
+
+# -- metric families (module-level so the lint/README coverage gate sees
+#    them whether or not an engine ran) --------------------------------------
+
+_STEPS_TOTAL = _m.counter(
+    "cake_steps_total",
+    "Engine steps recorded by the flight recorder, by step kind",
+    labelnames=("kind",))
+_STEP_DISPATCH = _m.histogram(
+    "cake_step_dispatch_seconds",
+    "Per-step dispatch wall seconds, by step kind",
+    labelnames=("kind",))
+_STEP_MFU = _m.gauge(
+    "cake_step_mfu",
+    "Last step's model-FLOPs utilization (cost_analysis FLOPs / peak "
+    "chip FLOPs x step seconds), by step kind",
+    labelnames=("kind",))
+_STEP_HBM = _m.gauge(
+    "cake_step_hbm_util",
+    "Last step's HBM-bandwidth utilization (cost_analysis bytes / HBM "
+    "bandwidth x step seconds), by step kind",
+    labelnames=("kind",))
+_JIT_COMPILES = _m.counter(
+    "cake_jit_compiles_total",
+    "New jit signatures dispatched per step fn (a rise during "
+    "steady-state decode is a shape-leak recompilation storm)",
+    labelnames=("fn",))
+_JIT_COMPILE_SECONDS = _m.histogram(
+    "cake_jit_compile_seconds",
+    "Wall seconds of step-fn dispatches that compiled a new signature")
+_DEV_HBM_IN_USE = _m.gauge(
+    "cake_device_hbm_bytes_in_use",
+    "Live HBM bytes per device (Device.memory_stats; absent on CPU)",
+    labelnames=("device",))
+_DEV_HBM_PEAK = _m.gauge(
+    "cake_device_hbm_peak_bytes",
+    "Peak HBM bytes per device since process start",
+    labelnames=("device",))
+_DEV_HBM_LIMIT = _m.gauge(
+    "cake_device_hbm_bytes_limit",
+    "HBM byte capacity per device",
+    labelnames=("device",))
+
+
+def refresh_page_gauges(engine) -> None:
+    """KV page-pool occupancy gauges for a paged engine (no-op for
+    dense). THE single definition — called at scrape time
+    (api/server.py) and on the serving heartbeat (parallel/health.py),
+    so the two sites cannot drift in names or help text."""
+    if not getattr(engine, "paged", False):
+        return
+    try:
+        _m.gauge("cake_engine_kv_pages_total",
+                 "KV pages in the pool").set(engine.cache.n_pages)
+        _m.gauge("cake_engine_kv_pages_free",
+                 "KV pages currently free").set(engine._pager.free_pages)
+    except Exception:  # noqa: BLE001 — telemetry must never fail serving
+        log.debug("page gauge refresh failed", exc_info=True)
+
+
+def refresh_device_gauges() -> None:
+    """Sync per-device HBM gauges from Device.memory_stats(). Graceful
+    no-op on backends without stats (CPU): the gauges simply stay
+    sample-less. Called at scrape time (api/server.py) and on the
+    serving heartbeat (parallel/health.py)."""
+    try:
+        from cake_tpu.utils.profiling import device_memory_stats
+        stats = device_memory_stats()
+    except Exception:  # noqa: BLE001 — a scrape must never fail
+        log.debug("device memory stats unavailable", exc_info=True)
+        return
+    for s in stats:
+        if s.get("bytes_in_use") is None:
+            continue   # backend without memory_stats (CPU)
+        dev = str(s["device"])
+        _DEV_HBM_IN_USE.labels(device=dev).set(float(s["bytes_in_use"]))
+        if s.get("peak_bytes_in_use") is not None:
+            _DEV_HBM_PEAK.labels(device=dev).set(
+                float(s["peak_bytes_in_use"]))
+        if s.get("bytes_limit") is not None:
+            _DEV_HBM_LIMIT.labels(device=dev).set(float(s["bytes_limit"]))
+
+
+# -- XLA cost accounting ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CostInfo:
+    """One compiled program's cost_analysis numbers (unoptimized HLO)."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+
+
+def _normalize_cost(ca) -> Optional[CostInfo]:
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops") or 0.0)
+    nbytes = float(ca.get("bytes accessed") or 0.0)
+    if flops <= 0.0 and nbytes <= 0.0:
+        return None
+    return CostInfo(flops=flops, bytes_accessed=nbytes)
+
+
+def lower_cost(fn, args: tuple, kwargs: Optional[dict] = None
+               ) -> Optional[CostInfo]:
+    """FLOPs + bytes-accessed of fn(*args, **kwargs) via one extra
+    LOWERING (trace only — `Lowered.cost_analysis()` runs HLO cost
+    analysis without invoking the XLA backend compiler, so this costs a
+    trace, not a compile). functools.partial layers and @wraps wrappers
+    are unwrapped to reach the jitted callable; anything without
+    `.lower` (or whose lowering/analysis raises) yields None — cost
+    accounting is best-effort and must never fail a dispatch."""
+    kwargs = dict(kwargs or {})
+    seen = 0
+    while seen < 8:   # bounded unwrap: partial chains + wraps chains
+        if isinstance(fn, functools.partial):
+            kwargs = {**fn.keywords, **kwargs}
+            args = tuple(fn.args) + tuple(args)
+            fn = fn.func
+        elif getattr(fn, "__wrapped__", None) is not None \
+                and not hasattr(fn, "lower"):
+            fn = fn.__wrapped__
+        else:
+            break
+        seen += 1
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        return _normalize_cost(lower(*args, **kwargs).cost_analysis())
+    except Exception:  # noqa: BLE001 — best-effort accounting
+        log.debug("cost_analysis unavailable for %r",
+                  getattr(fn, "__name__", fn), exc_info=True)
+        return None
+
+
+class JitAccountant:
+    """Process-global compile/cost tracker keyed by (fn name, caller
+    signature key). The engine's jit cache is process-global too (its
+    step fns are module-level jitted functions), so a global accountant
+    mirrors real retrace behavior: a second engine dispatching an
+    already-compiled signature counts no compile."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen: Dict[tuple, Optional[CostInfo]] = {}
+
+    def begin(self, name: str, key: tuple,
+              cost_cb) -> Tuple[bool, Optional[CostInfo]]:
+        """(is_new_signature, cost). On a new signature: increments the
+        per-fn compile counter and captures cost via cost_cb() (called
+        BEFORE the dispatch executes, while donated buffers are still
+        alive)."""
+        with self._lock:
+            if key in self._seen:
+                return False, self._seen[key]
+        cost = None
+        try:
+            cost = cost_cb()
+        except Exception:  # noqa: BLE001
+            log.debug("cost callback failed for %s", name, exc_info=True)
+        with self._lock:
+            if key in self._seen:   # racing thread won
+                return False, self._seen[key]
+            self._seen[key] = cost
+        _JIT_COMPILES.labels(fn=name).inc()
+        return True, cost
+
+    def compile_seconds(self, seconds: float) -> None:
+        _JIT_COMPILE_SECONDS.observe(seconds)
+
+
+ACCOUNTANT = JitAccountant()
+
+
+class _JitStep:
+    """Handle returned by StepTelemetry.jit_step: `.new` says this
+    dispatch compiles a fresh signature, `.cost` carries the program's
+    CostInfo; call `.finish(elapsed)` after the dispatch so compile
+    wall time lands in the histogram."""
+
+    __slots__ = ("new", "cost", "_acct")
+
+    def __init__(self, new: bool, cost: Optional[CostInfo],
+                 acct: JitAccountant):
+        self.new = new
+        self.cost = cost
+        self._acct = acct
+
+    def finish(self, seconds: float) -> None:
+        if self.new:
+            self._acct.compile_seconds(seconds)
+
+
+# -- flight recorder ----------------------------------------------------------
+
+# step kinds whose records carry decode throughput (utilization
+# aggregation weights these; prefill is reported per-kind only)
+_DECODE_KINDS = ("decode", "decode_scan", "spec")
+
+
+def _sig(v: Optional[float], digits: int = 6) -> Optional[float]:
+    """Round to significant digits (utilization exports: decimal-place
+    rounding would collapse legitimately tiny values to 0.0)."""
+    return float(f"%.{digits}g" % v) if v is not None else None
+
+
+@dataclass
+class StepRecord:
+    """One engine step. dispatch_s is host wall to get the work onto
+    the device (for double-buffered bursts, the dispatch half alone);
+    device_s is the measured completion wall (the fetch half, a proxy
+    for device time on sync paths); wall_s the whole step."""
+
+    step: int
+    ts: float                      # wall-clock
+    kind: str                      # prefill | decode | decode_scan | spec
+    impl: str                      # dense | ring | paged-fold | ... | custom
+    rows: int                      # batch occupancy this step
+    tokens: int                    # tokens emitted by this step
+    dispatch_s: float
+    device_s: float
+    wall_s: float
+    mfu: Optional[float] = None
+    hbm_util: Optional[float] = None
+    pages_free: Optional[int] = None
+    pages_total: Optional[int] = None
+    compiled: bool = False         # this step compiled a new signature
+
+    def to_dict(self) -> Dict:
+        out = {
+            "step": self.step,
+            "ts": round(self.ts, 6),
+            "kind": self.kind,
+            "impl": self.impl,
+            "rows": self.rows,
+            "tokens": self.tokens,
+            "dispatch_s": round(self.dispatch_s, 6),
+            "device_s": round(self.device_s, 6),
+            "wall_s": round(self.wall_s, 6),
+            # significant digits, not decimal places: a compile-inflated
+            # step's 1e-7 MFU must stay nonzero in the export
+            "mfu": _sig(self.mfu),
+            "hbm_util": _sig(self.hbm_util),
+            "compiled": self.compiled,
+        }
+        if self.pages_total is not None:
+            out["pages_free"] = self.pages_free
+            out["pages_total"] = self.pages_total
+        return out
+
+
+class StepTelemetry:
+    """Per-engine step flight recorder + jit/cost accounting front end.
+
+    capacity bounds the in-memory ring (GET /api/v1/steps); log_path
+    additionally appends every record as one JSON line (--step-log,
+    shared obs/jsonl.py durability semantics). key_prefix namespaces
+    the accountant keys so engines with different configs cannot alias
+    each other's signatures. peak_flops/hbm_bps override the
+    device-kind tables (tests pin them for exact MFU math)."""
+
+    def __init__(self, *, impl: str = "dense", capacity: int = 512,
+                 log_path: Optional[str] = None,
+                 key_prefix: tuple = (),
+                 peak_flops: Optional[float] = None,
+                 hbm_bps: Optional[float] = None,
+                 accountant: Optional[JitAccountant] = None):
+        self.impl = impl
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._next = 1
+        self._log = JsonlAppender(log_path) if log_path else None
+        self._acct = accountant or ACCOUNTANT
+        self._prefix = tuple(key_prefix)
+        self._peak = peak_flops
+        self._bps = hbm_bps
+
+    # -- jit/cost accounting ------------------------------------------------
+
+    def jit_step(self, fn_name: str, key: tuple, cost_cb) -> _JitStep:
+        """Account one dispatch of `fn_name` under signature `key`
+        (caller-chosen: the shapes/statics that select the compiled
+        program). cost_cb() -> CostInfo|None runs once per new key —
+        typically `lambda: lower_cost(fn, args, kwargs)`."""
+        new, cost = self._acct.begin(
+            fn_name, self._prefix + (fn_name,) + tuple(key), cost_cb)
+        return _JitStep(new, cost, self._acct)
+
+    def _peaks(self) -> Tuple[float, float]:
+        if self._peak is None or self._bps is None:
+            kind = ""
+            try:
+                import jax
+                kind = jax.devices()[0].device_kind
+            except Exception:  # noqa: BLE001
+                pass
+            if self._peak is None:
+                self._peak = peak_flops_for(kind)
+            if self._bps is None:
+                self._bps = hbm_bps_for(kind)
+        return self._peak, self._bps
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, kind: str, *, rows: int = 0, tokens: int = 0,
+               dispatch_s: Optional[float] = None,
+               device_s: Optional[float] = None,
+               wall_s: Optional[float] = None,
+               cost: Optional[CostInfo] = None,
+               compiled: bool = False,
+               pages_free: Optional[int] = None,
+               pages_total: Optional[int] = None) -> StepRecord:
+        """Append one step record; derives MFU / HBM utilization from
+        `cost` and the step's device seconds. Any subset of the three
+        timings may be given; missing ones fall back to the others."""
+        wall = wall_s if wall_s is not None else (
+            (dispatch_s or 0.0) + (device_s or 0.0))
+        disp = dispatch_s if dispatch_s is not None else wall
+        dev = device_s if device_s is not None else wall
+        mfu = hbm = None
+        if cost is not None and dev > 0:
+            peak, bps = self._peaks()
+            if cost.flops > 0 and peak > 0:
+                mfu = min(1.0, cost.flops / (peak * dev))
+            if cost.bytes_accessed > 0 and bps > 0:
+                hbm = min(1.0, cost.bytes_accessed / (bps * dev))
+        with self._lock:
+            rec = StepRecord(
+                step=self._next, ts=time.time(), kind=kind,
+                impl=self.impl, rows=int(rows), tokens=int(tokens),
+                dispatch_s=float(disp), device_s=float(dev),
+                wall_s=float(wall), mfu=mfu, hbm_util=hbm,
+                pages_free=pages_free, pages_total=pages_total,
+                compiled=bool(compiled))
+            self._next += 1
+            self._ring.append(rec)
+        _STEPS_TOTAL.labels(kind=kind).inc()
+        _STEP_DISPATCH.labels(kind=kind).observe(disp)
+        if mfu is not None:
+            _STEP_MFU.labels(kind=kind).set(_sig(mfu))
+        if hbm is not None:
+            _STEP_HBM.labels(kind=kind).set(_sig(hbm))
+        if self._log is not None:
+            self._log.append(rec.to_dict())
+        return rec
+
+    # -- export -------------------------------------------------------------
+
+    def dump(self, limit: Optional[int] = None) -> List[Dict]:
+        """Records newest first (the GET /api/v1/steps body)."""
+        with self._lock:
+            recs = list(reversed(self._ring))
+        if limit is not None:
+            recs = recs[:max(0, int(limit))]
+        return [r.to_dict() for r in recs]
+
+    def utilization(self, since_step: int = 0) -> Dict[str, float]:
+        """Wall-time-weighted mean MFU / HBM utilization over the
+        ring's decode-side records (decode / decode_scan / spec;
+        prefill excluded — its utilization profile is a different
+        question). Records whose dispatch compiled a new signature are
+        excluded — their wall is XLA compile, not decode — and
+        since_step drops everything up to a warmup boundary (pass the
+        post-warmup `summary()["recorded_steps"]`). 0.0 when no
+        remaining record carried cost info — a bench consumer always
+        gets the keys."""
+        with self._lock:
+            recs = [r for r in self._ring
+                    if r.kind in _DECODE_KINDS and not r.compiled
+                    and r.step > since_step]
+        out = {"mfu": 0.0, "hbm_util": 0.0}
+        for field in ("mfu", "hbm_util"):
+            num = den = 0.0
+            for r in recs:
+                v = getattr(r, field)
+                if v is not None and r.wall_s > 0:
+                    num += v * r.wall_s
+                    den += r.wall_s
+            if den > 0:
+                out[field] = _sig(num / den)
+        return out
+
+    def summary(self) -> Dict:
+        """Aggregate view for /api/v1/steps and tools: per-kind counts,
+        tokens, mean dispatch seconds, compile counts, plus the
+        decode-side utilization means."""
+        with self._lock:
+            recs = list(self._ring)
+            recorded = self._next - 1
+        kinds: Dict[str, Dict] = {}
+        for r in recs:
+            k = kinds.setdefault(r.kind, {
+                "count": 0, "tokens": 0, "compiles": 0,
+                "dispatch_s_sum": 0.0})
+            k["count"] += 1
+            k["tokens"] += r.tokens
+            k["compiles"] += 1 if r.compiled else 0
+            k["dispatch_s_sum"] += r.dispatch_s
+        for k in kinds.values():
+            k["mean_dispatch_s"] = round(
+                k.pop("dispatch_s_sum") / k["count"], 6)
+        return {
+            "recorded_steps": recorded,
+            "ring": len(recs),
+            "impl": self.impl,
+            "kinds": kinds,
+            **self.utilization(),
+        }
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+
+class ProfileBusyError(RuntimeError):
+    """A capture is already running (the single-flight guard). The API
+    layer maps this to HTTP 409."""
+
+
+class ProfileCapture:
+    """Single-flight jax.profiler capture from a live process.
+
+    jax.profiler supports one active trace per process; a second
+    concurrent capture would raise from deep inside the profiler (or
+    corrupt the first artifact), so the guard rejects it up front with
+    ProfileBusyError instead."""
+
+    MAX_SECONDS = 120.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    @property
+    def busy(self) -> bool:
+        # advisory only (the real gate is the non-blocking acquire)
+        return self._lock.locked()
+
+    def capture(self, seconds: float,
+                out_dir: Optional[str] = None) -> Dict:
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            raise ValueError("seconds must be a number")
+        if not (0 < seconds <= self.MAX_SECONDS):
+            raise ValueError(
+                f"seconds must be in (0, {self.MAX_SECONDS:.0f}]")
+        if not self._lock.acquire(blocking=False):
+            raise ProfileBusyError(
+                "a profiler capture is already in progress")
+        try:
+            from cake_tpu.utils.profiling import capture_trace
+            return capture_trace(seconds, out_dir)
+        finally:
+            self._lock.release()
+
+
+PROFILER = ProfileCapture()

@@ -29,7 +29,6 @@ submit/prefill/emit/retire seams covers every serving mode at once.
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
@@ -38,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from cake_tpu.obs import metrics as _m
+from cake_tpu.obs.jsonl import JsonlAppender
 
 log = logging.getLogger(__name__)
 
@@ -167,9 +167,11 @@ class RequestTracer:
     capacity bounds the FINISHED-record ring; active records are always
     retained (they are bounded by the engine's queue + slots). With
     `events_path`, each span appends one JSON line
-    ``{"ts", "rid", "event", ...}`` (append-only; open lazily so a
-    follower process that never serves requests never touches the
-    file)."""
+    ``{"ts", "rid", "event", ...}`` through the shared obs/jsonl.py
+    writer (append-only, lazily opened so a follower process that never
+    serves requests never touches the file, fsync on close, fail-open
+    on OSError; read it back with `obs.jsonl.read_jsonl`, which
+    tolerates the torn tail a killed process leaves)."""
 
     def __init__(self, capacity: int = 256,
                  events_path: Optional[str] = None,
@@ -177,9 +179,8 @@ class RequestTracer:
         self._lock = threading.Lock()
         self._active: Dict[int, TraceRecord] = {}
         self._done: deque = deque(maxlen=max(1, int(capacity)))
-        self._events_path = events_path
-        self._events_file = None
-        self._events_failed = False
+        self._events = (JsonlAppender(events_path)
+                        if events_path else None)
         self._observe = observe_metrics
 
     # -- lifecycle hooks (called by the engine) ---------------------------
@@ -316,31 +317,15 @@ class RequestTracer:
             return len(self._active)
 
     def close(self) -> None:
-        with self._lock:
-            f, self._events_file = self._events_file, None
-        if f is not None:
-            try:
-                f.close()
-            except OSError:
-                pass
+        if self._events is not None:
+            self._events.close()
 
     # -- JSONL event log ---------------------------------------------------
 
     def _event(self, rec: TraceRecord, event: str, **fields) -> None:
-        if self._events_path is None or self._events_failed:
+        if self._events is None:
             return
         line = {"ts": round(time.time(), 6), "rid": rec.rid,
                 "event": event}
         line.update({k: v for k, v in fields.items() if v is not None})
-        try:
-            with self._lock:
-                if self._events_file is None:
-                    self._events_file = open(self._events_path, "a")
-                self._events_file.write(json.dumps(line) + "\n")
-                self._events_file.flush()
-        except OSError:
-            # one warning, then disable: a full disk must not turn every
-            # token emit into a logged exception
-            self._events_failed = True
-            log.warning("trace events disabled: cannot write %s",
-                        self._events_path, exc_info=True)
+        self._events.append(line)

@@ -41,31 +41,49 @@ from cake_tpu.ops.rope import apply_rope
 
 NEG_INF = -1e30
 
-# host-side dispatch counters for the sp/stage-sp engine step fns: the
-# forwards themselves are jitted (no per-call Python), so counting wraps
-# the dispatch wrappers — one inc per device program launch, labeled by
-# op and serving mode. Shared with sp_pipeline via the fn factories.
+# host-side dispatch counters/timers for the sp/stage-sp engine step
+# fns: the forwards themselves are jitted (no per-call Python), so the
+# instrumentation wraps the dispatch wrappers — one inc + one wall
+# observation per device program launch, labeled by op and serving
+# mode. Shared with sp_pipeline via the fn factories.
 _SP_DISPATCH = obs_metrics.counter(
     "cake_sp_dispatch_total",
     "Device-program dispatches of the sp engine step fns",
     labelnames=("op", "mode"))
+_SP_DISPATCH_SECONDS = obs_metrics.histogram(
+    "cake_sp_dispatch_seconds",
+    "Wall seconds per sp engine step-fn dispatch",
+    labelnames=("op", "mode"))
 
 
 def _counted(fn, op: str, mode: str):
+    import functools
+    import time as _time
     child = _SP_DISPATCH.labels(op=op, mode=mode)
+    hist = _SP_DISPATCH_SECONDS.labels(op=op, mode=mode)
 
+    # functools.wraps exposes __wrapped__, so obs/steps.lower_cost can
+    # reach the jitted fn through this wrapper for MFU cost accounting
+    @functools.wraps(fn)
     def wrapper(*args, **kw):
         child.inc()
-        return fn(*args, **kw)
+        t0 = _time.perf_counter()
+        try:
+            return fn(*args, **kw)
+        finally:
+            hist.observe(_time.perf_counter() - t0)
     return wrapper
 
 
-def instrument_sp_engine(decode_scan_fn, mode: str, ctx_len: int,
+def instrument_sp_engine(step_fns, mode: str, ctx_len: int,
                          tail_len: int):
     """Shared observability tail of every sp-engine step-fn factory
-    (plain sp here, stage x sp in sp_pipeline): wrap the scan dispatch
-    with the op counter and publish the window-layout gauges — one
-    definition, so the two factories' metrics cannot drift."""
+    (plain sp here, stage x sp in sp_pipeline): wrap EVERY step fn's
+    dispatch with the op counter + wall histogram and publish the
+    window-layout gauges — one definition, so the two factories'
+    metrics cannot drift. Takes and returns the engine step-fn tuple
+    (prefill_slot, decode_ragged, decode_scan); None entries pass
+    through untouched."""
     obs_metrics.gauge(
         "cake_sp_ctx_window_tokens",
         "Sequence-sharded prompt window of the sp engine",
@@ -74,7 +92,10 @@ def instrument_sp_engine(decode_scan_fn, mode: str, ctx_len: int,
         "cake_sp_tail_window_tokens",
         "Replicated decode tail of the sp engine",
         labelnames=("mode",)).labels(mode=mode).set(tail_len)
-    return _counted(decode_scan_fn, "decode_scan", mode)
+    ops = ("prefill", "decode", "decode_scan")
+    return tuple(
+        _counted(fn, op, mode) if fn is not None else None
+        for fn, op in zip(step_fns, ops))
 
 
 def _chunk_scores(q, k, *, scale):
@@ -819,10 +840,10 @@ def make_sp_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                                            mode=mode)
 
     from cake_tpu.serve.engine import make_decode_scan
-    decode_scan_fn = instrument_sp_engine(
-        make_decode_scan(decode_ragged_forward), mode, ctx_len, tail_len)
-
-    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
+    return instrument_sp_engine(
+        (prefill_slot_fn, decode_ragged_fn,
+         make_decode_scan(decode_ragged_forward)),
+        mode, ctx_len, tail_len)
 
 
 def make_slot_prefill_fn(prefill_sm, ctx_len: int, mode: str = "sp"):
@@ -855,7 +876,10 @@ def make_slot_prefill_fn(prefill_sm, ctx_len: int, mode: str = "sp"):
         return logits, SPEngineCache(ctx_k, ctx_v, cache.tail_k,
                                      cache.tail_v, plen)
 
-    return _counted(prefill_slot_fn, "prefill", mode)
+    # instrumentation (dispatch counter + wall histogram) is applied by
+    # instrument_sp_engine over the whole step-fn tuple — wrapping here
+    # too would double-count every prefill dispatch
+    return prefill_slot_fn
 
 
 def make_sp_engine_decode_body(config: LlamaConfig, tp_axis, Sl: int,
@@ -902,7 +926,8 @@ def make_sp_engine_decode_body(config: LlamaConfig, tp_axis, Sl: int,
 def make_decode_ragged_fns(decode_sm, mode: str = "sp"):
     """(decode_ragged_forward, jitted decode_ragged_fn) over a ragged
     sp decode shard_map — shared by the plain-sp and stage x sp engine
-    factories. Only the jitted dispatch wrapper is dispatch-counted;
+    factories. Only the jitted dispatch wrapper gets dispatch-counted
+    (by instrument_sp_engine, over the whole step-fn tuple);
     decode_ragged_forward also gets traced INSIDE decode scans, where a
     host-side counter would be meaningless (and silently ignored)."""
 
@@ -925,5 +950,4 @@ def make_decode_ragged_fns(decode_sm, mode: str = "sp"):
         return decode_ragged_forward(params, tokens, cache, pos, active,
                                      rope, config_)
 
-    return decode_ragged_forward, _counted(decode_ragged_fn, "decode",
-                                           mode)
+    return decode_ragged_forward, decode_ragged_fn

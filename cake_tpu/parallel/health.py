@@ -253,6 +253,11 @@ class ServingHealth:
         self._recoverable = False
         self._failed_at_tokens = 0
         self.monitor: Optional[HeartbeatMonitor] = None
+        # device/page gauge refresh rides the watchdog's poll (the
+        # "existing heartbeat"), rate-limited so memory_stats isn't
+        # called every 0.5s
+        self._gauges_at = 0.0
+        self._gauge_interval_s = 5.0
         # tokens_generated advances on prefill first-tokens too, so a
         # long prefill is not a false stall; stall_after_s must exceed
         # worst-case first-request compile time (configurable via
@@ -283,6 +288,24 @@ class ServingHealth:
                 labelnames=("worker",))
             for name, age in self.monitor.staleness().items():
                 g.labels(worker=name).set(round(age, 3))
+        self._refresh_gauges(force=True)
+
+    def _refresh_gauges(self, force: bool = False) -> None:
+        """Per-device HBM gauges (obs/steps.py; no-op on CPU) and
+        page-pool occupancy, refreshed on the watchdog heartbeat so
+        dashboards fed only by --step-log / pushed expositions stay
+        current without scrapes. force=True (scrape time) bypasses the
+        rate limit."""
+        now = time.monotonic()
+        if not force and now - self._gauges_at < self._gauge_interval_s:
+            return
+        self._gauges_at = now
+        try:
+            from cake_tpu.obs import steps as obs_steps
+            obs_steps.refresh_device_gauges()
+            obs_steps.refresh_page_gauges(self.engine)
+        except Exception:  # noqa: BLE001 — telemetry must never fail health
+            log.debug("device gauge refresh failed", exc_info=True)
 
     def _progress_counter(self) -> int:
         """Watchdog counter; doubles as the recovery probe: a stall
@@ -290,6 +313,7 @@ class ServingHealth:
         — e.g. a false positive from an extra-long XLA compile must not
         brick an otherwise healthy server. Heartbeat failures (a dead
         host) never self-clear."""
+        self._refresh_gauges()
         v = self.engine.stats.tokens_generated
         with self._lock:
             if (self.reason is not None and self._recoverable

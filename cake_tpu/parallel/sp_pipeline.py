@@ -319,7 +319,10 @@ def make_sp_stage_engine_step_fns(mesh: Mesh, config: LlamaConfig,
                                            mode=mode)
 
     from cake_tpu.serve.engine import make_decode_scan
-    decode_scan_fn = instrument_sp_engine(
-        make_decode_scan(decode_ragged_forward), mode, ctx_len, tail_len)
-
-    return prefill_slot_fn, decode_ragged_fn, decode_scan_fn
+    # shared instrumentation tail: every step fn dispatch-counted and
+    # wall-timed (cake_sp_dispatch_total/_seconds{op,mode}), identical
+    # to the plain-sp factory so the two modes' metrics cannot drift
+    return instrument_sp_engine(
+        (prefill_slot_fn, decode_ragged_fn,
+         make_decode_scan(decode_ragged_forward)),
+        mode, ctx_len, tail_len)

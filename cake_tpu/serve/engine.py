@@ -38,6 +38,7 @@ import numpy as np
 
 from cake_tpu.models.chat import History, Message
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import steps as obs_steps
 from cake_tpu.obs.tracing import RequestTracer
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.config import LlamaConfig
@@ -219,6 +220,8 @@ class InferenceEngine:
         decode_budget: Optional[int] = None,
         trace_events: Optional[str] = None,
         trace_ring: int = 256,
+        step_log: Optional[str] = None,
+        step_ring: int = 512,
     ):
         self.config = config
         self.params = params
@@ -455,6 +458,24 @@ class InferenceEngine:
                                     events_path=trace_events)
         from cake_tpu.utils.profiling import StepStats
         self._step_stats = StepStats(name="engine", window=100)
+        # step-level flight recorder + jit compile/cost accounting
+        # (obs/steps.py): one record per engine step at the dispatch
+        # seams below, served at GET /api/v1/steps and optionally
+        # appended to --step-log. The accountant key prefix namespaces
+        # this engine's config so two engines with different configs
+        # (or cache dtypes) can never alias each other's compiled
+        # signatures in the process-global seen-set.
+        flavor = ("spec" if self._spec else
+                  f"paged-{self.paged_attn}" if self.paged else
+                  "ring" if self.ring else
+                  "custom" if step_fns is not None else "dense")
+        self.flight = obs_steps.StepTelemetry(
+            impl=flavor, capacity=step_ring, log_path=step_log,
+            key_prefix=(config, max_slots, max_seq_len,
+                        str(self._cache_dtype), flavor))
+        # latest dispatch's _JitStep (engine-thread-only mailbox between
+        # the device-call seam and the step record that follows it)
+        self._last_jit = None
 
         B = max_slots
         self._pos = np.zeros(B, np.int64)            # next write position
@@ -535,6 +556,7 @@ class InferenceEngine:
         # handles calls arriving later than this)
         self._drain_cancellations()
         self.tracer.close()
+        self.flight.close()
         if self._control is not None:
             # published only after the engine thread has exited, so no
             # step op can be ordered after the stop on the wire
@@ -1258,6 +1280,40 @@ class InferenceEngine:
         if self.paged:
             _PAGED_ATTN_STEP.labels(path=path).observe(seconds)
 
+    # -- step telemetry seams (obs/steps.py) -----------------------------
+
+    def _obs_jit(self, name: str, key: tuple, fn, args: tuple,
+                 kwargs: Optional[dict] = None):
+        """Pre-dispatch compile/cost accounting for one step-fn call:
+        a new (engine-config, name, key) signature bumps
+        cake_jit_compiles_total{fn} and captures cost_analysis FLOPs /
+        bytes from one extra lowering (trace only, no XLA compile) —
+        run NOW, before the dispatch consumes its donated buffers.
+        Callers time the dispatch and hand the wall to js.finish()."""
+        return self.flight.jit_step(
+            name, key, lambda: obs_steps.lower_cost(fn, args, kwargs))
+
+    def _page_kw(self) -> dict:
+        if not self.paged:
+            return {}
+        return {"pages_free": self._pager.free_pages,
+                "pages_total": self.cache.n_pages}
+
+    def _record_step(self, kind: str, *, rows: int, tokens: int,
+                     dispatch_s=None, device_s=None, wall_s=None,
+                     js=None) -> None:
+        """Append one flight record for the step that just completed,
+        attaching the pending dispatch's cost info (js, or the
+        engine-thread mailbox _last_jit) and page-pool occupancy."""
+        if js is None:
+            js, self._last_jit = self._last_jit, None
+        self.flight.record(
+            kind, rows=rows, tokens=tokens, dispatch_s=dispatch_s,
+            device_s=device_s, wall_s=wall_s,
+            cost=js.cost if js is not None else None,
+            compiled=bool(js is not None and js.new),
+            **self._page_kw())
+
     def _release_slot_pages(self, slot: int) -> None:
         if not self.paged or slot < 0:
             return
@@ -1372,6 +1428,7 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self.stats.prefill_time_s += dt
         self._obs_paged_step("prefill", dt)
+        self._record_step("prefill", rows=1, tokens=1, wall_s=dt)
         self._emit(req, tok, logprob=lp, top=top)
         return None
 
@@ -1390,6 +1447,7 @@ class InferenceEngine:
         collects the group's first tokens. Single-host only — a
         follower replays per-admission ops synchronously."""
         pend = []
+        pend_js = []   # each admission's _JitStep, in pend order
 
         def flush():
             hosts = jax.device_get([dev for (_, _, _, dev) in pend])
@@ -1400,15 +1458,34 @@ class InferenceEngine:
             dt = time.perf_counter() - pend[0][1]
             self.stats.prefill_time_s += dt
             self._obs_paged_step("prefill", dt / len(pend))
+            # one record per admission GROUP (per-admission walls would
+            # multi-count the overlap), with the group's SUMMED FLOPs /
+            # bytes over the group wall — and a compile anywhere in the
+            # group flags the record (a single admission's js would hide
+            # the other members' costs and compiles)
+            flops = sum(js.cost.flops for js in pend_js
+                        if js is not None and js.cost is not None)
+            nbytes = sum(js.cost.bytes_accessed for js in pend_js
+                         if js is not None and js.cost is not None)
+            cost = (obs_steps.CostInfo(flops=flops, bytes_accessed=nbytes)
+                    if flops or nbytes else None)
+            self.flight.record(
+                "prefill", rows=len(pend), tokens=len(pend), wall_s=dt,
+                cost=cost,
+                compiled=any(js is not None and js.new for js in pend_js),
+                **self._page_kw())
             for (req, t0, slot, _), host in zip(pend, hosts):
                 tok, lp, top = self._finish_prefill_complete(slot, host)
                 self._emit(req, tok, logprob=lp, top=top)
             pend.clear()
+            pend_js.clear()
 
         for rid, slot in prefill_plan:
             p = self._do_prefill(rid, slot, defer=True)
             if p is not None:
                 pend.append(p)
+                pend_js.append(self._last_jit)
+                self._last_jit = None
             if len(pend) >= self.PREFILL_FLUSH:
                 flush()
         if pend:
@@ -1486,11 +1563,17 @@ class InferenceEngine:
                                            pos0=len(p_ids))
         else:
             padded = suffix + [0] * (width - len(suffix))
-            logits, self.cache = prefill_slot_prefixed(
-                self.params, jnp.asarray([padded], jnp.int32),
-                jnp.asarray([len(suffix)], jnp.int32), jnp.int32(slot),
-                pk, pv, self.cache, self.rope, self.config,
-            )
+            fargs = (self.params, jnp.asarray([padded], jnp.int32),
+                     jnp.asarray([len(suffix)], jnp.int32),
+                     jnp.int32(slot), pk, pv, self.cache, self.rope,
+                     self.config)
+            js = self._obs_jit("prefill_prefixed",
+                               (width, int(pk.shape[2])),
+                               prefill_slot_prefixed, fargs)
+            t0 = time.perf_counter()
+            logits, self.cache = prefill_slot_prefixed(*fargs)
+            js.finish(time.perf_counter() - t0)
+            self._last_jit = js
         return self._finish_prefill(logits, slot, len(ids), temp,
                                     top_p, penalty, prime, n_top=n_top,
                                     defer=defer)
@@ -1502,10 +1585,14 @@ class InferenceEngine:
         padded = ids + [0] * (bucket - len(ids))
         toks = jnp.asarray([padded], jnp.int32)
         plen = jnp.asarray([len(ids)], jnp.int32)
-        logits, self.cache = self._prefill_slot(
-            self.params, toks, plen, jnp.int32(slot), self.cache,
-            self.rope, self.config,
-        )
+        fargs = (self.params, toks, plen, jnp.int32(slot), self.cache,
+                 self.rope, self.config)
+        js = self._obs_jit("prefill_slot", (bucket,),
+                           self._prefill_slot, fargs)
+        t0 = time.perf_counter()
+        logits, self.cache = self._prefill_slot(*fargs)
+        js.finish(time.perf_counter() - t0)
+        self._last_jit = js
         if self._spec:
             # the draft's KV must cover the prompt too (its proposals
             # attend the same positions the target verifies)
@@ -1595,12 +1682,16 @@ class InferenceEngine:
         from cake_tpu.models.llama.generator import chunk_windows
         logits = None
         for window, n_real, start in chunk_windows(ids, C):
-            logits, self.cache = self._prefill_chunk_step(
-                self.params, jnp.asarray([window], jnp.int32),
-                jnp.asarray([n_real], jnp.int32), jnp.int32(slot),
-                jnp.int32(pos0 + start), self.cache, self.rope,
-                self.config,
-            )
+            fargs = (self.params, jnp.asarray([window], jnp.int32),
+                     jnp.asarray([n_real], jnp.int32), jnp.int32(slot),
+                     jnp.int32(pos0 + start), self.cache, self.rope,
+                     self.config)
+            js = self._obs_jit("prefill_chunk", (C,),
+                               self._prefill_chunk_step, fargs)
+            t0 = time.perf_counter()
+            logits, self.cache = self._prefill_chunk_step(*fargs)
+            js.finish(time.perf_counter() - t0)
+            self._last_jit = js
         return logits
 
     def _do_decode_spec(self, decode_plan) -> None:
@@ -1648,18 +1739,27 @@ class InferenceEngine:
                     jnp.int32)
             else:
                 last, pos = state
+            fargs = (self.params, self.draft_params, self.cache,
+                     self.d_cache, last, pos, active_dev, self._keys,
+                     temp_dev, self.rope, self.d_rope, self.config,
+                     self.draft_config, g)
+            js = self._obs_jit("spec_round", (g,), spec_round_batched,
+                               fargs)
+            t0d = time.perf_counter()
             (out, n_emit, self.cache, self.d_cache, self._keys,
-             state_o) = spec_round_batched(
-                self.params, self.draft_params, self.cache,
-                self.d_cache, last, pos, active_dev, self._keys,
-                temp_dev, self.rope, self.d_rope, self.config,
-                self.draft_config, g)
-            return (out, n_emit), state_o
+             state_o) = spec_round_batched(*fargs)
+            disp = time.perf_counter() - t0d
+            js.finish(disp)
+            return (out, n_emit, disp, js), state_o
 
         def complete(devs):
+            out_d, n_emit_d, disp_k, js_k = devs
             # ONE batched fetch for every slot's round (a
             # remote-dispatch tunnel charges ~100ms per round-trip)
-            out_h, n_emit_h = jax.device_get(devs)
+            t0f = time.perf_counter()
+            out_h, n_emit_h = jax.device_get((out_d, n_emit_d))
+            fetch = time.perf_counter() - t0f
+            round_tokens = 0
             for req, slot in plan:
                 if req.done.is_set():
                     # chained round dispatched before this req's EOS /
@@ -1667,6 +1767,7 @@ class InferenceEngine:
                     # too: post-EOS rounds condition on garbage)
                     continue
                 n = int(n_emit_h[slot])
+                round_tokens += n
                 toks = [int(t) for t in out_h[slot, :n]]
                 self.stats.spec_proposed += g
                 self.stats.spec_accepted += n - 1
@@ -1688,6 +1789,10 @@ class InferenceEngine:
                 # stale positions past it are masked like padding
                 self._pos[slot] = pos0 + n
             self.stats.steps += 1
+            self._record_step("spec", rows=len(plan),
+                              tokens=round_tokens, dispatch_s=disp_k,
+                              device_s=fetch, wall_s=disp_k + fetch,
+                              js=js_k)
 
         # double-buffered chained rounds (single-host; multi-host spec
         # has no engine), via the shared _drive_burst driver: round k+1
@@ -1743,6 +1848,8 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self.stats.decode_time_s += dt
         self._obs_paged_step("decode", dt)
+        self._record_step("decode", rows=len(decode_plan),
+                          tokens=len(decode_plan), wall_s=dt)
         self._step_stats.step(bytes_out=len(decode_plan))
         for rid, slot in decode_plan:
             req = self._slot_req[slot]
@@ -1764,10 +1871,13 @@ class InferenceEngine:
         toks = jnp.asarray(self._last_tok[:, None], jnp.int32)
         pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
                           jnp.int32)
-        logits, self.cache = self._decode_step(
-            self.params, toks, pos, jnp.asarray(active), self.cache,
-            self.rope, self.config,
-        )
+        fargs = (self.params, toks, pos, jnp.asarray(active), self.cache,
+                 self.rope, self.config)
+        js = self._obs_jit("decode_step", (), self._decode_step, fargs)
+        t0 = time.perf_counter()
+        logits, self.cache = self._decode_step(*fargs)
+        js.finish(time.perf_counter() - t0)
+        self._last_jit = js
         if self._multihost:
             logits = np.asarray(logits)  # see _finish_prefill
         nxt, lp, tids, tlps = self._sample_rows(logits, rows=rows,
@@ -1839,6 +1949,8 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self.stats.decode_time_s += dt
         self._obs_paged_step("decode", dt / n)
+        self._record_step("decode_scan", rows=len(decode_plan),
+                          tokens=int(budget.sum()), wall_s=dt)
         self._complete_scan(decode_plan, n, fetched, budget)
 
     def _decode_burst(self, decode_plan, n: int) -> None:
@@ -1877,16 +1989,27 @@ class InferenceEngine:
             # thread), and an explicit recompute keeps _drive_burst's
             # can_chain a pure gate
             budget = self._scan_budget(decode_plan, n, shipped)
+            t0d = time.perf_counter()
             outs, state = self._dispatch_scan_device(
                 rows, n, n_top, budget, state=state)
+            disp = time.perf_counter() - t0d
+            js, self._last_jit = self._last_jit, None
             for _, slot in decode_plan:
                 shipped[slot] = shipped.get(slot, 0) + int(budget[slot])
             self.stats.steps += n
-            return (outs, budget), state
+            return (outs, budget, disp, js), state
 
         def complete(devs):
-            outs_k, budget_k = devs
+            outs_k, budget_k, disp_k, js_k = devs
+            t0f = time.perf_counter()
             fetched = self._fetch_scan(outs_k)
+            fetch = time.perf_counter() - t0f
+            # one record per scan: its own dispatch wall (this scan's
+            # trace+enqueue) and the fetch wall as the device-side proxy
+            self._record_step("decode_scan", rows=len(rows),
+                              tokens=int(budget_k.sum()),
+                              dispatch_s=disp_k, device_s=fetch,
+                              wall_s=disp_k + fetch, js=js_k)
             self._complete_scan(decode_plan, n, fetched, budget_k)
             for _, slot in decode_plan:
                 shipped[slot] = (shipped.get(slot, 0)
@@ -1956,14 +2079,19 @@ class InferenceEngine:
         keys, ring = self._keys, self._ring
         if self._multihost:
             keys, ring = np.asarray(keys), np.asarray(ring)
+        fargs = (self.params, last_tok, pos, active, self.cache,
+                 self.rope, self.config, keys, ring, steps,
+                 jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                 jnp.asarray(self._penalty),
+                 jnp.asarray(budget, jnp.int32))
+        fkw = dict(num_steps=n, top_k=self.defaults.top_k, n_top=n_top)
+        js = self._obs_jit("decode_scan", (n, n_top),
+                           self._decode_scan_impl, fargs, fkw)
+        t0 = time.perf_counter()
         (toks, lps, tops_i, tops_l, self.cache, keys_o, ring_o,
-         state_o) = self._decode_scan_impl(
-            self.params, last_tok, pos, active, self.cache, self.rope,
-            self.config, keys, ring, steps,
-            jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._penalty), jnp.asarray(budget, jnp.int32),
-            num_steps=n, top_k=self.defaults.top_k, n_top=n_top,
-        )
+         state_o) = self._decode_scan_impl(*fargs, **fkw)
+        js.finish(time.perf_counter() - t0)
+        self._last_jit = js
         if self._multihost:
             keys_h, ring_h = jax.device_get((keys_o, ring_o))
             keys_o, ring_o = jnp.asarray(keys_h), jnp.asarray(ring_h)

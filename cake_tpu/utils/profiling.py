@@ -53,6 +53,46 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def capture_trace(seconds: float, out_dir: Optional[str] = None) -> dict:
+    """Capture a jax.profiler trace of the NEXT `seconds` of live
+    execution (the POST /api/v1/profile backend, obs/steps.py): unlike
+    `trace(dir)` — which wraps a code block the caller controls — this
+    profiles whatever the process is doing right now (a serving engine
+    mid-decode), then returns where the artifacts landed.
+
+    out_dir: capture directory (created if missing); None makes a fresh
+    temp dir per capture. Returns {"dir", "perfetto_trace", "seconds"}
+    where perfetto_trace is the newest ``*.trace.json.gz`` under dir
+    (upload to ui.perfetto.dev), or None if the backend produced only
+    the TensorBoard artifacts."""
+    import os
+    import tempfile
+
+    d = out_dir or tempfile.mkdtemp(prefix="cake-profile-")
+    os.makedirs(d, exist_ok=True)
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(d, create_perfetto_trace=True)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    captured = time.perf_counter() - t0
+    newest, newest_mtime = None, -1.0
+    for root, _dirs, files in os.walk(d):
+        for name in files:
+            if name.endswith(".trace.json.gz"):
+                p = os.path.join(root, name)
+                try:
+                    m = os.path.getmtime(p)
+                except OSError:
+                    continue
+                if m > newest_mtime:
+                    newest, newest_mtime = p, m
+    log.info("profiler capture: %.2fs -> %s", captured, newest or d)
+    return {"dir": d, "perfetto_trace": newest,
+            "seconds": round(captured, 3)}
+
+
 def human_bytes(n: float) -> str:
     """1536 -> '1.5 KiB' (reference human_bytes crate semantics)."""
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):

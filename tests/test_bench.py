@@ -44,6 +44,10 @@ def test_smoke_tier_json_contract(tier):
     assert result["value"] > 0
     assert result["unit"] == "tokens/s"
     assert tier in result["metric"]
+    # utilization keys (obs/steps.py tables): an empty-utilization
+    # BENCH round must fail loudly, not regress to tok/s-only
+    assert 0 < result["mfu"] <= 1.0
+    assert 0 < result["hbm_util"] <= 1.0
 
 
 @pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)
@@ -61,6 +65,10 @@ def test_engine_smoke_tier_reports_ttft():
     assert result["ttft_p50_ms"] > 0
     assert result["engine_decode_tok_s"] > 0
     assert result["engine_streams"] == 2
+    # measured utilization from the step flight recorder: the keys must
+    # exist AND carry cost-analysis-backed values on the CPU lane too
+    assert 0 < result["mfu"] <= 1.0
+    assert 0 < result["hbm_util"] <= 1.0
 
 
 @pytest.mark.slow  # heaviest cases -> slow lane (tier-1 wall budget)

@@ -103,6 +103,44 @@ def test_duplicate_type_flagged():
     assert any("duplicate TYPE" in e for e in errs)
 
 
+def test_step_metric_families_documented_in_readme():
+    """The obs/steps.py satellite contract: every cake_step_* /
+    cake_steps_* / cake_jit_* / cake_device_* family must be registered
+    with real help text AND appear in the README metrics table — an
+    undocumented telemetry metric fails tier-1 here."""
+    lm = _load()
+    import cake_tpu.obs.steps  # noqa: F401 — registers the families
+    from cake_tpu.obs import metrics as m
+    readme = (TOOLS.parent / "README.md").read_text()
+    text = m.REGISTRY.render()
+    assert any(line.startswith("# TYPE cake_steps_total")
+               for line in text.splitlines()), "steps module families"
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs
+
+
+def test_readme_coverage_flags_undocumented_and_helpless():
+    lm = _load()
+    exposition = "\n".join([
+        "# HELP cake_step_bogus cake_step_bogus",   # help == name
+        "# TYPE cake_step_bogus gauge",
+        "cake_step_bogus 1",
+        "# HELP cake_device_mystery real help text",
+        "# TYPE cake_device_mystery gauge",
+        "cake_device_mystery 2",
+    ])
+    errs = lm.lint_readme_coverage(exposition, "nothing documented")
+    assert any("cake_step_bogus" in e and "help" in e for e in errs)
+    assert any("cake_device_mystery" in e and "README" in e
+               for e in errs)
+    # a documented family with real help passes
+    errs = lm.lint_readme_coverage(
+        "# HELP cake_step_ok good help\n# TYPE cake_step_ok gauge\n"
+        "cake_step_ok 1\n",
+        "table mentions cake_step_ok here")
+    assert errs == []
+
+
 def test_registry_render_always_lints_clean():
     """Renderer <-> linter contract, including edge-case label values."""
     lm = _load()

@@ -90,3 +90,30 @@ def test_cli_on_fixture_file(tmp_path):
     assert tool.main([str(p)]) == 0
     assert tool.main([str(p), "--max-total", "700"]) == 1
     assert tool.main([str(tmp_path / "missing.log")]) == 2
+
+
+def test_json_summary_mode(tmp_path, capsys):
+    import json
+    tool = _load()
+    p = tmp_path / "t1.log"
+    p.write_text(GOOD_LOG)
+    assert tool.main([str(p), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s["rc"] == 0
+    assert s["total_s"] == 729.36
+    assert s["n_durations"] == 3
+    assert s["violations"] == []
+    # over-budget verdict carries the violation in the JSON, rc stays 1
+    assert tool.main([str(p), "--json", "--max-total", "700"]) == 1
+    s = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s["rc"] == 1 and any("700" in v for v in s["violations"])
+    # truncated log: rc 2 with a parseable line (never a traceback)
+    q = tmp_path / "trunc.log"
+    q.write_text("....\n5.0s call tests/t.py::x\n")
+    assert tool.main([str(q), "--json"]) == 2
+    s = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s["rc"] == 2 and s["total_s"] is None
+    # missing file in json mode: still one JSON line
+    assert tool.main([str(tmp_path / "nope.log"), "--json"]) == 2
+    s = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert s["rc"] == 2

@@ -21,6 +21,12 @@ is checked (and their absence is noted).
 Usage:
     python tools/check_t1_budget.py /tmp/_t1.log
     python tools/check_t1_budget.py --max-test 15 --max-total 840 LOG
+    python tools/check_t1_budget.py --json /tmp/_t1.log   # one JSON line
+
+``--json`` prints ONE machine-readable summary line on stdout
+({"rc", "total_s", "violations", "warnings", "n_durations"}) with the
+human messages folded into the lists — for CI steps that want to attach
+the budget verdict to a build artifact instead of grepping stdout.
 
 Exit status: 0 = within budget, 1 = over budget, 2 = no parseable
 pytest summary in the log (a truncated/killed run is itself a failure:
@@ -65,40 +71,68 @@ def parse_log(text: str) -> Tuple[float | None, List[Tuple[float, str, str]]]:
     return total, durations
 
 
-def check(text: str, max_test: float, max_total: float,
-          warn_frac: float, out=sys.stdout, err=sys.stderr) -> int:
+def summarize(text: str, max_test: float, max_total: float,
+              warn_frac: float) -> dict:
+    """Pure verdict: {"rc", "total_s", "violations", "warnings",
+    "n_durations"} — the single source both output modes render."""
     total, durations = parse_log(text)
     if total is None:
-        print("BUDGET: no pytest summary line found — truncated or "
-              "killed run (the 870s timeout produces exactly this)",
-              file=err)
-        return 2
-    rc = 0
+        return {
+            "rc": 2, "total_s": None, "n_durations": len(durations),
+            "violations": [
+                "no pytest summary line found — truncated or killed "
+                "run (the 870s timeout produces exactly this)"],
+            "warnings": [],
+        }
+    violations, warnings = [], []
     for secs, phase, test in durations:
         if secs > max_test:
-            print(f"BUDGET FAIL: {test} {phase} took {secs:.1f}s "
-                  f"(> {max_test:.0f}s per-test cap)", file=out)
-            rc = 1
+            violations.append(
+                f"{test} {phase} took {secs:.1f}s "
+                f"(> {max_test:.0f}s per-test cap)")
     if total > max_total:
-        print(f"BUDGET FAIL: suite total {total:.1f}s exceeds "
-              f"{max_total:.0f}s (the lane is killed at 870s)",
-              file=out)
-        rc = 1
+        violations.append(
+            f"suite total {total:.1f}s exceeds {max_total:.0f}s "
+            "(the lane is killed at 870s)")
     elif total > warn_frac * max_total:
-        print(f"BUDGET WARN: suite total {total:.1f}s is above "
-              f"{warn_frac:.0%} of the {max_total:.0f}s budget — "
-              "move heavy tests to -m slow before the lane times out",
-              file=err)
+        warnings.append(
+            f"suite total {total:.1f}s is above {warn_frac:.0%} of "
+            f"the {max_total:.0f}s budget — move heavy tests to "
+            "-m slow before the lane times out")
     if not durations:
-        print("BUDGET: no --durations lines in the log; only the "
-              "suite total was checked (run pytest with --durations=25 "
-              "for per-test enforcement)", file=err)
-    if rc == 0:
-        n = len(durations)
-        print(f"BUDGET OK: total {total:.1f}s <= {max_total:.0f}s"
+        warnings.append(
+            "no --durations lines in the log; only the suite total "
+            "was checked (run pytest with --durations=25 for per-test "
+            "enforcement)")
+    return {
+        "rc": 1 if violations else 0, "total_s": total,
+        "n_durations": len(durations),
+        "violations": violations, "warnings": warnings,
+    }
+
+
+def check(text: str, max_test: float, max_total: float,
+          warn_frac: float, out=sys.stdout, err=sys.stderr,
+          as_json: bool = False) -> int:
+    s = summarize(text, max_test, max_total, warn_frac)
+    if as_json:
+        import json
+        print(json.dumps(s), file=out)
+        return s["rc"]
+    if s["rc"] == 2:
+        print("BUDGET: " + s["violations"][0], file=err)
+        return 2
+    for v in s["violations"]:
+        print(f"BUDGET FAIL: {v}", file=out)
+    for w in s["warnings"]:
+        print(f"BUDGET WARN: {w}", file=err)
+    if s["rc"] == 0:
+        n = s["n_durations"]
+        print(f"BUDGET OK: total {s['total_s']:.1f}s <= "
+              f"{max_total:.0f}s"
               + (f"; slowest of {n} phases within {max_test:.0f}s"
                  if n else ""), file=out)
-    return rc
+    return s["rc"]
 
 
 def main(argv=None) -> int:
@@ -111,6 +145,9 @@ def main(argv=None) -> int:
     ap.add_argument("--warn-frac", type=float, default=0.9,
                     help="warn when total exceeds this fraction of "
                          "--max-total (default 0.9)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable JSON summary line "
+                         "instead of the human messages")
     args = ap.parse_args(argv)
     if args.log == "-":
         text = sys.stdin.read()
@@ -119,10 +156,18 @@ def main(argv=None) -> int:
             with open(args.log, errors="replace") as f:
                 text = f.read()
         except OSError as e:
-            print(f"BUDGET: cannot read {args.log}: {e}",
-                  file=sys.stderr)
+            if args.json:
+                import json
+                print(json.dumps({
+                    "rc": 2, "total_s": None, "n_durations": 0,
+                    "violations": [f"cannot read {args.log}: {e}"],
+                    "warnings": []}))
+            else:
+                print(f"BUDGET: cannot read {args.log}: {e}",
+                      file=sys.stderr)
             return 2
-    return check(text, args.max_test, args.max_total, args.warn_frac)
+    return check(text, args.max_test, args.max_total, args.warn_frac,
+                 as_json=args.json)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,5 @@
-"""On-chip engine dispatch profiler.
+#!/usr/bin/env python3
+"""On-chip engine dispatch profiler, driven by the step flight recorder.
 
 Times the pieces the aggregate engine number is made of, to attribute
 throughput between device compute and host<->device dispatch latency
@@ -7,156 +8,196 @@ tier's on-device `lax.scan` loop pays it once, the engine pays it per
 step/scan):
 
   - raw dispatch RTT: a trivial jitted op, timed per round-trip
-  - per-prefill dispatch time
-  - per-scan (K-step) and per-single-step decode dispatch time
-  - decode token accounting: how many tokens came from scans vs singles
+  - per-kind step timing (prefill / decode / decode_scan) straight from
+    the engine's own flight recorder (obs/steps.py) — no hand-timed
+    monkeypatching of dispatch internals, so the numbers are exactly
+    what GET /api/v1/steps would report for the same run
+  - per-step MFU / HBM utilization and jit compile counts
+  - decode token accounting: tokens from scans vs single steps
 
-Usage:  python tools/engine_profile.py [model] [slots] [gen_tokens] \
-            [int8|int4|bf16]      # weight quant; default int8 for 8b
+Usage:
+    python tools/engine_profile.py [model] [slots] [gen_tokens] [quant]
+    python tools/engine_profile.py 8b 16 64 int8 --json
+
+With --json the report is ONE machine-readable JSON line on stdout
+(human narration stays on stderr); without it, everything goes to
+stderr as before.
 """
 from __future__ import annotations
 
+import argparse
+import json
 import sys
 import time
 from functools import partial
+from pathlib import Path
 
-sys.path.insert(0, ".")
+# resolve the repo root from this file, not the caller's cwd — the old
+# sys.path.insert(0, ".") hack broke the tool whenever it was launched
+# from anywhere but the repo root
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
 
-import jax
-import jax.numpy as jnp
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
 
-import bench
-from cake_tpu.models.llama.generator import ByteTokenizer
-from cake_tpu.ops.sampling import SamplingConfig
-from cake_tpu.serve.engine import InferenceEngine
+import bench                                                # noqa: E402
+from cake_tpu.models.llama.generator import ByteTokenizer   # noqa: E402
+from cake_tpu.obs import metrics as obs_metrics             # noqa: E402
+from cake_tpu.ops.sampling import SamplingConfig            # noqa: E402
+from cake_tpu.serve.engine import InferenceEngine           # noqa: E402
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main():
-    model = sys.argv[1] if len(sys.argv) > 1 else "8b"
-    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    gen_tokens = int(sys.argv[3]) if len(sys.argv) > 3 else 64
-    quant_s = sys.argv[4] if len(sys.argv) > 4 else (
-        "int8" if model == "8b" else "bf16")
-    if quant_s not in ("int8", "int4", "bf16"):
-        raise SystemExit(f"quant must be int8|int4|bf16, got {quant_s!r}")
-    quant = False if quant_s == "bf16" else quant_s
-
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform}/{dev.device_kind}")
-
-    # --- raw dispatch RTT ---
+def _measure_rtt(n_rtt: int = 20) -> tuple[float, float]:
+    """(blocking RTT, async chained dispatch) of a trivial jitted op."""
     f = jax.jit(lambda x: x + 1)
     x = jnp.zeros((8,), jnp.int32)
     x = f(x)
     jax.block_until_ready(x)
     t0 = time.perf_counter()
-    n_rtt = 20
     for _ in range(n_rtt):
         x = f(x)
         jax.block_until_ready(x)
     rtt = (time.perf_counter() - t0) / n_rtt
-    log(f"raw dispatch RTT (tiny jit, block each): {rtt * 1e3:.1f} ms")
-
-    # async dispatch depth: issue 20 without blocking, then block once
     t0 = time.perf_counter()
     for _ in range(n_rtt):
         x = f(x)
     jax.block_until_ready(x)
     async_rtt = (time.perf_counter() - t0) / n_rtt
-    log(f"async chained dispatch (block once): {async_rtt * 1e3:.1f} ms/op")
+    return rtt, async_rtt
 
-    cfg = bench.make_config(model)
+
+def _jit_compile_counts() -> dict:
+    """Current cake_jit_compiles_total{fn} values from the registry."""
+    fam = obs_metrics.REGISTRY.get("cake_jit_compiles_total")
+    if fam is None:
+        return {}
+    return {labels[0]: value
+            for labels, value in fam.samples().items() if labels}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Engine dispatch profiler over the step flight "
+                    "recorder")
+    ap.add_argument("model", nargs="?", default="8b",
+                    help="model size (8b|3b|1b|tiny; default 8b)")
+    ap.add_argument("slots", nargs="?", type=int, default=16)
+    ap.add_argument("gen_tokens", nargs="?", type=int, default=64)
+    ap.add_argument("quant", nargs="?", default=None,
+                    choices=("int8", "int4", "bf16"),
+                    help="weight quant; default int8 for 8b, bf16 else")
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--decode-scan", type=int, default=8)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary line on stdout")
+    args = ap.parse_args(argv)
+
+    quant_s = args.quant or ("int8" if args.model == "8b" else "bf16")
+    quant = False if quant_s == "bf16" else quant_s
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    rtt, async_rtt = _measure_rtt()
+    log(f"raw dispatch RTT (tiny jit, block each): {rtt * 1e3:.1f} ms")
+    log(f"async chained dispatch (block once): {async_rtt * 1e3:.1f} "
+        "ms/op")
+
+    cfg = bench.make_config(args.model)
     init, desc = bench._init_fn(quant)
     log(f"weights: {desc}")
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
 
     engine = InferenceEngine(
-        cfg, params, ByteTokenizer(cfg.vocab_size), max_slots=slots,
-        max_seq_len=512,
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        max_slots=args.slots, max_seq_len=args.max_seq,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
-        decode_scan_steps=8,
+        decode_scan_steps=args.decode_scan,
+        # the measured run must fit in the ring (one record per step)
+        step_ring=max(4096, args.slots * args.gen_tokens + 64),
     )
 
-    # spy on the DISPATCH/FETCH primitives, not the high-level wrappers:
-    # single-host multi-step decode routes through _decode_burst (which
-    # calls _dispatch_scan_device/_fetch_scan directly), and prefill
-    # admission goes through _do_prefill(..., defer=True)
-    times = {"prefill": [], "scan_dispatch": [], "scan_fetch": [],
-             "single": []}
-    counts = {"scan_tokens": 0, "single_tokens": 0}
-
-    orig_prefill = engine._do_prefill
-    orig_dispatch = engine._dispatch_scan_device
-    orig_fetch = engine._fetch_scan
-    orig_dec = engine._do_decode
-
-    def prefill(rid, slot, defer=False):
-        t = time.perf_counter()
-        r = orig_prefill(rid, slot, defer=defer)
-        times["prefill"].append(time.perf_counter() - t)
-        return r
-
-    def dispatch(rows, n, n_top, budget, state=None):
-        t = time.perf_counter()
-        r = orig_dispatch(rows, n, n_top, budget, state=state)
-        times["scan_dispatch"].append(time.perf_counter() - t)
-        counts["scan_tokens"] += int(sum(budget))
-        return r
-
-    def fetch(outs):
-        t = time.perf_counter()
-        r = orig_fetch(outs)
-        times["scan_fetch"].append(time.perf_counter() - t)
-        return r
-
-    def dec(plan):
-        t = time.perf_counter()
-        r = orig_dec(plan)
-        times["single"].append(time.perf_counter() - t)
-        counts["single_tokens"] += len(plan)
-        return r
-
-    engine._do_prefill = prefill
-    engine._dispatch_scan_device = dispatch
-    engine._fetch_scan = fetch
-    engine._do_decode = dec
-
-    prompt = list(range(3, 3 + 64))
+    prompt = list(range(3, 3 + args.prompt_len))
     with engine:
         t0 = time.perf_counter()
         warm = engine.submit(prompt, max_new_tokens=32)
         assert warm.wait(timeout=900)
         log(f"warmup: {time.perf_counter() - t0:.1f}s")
-        for k in times:
-            times[k].clear()
-        counts["scan_tokens"] = counts["single_tokens"] = 0
+        warm_steps = engine.flight.summary()["recorded_steps"]
         base = engine.stats.tokens_generated
         t0 = time.perf_counter()
-        handles = [engine.submit(prompt, max_new_tokens=gen_tokens)
-                   for _ in range(slots)]
+        handles = [engine.submit(prompt, max_new_tokens=args.gen_tokens)
+                   for _ in range(args.slots)]
         assert all(h.wait(timeout=900) for h in handles)
         wall = time.perf_counter() - t0
         toks = engine.stats.tokens_generated - base
+        # measured window = everything the recorder saw after warmup;
+        # utilization uses the same window (compile steps excluded), so
+        # the JSON's mfu agrees with its own per-kind table
+        recs = [r for r in engine.flight.dump()
+                if r["step"] > warm_steps]
+        summary = engine.flight.summary()
+        util = engine.flight.utilization(since_step=warm_steps)
 
-    for k, v in times.items():
-        if not v:
-            log(f"{k:8s}: 0 dispatches")
-            continue
-        tot = sum(v)
-        log(f"{k:8s}: {len(v):4d} dispatches, total {tot:6.2f}s, "
-            f"mean {tot / len(v) * 1e3:7.1f} ms, "
-            f"min {min(v) * 1e3:7.1f} ms, max {max(v) * 1e3:7.1f} ms")
-    log(f"tokens: {toks} ({counts['scan_tokens']} scanned, "
-        f"{counts['single_tokens']} single)")
+    by_kind: dict = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    for kind, rs in sorted(by_kind.items()):
+        d = [r["dispatch_s"] for r in rs]
+        tot = sum(d)
+        log(f"{kind:12s}: {len(rs):4d} steps, total {tot:6.2f}s, "
+            f"mean {tot / len(rs) * 1e3:7.1f} ms, "
+            f"min {min(d) * 1e3:7.1f} ms, max {max(d) * 1e3:7.1f} ms, "
+            f"{sum(r['tokens'] for r in rs)} tokens")
+    scan_tokens = sum(r["tokens"] for r in by_kind.get("decode_scan", []))
+    single_tokens = sum(r["tokens"] for r in by_kind.get("decode", []))
+    log(f"tokens: {toks} ({scan_tokens} scanned, {single_tokens} single)")
     log(f"wall: {wall:.2f}s -> {toks / wall:.1f} tok/s incl. prefill")
+    log(f"utilization: mfu {util['mfu']:.4f}, "
+        f"hbm_util {util['hbm_util']:.4f}")
+    compiles = _jit_compile_counts()
+    log(f"jit compiles: {compiles}")
     ttfts = sorted(h.ttft for h in handles)
-    log(f"TTFT p50 {ttfts[len(ttfts) // 2] * 1e3:.0f} ms")
+    p50 = ttfts[len(ttfts) // 2]
+    log(f"TTFT p50 {p50 * 1e3:.0f} ms")
+
+    if args.json:
+        print(json.dumps({
+            "device_kind": dev.device_kind,
+            "model": args.model,
+            "quant": quant_s,
+            "slots": args.slots,
+            "gen_tokens": args.gen_tokens,
+            "raw_rtt_ms": round(rtt * 1e3, 2),
+            "async_rtt_ms": round(async_rtt * 1e3, 2),
+            "tokens": toks,
+            "tok_s_incl_prefill": round(toks / wall, 2),
+            "ttft_p50_ms": round(p50 * 1e3, 1),
+            "scan_tokens": scan_tokens,
+            "single_tokens": single_tokens,
+            "kinds": {
+                kind: {
+                    "steps": len(rs),
+                    "mean_dispatch_ms": round(
+                        sum(r["dispatch_s"] for r in rs) / len(rs) * 1e3,
+                        2),
+                    "tokens": sum(r["tokens"] for r in rs),
+                } for kind, rs in sorted(by_kind.items())
+            },
+            "mfu": util["mfu"],
+            "hbm_util": util["hbm_util"],
+            "jit_compiles": compiles,
+            "flight_summary": summary,
+        }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

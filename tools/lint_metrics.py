@@ -16,8 +16,16 @@ never ship):
     ``_count == +Inf bucket``;
   * counter samples are finite and non-negative.
 
+Additionally, step-telemetry metric families (``cake_step_*``,
+``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``) must carry real
+help text (not just an echoed name) and appear in the README metrics
+table — pass ``--readme README.md`` to enforce it (the tier-1 hook in
+tests/test_metrics_lint.py does, so an undocumented telemetry metric
+fails the fast lane).
+
 Usage:
     python tools/lint_metrics.py FILE          # or '-' for stdin
+    python tools/lint_metrics.py FILE --readme README.md
     python tools/lint_metrics.py --url http://HOST:PORT/api/v1/metrics
 
 Exit status 0 = clean, 1 = violations (printed one per line).
@@ -40,6 +48,11 @@ LABEL_PAIR_RE = re.compile(
     r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
 VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# step-telemetry families that MUST be documented (help text + README
+# metrics table row) — the obs/steps.py surface
+DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
+                       "cake_device_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
@@ -220,10 +233,60 @@ def lint(text: str) -> List[str]:
     return errors
 
 
+def lint_readme_coverage(text: str, readme_text: str,
+                         prefixes=DOCUMENTED_PREFIXES) -> List[str]:
+    """Documentation lint for the step-telemetry families: every
+    ``# TYPE`` family matching `prefixes` must (a) have a HELP line
+    whose text is more than the echoed metric name — the registry
+    defaults help to the name, so an undocumented registration is
+    detectable — and (b) appear verbatim somewhere in the README (the
+    metrics table). Returns human-readable violations (empty = clean).
+    """
+    errors: List[str] = []
+    helps: Dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+    for line in text.splitlines():
+        if not line.startswith("# TYPE "):
+            continue
+        parts = line.split()
+        if len(parts) != 4:
+            continue
+        name = parts[2]
+        if not name.startswith(prefixes):
+            continue
+        help_text = helps.get(name, "")
+        if not help_text or help_text.strip() == name:
+            errors.append(
+                f"{name}: telemetry metric registered without help "
+                "text (pass help= to counter()/gauge()/histogram())")
+        if name not in readme_text:
+            errors.append(
+                f"{name}: telemetry metric missing from the README "
+                "metrics table (document every cake_step_*/cake_jit_*/"
+                "cake_device_* series)")
+    return errors
+
+
 def main(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 1
+    readme_path = None
+    if "--readme" in argv:
+        i = argv.index("--readme")
+        if i + 1 >= len(argv):
+            print("--readme needs a path", file=sys.stderr)
+            return 2
+        readme_path = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+        if not argv:
+            print("--readme needs an exposition input too "
+                  "(FILE, '-', or --url URL)", file=sys.stderr)
+            return 2
     if argv[0] == "--url":
         import urllib.request
         text = urllib.request.urlopen(argv[1], timeout=10).read().decode()
@@ -233,6 +296,9 @@ def main(argv: List[str]) -> int:
         with open(argv[0]) as f:
             text = f.read()
     errors = lint(text)
+    if readme_path is not None:
+        with open(readme_path) as f:
+            errors += lint_readme_coverage(text, f.read())
     for e in errors:
         print(e)
     if not errors:
