@@ -131,9 +131,30 @@ PAGED_TIERS = {
                                  kv_page_size=128, paged_attn="pallas"),
 }
 
+# Paged prefix-sharing tiers (bench.py --paged-prefix): N streams share
+# a 1k-token system prompt through a --kv-pages engine — the tier
+# measures the page-granular prefix-sharing win on BOTH axes: TTFT
+# (suffix-only prefill vs whole-prompt prefill, same engine) and pool
+# capacity (pages_shared = prefix pages the pool did not have to spend
+# per slot). One engine, two measured phases (unshared first, then
+# register + shared), each phase warmed so compiles stay out of TTFT.
+PAGED_PREFIX_TIERS = {
+    # 1024-token prefix = 8 x 128-token pages; 8 streams would cost 64
+    # prefix pages unshared, 8 shared — the pool is sized so BOTH
+    # phases fit, making the delta pure sharing, not admission stalls
+    "paged_prefix_8b_int8": dict(model="8b", quant="int8", max_seq=2048,
+                                 slots=8, kv_pages=96, kv_page_size=128,
+                                 paged_attn="pallas", prefix_len=1024,
+                                 suffix_len=64, gen_tokens=16),
+}
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
+    "paged_prefix_tiny": dict(model="tiny", quant=False, max_seq=128,
+                              slots=2, kv_pages=16, kv_page_size=16,
+                              paged_attn="fold", prefix_len=32,
+                              suffix_len=8, gen_tokens=4),
     "paged_tiny_fold": dict(model="tiny", quant=False, max_seq=128,
                             slots=2, kv_pages=16, kv_page_size=16,
                             paged_attn="fold", prompt_len=16,
@@ -480,6 +501,101 @@ def run_paged_tier(name: str, model: str, quant, max_seq: int,
     }
 
 
+def run_paged_prefix_tier(name: str, model: str, quant, max_seq: int,
+                          slots: int, kv_pages: int, kv_page_size: int,
+                          paged_attn: str, prefix_len: int,
+                          suffix_len: int, gen_tokens: int) -> dict:
+    """Page-granular prefix sharing: N streams share a long system
+    prompt through one --kv-pages engine. Phase 1 serves them unshared
+    (whole-prompt prefill); phase 2 registers the prefix and serves the
+    same workload suffix-only with the prefix pages mapped shared.
+    Reports TTFT p50 for both phases, whole vs suffix-only prefill
+    tok/s, and pages_shared (prefix pages the pool did not re-spend
+    per slot). Each phase is warmed with one request so jit compiles
+    stay out of the measured TTFT."""
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    engine = InferenceEngine(
+        cfg, params, ByteTokenizer(cfg.vocab_size), max_slots=slots,
+        max_seq_len=max_seq,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        kv_pages=kv_pages, kv_page_size=kv_page_size,
+        paged_attn=paged_attn,
+    )
+    V = cfg.vocab_size - 4
+    prefix = [(7 * i) % V + 3 for i in range(prefix_len)]
+
+    def suffix(stream: int):
+        return [(31 * stream + j) % V + 3 for j in range(suffix_len)]
+
+    def phase(tag: str, prefilled: int) -> tuple:
+        """Warm once, then serve `slots` concurrent streams; returns
+        (ttft_p50_s, prefill_tok_s, prefix_hits_delta). `prefilled` is
+        the tokens the engine actually COMPUTES per prompt — the whole
+        prompt unshared, only the suffix when the prefix pages are
+        mapped shared — so the tok/s numerator matches the work done."""
+        t0 = time.perf_counter()
+        warm = engine.submit(prefix + suffix(99), max_new_tokens=4)
+        assert warm.wait(timeout=900), f"{tag} warmup timed out"
+        log(f"{tag} warmup (compile): {time.perf_counter() - t0:.1f}s")
+        base_prefill_s = engine.stats.prefill_time_s
+        base_hits = engine.stats.prefix_hits
+        handles = [engine.submit(prefix + suffix(i),
+                                 max_new_tokens=gen_tokens)
+                   for i in range(slots)]
+        assert all(h.wait(timeout=900) for h in handles)
+        prefill_s = engine.stats.prefill_time_s - base_prefill_s
+        ttfts = sorted(h.ttft for h in handles)
+        p50 = ttfts[len(ttfts) // 2]
+        tokens = slots * prefilled
+        return (p50, tokens / prefill_s if prefill_s > 0 else 0.0,
+                engine.stats.prefix_hits - base_hits)
+
+    with engine:
+        p50_full, full_tok_s, _ = phase("unshared",
+                                        prefix_len + suffix_len)
+        engine.register_prefix(prefix)
+        p50_suffix, suffix_tok_s, hits = phase("shared", suffix_len)
+
+    n_pp = prefix_len // kv_page_size
+    pages_shared = hits * n_pp
+    log(f"prefix sharing[{paged_attn}]: TTFT p50 {p50_suffix*1e3:.1f}ms "
+        f"suffix-only vs {p50_full*1e3:.1f}ms whole-prompt; prefill "
+        f"{suffix_tok_s:.0f} vs {full_tok_s:.0f} tok/s; {hits} hits x "
+        f"{n_pp} prefix pages = {pages_shared} pages shared")
+    return {
+        "metric": f"{name}_prefix_ttft_p50_ms",
+        "value": round(p50_suffix * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "paged_attn": paged_attn,
+        "ttft_p50_shared_ms": round(p50_suffix * 1e3, 1),
+        "ttft_p50_unshared_ms": round(p50_full * 1e3, 1),
+        "prefill_suffix_tok_s": round(suffix_tok_s, 1),
+        "prefill_full_tok_s": round(full_tok_s, 1),
+        "pages_shared": pages_shared,
+        "prefix_hits": hits,
+        "prefix_tokens": prefix_len,
+        "kv_pages": kv_pages,
+        "kv_page_size": kv_page_size,
+        "prefix_streams": slots,
+        "device_kind": dev.device_kind,
+    }
+
+
 def run_sd_tier(name: str, version: str, height: int | None = None,
                 width: int | None = None, steps_a: int = 20,
                 steps_b: int = 40) -> dict:
@@ -624,7 +740,10 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in PAGED_TIERS or name.startswith("paged_tiny"):
+    if name in PAGED_PREFIX_TIERS or name.startswith("paged_prefix"):
+        kwargs = {**PAGED_PREFIX_TIERS, **SMOKE_TIERS}[name]
+        result = run_paged_prefix_tier(name, **kwargs)
+    elif name in PAGED_TIERS or name.startswith("paged_tiny"):
         kwargs = {**PAGED_TIERS, **SMOKE_TIERS}[name]
         result = run_paged_tier(name, **kwargs)
     elif (name in dict(ENGINE_TIERS) or name in dict(ENGINE_PEAK_TIERS)
@@ -744,6 +863,39 @@ def _run_tier_subprocess(name: str,
     return None
 
 
+def _single_tier_main(metric: str, unit: str, cpu_tier: str,
+                      tpu_tier: str, fail_error: str,
+                      extra: dict | None = None) -> int:
+    """THE probe → cpu-fallback → one-tier → one-JSON-line scaffold
+    shared by every `bench.py --<mode>` entry (the BENCH_r05 contract:
+    always emit one parseable line; rc 0 on an unreachable backend so a
+    perf-trajectory parser never sees an empty run). `metric`/`unit`
+    shape the error lines; `extra` rides every error line (e.g. the
+    chosen paged_attn impl)."""
+    info, env_extra = _probe_with_fallback()
+    if info is None:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "backend": "cpu_fallback",
+            "error": "no backend reachable (TPU and CPU probes failed)",
+            **(extra or {}),
+        }), flush=True)
+        return 0
+    on_cpu = env_extra is not None or info.get("platform") != "tpu"
+    name = cpu_tier if on_cpu else tpu_tier
+    result = _run_tier_subprocess(name, env_extra=env_extra)
+    if result is None:
+        print(json.dumps({
+            "metric": f"{name}_{metric}", "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "error": fail_error, **(extra or {}),
+        }), flush=True)
+        return 1
+    if env_extra is not None:
+        result["backend"] = "cpu_fallback"
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def _paged_main(impl: str) -> int:
     """`bench.py --paged-attn fold|pallas`: the paged-decode microbench
     — one tier, one JSON line, measuring the chosen attention impl
@@ -755,29 +907,21 @@ def _paged_main(impl: str) -> int:
             "error": f"--paged-attn takes fold or pallas, got {impl!r}",
         }), flush=True)
         return 2
-    info, env_extra = _probe_with_fallback()
-    if info is None:
-        print(json.dumps({
-            "metric": "paged_decode_tok_s", "value": 0.0,
-            "unit": "tokens/s", "vs_baseline": 0.0,
-            "backend": "cpu_fallback",
-            "error": "no backend reachable (TPU and CPU probes failed)",
-        }), flush=True)
-        return 0
-    on_cpu = env_extra is not None or info.get("platform") != "tpu"
-    name = f"paged_tiny_{impl}" if on_cpu else f"paged_8b_int8_{impl}"
-    result = _run_tier_subprocess(name, env_extra=env_extra)
-    if result is None:
-        print(json.dumps({
-            "metric": f"{name}_paged_decode_tok_s", "value": 0.0,
-            "unit": "tokens/s", "vs_baseline": 0.0, "paged_attn": impl,
-            "error": "paged microbench tier failed",
-        }), flush=True)
-        return 1
-    if env_extra is not None:
-        result["backend"] = "cpu_fallback"
-    print(json.dumps(result), flush=True)
-    return 0
+    return _single_tier_main(
+        "paged_decode_tok_s", "tokens/s",
+        cpu_tier=f"paged_tiny_{impl}", tpu_tier=f"paged_8b_int8_{impl}",
+        fail_error="paged microbench tier failed",
+        extra={"paged_attn": impl})
+
+
+def _paged_prefix_main() -> int:
+    """`bench.py --paged-prefix`: the paged prefix-sharing tier — one
+    JSON line with suffix-only vs whole-prompt TTFT and pages_shared
+    through a --kv-pages engine. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "prefix_ttft_p50_ms", "ms",
+        cpu_tier="paged_prefix_tiny", tpu_tier="paged_prefix_8b_int8",
+        fail_error="paged prefix tier failed")
 
 
 def main():
@@ -871,6 +1015,8 @@ if __name__ == "__main__":
         probe_main()
     elif os.environ.get(ORCH_ENV):
         tier_main()
+    elif "--paged-prefix" in sys.argv:
+        sys.exit(_paged_prefix_main())
     elif "--paged-attn" in sys.argv:
         i = sys.argv.index("--paged-attn")
         arg = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""

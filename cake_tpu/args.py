@@ -70,7 +70,9 @@ class Args:
                                         # (1 = reference depth-1 behavior)
     # prefill prompts in fixed windows of N tokens (one compiled program
     # for every prompt length; cache-aware flash attention per chunk);
-    # None = whole-prompt prefill with bucketed shapes
+    # None = whole-prompt prefill with bucketed shapes. Applies to the
+    # paged (--kv-pages) engine too: windows scatter into the slot's
+    # pages at any offset (models/llama/paged.prefill_slot_paged_chunk)
     prefill_chunk: Optional[int] = None
     # engine: when no request is queued, decode N tokens per host
     # round-trip as one on-device scan (amortizes dispatch latency);
@@ -109,12 +111,18 @@ class Args:
     heartbeat_timeout: float = 15.0
     # --auto-prefix: the API engine KV-caches each distinct system
     # prompt's rendered head once (serve/engine.register_prefix), so
-    # conversations sharing it prefill only their own turns
+    # conversations sharing it prefill only their own turns. On the
+    # paged (--kv-pages) engine the head is rounded down to a page
+    # boundary and its pages are mapped READ-ONLY into every matching
+    # slot's table row (page-granular prefix sharing: one copy in the
+    # pool, refcounted, however many slots share it)
     auto_prefix: bool = False
     # --kv-pages N: paged KV for the serving engine — KV lives in a pool
     # of N pages of --kv-page-size tokens; slot admission is gated by
     # free pages, so resident KV is bounded by the pool instead of
-    # max_slots x max_seq_len (models/llama/paged.py)
+    # max_slots x max_seq_len (models/llama/paged.py). Composes with
+    # --auto-prefix (shared prefix pages) and --prefill-chunk (windowed
+    # paged prefill)
     kv_pages: Optional[int] = None
     kv_page_size: int = 128
     # --paged-attn: attention impl for the paged (--kv-pages) engine —

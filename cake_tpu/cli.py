@@ -299,6 +299,14 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--step-log applies to engine serving (--api); one-shot "
             "generation records no step flight")
+    if args.kv_pages or args.auto_prefix:
+        # both live in the serving engine (paged pool / prefix
+        # registry); a one-shot generation silently ignoring them would
+        # look like the feature "did nothing"
+        logging.getLogger(__name__).warning(
+            "--kv-pages / --auto-prefix apply to engine serving "
+            "(--api); one-shot generation uses the sequential "
+            "generator's dense cache")
 
     if args.model_type.value == "image":
         count = [0]

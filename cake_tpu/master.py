@@ -179,6 +179,9 @@ class Master:
             seed=self.args.seed,
             decode_scan_steps=self.args.decode_scan,
             cache_dtype=g.cache.k.dtype,  # follow --kv-dtype
+            # honored by the paged (--kv-pages) engine too: prefixes
+            # prefill once into pool pages and map shared, and chunked
+            # prefill windows scatter into pages at any offset
             auto_prefix_system=getattr(self.args, "auto_prefix", False),
             # pass through unconditionally: the engine's own step_fns
             # guard warns when a pipelined path ignores the knob

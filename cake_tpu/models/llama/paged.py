@@ -89,32 +89,94 @@ class PagedKVCache(NamedTuple):
 
 
 class PageAllocator:
-    """Host-side free list. The ENGINE calls this at admission/retire —
-    allocation never happens on the device path, so the jitted steps see
-    only the (already-updated) table array."""
+    """Host-side free list with per-page refcounts. The ENGINE calls
+    this at admission/retire — allocation never happens on the device
+    path, so the jitted steps see only the (already-updated) table array.
+
+    Refcounts are what make page-granular PREFIX SHARING safe: a shared
+    prefix's pages appear in many slots' table rows, each mapping holds
+    one reference (`retain`), and `release` returns a page to the free
+    list only when its last holder lets go — a retiring request decrefs
+    shared pages instead of freeing another slot's live context.
+
+    The invariant `free_pages + live_pages == n_pages` holds after every
+    operation; violations (double-free, foreign page ids) raise instead
+    of silently corrupting the pool and masking leaks."""
 
     def __init__(self, n_pages: int, page_size: int):
         self.page_size = page_size
+        self.n_pages = n_pages
         self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        # page id -> refcount, for every currently-allocated page
+        self._refs: dict = {}
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def live_pages(self) -> int:
+        """Distinct pages currently allocated (each counted once however
+        many holders share it): free_pages + live_pages == n_pages."""
+        return len(self._refs)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
     def alloc(self, n_tokens: int) -> Optional[List[int]]:
-        """Pages covering n_tokens, or None when the pool is exhausted
-        (the caller keeps the request queued — admission control is the
-        whole point of paging)."""
+        """Pages covering n_tokens (each at refcount 1), or None when
+        the pool is exhausted (the caller keeps the request queued —
+        admission control is the whole point of paging)."""
         need = self.pages_for(n_tokens)
         if need > len(self._free):
             return None
-        return [self._free.pop() for _ in range(need)]
+        pages = [self._free.pop() for _ in range(need)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def retain(self, pages: List[int]) -> None:
+        """Add one reference to each (already-live) page — a slot
+        mapping a shared prefix's pages into its table row. Retaining a
+        free or foreign page is a bookkeeping bug: raise before the
+        table can alias dead storage."""
+        for p in pages:
+            if self._refs.get(p, 0) < 1:
+                raise ValueError(
+                    f"retain of page {p} which is not allocated "
+                    f"(refcount 0) — the mapping would alias freed "
+                    "storage")
+        for p in pages:
+            self._refs[p] += 1
+
+    def release(self, pages: List[int]) -> None:
+        """Drop one reference per page; a page returns to the free list
+        only at refcount 0. Raises on foreign ids and double-frees —
+        silently extending the free list would corrupt the pool (one
+        page handed to two slots) and mask the leak that caused it."""
+        for p in pages:
+            if not 0 <= p < self.n_pages:
+                raise ValueError(
+                    f"release of foreign page id {p!r} (pool has pages "
+                    f"0..{self.n_pages - 1})")
+        for p in pages:
+            n = self._refs.get(p, 0)
+            if n <= 0:
+                raise ValueError(
+                    f"double-free of page {p} (refcount already 0)")
+            if n == 1:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = n - 1
 
     def free(self, pages: List[int]) -> None:
-        self._free.extend(reversed(pages))
+        """Alias of release() — kept for call sites that predate
+        refcounting; same validation applies."""
+        self.release(pages)
 
 
 def table_set_slot(table: jnp.ndarray, slot: int,
@@ -157,6 +219,32 @@ def write_prompt_pages(pool_k, pool_v, k, v, table_row):
     vw = v[0].reshape(n_win, P, KV, hd)
     pk = pool_k.at[idx].set(kw.astype(pool_k.dtype), mode="drop")
     pv = pool_v.at[idx].set(vw.astype(pool_v.dtype), mode="drop")
+    return pk, pv
+
+
+def write_window_pages(pool_k, pool_v, k, v, table_row, pos0):
+    """Scatter one prefill window's KV ([1, C, KV, hd]) at absolute
+    position `pos0` into one slot's pages (per layer).
+
+    Unlike write_prompt_pages, pos0 need NOT be page-aligned: each of
+    the C positions resolves its own (page, offset) pair through the
+    table row, so chunked prefill windows may straddle page boundaries
+    at any offset. Distinct positions map to distinct targets, so one
+    vectorized scatter covers the window; positions past the slot's
+    mapped pages (bucket padding beyond the allocation, or past the
+    table entirely) route to the out-of-bounds index and mode="drop"
+    skips them — the paged analog of dense padding semantics."""
+    N, P = pool_k.shape[0], pool_k.shape[1]
+    C = k.shape[1]
+    max_pages = table_row.shape[0]
+    pos = pos0 + jnp.arange(C)
+    pidx = pos // P
+    pages = table_row[jnp.minimum(pidx, max_pages - 1)]
+    valid = jnp.logical_and(pidx < max_pages, pages >= 0)
+    idx = jnp.where(valid, pages, N)
+    offs = pos % P
+    pk = pool_k.at[idx, offs].set(k[0].astype(pool_k.dtype), mode="drop")
+    pv = pool_v.at[idx, offs].set(v[0].astype(pool_v.dtype), mode="drop")
     return pk, pv
 
 
@@ -371,6 +459,224 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     last = jnp.take_along_axis(
         x, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
+    return logits, PagedKVCache(k_new, v_new, cache.table)
+
+
+# -- prefix sharing + chunked prefill (page-granular) --------------------------
+
+
+@_partial(jax.jit, static_argnames=("config", "attn"),
+          donate_argnames=("cache",))
+def prefill_prefix_pages(params, tokens, table_row,
+                         cache: PagedKVCache, rope, config: LlamaConfig,
+                         attn: str = "fold"):
+    """Prefill a registered prefix ONCE into dedicated pool pages.
+
+    tokens: [1, S] with S the page-ALIGNED prefix length (the engine
+    rounds registrations down to a page boundary; remainder ids join
+    each request's suffix); table_row: [max_pages] int32 mapping the
+    prefix's dedicated pages (no engine slot involved — the row is a
+    standalone mapping, later copied into every matching slot's table
+    row head). Ordinary causal prefill at position 0 with each layer's
+    KV scattered into the mapped pages; logits are discarded (a
+    registered prefix is always a proper head, so the next token comes
+    from the suffix prefill). attn="pallas" routes the fresh-window
+    attention through the Pallas flash kernel like prefill_slot_paged.
+    Returns the updated cache."""
+    from cake_tpu.models.llama.model import block_skeleton
+    from cake_tpu.ops.attention import causal_mask, gqa_attention
+    from cake_tpu.ops.flash_attention import (
+        flash_attention, flash_supported,
+    )
+    from cake_tpu.ops.rope import apply_rope, rope_rows
+
+    B, S = tokens.shape
+    H = config.num_attention_heads
+    KV = config.num_key_value_heads
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows(rope.cos, rope.sin, jnp.int32(0), S)
+    use_flash = (attn == "pallas"
+                 and flash_supported(S, S, H, KV, hd=config.head_dim))
+    mask = None if use_flash else causal_mask(S)
+
+    def body(h, xs):
+        lp, pk, pv = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            pk2, pv2 = write_prompt_pages(pk, pv, k, v, table_row)
+            if use_flash:
+                return flash_attention(q, k, v, causal=True), (pk2, pv2)
+            return gqa_attention(q, k, v, mask=mask), (pk2, pv2)
+
+        h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)
+        return h, (pk2, pv2)
+
+    _, (k_new, v_new) = lax.scan(body, x,
+                                 (params["blocks"], cache.k, cache.v))
+    # final norm / lm_head skipped on purpose: only the KV matters here
+    return PagedKVCache(k_new, v_new, cache.table)
+
+
+@_partial(jax.jit, static_argnames=("config", "n_prefix", "attn"),
+          donate_argnames=("cache",))
+def prefill_slot_paged_prefixed(params, tokens, suffix_len, slot,
+                                cache: PagedKVCache, rope,
+                                config: LlamaConfig, n_prefix: int,
+                                attn: str = "fold"):
+    """Slot prefill continuing a POOL-RESIDENT shared prefix: prefill
+    only the suffix window, attending the fresh window causally PLUS the
+    prefix pages already mapped into the slot's table row head.
+
+    tokens: [1, S] right-padded suffix; suffix_len: [1] real length;
+    n_prefix: static page-aligned prefix token count — the slot's first
+    n_prefix // page_size table entries are the SHARED prefix pages
+    (read-only here: suffix KV scatters into the row's remaining pages
+    only, so one prefix page can back many slots). The prefix K/V are
+    gathered from their pages once per layer and concatenated with the
+    fresh window, giving dense-prefixed-prefill semantics without any
+    per-slot prefix copy. Compiles once per (suffix bucket, n_prefix)
+    pair — n_prefix is a registered-prefix property, so the set stays
+    small. attn="pallas" routes through the cache-aware flash kernel
+    (queries at pos n_prefix+i attend keys <= n_prefix+i); decode needs
+    no changes at all — the ragged kernel reads through the table."""
+    from cake_tpu.models.llama.model import block_skeleton
+    from cake_tpu.ops.attention import gqa_attention
+    from cake_tpu.ops.flash_attention import (
+        flash_attention_cached, flash_supported,
+    )
+    from cake_tpu.ops.norms import rms_norm
+    from cake_tpu.ops.quant import qmatmul
+    from cake_tpu.ops.rope import apply_rope, rope_rows
+
+    B, S = tokens.shape
+    H = config.num_attention_heads
+    KV = config.num_key_value_heads
+    hd = config.head_dim
+    P = cache.page_size
+    n_pp = n_prefix // P          # static: whole pages by contract
+    T = n_prefix + S
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows(rope.cos, rope.sin, jnp.int32(n_prefix), S)
+    table_row = jnp.take(cache.table, slot, axis=0)
+    prefix_pages = jnp.maximum(table_row[:n_pp], 0)
+    suffix_row = table_row[n_pp:]
+    use_flash = (attn == "pallas"
+                 and flash_supported(S, T, H, KV, hd=hd))
+    mask = (None if use_flash else
+            (jnp.arange(T)[None, :] <= n_prefix + jnp.arange(S)[:, None]))
+
+    def body(h, xs):
+        lp, pk, pv = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            pk2, pv2 = write_prompt_pages(pk, pv, k, v, suffix_row)
+            # gather the shared prefix pages (position-ordered by the
+            # row) into a dense [1, n_prefix, KV, hd] view — read-only,
+            # pre-write pool (prefix and suffix pages are disjoint)
+            kp = jnp.take(pk, prefix_pages, axis=0).reshape(
+                1, n_prefix, KV, hd).astype(q.dtype)
+            vp = jnp.take(pv, prefix_pages, axis=0).reshape(
+                1, n_prefix, KV, hd).astype(q.dtype)
+            k_full = jnp.concatenate([kp, k.astype(q.dtype)], axis=1)
+            v_full = jnp.concatenate([vp, v.astype(q.dtype)], axis=1)
+            if use_flash:
+                return (flash_attention_cached(q, k_full, v_full,
+                                               jnp.int32(n_prefix)),
+                        (pk2, pv2))
+            return gqa_attention(q, k_full, v_full, mask=mask), (pk2, pv2)
+
+        h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)
+        return h, (pk2, pv2)
+
+    x, (k_new, v_new) = lax.scan(body, x,
+                                 (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, (suffix_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
+    return logits, PagedKVCache(k_new, v_new, cache.table)
+
+
+@_partial(jax.jit, static_argnames=("config", "attn"),
+          donate_argnames=("cache",))
+def prefill_slot_paged_chunk(params, tokens, n_real, slot, pos0,
+                             cache: PagedKVCache, rope,
+                             config: LlamaConfig, attn: str = "fold"):
+    """One fixed-size prefill window into a PAGED slot at absolute
+    position `pos0` — the paged analog of model.prefill_slot_chunk,
+    lifting the old "paged prompts prefill whole-window" restriction:
+    long prompts admit in C-token windows with bounded activation
+    memory, one compiled program per window shape (pos0 is traced).
+
+    tokens: [1, C]; n_real: [1] real tokens in the window. The window's
+    KV scatters through write_window_pages (pos0 may sit anywhere
+    inside a page); attention gathers the slot's mapped pages into a
+    position-ordered dense [1, max_seq, KV, hd] view and masks
+    kj <= pos0 + qi — every already-written position (earlier windows
+    AND a shared prefix mapped at the row head) is attended through the
+    same gather, so prefix + chunked-suffix composes with no separate
+    install step. attn="pallas" routes through the cache-aware flash
+    kernel; unmapped pages gather as zeros, which only garbage
+    (padding) queries can see under the causal bound."""
+    from cake_tpu.models.llama.model import block_skeleton
+    from cake_tpu.ops.attention import gqa_attention
+    from cake_tpu.ops.flash_attention import (
+        flash_attention_cached, flash_supported,
+    )
+    from cake_tpu.ops.norms import rms_norm
+    from cake_tpu.ops.quant import qmatmul
+    from cake_tpu.ops.rope import apply_rope, rope_rows
+
+    B, C = tokens.shape
+    H = config.num_attention_heads
+    KV = config.num_key_value_heads
+    hd = config.head_dim
+    N, P = cache.n_pages, cache.page_size
+    T = cache.max_seq_len
+    x = jnp.take(params["embed"], tokens, axis=0)
+    rope_c, rope_s = rope_rows(rope.cos, rope.sin, pos0, C)
+    table_row = jnp.take(cache.table, slot, axis=0)
+    gather_idx = jnp.where(table_row >= 0, table_row, N)
+    use_flash = (attn == "pallas"
+                 and flash_supported(C, T, H, KV, hd=hd))
+    mask = (None if use_flash else
+            (jnp.arange(T)[None, :] <= pos0 + jnp.arange(C)[:, None]))
+
+    def body(h, xs):
+        lp, pk, pv = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            pk2, pv2 = write_window_pages(pk, pv, k, v, table_row, pos0)
+            # post-write gather: the dense view holds every written
+            # position (prefix head, earlier windows, this window)
+            k_full = jnp.take(pk2, gather_idx, axis=0, mode="fill",
+                              fill_value=0).reshape(
+                1, T, KV, hd).astype(q.dtype)
+            v_full = jnp.take(pv2, gather_idx, axis=0, mode="fill",
+                              fill_value=0).reshape(
+                1, T, KV, hd).astype(q.dtype)
+            if use_flash:
+                return (flash_attention_cached(q, k_full, v_full, pos0),
+                        (pk2, pv2))
+            return gqa_attention(q, k_full, v_full, mask=mask), (pk2, pv2)
+
+        h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)
+        return h, (pk2, pv2)
+
+    x, (k_new, v_new) = lax.scan(body, x,
+                                 (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, (n_real - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
     )[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
     return logits, PagedKVCache(k_new, v_new, cache.table)

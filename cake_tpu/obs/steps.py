@@ -165,6 +165,14 @@ def refresh_page_gauges(engine) -> None:
                  "KV pages in the pool").set(engine.cache.n_pages)
         _m.gauge("cake_engine_kv_pages_free",
                  "KV pages currently free").set(engine._pager.free_pages)
+        # prefix sharing (serve/engine.py sets this at admission /
+        # release; re-set at scrape so a restarted scraper converges
+        # without waiting for the next admission)
+        _m.gauge("cake_prefix_pages_shared",
+                 "Shared prefix pages currently mapped into admitted "
+                 "slots' table rows (pool pages saved vs unshared "
+                 "admission)").set(
+            getattr(engine, "_prefix_pages_shared", 0))
     except Exception:  # noqa: BLE001 — telemetry must never fail serving
         log.debug("page gauge refresh failed", exc_info=True)
 

@@ -25,7 +25,13 @@ Paged Attention" shape, PAPERS.md arxiv 2604.15464):
   * GQA without repeat_kv: the KV-head axis is unrolled statically
     inside the kernel (KV is 2-8 in practice), so query group g of kv
     head k reads exactly its own `hd`-wide lane slice of the page block
-    — each live page is streamed through VMEM ONCE for all H heads.
+    — each live page is streamed through VMEM ONCE for all H heads;
+  * page-granular PREFIX SHARING is free at decode: the kernel only
+    ever reads pages through the table, so the same physical page id
+    appearing in many rows' table heads (a shared system prompt's KV,
+    serve/engine page-granular prefix sharing) needs zero kernel
+    changes — each row streams the shared page like any other, and
+    nothing here ever writes the pool.
 
 Layout contract: the pool keeps `models/llama/paged.py`'s
 [N_pages, page, KV, hd] layout; the wrapper flattens the two minor axes

@@ -70,6 +70,21 @@ _PAGED_ATTN_STEP = obs_metrics.histogram(
     "Paged-engine step wall latency by path (prefill|decode)",
     labelnames=("path",))
 
+# page-granular prefix sharing (the paged engine's prompt-cache path):
+# the gauge tracks how many pool pages are currently backing more than
+# their first mapping (capacity the pool did NOT have to spend), the
+# counters how often and how many prompt tokens the sharing saved
+_PREFIX_PAGES_SHARED = obs_metrics.gauge(
+    "cake_prefix_pages_shared",
+    "Shared prefix pages currently mapped into admitted slots' table "
+    "rows (pool pages saved vs unshared admission)")
+_PREFIX_PAGED_HITS = obs_metrics.counter(
+    "cake_prefix_paged_hits_total",
+    "Paged prefills served from pool-resident shared prefix pages")
+_PREFIX_TOKENS_SAVED = obs_metrics.counter(
+    "cake_prefix_tokens_saved_total",
+    "Prompt tokens whose prefill was skipped via a cached prefix")
+
 
 @dataclass
 class _Request:
@@ -383,14 +398,10 @@ class InferenceEngine:
                 raise ValueError(
                     "--kv-pages builds its own page pool; a pre-placed "
                     "cache= cannot apply")
-            if prefill_chunk is not None:
-                log.warning("prefill_chunk ignored with --kv-pages "
-                            "(paged prompts prefill whole-window)")
-                prefill_chunk = None
-            self._prefix_capable = False
             from cake_tpu.models.llama.paged import (
                 PageAllocator, PagedKVCache, decode_step_ragged_paged,
-                prefill_slot_paged,
+                prefill_prefix_pages, prefill_slot_paged,
+                prefill_slot_paged_chunk, prefill_slot_paged_prefixed,
             )
             # paged_attn: {fold,pallas} attention impl for the paged
             # step fns; None/"auto" = pallas on a real TPU, fold
@@ -412,9 +423,27 @@ class InferenceEngine:
             self._decode_scan_impl = (_decode_scan_paged
                                       if impl == "fold"
                                       else _decode_scan_paged_pallas)
-            self._prefill_chunk_step = None
+            # chunked paged prefill: long prompts admit in C-token
+            # windows (the old "paged prompts prefill whole-window"
+            # restriction is gone); prefill_chunk was already validated
+            # above against the builtin contract, which is unchanged
+            self._prefill_chunk_step = partial(prefill_slot_paged_chunk,
+                                               attn=impl)
+            # page-granular prefix sharing: registered prefixes (and
+            # auto_prefix_system heads) prefill ONCE into pool pages and
+            # are mapped read-only into every matching slot's table row
+            # (_alloc_slot_pages). _prefix_capable stays True.
+            self._paged_prefixed_step = partial(
+                prefill_slot_paged_prefixed, attn=impl)
+            self._prefix_pages_step = partial(prefill_prefix_pages,
+                                              attn=impl)
             self._pager = PageAllocator(kv_pages, kv_page_size)
             self._slot_pages: dict = {}
+            # slot -> count of SHARED prefix pages in its table row
+            # (gauge bookkeeping; the pages themselves ride
+            # _slot_pages for the refcounted release)
+            self._slot_prefix_pages: dict = {}
+            self._prefix_pages_shared = 0
             self.cache = PagedKVCache.create(
                 config, max_slots, kv_pages, kv_page_size, max_seq_len,
                 dtype=cache_dtype)
@@ -721,7 +750,11 @@ class InferenceEngine:
         if self.paged and (self._pager.pages_for(len(ids) + max_new)
                            > self.cache.n_pages):
             # can NEVER be admitted (need exceeds the whole pool) —
-            # fail fast instead of requeueing forever
+            # fail fast instead of requeueing forever. A shared prefix
+            # does not change this bound: the prefix is page-aligned,
+            # so prefix pages + suffix pages == the contiguous page
+            # count exactly (sharing saves FREE pages at admission,
+            # not table-row size)
             raise ValueError(
                 f"request needs "
                 f"{self._pager.pages_for(len(ids) + max_new)} kv pages; "
@@ -794,10 +827,24 @@ class InferenceEngine:
                 "followers mirror the coordinator's prefix registry; "
                 "register prefixes on the coordinator process")
         if not self._prefix_capable:
-            raise ValueError(
-                "prefix caching is unavailable here: ring sliding-window "
-                "caches own their layout, and custom step fns without a "
-                "chunked-prefill variant cannot window the suffix")
+            # name the ACTUAL refusal per engine flavor — the paged
+            # engine serves prefixes now (page-granular sharing), so a
+            # one-size message would blame the wrong subsystem
+            if self._spec:
+                reason = ("speculative serving keeps the draft cache "
+                          "aligned with the target, and a prefix-cached "
+                          "target prefill would leave the draft cold "
+                          "(acceptance would silently collapse)")
+            elif self.ring:
+                reason = ("ring sliding-window caches own their layout "
+                          "(a prefix install writes dense positions the "
+                          "ring would misplace)")
+            else:
+                reason = ("these custom step fns provide no "
+                          "chunked-prefill variant to window the suffix "
+                          "at the prefix boundary")
+            raise ValueError(f"prefix caching is unavailable here: "
+                             f"{reason}")
         ids = list(prefix_ids)
         if not ids:
             raise ValueError("empty prefix")
@@ -805,6 +852,21 @@ class InferenceEngine:
             raise ValueError(
                 f"prefix length {len(ids)} leaves no room for a suffix "
                 f"(max_seq_len {self.max_seq_len})")
+        if self.paged:
+            P = self._pager.page_size
+            if len(ids) < P:
+                raise ValueError(
+                    f"paged prefix sharing is page-granular: the prefix "
+                    f"({len(ids)} tokens) is shorter than one kv page "
+                    f"({P} tokens), so there is nothing to share "
+                    "(lower --kv-page-size or skip registration)")
+            # pool pages + the table are single-writer state: route
+            # through the engine thread when it is running (auto-prefix
+            # registrations arrive on HTTP handler threads)
+            if self._thread is not None and self._thread.is_alive():
+                return self._run_on_engine_thread(
+                    lambda: self._register_prefix_paged(ids))
+            return self._register_prefix_paged(ids)
         if self._control is not None:
             return self._run_on_engine_thread(
                 lambda: self._register_prefix_sync(ids))
@@ -824,6 +886,49 @@ class InferenceEngine:
         with self._rid_lock:
             self._prefixes[pid] = (ids, k, v)
         log.info("registered prefix %d: %d tokens", pid, P)
+        return pid
+
+    def _register_prefix_paged(self, ids: List[int]) -> int:
+        """Paged registration: round the prefix DOWN to a page boundary
+        (remainder ids join every request's suffix — no copy-on-write of
+        a partial last page), prefill it ONCE into dedicated pool pages,
+        and record the page list. Matching admissions map those pages
+        read-only into their table rows (_alloc_slot_pages) — a 1k-token
+        system prompt costs ceil(1k/page) pool pages TOTAL instead of
+        per slot. Runs on the engine thread when the engine is live (the
+        pool + table are single-writer state)."""
+        P = self._pager.page_size
+        aligned = (len(ids) // P) * P
+        p_ids = ids[:aligned]
+        n_pp = aligned // P
+        pages = self._pager.alloc(aligned)
+        if pages is None:
+            raise ValueError(
+                f"kv page pool cannot hold the prefix: needs {n_pp} "
+                f"pages, {self._pager.free_pages} free (raise "
+                "--kv-pages, or register before taking load)")
+        with self._rid_lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+        row = np.full(self.cache.max_pages, -1, np.int64)
+        row[:n_pp] = pages
+        try:
+            fargs = (self.params, jnp.asarray([p_ids], jnp.int32),
+                     jnp.asarray(row, jnp.int32), self.cache, self.rope,
+                     self.config)
+            js = self._obs_jit("prefill_prefix_pages", (aligned,),
+                               self._prefix_pages_step, fargs)
+            t0 = time.perf_counter()
+            self.cache = self._prefix_pages_step(*fargs)
+            js.finish(time.perf_counter() - t0)
+        except Exception:
+            self._pager.release(pages)
+            raise
+        with self._rid_lock:
+            self._prefixes[pid] = (p_ids, pages, None)
+        log.info("registered paged prefix %d: %d tokens in %d shared "
+                 "pages (%d trailing tokens fall to each suffix)",
+                 pid, aligned, n_pp, len(ids) - aligned)
         return pid
 
     def _prefix_kv_device(self, ids: List[int], P: int, bucket: int):
@@ -917,8 +1022,26 @@ class InferenceEngine:
                     self._prefixes.pop(prefix_id, None)
             self._run_on_engine_thread(job)
             return
+        if self.paged and (self._thread is not None
+                           and self._thread.is_alive()):
+            # the registry's page references drop on the engine thread:
+            # slots mid-decode on those pages hold their own refs, so
+            # the pages outlive the registration until the last request
+            # using them retires
+            self._run_on_engine_thread(
+                lambda: self._unregister_paged_sync(prefix_id))
+            return
+        if self.paged:
+            self._unregister_paged_sync(prefix_id)
+            return
         with self._rid_lock:
             self._prefixes.pop(prefix_id, None)
+
+    def _unregister_paged_sync(self, prefix_id: int) -> None:
+        with self._rid_lock:
+            entry = self._prefixes.pop(prefix_id, None)
+        if entry is not None:
+            self._pager.release(entry[1])
 
     def _match_prefix(self, ids: List[int]):
         """Longest registered prefix that is a proper head of `ids`:
@@ -959,7 +1082,15 @@ class InferenceEngine:
         evict = None
         with self._rid_lock:
             if head in self._auto_pids:
-                return
+                pid = self._auto_pids[head]
+                if pid is None or pid < 0 or pid in self._prefixes:
+                    return   # in-flight, negative-cached, or live
+                # stale head->pid: the registry was cleared underneath
+                # a completed registration (paged _reset_after_error
+                # racing a handler-thread registration) — drop the
+                # entry and re-register, or this head would silently
+                # serve whole-prompt prefills forever
+                del self._auto_pids[head]
             if len(self._auto_pids) >= self._max_auto:
                 # evict the oldest COMPLETED registration; in-flight
                 # reservations (None) are skipped
@@ -983,7 +1114,12 @@ class InferenceEngine:
                 log.exception("auto-prefix eviction failed")
         try:
             ids = encode_text(self.tokenizer, head)
-            if len(ids) < 8 or len(ids) >= self.max_seq_len - 1:
+            min_len = 8
+            if self.paged:
+                # page-granular sharing: a head shorter than one page
+                # has nothing to share (register_prefix would refuse)
+                min_len = max(min_len, self._pager.page_size)
+            if len(ids) < min_len or len(ids) >= self.max_seq_len - 1:
                 # unqualifying head: keep a negative sentinel so the
                 # membership check short-circuits every later request
                 # with the same system prompt
@@ -1255,10 +1391,18 @@ class InferenceEngine:
                 PageAllocator, PagedKVCache,
             )
             # the rebuild loses every slot's KV; reset the allocator and
-            # table bookkeeping with it
+            # table bookkeeping with it. Registered prefixes lived in
+            # the (now gone) pool pages, so the registry is cleared too
+            # — auto-prefix heads re-register on their next request
             self._pager = PageAllocator(self.cache.n_pages,
                                         self.cache.page_size)
             self._slot_pages = {}
+            self._slot_prefix_pages = {}
+            self._prefix_pages_shared = 0
+            _PREFIX_PAGES_SHARED.set(0)
+            with self._rid_lock:
+                self._prefixes.clear()
+                self._auto_pids.clear()
             return PagedKVCache.create(
                 self.config, self.max_slots, self.cache.n_pages,
                 self.cache.page_size, self.max_seq_len,
@@ -1315,16 +1459,32 @@ class InferenceEngine:
             **self._page_kw())
 
     def _release_slot_pages(self, slot: int) -> None:
+        """Refcounted release of a slot's page mappings — idempotent
+        under the cancel-vs-error race (both teardown paths pop the same
+        dict entry; the second caller finds nothing to release). Shared
+        prefix pages decref back to the registry's reference instead of
+        freeing another slot's live context."""
         if not self.paged or slot < 0:
             return
         pages = self._slot_pages.pop(slot, None)
         if pages:
-            self._pager.free(pages)
+            self._pager.release(pages)
+        n_shared = self._slot_prefix_pages.pop(slot, 0)
+        if n_shared:
+            self._prefix_pages_shared -= n_shared
+            _PREFIX_PAGES_SHARED.set(self._prefix_pages_shared)
 
-    def _alloc_slot_pages(self, req: _Request, slot: int) -> bool:
+    def _alloc_slot_pages(self, req: _Request, slot: int,
+                          hit=None) -> bool:
         """Admission by pages: map the slot's table row when the pool
         can cover prompt + budget; otherwise requeue the request (it is
         planned again as retiring requests free pages).
+
+        hit: a validated prefix match ((pid, (p_ids, pages, _)), from
+        _match_and_validate_prefix) — the slot then allocates only
+        SUFFIX + budget pages and maps the shared prefix pages
+        (refcount-retained) at the head of its row, so a 1k-token
+        system prompt stops costing ceil(1k/page) pages per slot.
 
         FIFO fairness: a page-starved request becomes the BLOCKING head
         — younger requests requeue behind it instead of being admitted
@@ -1337,16 +1497,29 @@ class InferenceEngine:
             blocked = self._page_blocked_rid = None  # cancelled/failed
         if blocked is not None and req.rid != blocked:
             return self._requeue_for_pages(req, slot, starved=False)
-        need = len(req.prompt_ids) + req.max_new_tokens
+        prefix_pages: List[int] = []
+        n_prefix = 0
+        if hit is not None:
+            p_ids, prefix_pages, _ = hit[1]
+            n_prefix = len(p_ids)
+        need = len(req.prompt_ids) - n_prefix + req.max_new_tokens
         pages = self._pager.alloc(need)
-        if pages is not None:
-            self._slot_pages[slot] = pages
-            self.cache = self.cache._replace(
-                table=table_set_slot(self.cache.table, slot, pages))
-            if req.rid == blocked:
-                self._page_blocked_rid = None
-            return True
-        return self._requeue_for_pages(req, slot, starved=True)
+        if pages is None:
+            return self._requeue_for_pages(req, slot, starved=True)
+        if prefix_pages:
+            # retain AFTER the suffix alloc: a requeued admission must
+            # leave no dangling references behind
+            self._pager.retain(prefix_pages)
+            self._slot_prefix_pages[slot] = len(prefix_pages)
+            self._prefix_pages_shared += len(prefix_pages)
+            _PREFIX_PAGES_SHARED.set(self._prefix_pages_shared)
+        row = list(prefix_pages) + pages
+        self._slot_pages[slot] = row
+        self.cache = self.cache._replace(
+            table=table_set_slot(self.cache.table, slot, row))
+        if req.rid == blocked:
+            self._page_blocked_rid = None
+        return True
 
     def _requeue_for_pages(self, req: _Request, slot: int,
                            starved: bool) -> bool:
@@ -1384,11 +1557,14 @@ class InferenceEngine:
         t0 = time.perf_counter()
         req.slot = slot
         self._slot_req[slot] = req
-        if self.paged and not self._alloc_slot_pages(req, slot):
-            return None   # pool exhausted: requeued (or failed) inside
         ids = req.prompt_ids
+        # match BEFORE page admission: a paged prefix hit changes the
+        # allocation itself (suffix + budget pages only, prefix pages
+        # mapped shared)
         hit = (self._match_and_validate_prefix(ids)
                if self._prefix_capable else None)
+        if self.paged and not self._alloc_slot_pages(req, slot, hit):
+            return None   # pool exhausted: requeued (or failed) inside
         n_top = self._n_top_for([slot])
         if hit is not None:
             hit_pid, entry = hit
@@ -1511,15 +1687,20 @@ class InferenceEngine:
         flavor) — the coordinator decides with it and a multi-host
         follower re-derives the identical plan from the published op.
 
-        One clamp rule for both engines: windows (or the padded
+        One clamp rule for every engine: windows (or the padded
         single-program bucket) must never clamp over the live prefix.
         The pipelined engine ALWAYS windows the suffix at pos0 = P (it
-        has no single-program prefixed-prefill variant); the dense
-        engine windows only when --prefill-chunk applies, else takes
-        the single program."""
+        has no single-program prefixed-prefill variant); the dense and
+        paged engines window only when --prefill-chunk applies, else
+        take their single program (prefill_slot_prefixed /
+        prefill_slot_paged_prefixed)."""
         C = self.prefill_chunk
         suffix = ids[len(p_ids):]
-        pipelined = self._prefill_slot is not prefill_slot
+        # the paged engine has its own single-program prefixed prefill
+        # (prefill_slot_paged_prefixed), so only a genuinely pipelined
+        # custom path is forced through suffix windows
+        pipelined = (self._prefill_slot is not prefill_slot
+                     and not self.paged)
         if pipelined or (C and len(suffix) > C):
             Cw = C or bucket_length(len(suffix), self.max_seq_len)
             n_win = -(-len(suffix) // Cw)
@@ -1555,6 +1736,36 @@ class InferenceEngine:
                 f"prefix {pid} no longer serves prompt of len {len(ids)}")
         chunk_suffix, width = plan
         suffix = ids[len(p_ids):]
+        _PREFIX_TOKENS_SAVED.inc(len(p_ids))
+        if self.paged:
+            # the shared prefix pages are ALREADY mapped at the head of
+            # this slot's table row (_alloc_slot_pages) — no install
+            # step at all. The suffix prefills through the paged
+            # prefixed program (single window) or the paged chunk fn
+            # (which attends everything written through the table,
+            # prefix head included).
+            _PREFIX_PAGED_HITS.inc()
+            if chunk_suffix:
+                logits = self._prefill_chunked(suffix, slot, width,
+                                               pos0=len(p_ids))
+            else:
+                padded = suffix + [0] * (width - len(suffix))
+                fargs = (self.params, jnp.asarray([padded], jnp.int32),
+                         jnp.asarray([len(suffix)], jnp.int32),
+                         jnp.int32(slot), self.cache, self.rope,
+                         self.config)
+                fkw = dict(n_prefix=len(p_ids))
+                js = self._obs_jit("prefill_paged_prefixed",
+                                   (width, len(p_ids)),
+                                   self._paged_prefixed_step, fargs, fkw)
+                t0 = time.perf_counter()
+                logits, self.cache = self._paged_prefixed_step(*fargs,
+                                                               **fkw)
+                js.finish(time.perf_counter() - t0)
+                self._last_jit = js
+            return self._finish_prefill(logits, slot, len(ids), temp,
+                                        top_p, penalty, prime,
+                                        n_top=n_top, defer=defer)
         if chunk_suffix:
             from cake_tpu.models.llama.model import install_prefix_slot
             self.cache = install_prefix_slot(self.cache, pk, pv,

@@ -133,6 +133,21 @@ def test_paged_attn_microbench_cli(impl):
     assert result["kv_pages"] > 0
 
 
+@pytest.mark.slow  # two engine phases + registration compile -> slow lane
+def test_paged_prefix_smoke_tier_reports_sharing():
+    """The paged prefix-sharing tier must emit pages_shared > 0 plus
+    both phases' TTFTs — a tier where sharing silently stopped engaging
+    (0 hits) fails here instead of benching the unshared path twice."""
+    result = _run_tier("paged_prefix_tiny")
+    assert result["value"] > 0
+    assert result["unit"] == "ms"
+    assert result["pages_shared"] > 0
+    assert result["prefix_hits"] > 0
+    assert result["ttft_p50_shared_ms"] > 0
+    assert result["ttft_p50_unshared_ms"] > 0
+    assert result["prefill_suffix_tok_s"] > 0
+
+
 def test_paged_attn_microbench_rejects_bad_impl():
     proc = subprocess.run(
         [sys.executable, BENCH, "--paged-attn", "nope"], env=_base_env(),

@@ -16,10 +16,11 @@ never ship):
     ``_count == +Inf bucket``;
   * counter samples are finite and non-negative.
 
-Additionally, step-telemetry metric families (``cake_step_*``,
-``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``) must carry real
-help text (not just an echoed name) and appear in the README metrics
-table — pass ``--readme README.md`` to enforce it (the tier-1 hook in
+Additionally, telemetry metric families (``cake_step_*``,
+``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``, and the paged
+prefix-sharing ``cake_prefix_*``) must carry real help text (not just
+an echoed name) and appear in the README metrics table — pass
+``--readme README.md`` to enforce it (the tier-1 hook in
 tests/test_metrics_lint.py does, so an undocumented telemetry metric
 fails the fast lane).
 
@@ -49,10 +50,11 @@ LABEL_PAIR_RE = re.compile(
 VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
-# step-telemetry families that MUST be documented (help text + README
-# metrics table row) — the obs/steps.py surface
+# telemetry families that MUST be documented (help text + README
+# metrics table row) — the obs/steps.py surface plus the paged
+# prefix-sharing families (serve/engine.py cake_prefix_*)
 DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
-                       "cake_device_")
+                       "cake_device_", "cake_prefix_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
@@ -267,7 +269,7 @@ def lint_readme_coverage(text: str, readme_text: str,
             errors.append(
                 f"{name}: telemetry metric missing from the README "
                 "metrics table (document every cake_step_*/cake_jit_*/"
-                "cake_device_* series)")
+                "cake_device_*/cake_prefix_* series)")
     return errors
 
 
