@@ -148,9 +148,27 @@ PAGED_PREFIX_TIERS = {
                                  suffix_len=64, gen_tokens=16),
 }
 
+# SLO scheduling tiers (bench.py --slo): a mixed-priority saturation
+# run through a --priority-classes engine, measured TWICE — preemption
+# off then on, same offered load — reporting per-class TTFT p50/p99
+# and the preemption count. The number this tier exists for: with
+# preemption on, interactive-class p99 TTFT must sit strictly below
+# the preemption-off phase (batch slots are reclaimed instead of
+# head-of-line-blocking the interactive arrivals).
+SLO_TIERS = {
+    "slo_8b_int8": dict(model="8b", quant="int8", max_seq=512, slots=4,
+                        prompt_len=128, prefill_chunk=128,
+                        batch_gen=128, inter_n=8, inter_gen=8,
+                        standard_n=2, standard_gen=16, stagger_s=0.25),
+}
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
+    "slo_tiny": dict(model="tiny", quant=False, max_seq=128, slots=2,
+                     prompt_len=24, prefill_chunk=16, batch_gen=64,
+                     inter_n=6, inter_gen=4, standard_n=1,
+                     standard_gen=6, stagger_s=0.05),
     "paged_prefix_tiny": dict(model="tiny", quant=False, max_seq=128,
                               slots=2, kv_pages=16, kv_page_size=16,
                               paged_attn="fold", prefix_len=32,
@@ -596,6 +614,118 @@ def run_paged_prefix_tier(name: str, model: str, quant, max_seq: int,
     }
 
 
+def run_slo_tier(name: str, model: str, quant, max_seq: int,
+                 slots: int, prompt_len: int, prefill_chunk: int,
+                 batch_gen: int, inter_n: int, inter_gen: int,
+                 standard_n: int, standard_gen: int,
+                 stagger_s: float) -> dict:
+    """Mixed-priority saturation through the SLO scheduler
+    (cake_tpu/sched): fill every slot with batch-class requests, then
+    offer a staggered stream of interactive (plus a little standard)
+    traffic, and measure per-class TTFT p50/p99 — once with preemption
+    OFF (interactive head-of-line-blocks behind decoding batch slots)
+    and once ON (batch slots are reclaimed, generated tokens fold into
+    their prompts, they re-prefill later). Both phases warm their jit
+    programs first; prefill_chunk keeps every prefill — including the
+    folded resume prefills, whose lengths vary — on ONE compiled
+    window program, so no phase pays a mid-load compile."""
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.sched import SchedConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+
+    def prompt(seed: int):
+        return [(7 * seed + 3 * j) % V + 3 for j in range(prompt_len)]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+    def phase(preempt: bool) -> dict:
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefill_chunk=prefill_chunk,
+            priority_classes=True, preemption=preempt,
+            # the tier measures steady preemption under sustained
+            # interactive load, not the budget backstop — lift it so
+            # every interactive arrival can reclaim a slot
+            sched_config=SchedConfig(preempt_budget=1_000_000),
+        )
+        with engine:
+            t0 = time.perf_counter()
+            warm = engine.submit(prompt(99), max_new_tokens=8,
+                                 priority="interactive")
+            assert warm.wait(timeout=900), "slo warmup timed out"
+            log(f"slo[{'on' if preempt else 'off'}] warmup (compile): "
+                f"{time.perf_counter() - t0:.1f}s")
+            batch = [engine.submit(prompt(i), max_new_tokens=batch_gen,
+                                   priority="batch")
+                     for i in range(slots)]
+            # saturation point: every slot decoding batch work before
+            # the interactive stream arrives
+            t0 = time.perf_counter()
+            while (any(len(h._req.out_tokens) < 2 for h in batch)
+                   and time.perf_counter() - t0 < 300):
+                time.sleep(0.005)
+            inter, std = [], []
+            for i in range(inter_n):
+                inter.append(engine.submit(
+                    prompt(100 + i), max_new_tokens=inter_gen,
+                    priority="interactive"))
+                if standard_n and i == inter_n // 2:
+                    std = [engine.submit(prompt(200 + k),
+                                         max_new_tokens=standard_gen,
+                                         priority="standard")
+                           for k in range(standard_n)]
+                time.sleep(stagger_s)
+            assert all(h.wait(timeout=900)
+                       for h in batch + inter + std), "slo load timed out"
+            return {"preemptions": engine.stats.preemptions,
+                    "interactive": [h.ttft for h in inter],
+                    "standard": [h.ttft for h in std],
+                    "batch": [h.ttft for h in batch]}
+
+    off = phase(False)
+    on = phase(True)
+    result = {
+        "metric": f"{name}_interactive_ttft_p99_ms",
+        "value": 0.0, "unit": "ms", "vs_baseline": 0.0,
+        "preemptions_total": on["preemptions"],
+        "preemptions_total_off": off["preemptions"],
+        "slo_streams": slots + inter_n + standard_n,
+        "device_kind": dev.device_kind,
+    }
+    for cls in ("interactive", "standard", "batch"):
+        for tag, ph in (("on", on), ("off", off)):
+            xs = ph[cls]
+            if xs:
+                result[f"{cls}_ttft_p50_{tag}_ms"] = round(
+                    pct(xs, 0.5) * 1e3, 1)
+                result[f"{cls}_ttft_p99_{tag}_ms"] = round(
+                    pct(xs, 0.99) * 1e3, 1)
+    result["value"] = result["interactive_ttft_p99_on_ms"]
+    log(f"slo: interactive TTFT p99 {result['value']:.1f}ms with "
+        f"preemption ({on['preemptions']} preemptions) vs "
+        f"{result['interactive_ttft_p99_off_ms']:.1f}ms without; "
+        f"batch p99 {result.get('batch_ttft_p99_on_ms')}ms on / "
+        f"{result.get('batch_ttft_p99_off_ms')}ms off")
+    return result
+
+
 def run_sd_tier(name: str, version: str, height: int | None = None,
                 width: int | None = None, steps_a: int = 20,
                 steps_b: int = 40) -> dict:
@@ -740,7 +870,10 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in PAGED_PREFIX_TIERS or name.startswith("paged_prefix"):
+    if name in SLO_TIERS or name.startswith("slo_"):
+        kwargs = {**SLO_TIERS, **SMOKE_TIERS}[name]
+        result = run_slo_tier(name, **kwargs)
+    elif name in PAGED_PREFIX_TIERS or name.startswith("paged_prefix"):
         kwargs = {**PAGED_PREFIX_TIERS, **SMOKE_TIERS}[name]
         result = run_paged_prefix_tier(name, **kwargs)
     elif name in PAGED_TIERS or name.startswith("paged_tiny"):
@@ -914,6 +1047,17 @@ def _paged_main(impl: str) -> int:
         extra={"paged_attn": impl})
 
 
+def _slo_main() -> int:
+    """`bench.py --slo`: the mixed-priority SLO scheduling tier — one
+    JSON line with per-class TTFT p50/p99 for a preemption-on vs
+    preemption-off phase under the same offered load, plus the
+    preemption count. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "interactive_ttft_p99_ms", "ms",
+        cpu_tier="slo_tiny", tpu_tier="slo_8b_int8",
+        fail_error="slo scheduling tier failed")
+
+
 def _paged_prefix_main() -> int:
     """`bench.py --paged-prefix`: the paged prefix-sharing tier — one
     JSON line with suffix-only vs whole-prompt TTFT and pages_shared
@@ -1015,6 +1159,8 @@ if __name__ == "__main__":
         probe_main()
     elif os.environ.get(ORCH_ENV):
         tier_main()
+    elif "--slo" in sys.argv:
+        sys.exit(_slo_main())
     elif "--paged-prefix" in sys.argv:
         sys.exit(_paged_prefix_main())
     elif "--paged-attn" in sys.argv:

@@ -152,6 +152,7 @@ class ApiServer:
             temperature=opts["temperature"],
             top_p=opts["top_p"],
             want_top_logprobs=n_top > 0,
+            priority=opts.get("priority"),
         )
 
         def lp_entry(t, lp, top):
@@ -166,11 +167,14 @@ class ApiServer:
                 e["top_logprobs"] = [alt(at, al) for at, al in top[:n_top]]
             return e
 
+        from cake_tpu.sched import ShedError
+
         if send_chunk is None:
             try:
                 h = self.engine.chat(messages, **kw)
-            except QueueFullError:
-                raise QueueFull()
+            except (QueueFullError, ShedError) as e:
+                raise QueueFull(getattr(e, "retry_after", 1.0),
+                                shed=isinstance(e, ShedError))
             h.wait()
             lp = None
             if want_lp:
@@ -197,8 +201,9 @@ class ApiServer:
         stream.wants_count = True
         try:
             h = self.engine.chat(messages, stream=stream, **kw)
-        except QueueFullError:
-            raise QueueFull()
+        except (QueueFullError, ShedError) as e:
+            raise QueueFull(getattr(e, "retry_after", 1.0),
+                            shed=isinstance(e, ShedError))
         if on_start is not None:
             on_start()
         lp_cursor = 0
@@ -283,6 +288,12 @@ class ApiServer:
                 tokens_generated=st.tokens_generated,
                 decode_tokens_per_s=round(st.decode_tokens_per_s, 2),
             )
+            depths = getattr(self.engine.scheduler, "class_depths", None)
+            if depths is not None:
+                # SLO scheduling on: per-class queue + outcome counters
+                out["queue_depth_by_class"] = depths()
+                out["preemptions"] = st.preemptions
+                out["requests_shed"] = st.shed
         return out
 
     def cluster(self) -> dict:
@@ -374,6 +385,9 @@ class ApiServer:
                 m.gauge("cake_engine_spec_acceptance",
                         "Lifetime draft acceptance ratio").set(
                     round(st.spec_acceptance, 4))
+            # scrape-fresh per-class queue depths through the engine's
+            # one registration site (no-op without the SLO scheduler)
+            self.engine._set_queue_gauges()
             obs_steps.refresh_page_gauges(self.engine)
         return m.REGISTRY.render()
 
@@ -436,7 +450,15 @@ DISCONNECTED = object()
 
 
 class QueueFull(Exception):
-    pass
+    """Admission rejected: queue full, or load-shed (shed=True).
+    retry_after seconds ride the HTTP 429 Retry-After header — computed
+    from the measured service rate when shedding is on (sched/shed.py),
+    a 1s floor otherwise."""
+
+    def __init__(self, retry_after: float = 1.0, shed: bool = False):
+        super().__init__("request shed" if shed else "queue full")
+        self.retry_after = retry_after
+        self.shed = shed
 
 
 def make_handler(api: ApiServer):
@@ -545,17 +567,27 @@ def make_handler(api: ApiServer):
                 if getattr(self, "_stream_started", False):
                     return
                 return self._json(400, {"error": str(e)})
-            except QueueFull:
+            except QueueFull as e:
                 if getattr(self, "_stream_started", False):
                     return  # headers already gone; just drop the connection
-                data = json.dumps({"error": "queue full"}).encode()
-                self.send_response(503)
-                self.send_header("Retry-After", "1")
+                # 429 + an HONEST Retry-After: computed seconds until
+                # the backlog drains inside the class SLO at the
+                # measured service rate (sched/shed.py), not a
+                # hardcoded constant — for shed AND queue-full alike
+                retry = max(1, int(-(-e.retry_after // 1)))   # ceil
+                data = json.dumps({
+                    "error": ("request shed: server saturated for "
+                              "this priority class" if e.shed
+                              else "queue full"),
+                    "retry_after_s": retry,
+                }).encode()
+                self.send_response(429)
+                self.send_header("Retry-After", str(retry))
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
-                api._count(self.path, 503)
+                api._count(self.path, 429)
             except Exception as e:  # noqa: BLE001
                 log.exception("request failed")
                 if getattr(self, "_stream_started", False):
@@ -563,6 +595,15 @@ def make_handler(api: ApiServer):
                 self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
         def _chat(self, body: dict):
+            # x-cake-priority header names the SLO class for clients
+            # that cannot edit the body (gateways, sidecars); an
+            # explicit body "priority" wins — a JSON null counts as
+            # unset (SDKs serialize optional fields as null), so the
+            # header still applies then. Unknown values 400 via
+            # parse_chat_request's validation.
+            hdr = self.headers.get("x-cake-priority")
+            if hdr is not None and body.get("priority") is None:
+                body["priority"] = hdr
             if not body.get("stream"):
                 return self._json(200, api.chat(body))
             self._stream_started = False

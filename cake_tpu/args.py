@@ -149,6 +149,27 @@ class Args:
     # --profile-dir DIR: where POST /api/v1/profile writes its
     # jax.profiler capture; None = a fresh temp dir per capture
     profile_dir: Optional[str] = None
+    # --priority-classes: SLO-aware scheduling (cake_tpu/sched/) for
+    # the serving engine — requests carry a class (interactive |
+    # standard | batch, via the request-body "priority" field or the
+    # x-cake-priority header) and plan() admits by class with
+    # anti-starvation aging instead of FIFO arrival order
+    priority_classes: bool = False
+    # --preemption / --no-preemption: recompute-style preemption
+    # (requires --priority-classes): when a higher class is slot- or
+    # page-starved, the youngest lowest-class decoding slot is
+    # preempted — its generated tokens fold into its prompt (the
+    # checkpoint-resume fold), its kv pages release through the
+    # refcounted allocator, and it requeues to re-prefill later, with a
+    # per-request preemption budget guaranteeing progress. None = auto
+    # (on whenever --priority-classes is on and the engine flavor
+    # supports the fold)
+    preemption: Optional[bool] = None
+    # --shed: per-class load shedding — admission probability derived
+    # from the measured service rate and queue depth; rejected requests
+    # surface as HTTP 429 with an honest computed Retry-After
+    # (cake_tpu/sched/shed.py)
+    shed: bool = False
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):

@@ -299,6 +299,15 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--step-log applies to engine serving (--api); one-shot "
             "generation records no step flight")
+    if args.priority_classes or args.preemption or args.shed:
+        # the whole scheduling subsystem lives in the serving engine
+        # (priority queues / preemption / shed admission); a one-shot
+        # generation has exactly one request and nothing to schedule —
+        # be loud instead of the flags silently doing nothing
+        logging.getLogger(__name__).warning(
+            "--priority-classes / --preemption / --shed apply to "
+            "engine serving (--api); one-shot generation runs a "
+            "single request with nothing to schedule")
     if args.kv_pages or args.auto_prefix:
         # both live in the serving engine (paged pool / prefix
         # registry); a one-shot generation silently ignoring them would

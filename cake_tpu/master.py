@@ -89,6 +89,7 @@ class Master:
                 draft_config=g.draft_config,
                 spec_gamma=g.gamma,
                 **self._trace_kwargs(),
+                **self._sched_kwargs(),
                 # passed through so the engine's own guard WARNS that
                 # multi-step scans don't apply in speculative mode
                 # (each round already advances up to gamma+1 tokens),
@@ -134,6 +135,7 @@ class Master:
                 step_fns=fns, cache=cache,
                 prompt_limit=ctx_len, decode_budget=tail_len,
                 **self._trace_kwargs(),
+                **self._sched_kwargs(),
                 # passed through so the engine's no-chunk-fn guard WARNS
                 # that --prefill-chunk has no sp variant, instead of the
                 # flag silently vanishing
@@ -190,6 +192,7 @@ class Master:
             kv_page_size=getattr(self.args, "kv_page_size", 128),
             paged_attn=getattr(self.args, "paged_attn", "auto"),
             **self._trace_kwargs(),
+            **self._sched_kwargs(),
             **kwargs,
         )
 
@@ -202,6 +205,18 @@ class Master:
             trace_ring=getattr(self.args, "trace_ring", 256),
             step_log=getattr(self.args, "step_log", None),
             step_ring=getattr(self.args, "step_ring", 512),
+        )
+
+    def _sched_kwargs(self) -> dict:
+        """SLO scheduling knobs (--priority-classes / --preemption /
+        --shed), plumbed to every engine flavor; the engine itself
+        warns and degrades when a flavor cannot preempt (speculative,
+        windowed ctx+tail layouts)."""
+        return dict(
+            priority_classes=getattr(self.args, "priority_classes",
+                                     False),
+            preemption=getattr(self.args, "preemption", None),
+            shed=getattr(self.args, "shed", False),
         )
 
     # -- text ----------------------------------------------------------------

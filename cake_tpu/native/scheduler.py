@@ -10,6 +10,11 @@ Both implementations expose the same interface:
     plan() -> (prefill [(id, slot)], decode [(id, slot)])
     report(id, n_tokens, eos) -> bool finished
     queue_depth / active / completed properties
+
+This module is the PRIORITY-FREE fallback: `cake_tpu/sched` wraps this
+seam with priority-class queues, anti-starvation aging, preemption and
+load shedding (--priority-classes); with those off, the engine drives
+these FIFO schedulers unchanged.
 """
 
 from __future__ import annotations

@@ -74,6 +74,9 @@ class TraceRecord:
     rid: int
     prompt_tokens: int = 0
     max_new_tokens: int = 0
+    # admission class (cake_tpu/sched priority classes; "standard"
+    # for engines without SLO scheduling)
+    priority: str = "standard"
     spans: List[tuple] = field(default_factory=list)
     status: str = "active"
     error: Optional[str] = None
@@ -128,6 +131,7 @@ class TraceRecord:
         out = {
             "rid": self.rid,
             "status": self.status,
+            "priority": self.priority,
             "prompt_tokens": self.prompt_tokens,
             "max_new_tokens": self.max_new_tokens,
             "output_tokens": self.output_tokens,
@@ -186,17 +190,18 @@ class RequestTracer:
     # -- lifecycle hooks (called by the engine) ---------------------------
 
     def admit(self, rid: int, prompt_tokens: int,
-              max_new_tokens: int) -> None:
+              max_new_tokens: int, priority: str = "standard") -> None:
         now = time.perf_counter()
         rec = TraceRecord(rid=rid, prompt_tokens=prompt_tokens,
                           max_new_tokens=max_new_tokens,
+                          priority=priority,
                           wall_start=time.time())
         rec.spans.append(("admitted", now))
         rec.spans.append(("queued", now))
         with self._lock:
             self._active[rid] = rec
         self._event(rec, "admitted", prompt_tokens=prompt_tokens,
-                    max_new_tokens=max_new_tokens)
+                    max_new_tokens=max_new_tokens, priority=priority)
 
     def drop(self, rid: int) -> None:
         """Un-admit a request whose submission was rejected (queue

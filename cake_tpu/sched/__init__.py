@@ -1,0 +1,45 @@
+"""SLO-aware scheduling for the serving engine (`cake_tpu/sched`).
+
+The subsystem that turns the engine from a batcher into a multi-tenant
+server. It wraps the existing ``make_scheduler`` seam — the priority-
+free native/Python FIFO scheduler (``cake_tpu/native/scheduler.py``)
+stays the fallback — with three capabilities:
+
+  1. **Priority classes** (``classes.py``): ``interactive`` /
+     ``standard`` / ``batch`` queues with weighted anti-starvation
+     aging, so ``plan()`` admits by class, not arrival order.
+  2. **Recompute-style preemption** (``slo.py`` victim selection +
+     the engine's fold): when a higher class is slot- or page-starved,
+     the youngest lowest-class decoding slot is preempted — its
+     generated tokens fold into its prompt (the checkpoint-resume
+     fold), its pages release through the refcounted allocator, and it
+     requeues to re-prefill later, with a per-request preemption budget
+     guaranteeing progress.
+  3. **Load shedding** (``shed.py``): per-class admission probability
+     from measured service rate and queue depth, surfaced as HTTP 429
+     with an honest computed ``Retry-After``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cake_tpu.sched.classes import (  # noqa: F401
+    CLASS_RANK, DEFAULT_PRIORITY, PRIORITY_CLASSES, ClassPolicy,
+    SchedConfig, validate_priority,
+)
+from cake_tpu.sched.shed import (  # noqa: F401
+    ShedController, ShedDecision, ShedError,
+)
+from cake_tpu.sched.slo import SLOScheduler  # noqa: F401
+
+
+def make_scheduler(max_slots: int, max_queue: int = 1024, *,
+                   priority_classes: bool = False,
+                   config: Optional[SchedConfig] = None):
+    """The scheduler seam: the SLO scheduler when priority classes are
+    on, else the native (C++)/Python FIFO fallback unchanged."""
+    if priority_classes:
+        return SLOScheduler(max_slots, max_queue, config=config)
+    from cake_tpu.native.scheduler import make_scheduler as _fifo
+    return _fifo(max_slots, max_queue)

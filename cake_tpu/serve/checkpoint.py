@@ -146,6 +146,9 @@ def snapshot_requests(engine) -> List[Dict]:
             # ring over its whole transcript, not just the latest leg
             "penalty_context": list(req.prime_tokens) + list(req.out_tokens),
             "remaining": max(0, req.max_new_tokens - len(req.out_tokens)),
+            # SLO class survives the restart (older snapshots lack the
+            # field; resume defaults it to "standard")
+            "priority": getattr(req, "priority", "standard"),
             "temperature": req.temperature,
             "top_p": req.top_p,
             "repeat_penalty": req.repeat_penalty,
@@ -254,6 +257,7 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
                 repeat_penalty=rec["repeat_penalty"],
                 prime_penalty_tokens=rec.get("penalty_context",
                                              rec["out_tokens"]),
+                priority=rec.get("priority"),
             )
             tracer = getattr(engine, "tracer", None)
             if tracer is not None:

@@ -48,10 +48,13 @@ from cake_tpu.models.llama.generator import (
 from cake_tpu.models.llama.model import (
     RopeTables, decode_step_ragged, prefill_slot, prefill_slot_prefixed,
 )
-from cake_tpu.native.scheduler import make_scheduler
 from cake_tpu.ops.sampling import (
     SamplingConfig, sample_tokens_ragged, update_ring_per_row,
 )
+from cake_tpu.sched import (
+    SchedConfig, ShedController, ShedError, make_scheduler,
+)
+from cake_tpu.sched.classes import CLASS_RANK, validate_priority
 
 log = logging.getLogger(__name__)
 
@@ -85,6 +88,30 @@ _PREFIX_TOKENS_SAVED = obs_metrics.counter(
     "cake_prefix_tokens_saved_total",
     "Prompt tokens whose prefill was skipped via a cached prefix")
 
+# SLO-aware scheduling (cake_tpu/sched): preemption/shed outcomes and
+# per-class queue state — the observables behind the 429 contract and
+# the bench --slo tier's preemption-on-vs-off comparison
+_PREEMPTIONS = obs_metrics.counter(
+    "cake_preemptions_total",
+    "Decoding slots preempted for a starved higher priority class, by "
+    "trigger (slots = slot-starved, pages = kv-page-starved)",
+    labelnames=("reason",))
+_SHED_REQUESTS = obs_metrics.counter(
+    "cake_shed_requests_total",
+    "Requests rejected by per-class load shedding (HTTP 429 with a "
+    "computed Retry-After)",
+    labelnames=("class",))
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "cake_queue_depth",
+    "Queued requests by priority class (SLO scheduler; refreshed at "
+    "submit, each engine iteration, and metrics scrape)",
+    labelnames=("class",))
+_SCHED_TTFT = obs_metrics.histogram(
+    "cake_sched_ttft_seconds",
+    "Submit-to-first-token latency by priority class (includes queue "
+    "wait and any preemption-induced requeues)",
+    labelnames=("class",))
+
 
 @dataclass
 class _Request:
@@ -110,6 +137,10 @@ class _Request:
     # extra lax.top_k + host transfer is only paid while such a request
     # is in the batch
     want_top: bool = False
+    # SLO scheduling (cake_tpu/sched): admission class and how many
+    # times this request's slot has been reclaimed for a higher class
+    priority: str = "standard"
+    preemptions: int = 0
     out_tokens: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
     # per emitted token: [(alt_token_id, alt_logprob), ...] top-N list
@@ -187,6 +218,10 @@ class EngineStats:
     prefix_hits: int = 0     # prefills served from a registered prefix
     errors: int = 0
     last_error: str = ""
+    # SLO scheduling: slots reclaimed for a higher class / requests
+    # rejected by load shedding (cake_tpu/sched)
+    preemptions: int = 0
+    shed: int = 0
     # speculative engine mode: drafts offered / kept across all slots
     spec_proposed: int = 0
     spec_accepted: int = 0
@@ -237,6 +272,10 @@ class InferenceEngine:
         trace_ring: int = 256,
         step_log: Optional[str] = None,
         step_ring: int = 512,
+        priority_classes: bool = False,
+        preemption: Optional[bool] = None,
+        shed: bool = False,
+        sched_config: Optional[SchedConfig] = None,
     ):
         self.config = config
         self.params = params
@@ -476,7 +515,41 @@ class InferenceEngine:
                 lambda x: (x.shape, x.dtype, x.sharding), self.cache,
                 is_leaf=lambda x: hasattr(x, "sharding"))
             self._cache_dtype = self.cache[0].dtype
-        self.scheduler = make_scheduler(max_slots, max_queue)
+        # SLO-aware scheduling (cake_tpu/sched): priority-class queues
+        # with anti-starvation aging replace FIFO admission; preemption
+        # recompute-folds a lower-class slot back into the queue when a
+        # higher class is slot- or page-starved; shedding turns
+        # overload into honest 429s. The FIFO native scheduler stays
+        # the priority-free fallback.
+        self._sched_cfg = sched_config or SchedConfig()
+        self._slo = bool(priority_classes)
+        can_preempt = not self._spec and self.decode_budget is None
+        if preemption is None:
+            self._preemption = self._slo and can_preempt
+        else:
+            self._preemption = bool(preemption)
+        if self._preemption and not self._slo:
+            log.warning("--preemption requires --priority-classes; "
+                        "preemption disabled")
+            self._preemption = False
+        if self._preemption and not can_preempt:
+            log.warning(
+                "preemption disabled: %s",
+                "speculative serving keeps the draft cache aligned "
+                "with the target per round (no recompute-resume path)"
+                if self._spec else
+                "windowed (ctx+tail) layouts cannot fold generated "
+                "tokens back into the prompt window")
+            self._preemption = False
+        self._shed = ShedController(self._sched_cfg) if shed else None
+        # rank of a page-starved higher-class admission awaiting a
+        # victim; consumed at the TOP of the next engine iteration (a
+        # mid-wave preemption would leave already-planned decode rows
+        # writing through a released page-table row)
+        self._pending_page_preempt: Optional[int] = None
+        self.scheduler = make_scheduler(
+            max_slots, max_queue, priority_classes=self._slo,
+            config=self._sched_cfg)
         self.stats = EngineStats()
         # request-lifecycle traces (obs/tracing.py): spans recorded at
         # the submit/prefill/emit/retire seams below, so every serving
@@ -720,6 +793,7 @@ class InferenceEngine:
         stream: Optional[Callable[..., None]] = None,
         prime_penalty_tokens: Optional[Sequence[int]] = None,
         want_top_logprobs: bool = False,
+        priority: Optional[str] = None,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; a callback with attribute
@@ -731,6 +805,10 @@ class InferenceEngine:
             # post-stop submits (e.g. an HTTP handler racing shutdown) must
             # not mutate state under a checkpoint snapshot
             raise RuntimeError("engine stopped")
+        # validate the class EVERY time (unknown values must 400 at the
+        # API); the class only orders admission when the SLO scheduler
+        # is on, but it always labels the TTFT histogram
+        cls = validate_priority(priority)
         ids = list(prompt_ids)
         if not ids:
             raise ValueError("empty prompt")
@@ -781,6 +859,20 @@ class InferenceEngine:
                 raise ValueError(
                     "logprobs are unavailable in speculative serving "
                     "(accepted drafts are not sampled step-by-step)")
+        if self._shed is not None:
+            # AFTER every validation above: an invalid request must get
+            # its deterministic 400, never a 429 inviting a retry of
+            # something that can never succeed (and must not pollute
+            # the shed counters)
+            depth = (self.scheduler.depth_ahead(cls)
+                     if hasattr(self.scheduler, "depth_ahead")
+                     else self.scheduler.queue_depth)
+            dec = self._shed.decide(cls, depth)
+            if not dec.admit:
+                self.stats.shed += 1
+                _SHED_REQUESTS.labels(cls).inc()
+                raise ShedError(cls, dec.retry_after_s,
+                                est_wait_s=dec.est_wait_s)
         req = _Request(
             rid=rid, prompt_ids=ids, max_new_tokens=max_new,
             temperature=eff_temp if eff_temp is not None else 0.0,
@@ -792,6 +884,7 @@ class InferenceEngine:
             submit_t=time.perf_counter(),
             prime_tokens=list(prime_penalty_tokens or ()),
             want_top=want_top_logprobs,
+            priority=cls,
         )
         # register BEFORE scheduler.submit: the engine thread may plan the
         # rid immediately, and _do_prefill treats an unknown rid as cancelled
@@ -799,11 +892,19 @@ class InferenceEngine:
         # trace BEFORE scheduler.submit: the engine thread may plan the
         # rid immediately, and prefill_start on an unknown rid would
         # silently drop the span (no queue-wait/prefill observation)
-        self.tracer.admit(rid, len(ids), max_new)
-        if not self.scheduler.submit(rid, len(ids), max_new):
+        self.tracer.admit(rid, len(ids), max_new, priority=cls)
+        ok = (self.scheduler.submit(rid, len(ids), max_new, priority=cls)
+              if self._slo else
+              self.scheduler.submit(rid, len(ids), max_new))
+        if not ok:
             self._requests.pop(rid, None)
             self.tracer.drop(rid)
-            raise QueueFullError("engine queue full")
+            retry = 1.0
+            if self._shed is not None:
+                retry = self._shed.estimate_retry_after(
+                    cls, self.scheduler.queue_depth)
+            raise QueueFullError(retry_after=retry)
+        self._set_queue_gauges()
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
 
@@ -1243,7 +1344,14 @@ class InferenceEngine:
         while not self._stop.is_set():
             self._drain_cancellations()
             self._drain_commands()
+            if self._slo and self._preemption:
+                # between iterations only: no device work is in flight,
+                # so a reclaimed slot cannot be mid-decode through a
+                # just-released page-table row
+                self._maybe_preempt()
             prefill_plan, decode_plan = self.scheduler.plan()
+            if self._slo:
+                self._set_queue_gauges()
             if not prefill_plan and not decode_plan:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -1458,6 +1566,66 @@ class InferenceEngine:
             compiled=bool(js is not None and js.new),
             **self._page_kw())
 
+    # -- SLO scheduling: preemption + shed seams (cake_tpu/sched) --------
+
+    def _set_queue_gauges(self) -> None:
+        depths = getattr(self.scheduler, "class_depths", None)
+        if depths is None:
+            return
+        for c, d in depths().items():
+            _QUEUE_DEPTH.labels(c).set(d)
+
+    def _maybe_preempt(self) -> None:
+        """Reclaim at most one decoding slot per iteration for a
+        starved higher class: first for a page-starved admission noted
+        last iteration (reason=pages), else for the best-scored waiting
+        request when every slot is taken (reason=slots). Victim choice
+        (youngest slot of the worst class, preemption budget respected)
+        lives in the scheduler; the recompute fold lives here."""
+        pend, self._pending_page_preempt = self._pending_page_preempt, None
+        cands = []
+        if pend is not None:
+            cands = [(v, "pages")
+                     for v in self.scheduler.preemption_victims(pend)]
+        if not cands:
+            cands = [(v, "slots")
+                     for v in self.scheduler.slot_preemption_victims()]
+        for (rid, slot), reason in cands:
+            if self._preempt_slot(rid, slot, reason):
+                return
+
+    def _preempt_slot(self, rid: int, slot: int, reason: str) -> bool:
+        """Recompute-style preemption of one decoding slot: the victim's
+        generated tokens fold into its prompt (exactly the
+        checkpoint-resume fold, serve/checkpoint.resume — _do_prefill
+        re-prefills prompt+generated and the next sampled token is the
+        one an uninterrupted greedy run would emit), its pages release
+        through the refcounted allocator (shared prefix pages just
+        decref), and it requeues WITH its original seniority to
+        re-prefill when capacity returns."""
+        req = (self._slot_req[slot]
+               if 0 <= slot < self.max_slots else None)
+        if req is None or req.rid != rid or req.done.is_set():
+            return False
+        remaining = req.max_new_tokens - len(req.out_tokens)
+        if remaining <= 0:
+            return False    # retiring this iteration anyway
+        if not self.scheduler.requeue(
+                rid, len(req.prompt_ids) + len(req.out_tokens),
+                remaining, preempted=True):
+            return False
+        self._slot_req[slot] = None
+        req.slot = -1
+        req.preemptions += 1
+        self._release_slot_pages(slot)
+        self.stats.preemptions += 1
+        _PREEMPTIONS.labels(reason=reason).inc()
+        self.tracer.span(rid, "preempted", reason=reason,
+                         generated=len(req.out_tokens))
+        log.debug("preempted rid=%d (%s, %d tokens fold into the "
+                  "prompt)", rid, reason, len(req.out_tokens))
+        return True
+
     def _release_slot_pages(self, slot: int) -> None:
         """Refcounted release of a slot's page mappings — idempotent
         under the cancel-vs-error race (both teardown paths pop the same
@@ -1496,7 +1664,16 @@ class InferenceEngine:
         if blocked is not None and blocked not in self._requests:
             blocked = self._page_blocked_rid = None  # cancelled/failed
         if blocked is not None and req.rid != blocked:
-            return self._requeue_for_pages(req, slot, starved=False)
+            # SLO scheduling: a request that OUTRANKS the blocked head
+            # (strictly better effective score) may try the pool past
+            # it — once the head ages enough its score is best, nothing
+            # outranks it, and it keeps first claim on freed pages
+            # (the aged blocking head cannot be starved)
+            leapfrog = (self._slo
+                        and hasattr(self.scheduler, "outranks")
+                        and self.scheduler.outranks(req.rid, blocked))
+            if not leapfrog:
+                return self._requeue_for_pages(req, slot, starved=False)
         prefix_pages: List[int] = []
         n_prefix = 0
         if hit is not None:
@@ -1523,14 +1700,22 @@ class InferenceEngine:
 
     def _requeue_for_pages(self, req: _Request, slot: int,
                            starved: bool) -> bool:
-        self.scheduler.cancel(req.rid)
         self._slot_req[slot] = None
         req.slot = -1
         self._page_starved = True
         if starved and getattr(self, "_page_blocked_rid", None) is None:
             self._page_blocked_rid = req.rid
-        if not self.scheduler.submit(req.rid, len(req.prompt_ids),
-                                     req.max_new_tokens):
+        if self._slo:
+            # requeue (not cancel+submit): seniority survives, so the
+            # aging score keeps counting from the original admission
+            ok = self.scheduler.requeue(
+                req.rid, len(req.prompt_ids) + len(req.out_tokens),
+                req.max_new_tokens - len(req.out_tokens))
+        else:
+            self.scheduler.cancel(req.rid)
+            ok = self.scheduler.submit(req.rid, len(req.prompt_ids),
+                                       req.max_new_tokens)
+        if not ok:
             req.error = RuntimeError(
                 "kv page pool exhausted and admission queue full")
             self._requests.pop(req.rid, None)
@@ -1540,6 +1725,15 @@ class InferenceEngine:
             req.done.set()
         else:
             self.tracer.span(req.rid, "requeued")
+            if starved and self._slo and self._preemption:
+                # note the starved class for the TOP of the next
+                # iteration: preempting mid-wave would leave the
+                # already-planned decode rows writing through a
+                # released page-table row
+                r = CLASS_RANK[req.priority]
+                cur = self._pending_page_preempt
+                self._pending_page_preempt = (r if cur is None
+                                              else min(cur, r))
         return False
 
     def _do_prefill(self, rid: int, slot: int, defer: bool = False):
@@ -1558,6 +1752,17 @@ class InferenceEngine:
         req.slot = slot
         self._slot_req[slot] = req
         ids = req.prompt_ids
+        prime = req.prime_tokens
+        if req.out_tokens:
+            # preempted-and-requeued request (tokens exist before this
+            # prefill only via preemption): recompute-style resume —
+            # the generated tokens fold into the prompt and the
+            # penalty ring reconstructs over the whole transcript,
+            # exactly the checkpoint-resume fold (serve/checkpoint
+            # .resume), so the re-prefill leaves cache and sampling
+            # state as an uninterrupted run would have them
+            ids = list(req.prompt_ids) + list(req.out_tokens)
+            prime = list(req.prime_tokens) + list(req.out_tokens)
         # match BEFORE page admission: a paged prefix hit changes the
         # allocation itself (suffix + budget pages only, prefix pages
         # mapped shared)
@@ -1576,11 +1781,11 @@ class InferenceEngine:
                 "op": "prefill_prefixed", "pid": hit_pid, "ids": ids,
                 "slot": slot, "temp": req.temperature,
                 "top_p": req.top_p, "penalty": req.repeat_penalty,
-                "prime": list(req.prime_tokens), "n_top": n_top,
+                "prime": list(prime), "n_top": n_top,
             })
             out = self._prefixed_prefill_device(
                 hit_pid, ids, slot, req.temperature, req.top_p,
-                req.repeat_penalty, req.prime_tokens, n_top=n_top,
+                req.repeat_penalty, prime, n_top=n_top,
                 entry=entry, defer=defer)
             self.stats.prefix_hits += 1
         else:
@@ -1592,11 +1797,11 @@ class InferenceEngine:
                 "op": "prefill", "ids": ids, "slot": slot,
                 "temp": req.temperature, "top_p": req.top_p,
                 "penalty": req.repeat_penalty,
-                "prime": list(req.prime_tokens), "n_top": n_top,
+                "prime": list(prime), "n_top": n_top,
             })
             out = self._prefill_device(
                 ids, slot, req.temperature, req.top_p,
-                req.repeat_penalty, req.prime_tokens, n_top=n_top,
+                req.repeat_penalty, prime, n_top=n_top,
                 defer=defer)
         if defer:
             return (req, t0, slot, out)
@@ -2035,6 +2240,8 @@ class InferenceEngine:
         if req.slot >= 0 and self._slot_req[req.slot] is req:
             self._slot_req[req.slot] = None
         self._requests.pop(req.rid, None)
+        if self._shed is not None:
+            self._shed.observe_retire()
         self.stats.requests_completed += 1
         self.tracer.finish(req.rid, "retired",
                            output_tokens=len(req.out_tokens))
@@ -2405,6 +2612,11 @@ class InferenceEngine:
         if not req.out_tokens:
             req.first_token_t = now
             self.tracer.first_token(req.rid)
+            # per-class TTFT (includes queue wait and any
+            # preemption-induced requeues): the latency the SLO
+            # scheduler exists to protect, labeled so interactive and
+            # batch distributions separate on one scrape
+            _SCHED_TTFT.labels(req.priority).observe(now - req.submit_t)
         else:
             self.tracer.token(req.rid)
         req.out_tokens.append(token_id)
@@ -2431,6 +2643,8 @@ class InferenceEngine:
             self._release_slot_pages(req.slot)
             self._requests.pop(req.rid, None)
             self.stats.requests_completed += 1
+            if self._shed is not None:
+                self._shed.observe_retire()
             self.tracer.finish(req.rid, "retired",
                                output_tokens=len(req.out_tokens))
             req.done.set()
@@ -2534,7 +2748,15 @@ class InferenceEngine:
 
 
 class QueueFullError(Exception):
-    pass
+    """Admission queue full. retry_after: computed seconds a client
+    should wait before retrying — derived from the measured service
+    rate when load shedding is on, else a 1s floor (the API surfaces
+    it as HTTP 429 + Retry-After, api/server.py)."""
+
+    def __init__(self, msg: str = "engine queue full",
+                 retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 @jax.jit

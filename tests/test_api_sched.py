@@ -1,0 +1,154 @@
+"""REST API: priority classes, the 429 + Retry-After overload contract.
+
+One tiny engine-backed server; individual tests flip the engine into
+queue-full / always-shed states and restore them, so the fixture is
+shared without cross-talk.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.api.server import start
+from cake_tpu.args import Args
+from cake_tpu.master import Master
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import ByteTokenizer, LlamaGenerator
+from cake_tpu.models.llama.params import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.sched.shed import ShedDecision
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, params, ByteTokenizer(cfg.vocab_size),
+                         max_seq_len=256,
+                         sampling=SamplingConfig(temperature=0.0),
+                         cache_dtype=jnp.float32)
+    master = Master(Args(sample_len=4, priority_classes=True,
+                         shed=True),
+                    text_generator=gen)
+    engine = master.make_engine(max_slots=2)
+    httpd = start(master, address="127.0.0.1:0", block=False,
+                  engine=engine)
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}", engine
+    httpd.shutdown()
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url + "/api/v1/chat/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=60)
+
+
+BODY = {"messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3}
+
+
+def test_priority_in_body_accepted(served):
+    url, engine = served
+    resp = _post(url, {**BODY, "priority": "interactive"})
+    obj = json.loads(resp.read())
+    assert obj["object"] == "chat.completion"
+    # the trace recorded the class
+    recs = engine.tracer.dump(limit=1)
+    assert recs[0]["priority"] == "interactive"
+
+
+def test_priority_header_accepted_body_wins(served):
+    url, engine = served
+    resp = _post(url, BODY, headers={"x-cake-priority": "batch"})
+    assert json.loads(resp.read())["object"] == "chat.completion"
+    assert engine.tracer.dump(limit=1)[0]["priority"] == "batch"
+    # explicit body priority beats the header
+    resp = _post(url, {**BODY, "priority": "standard"},
+                 headers={"x-cake-priority": "batch"})
+    assert json.loads(resp.read())["object"] == "chat.completion"
+    assert engine.tracer.dump(limit=1)[0]["priority"] == "standard"
+    # a JSON null body priority counts as unset: the header applies
+    # (SDKs serialize optional fields as null)
+    resp = _post(url, {**BODY, "priority": None},
+                 headers={"x-cake-priority": "interactive"})
+    assert json.loads(resp.read())["object"] == "chat.completion"
+    assert engine.tracer.dump(limit=1)[0]["priority"] == "interactive"
+
+
+@pytest.mark.parametrize("how", ["body", "header"])
+def test_unknown_priority_400(served, how):
+    url, _engine = served
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        if how == "body":
+            _post(url, {**BODY, "priority": "vip"})
+        else:
+            _post(url, BODY, headers={"x-cake-priority": "vip"})
+    assert ei.value.code == 400
+    assert "priority" in json.loads(ei.value.read())["error"]
+
+
+def test_queue_full_maps_to_429_with_retry_after(served):
+    url, engine = served
+    old = engine.scheduler.max_queue
+    engine.scheduler.max_queue = 0
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, BODY)
+        assert ei.value.code == 429
+        retry = ei.value.headers["Retry-After"]
+        assert retry is not None and int(retry) >= 1
+        assert json.loads(ei.value.read())["error"] == "queue full"
+    finally:
+        engine.scheduler.max_queue = old
+
+
+def test_shed_maps_to_429_with_computed_retry_after(served):
+    url, engine = served
+
+    class _AlwaysShed:
+        def decide(self, cls, depth, now=None):
+            return ShedDecision(False, 7.0, 0.0, 9.0)
+
+        def observe_retire(self, now=None):
+            pass
+
+        def estimate_retry_after(self, cls, depth, now=None):
+            return 7.0
+
+    old = engine._shed
+    engine._shed = _AlwaysShed()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url, BODY)
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "7"
+        assert "shed" in json.loads(ei.value.read())["error"]
+    finally:
+        engine._shed = old
+
+
+def test_health_reports_class_depths(served):
+    url, _engine = served
+    obj = json.loads(urllib.request.urlopen(
+        url + "/api/v1/health", timeout=30).read())
+    assert set(obj["queue_depth_by_class"]) == {
+        "interactive", "standard", "batch"}
+    assert "preemptions" in obj and "requests_shed" in obj
+
+
+def test_metrics_expose_sched_families(served):
+    url, _engine = served
+    text = urllib.request.urlopen(
+        url + "/api/v1/metrics", timeout=30).read().decode()
+    assert "cake_queue_depth{" in text
+    assert "cake_sched_ttft_seconds_bucket" in text
+    assert "# TYPE cake_preemptions_total counter" in text
+    assert "# TYPE cake_shed_requests_total counter" in text

@@ -148,6 +148,34 @@ def test_paged_prefix_smoke_tier_reports_sharing():
     assert result["prefill_suffix_tok_s"] > 0
 
 
+@pytest.mark.slow  # two engine phases under load -> slow lane
+def test_slo_smoke_tier_reports_preemption_win():
+    """The --slo tier's acceptance contract: preemption actually
+    engaged (preemptions_total > 0) and interactive-class p99 TTFT
+    with preemption sits STRICTLY below the preemption-off phase under
+    the same offered load — the number the sched/ subsystem exists
+    for. A run where preemption silently stopped firing benches FIFO
+    twice and fails here."""
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_base_env(CAKE_BENCH_TIER="slo_tiny"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("{"))
+    result = json.loads(line)
+    assert result["unit"] == "ms" and result["value"] > 0
+    assert result["preemptions_total"] > 0
+    assert result["preemptions_total_off"] == 0
+    assert (result["interactive_ttft_p99_on_ms"]
+            < result["interactive_ttft_p99_off_ms"])
+    # every class reported both phases' percentiles
+    for cls in ("interactive", "standard", "batch"):
+        for tag in ("on", "off"):
+            assert result[f"{cls}_ttft_p50_{tag}_ms"] > 0
+            assert result[f"{cls}_ttft_p99_{tag}_ms"] > 0
+
+
 def test_paged_attn_microbench_rejects_bad_impl():
     proc = subprocess.run(
         [sys.executable, BENCH, "--paged-attn", "nope"], env=_base_env(),

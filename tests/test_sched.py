@@ -1,0 +1,271 @@
+"""SLO scheduler (cake_tpu/sched): classes, aging, preemption, shed.
+
+Pure host-side tests — no device work — so the property test can drive
+hundreds of random submit/cancel/preempt/shed interleavings and check
+the invariants the engine relies on: slot accounting, page-refcount
+conservation (free + live == n_pages), and that the aged blocking head
+is never starved (admissions always follow the effective-score order).
+"""
+
+import random
+
+import pytest
+
+from cake_tpu.models.llama.paged import PageAllocator
+from cake_tpu.sched import (
+    PRIORITY_CLASSES, ClassPolicy, SchedConfig, ShedController,
+    SLOScheduler, make_scheduler, validate_priority,
+)
+
+
+def _cfg(**kw):
+    return SchedConfig(**kw)
+
+
+def test_make_scheduler_seam():
+    fifo = make_scheduler(2)
+    assert not isinstance(fifo, SLOScheduler)
+    slo = make_scheduler(2, priority_classes=True)
+    assert isinstance(slo, SLOScheduler)
+
+
+def test_validate_priority():
+    assert validate_priority(None) == "standard"
+    for c in PRIORITY_CLASSES:
+        assert validate_priority(c) == c
+    with pytest.raises(ValueError):
+        validate_priority("vip")
+
+
+def test_class_admission_order_not_fifo():
+    """A later-arriving interactive request is admitted before an
+    earlier batch request — plan() orders by class, not arrival."""
+    s = SLOScheduler(1)
+    assert s.submit(1, 10, 4, priority="batch", now=0.0)
+    pf, _ = s.plan(now=0.0)
+    assert pf == [(1, 0)]
+    assert s.submit(2, 10, 4, priority="batch", now=1.0)
+    assert s.submit(3, 10, 4, priority="interactive", now=2.0)
+    assert s.report(1, 4, eos=True)        # frees the slot
+    pf, _ = s.plan(now=3.0)
+    assert pf == [(3, 0)]                  # interactive leapfrogs batch
+    assert s.queue_depth == 1 and s.active == 1
+
+
+def test_aged_batch_head_beats_fresh_interactive():
+    """Anti-starvation aging: once a batch request has waited past
+    rank x aging_s, its effective score beats a fresh interactive
+    arrival and it MUST be admitted first."""
+    cfg = _cfg(policies=(
+        ClassPolicy("interactive", 0, aging_s=10.0, target_wait_s=2.0),
+        ClassPolicy("standard", 1, aging_s=10.0, target_wait_s=15.0),
+        ClassPolicy("batch", 2, aging_s=10.0, target_wait_s=120.0),
+    ))
+    s = SLOScheduler(1, config=cfg)
+    assert s.submit(1, 10, 4, priority="interactive", now=0.0)
+    s.plan(now=0.0)                        # rid 1 occupies the slot
+    assert s.submit(2, 10, 4, priority="batch", now=0.0)
+    # batch score at t=25: 2 - 25/10 = -0.5 < fresh interactive's 0.0
+    assert s.submit(3, 10, 4, priority="interactive", now=25.0)
+    assert s.report(1, 4, eos=True)
+    pf, _ = s.plan(now=25.0)
+    assert pf == [(2, 0)]                  # the aged head wins
+    assert s.outranks(2, 3, now=25.0)
+
+
+def test_requeue_preserves_seniority():
+    s = SLOScheduler(1)
+    assert s.submit(1, 10, 8, priority="standard", now=0.0)
+    s.plan(now=0.0)
+    # a fresh same-class request queued later
+    assert s.submit(2, 10, 8, priority="standard", now=5.0)
+    # rid 1 preempted back to the queue with its ORIGINAL enqueue time
+    assert s.requeue(1, 12, 6, preempted=True)
+    assert s.queue_depth == 2 and s.active == 0
+    pf, _ = s.plan(now=6.0)
+    assert pf == [(1, 0)]                  # seniority survived
+    # requeue of a queued (not active) rid refuses
+    assert not s.requeue(2, 10, 8)
+
+
+def test_preemption_victims_youngest_lowest_class_budget():
+    cfg = _cfg(preempt_budget=1)
+    s = SLOScheduler(3, config=cfg)
+    assert s.submit(1, 10, 50, priority="batch", now=0.0)
+    assert s.submit(2, 10, 50, priority="batch", now=1.0)
+    assert s.submit(3, 10, 50, priority="standard", now=2.0)
+    s.plan(now=2.0)                        # all three admitted
+    # nothing waits -> no slot-starvation victims
+    assert s.slot_preemption_victims(now=3.0) == []
+    assert s.submit(4, 10, 4, priority="interactive", now=3.0)
+    victims = s.slot_preemption_victims(now=3.0)
+    # worst class first, youngest first; the standard slot is last
+    assert [rid for rid, _slot in victims] == [2, 1, 3]
+    # budget: one preemption exhausts rid 2's allowance
+    assert s.requeue(2, 12, 40, preempted=True)
+    s.plan(now=3.0)                        # rid 4 takes the free slot
+    assert s.submit(5, 10, 4, priority="interactive", now=4.0)
+    assert 2 not in [r for r, _ in s.slot_preemption_victims(now=4.0)]
+    # an interactive waiter never preempts interactive peers
+    assert all(s._reqs[r]["rank"] > 0
+               for r, _ in s.slot_preemption_victims(now=4.0))
+
+
+def test_shed_controller_rate_and_decision():
+    cfg = _cfg()
+    ctl = ShedController(cfg, rng=random.Random(0), clock=lambda: 100.0)
+    # cold start: no measured completions -> admit, 1s retry floor
+    d = ctl.decide("interactive", depth_ahead=50, now=100.0)
+    assert d.admit and d.est_wait_s is None
+    assert ctl.estimate_retry_after("interactive", 50, now=100.0) == 1.0
+    # 1 completion/s over the last 10s
+    for t in range(90, 101):
+        ctl.observe_retire(now=float(t))
+    rate = ctl.service_rate(now=100.0)
+    assert rate == pytest.approx(1.1, rel=0.01)    # 11 events / 10s
+    # inside the class SLO -> admit with p=1
+    d = ctl.decide("interactive", depth_ahead=2, now=100.0)
+    assert d.admit and d.probability == 1.0
+    # far beyond it -> probability collapses, Retry-After is the
+    # honest drain time (est - target), not a constant
+    d = ctl.decide("interactive", depth_ahead=110, now=100.0)
+    assert d.est_wait_s == pytest.approx(100.0, rel=0.01)
+    assert d.probability == pytest.approx(2.0 / 100.0, rel=0.01)
+    assert d.retry_after_s == pytest.approx(d.est_wait_s - 2.0, rel=0.01)
+    # batch's loose target keeps admitting at the same depth
+    d_b = ctl.decide("batch", depth_ahead=110, now=100.0)
+    assert d_b.probability == 1.0 and d_b.admit
+
+
+def test_property_random_interleavings_preserve_invariants():
+    """Random submit/cancel/plan/report/preempt/shed interleavings:
+    slot accounting and page refcounts stay conserved, admissions
+    always follow the effective-score order (so the aged blocking head
+    cannot be starved), and every admitted request eventually
+    completes once arrivals stop."""
+    rng = random.Random(7)
+    N_PAGES, PSZ, SLOTS = 24, 4, 3
+    cfg = _cfg(preempt_budget=2)
+    sched = SLOScheduler(SLOTS, max_queue=64, config=cfg)
+    alloc = PageAllocator(N_PAGES, PSZ)
+    shed = ShedController(cfg, rng=random.Random(1))
+
+    now = 0.0
+    next_rid = 1
+    queued, active = {}, {}    # rid -> meta dict
+    done, shed_n = set(), 0
+
+    def score(meta):
+        return (meta["rank"] - max(0.0, now - meta["enq"])
+                / cfg.aging_s(meta["cls"]), meta["seq"])
+
+    def check():
+        assert alloc.free_pages + alloc.live_pages == alloc.n_pages
+        assert sched.active == len(active)
+        assert sched.queue_depth == len(queued)
+        slots = [m["slot"] for m in active.values()]
+        assert len(slots) == len(set(slots))
+
+    def do_plan():
+        prefill, _decode = sched.plan(now=now)
+        admitted = [rid for rid, _ in prefill]
+        # the never-starve property: every admitted rid scores no
+        # worse than anything still queued (score order == admission
+        # order, and the aged head's score only falls)
+        for rid in admitted:
+            for other, om in queued.items():
+                if other not in admitted:
+                    assert score(queued[rid]) <= score(om)
+        for rid, slot in prefill:
+            meta = queued.pop(rid)
+            need = meta["plen"] + meta["left"]
+            pages = alloc.alloc(need)
+            if pages is None:
+                assert sched.requeue(rid, meta["plen"], meta["left"])
+                queued[rid] = meta
+                continue
+            meta.update(slot=slot, pages=pages)
+            active[rid] = meta
+
+    def do_report():
+        for rid, meta in list(active.items()):
+            if rng.random() < 0.5:
+                continue
+            n = rng.randint(1, 2)
+            eos = rng.random() < 0.2
+            fin = sched.report(rid, n, eos)
+            meta["left"] -= n
+            if fin:
+                alloc.release(meta["pages"])
+                active.pop(rid)
+                done.add(rid)
+                shed.observe_retire(now=now)
+            else:
+                assert not eos and meta["left"] > 0
+
+    def do_submit():
+        nonlocal next_rid, shed_n
+        cls = rng.choice(PRIORITY_CLASSES)
+        d = shed.decide(cls, sched.depth_ahead(cls), now=now)
+        if not d.admit:
+            shed_n += 1
+            return
+        rid = next_rid
+        next_rid += 1
+        plen, left = rng.randint(2, 12), rng.randint(1, 8)
+        if sched.submit(rid, plen, left, priority=cls, now=now):
+            queued[rid] = dict(cls=cls, rank=cfg.rank(cls), enq=now,
+                               seq=sched._reqs[rid]["seq"], plen=plen,
+                               left=left, slot=-1, pages=None)
+
+    def do_preempt():
+        for rid, slot in sched.slot_preemption_victims(now=now)[:1]:
+            meta = active[rid]
+            assert meta["slot"] == slot
+            assert sched.requeue(rid, meta["plen"], meta["left"],
+                                 preempted=True)
+            alloc.release(meta["pages"])
+            meta.update(slot=-1, pages=None)
+            queued[rid] = active.pop(rid)
+
+    def do_cancel():
+        pool = list(queued) + list(active)
+        if not pool:
+            return
+        rid = rng.choice(pool)
+        assert sched.cancel(rid)
+        meta = (queued.pop(rid, None) or active.pop(rid))
+        if meta["pages"]:
+            alloc.release(meta["pages"])
+        done.add(rid)
+
+    ops = [(do_submit, 0.35), (do_plan, 0.3), (do_report, 0.2),
+           (do_preempt, 0.1), (do_cancel, 0.05)]
+    for _ in range(800):
+        now += rng.random() * 0.5
+        r, acc = rng.random(), 0.0
+        for fn, w in ops:
+            acc += w
+            if r < acc:
+                fn()
+                break
+        check()
+
+    # drain: arrivals stop; everything still in the system completes
+    for _ in range(2000):
+        if not queued and not active:
+            break
+        now += 0.5
+        do_plan()
+        for rid, meta in list(active.items()):
+            fin = sched.report(rid, 1, eos=False)
+            meta["left"] -= 1
+            if fin:
+                assert meta["left"] == 0   # budget math stayed in sync
+                alloc.release(meta["pages"])
+                active.pop(rid)
+                done.add(rid)
+        check()
+    assert not queued and not active, "scheduler starved the queue"
+    assert alloc.free_pages == alloc.n_pages
+    assert shed_n >= 0 and len(done) > 50

@@ -51,10 +51,13 @@ VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 # telemetry families that MUST be documented (help text + README
-# metrics table row) — the obs/steps.py surface plus the paged
-# prefix-sharing families (serve/engine.py cake_prefix_*)
+# metrics table row) — the obs/steps.py surface, the paged
+# prefix-sharing families (serve/engine.py cake_prefix_*), and the SLO
+# scheduling families (cake_tpu/sched: preemption / shed / per-class
+# TTFT)
 DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
-                       "cake_device_", "cake_prefix_")
+                       "cake_device_", "cake_prefix_", "cake_sched_",
+                       "cake_shed_", "cake_preemptions_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
