@@ -148,6 +148,23 @@ PAGED_PREFIX_TIERS = {
                                  suffix_len=64, gen_tokens=16),
 }
 
+# Token-level continuous batching tiers (bench.py --mixed): the same
+# interleaved-admission load served twice through one paged engine
+# config — --mixed-batch off (phase-split prefill-then-decode loop)
+# then on (one mixed ragged step, decode rows + prefill-chunk rows in
+# the same launch) — reporting aggregate tok/s, flight-recorder step
+# MFU, and TTFT p50/p99 of the mid-decode arrivals. The number this
+# tier exists for: with mixed batching on, step MFU rises and arrival
+# TTFT p99 falls under the same offered load, because admissions stop
+# pausing decode and prefill stops running at batch-1 occupancy.
+MIXED_TIERS = {
+    "mixed_8b_int8": dict(model="8b", quant="int8", max_seq=512,
+                          slots=8, kv_pages=64, kv_page_size=128,
+                          paged_attn="pallas", prompt_len=256,
+                          prefill_chunk=128, base_gen=128, wave_n=8,
+                          wave_gen=16, stagger_s=0.05),
+}
+
 # SLO scheduling tiers (bench.py --slo): a mixed-priority saturation
 # run through a --priority-classes engine, measured TWICE — preemption
 # off then on, same offered load — reporting per-class TTFT p50/p99
@@ -165,6 +182,10 @@ SLO_TIERS = {
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
+    "mixed_tiny": dict(model="tiny", quant=False, max_seq=128, slots=3,
+                       kv_pages=24, kv_page_size=16, paged_attn="fold",
+                       prompt_len=24, prefill_chunk=8, base_gen=64,
+                       wave_n=4, wave_gen=6, stagger_s=0.02),
     "slo_tiny": dict(model="tiny", quant=False, max_seq=128, slots=2,
                      prompt_len=24, prefill_chunk=16, batch_gen=64,
                      inter_n=6, inter_gen=4, standard_n=1,
@@ -264,6 +285,17 @@ def _settle_decode_stats(engine, base_decode_s: float,
            and time.perf_counter() - t0 < deadline_s):
         time.sleep(0.01)
     time.sleep(0.05)    # let any still-in-flight accrual land too
+
+
+def _synth_prompt(seed: int, prompt_len: int, vocab: int) -> list:
+    """Deterministic synthetic prompt shared by the A/B serving tiers."""
+    return [(7 * seed + 3 * j) % vocab + 3 for j in range(prompt_len)]
+
+
+def _pct(xs, q):
+    """Nearest-rank percentile over a small latency sample."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
 
 
 def _init_fn(quant):
@@ -478,6 +510,10 @@ def run_paged_tier(name: str, model: str, quant, max_seq: int,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
         kv_pages=kv_pages, kv_page_size=kv_page_size,
         paged_attn=paged_attn,
+        # phase-split on purpose: this microbench isolates the
+        # fold-vs-pallas DECODE kernel; the mixed step is benched by
+        # run_mixed_tier (bench.py --mixed)
+        mixed_batch="off",
     )
     prompt = list(range(3, 3 + prompt_len))
     with engine:
@@ -552,6 +588,11 @@ def run_paged_prefix_tier(name: str, model: str, quant, max_seq: int,
         sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
         kv_pages=kv_pages, kv_page_size=kv_page_size,
         paged_attn=paged_attn,
+        # phase-split on purpose: the tier's prefill tok/s numbers come
+        # from stats.prefill_time_s, which the mixed step folds into
+        # one combined launch — the sharing win is measured on the
+        # phase path where prefill wall is separable
+        mixed_batch="off",
     )
     V = cfg.vocab_size - 4
     prefix = [(7 * i) % V + 3 for i in range(prefix_len)]
@@ -614,6 +655,124 @@ def run_paged_prefix_tier(name: str, model: str, quant, max_seq: int,
     }
 
 
+def run_mixed_tier(name: str, model: str, quant, max_seq: int,
+                   slots: int, kv_pages: int, kv_page_size: int,
+                   paged_attn: str, prompt_len: int, prefill_chunk: int,
+                   base_gen: int, wave_n: int, wave_gen: int,
+                   stagger_s: float) -> dict:
+    """Token-level continuous batching A/B: slots-1 base streams decode
+    while wave_n staggered arrivals admit mid-decode; measured once
+    with --mixed-batch off (phase-split loop) and once on (one mixed
+    ragged step). Reports aggregate tok/s, flight-recorder step MFU,
+    and arrival TTFT p50/p99 for both phases, plus the count of mixed
+    steps that carried BOTH row kinds (the no-decode-pause observable
+    the test_bench smoke asserts). Each phase warms its jit programs
+    first so compiles stay out of the measured load."""
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+    pct = _pct
+
+    def phase(mixed: str) -> dict:
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            kv_pages=kv_pages, kv_page_size=kv_page_size,
+            paged_attn=paged_attn, prefill_chunk=prefill_chunk,
+            mixed_batch=mixed,
+        )
+        with engine:
+            t0 = time.perf_counter()
+            warm = engine.submit(prompt(99), max_new_tokens=8)
+            assert warm.wait(timeout=900), f"mixed[{mixed}] warmup timed out"
+            log(f"mixed[{mixed}] warmup (compile): "
+                f"{time.perf_counter() - t0:.1f}s")
+            _settle_decode_stats(engine, 0.0)
+            warm_steps = engine.flight.summary()["recorded_steps"]
+            base_decode_s = engine.stats.decode_time_s
+            # slots-1 base streams so one slot stays free: an arrival's
+            # chunks must be able to join the next step immediately
+            base = [engine.submit(prompt(i), max_new_tokens=base_gen)
+                    for i in range(slots - 1)]
+            t0 = time.perf_counter()
+            while (any(len(h._req.out_tokens) < 2 for h in base)
+                   and time.perf_counter() - t0 < 300):
+                time.sleep(0.005)
+            # snapshot AT the window start: tokens the base streams
+            # emitted while saturating must not inflate tokens/wall
+            t_load = time.perf_counter()
+            base_tokens = engine.stats.tokens_generated
+            wave = []
+            for i in range(wave_n):
+                wave.append(engine.submit(prompt(100 + i),
+                                          max_new_tokens=wave_gen))
+                time.sleep(stagger_s)
+            assert all(h.wait(timeout=900) for h in base + wave), \
+                f"mixed[{mixed}] load timed out"
+            wall = time.perf_counter() - t_load
+            _settle_decode_stats(engine, base_decode_s)
+            tokens = engine.stats.tokens_generated - base_tokens
+            # include_prefill: the OFF phase does its chunk prefills in
+            # dedicated `prefill` steps while the ON phase folds the
+            # same FLOPs into `mixed` records — counting both sides'
+            # full launches makes the A/B measure occupancy, not which
+            # records the aggregate happens to weight
+            util = engine.flight.utilization(since_step=warm_steps,
+                                             include_prefill=True)
+            both = sum(
+                1 for r in engine.flight.dump()
+                if r["kind"] == "mixed"
+                and r.get("rows_decode", 0) > 0
+                and r.get("rows_prefill", 0) > 0)
+            ttfts = [h.ttft for h in wave]
+        return {"tok_s": tokens / wall if wall > 0 else 0.0,
+                "mfu": util["mfu"], "hbm_util": util["hbm_util"],
+                "ttft_p50": pct(ttfts, 0.5), "ttft_p99": pct(ttfts, 0.99),
+                "both_kinds": both}
+
+    off = phase("off")
+    on = phase("on")
+    log(f"mixed: on {on['tok_s']:.1f} tok/s mfu {on['mfu']:.4f} "
+        f"TTFT p99 {on['ttft_p99']*1e3:.1f}ms "
+        f"({on['both_kinds']} both-kind mixed steps) vs off "
+        f"{off['tok_s']:.1f} tok/s mfu {off['mfu']:.4f} "
+        f"TTFT p99 {off['ttft_p99']*1e3:.1f}ms")
+    return {
+        "metric": f"{name}_mixed_ttft_p99_ms",
+        "value": round(on["ttft_p99"] * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "paged_attn": paged_attn,
+        "mixed_streams": slots - 1 + wave_n,
+        "mixed_steps_both_kinds": on["both_kinds"],
+        "mixed_tok_s_on": round(on["tok_s"], 2),
+        "mixed_tok_s_off": round(off["tok_s"], 2),
+        "mixed_step_mfu_on": on["mfu"],
+        "mixed_step_mfu_off": off["mfu"],
+        "mixed_ttft_p50_on_ms": round(on["ttft_p50"] * 1e3, 1),
+        "mixed_ttft_p50_off_ms": round(off["ttft_p50"] * 1e3, 1),
+        "mixed_ttft_p99_on_ms": round(on["ttft_p99"] * 1e3, 1),
+        "mixed_ttft_p99_off_ms": round(off["ttft_p99"] * 1e3, 1),
+        "kv_pages": kv_pages,
+        "kv_page_size": kv_page_size,
+        "device_kind": dev.device_kind,
+    }
+
+
 def run_slo_tier(name: str, model: str, quant, max_seq: int,
                  slots: int, prompt_len: int, prefill_chunk: int,
                  batch_gen: int, inter_n: int, inter_gen: int,
@@ -645,13 +804,8 @@ def run_slo_tier(name: str, model: str, quant, max_seq: int,
     params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     V = cfg.vocab_size - 4
-
-    def prompt(seed: int):
-        return [(7 * seed + 3 * j) % V + 3 for j in range(prompt_len)]
-
-    def pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+    pct = _pct
 
     def phase(preempt: bool) -> dict:
         engine = InferenceEngine(
@@ -870,7 +1024,10 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in SLO_TIERS or name.startswith("slo_"):
+    if name in MIXED_TIERS or name.startswith("mixed_"):
+        kwargs = {**MIXED_TIERS, **SMOKE_TIERS}[name]
+        result = run_mixed_tier(name, **kwargs)
+    elif name in SLO_TIERS or name.startswith("slo_"):
         kwargs = {**SLO_TIERS, **SMOKE_TIERS}[name]
         result = run_slo_tier(name, **kwargs)
     elif name in PAGED_PREFIX_TIERS or name.startswith("paged_prefix"):
@@ -1047,6 +1204,18 @@ def _paged_main(impl: str) -> int:
         extra={"paged_attn": impl})
 
 
+def _mixed_main() -> int:
+    """`bench.py --mixed`: the token-level continuous-batching tier —
+    one JSON line with mixed-on vs mixed-off tok/s, step MFU, and
+    arrival TTFT p50/p99 under the same interleaved-admission load,
+    plus the both-kinds mixed-step count. CPU-fallback rules match
+    main()."""
+    return _single_tier_main(
+        "mixed_ttft_p99_ms", "ms",
+        cpu_tier="mixed_tiny", tpu_tier="mixed_8b_int8",
+        fail_error="mixed continuous-batching tier failed")
+
+
 def _slo_main() -> int:
     """`bench.py --slo`: the mixed-priority SLO scheduling tier — one
     JSON line with per-class TTFT p50/p99 for a preemption-on vs
@@ -1159,6 +1328,8 @@ if __name__ == "__main__":
         probe_main()
     elif os.environ.get(ORCH_ENV):
         tier_main()
+    elif "--mixed" in sys.argv:
+        sys.exit(_mixed_main())
     elif "--slo" in sys.argv:
         sys.exit(_slo_main())
     elif "--paged-prefix" in sys.argv:

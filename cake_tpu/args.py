@@ -131,6 +131,14 @@ class Args:
     # fold over all pages (the reference semantics; use for debugging
     # or non-TPU backends); "auto" = pallas on TPU, fold elsewhere
     paged_attn: str = "auto"
+    # --mixed-batch: token-level continuous batching for the paged
+    # (--kv-pages) engine — ONE jitted mixed step processes decode rows
+    # and prefill-chunk rows together (per-row query-length metadata in
+    # the ragged paged-attention kernel), so a new request's chunks
+    # join the very next step instead of waiting for a decode pause.
+    # "auto" = on for paged serving, off elsewhere; "on" without
+    # --kv-pages is a config error; "off" keeps the phase-split loop
+    mixed_batch: str = "auto"
     # --trace-events PATH: append every request-lifecycle span as one
     # JSON line (obs/tracing.py) — the replayable audit log behind the
     # in-memory ring served at GET /api/v1/requests
@@ -180,6 +188,10 @@ class Args:
             raise ValueError(
                 f"unsupported paged_attn '{self.paged_attn}' "
                 "(choose auto, fold or pallas)")
+        if self.mixed_batch not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unsupported mixed_batch '{self.mixed_batch}' "
+                "(choose auto, on or off)")
         if self.kv_dtype is not None:
             # single source of truth for storage dtypes
             from cake_tpu.utils.devices import resolve_kv_dtype

@@ -308,14 +308,15 @@ def main(argv=None) -> int:
             "--priority-classes / --preemption / --shed apply to "
             "engine serving (--api); one-shot generation runs a "
             "single request with nothing to schedule")
-    if args.kv_pages or args.auto_prefix:
-        # both live in the serving engine (paged pool / prefix
-        # registry); a one-shot generation silently ignoring them would
-        # look like the feature "did nothing"
+    if args.kv_pages or args.auto_prefix \
+            or getattr(args, "mixed_batch", "auto") == "on":
+        # all live in the serving engine (paged pool / prefix registry
+        # / mixed ragged step); a one-shot generation silently ignoring
+        # them would look like the feature "did nothing"
         logging.getLogger(__name__).warning(
-            "--kv-pages / --auto-prefix apply to engine serving "
-            "(--api); one-shot generation uses the sequential "
-            "generator's dense cache")
+            "--kv-pages / --auto-prefix / --mixed-batch apply to "
+            "engine serving (--api); one-shot generation uses the "
+            "sequential generator's dense cache")
 
     if args.model_type.value == "image":
         count = [0]

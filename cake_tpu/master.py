@@ -77,6 +77,10 @@ class Master:
                             "prefix caching is not implemented for the "
                             "spec engine (draft cache has no prefix "
                             "install path)")
+            if getattr(self.args, "mixed_batch", "auto") == "on":
+                log.warning("--mixed-batch ignored with --draft-model: "
+                            "the mixed ragged step is a paged-engine "
+                            "path and the spec engine is not paged")
             slots = max_slots or getattr(self.args, "max_slots", 8)
             return InferenceEngine(
                 g.config, g.params, g.tokenizer,
@@ -125,6 +129,10 @@ class Master:
                 log.warning("--auto-prefix ignored: prefix caching is "
                             "not implemented for the sp engine's "
                             "sequence-sharded ctx cache")
+            if getattr(self.args, "mixed_batch", "auto") == "on":
+                log.warning("--mixed-batch ignored: the sp engine's "
+                            "ctx/tail cache is not paged, so there is "
+                            "no mixed ragged step to dispatch")
             log.info("sp engine: %d slots, ctx window %d + decode tail "
                      "%d", slots, ctx_len, tail_len)
             return InferenceEngine(
@@ -191,6 +199,11 @@ class Master:
             kv_pages=getattr(self.args, "kv_pages", None),
             kv_page_size=getattr(self.args, "kv_page_size", 128),
             paged_attn=getattr(self.args, "paged_attn", "auto"),
+            # token-level continuous batching: the paged engine's mixed
+            # ragged step (auto = on for --kv-pages serving; "on"
+            # without --kv-pages is rejected by the engine with a
+            # named reason instead of silently vanishing)
+            mixed_batch=getattr(self.args, "mixed_batch", "auto"),
             **self._trace_kwargs(),
             **self._sched_kwargs(),
             **kwargs,

@@ -248,6 +248,34 @@ def write_window_pages(pool_k, pool_v, k, v, table_row, pos0):
     return pk, pv
 
 
+def write_windows_pages(pool_k, pool_v, k, v, pos, q_len, active, table):
+    """Batched write_window_pages: every row scatters its q_len-token
+    window at absolute position pos[b] into its own pages (per layer).
+
+    pool_k/v: [N_pages, page, KV, hd]; k/v: [B, C, KV, hd]; pos/q_len:
+    [B]; active: [B] bool; table: [slots(=B), max_pages]. One
+    vectorized scatter covers the whole mixed batch: decode rows write
+    their single token (q_len=1), prefill-chunk rows their window, and
+    padding columns (i >= q_len), inactive rows, and positions landing
+    on unmapped pages all route to the out-of-bounds index N where
+    mode="drop" skips them. Distinct rows own distinct pages and a
+    row's positions are distinct, so the targets never collide."""
+    N, P = pool_k.shape[0], pool_k.shape[1]
+    B, C = k.shape[0], k.shape[1]
+    max_pages = table.shape[1]
+    positions = pos[:, None] + jnp.arange(C)[None, :]         # [B, C]
+    pidx = positions // P
+    pages = jnp.take_along_axis(
+        table, jnp.minimum(pidx, max_pages - 1), axis=1)
+    valid = ((jnp.arange(C)[None, :] < q_len[:, None])
+             & active[:, None] & (pidx < max_pages) & (pages >= 0))
+    idx = jnp.where(valid, pages, N)
+    offs = positions % P
+    pk = pool_k.at[idx, offs].set(k.astype(pool_k.dtype), mode="drop")
+    pv = pool_v.at[idx, offs].set(v.astype(pool_v.dtype), mode="drop")
+    return pk, pv
+
+
 def update_pool_per_row(pool_k, pool_v, k, v, pos, active, table):
     """Write one decode token per row into its page (per layer).
 
@@ -336,6 +364,75 @@ def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
     out = merge_attention_stats([(m, l, o)])
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
         B, 1, H, hd).astype(q.dtype)
+
+
+def paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
+                          impl: str = "fold"):
+    """Mixed ragged attention over paged KV: decode rows (q_len=1) and
+    prefill-chunk rows (q_len=C at arbitrary page offset) in ONE batch.
+
+    impl="fold" (the bit-exact REFERENCE semantics, exactly as the fold
+    is for decode): an XLA fori_loop over all max_pages — per-query
+    online-softmax accumulation where every page is read once; no dense
+    per-slot copy ever exists. impl="pallas": the mixed TPU kernel
+    (ops/ragged_paged_attention.ragged_paged_attention_mixed) — same
+    math, but each row streams only the pages up to
+    ceil((pos + q_len)/page); falls back to the fold on
+    hardware-untileable shapes (tiny test configs) and on chunk widths
+    whose C-scaled scratch would overflow VMEM (large --prefill-chunk).
+
+    q: [B, C, H, hd] (rope applied; every real query token's KV already
+    written to its page); pos: [B] position of each row's FIRST query;
+    q_len: [B] real query tokens (0 = idle row). Columns past q_len are
+    padding whose output the caller never reads. Returns [B, C, H, hd].
+    """
+    B, C, H, hd = q.shape
+    P = pool_k.shape[1]
+    max_pages = table.shape[1]
+    KV = pool_k.shape[2]
+
+    if impl == "pallas":
+        from cake_tpu.ops.ragged_paged_attention import (
+            ragged_paged_attention_mixed, ragged_paged_mixed_supported,
+        )
+        if ragged_paged_mixed_supported(P, H, KV, hd, C):
+            return ragged_paged_attention_mixed(q, pool_k, pool_v,
+                                                table, pos, q_len)
+    elif impl != "fold":
+        raise ValueError(f"unknown paged_attn impl {impl!r}")
+
+    G = H // KV
+    m0 = jnp.full((B, KV, G, C, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, C, 1), jnp.float32)
+    o0 = jnp.zeros((B, KV, G, C, hd), jnp.float32)
+    qi = jnp.arange(C)
+
+    def fold(j, carry):
+        m, l, o = carry
+        pages = table[:, j]                          # [B]
+        idx = jnp.where(pages >= 0, pages, pool_k.shape[0])
+        kj = jnp.take(pool_k, idx, axis=0, mode="fill",
+                      fill_value=0)                  # [B,P,KV,hd]
+        vj = jnp.take(pool_v, idx, axis=0, mode="fill", fill_value=0)
+        # per-query causality: absolute slot j*P + t attends for query
+        # i iff <= pos + i (current token included) AND the page is
+        # mapped — the decode fold's mask with a query axis
+        slots_abs = j * P + jnp.arange(P)            # [P]
+        valid = (slots_abs[None, None, :]
+                 <= (pos[:, None] + qi[None, :])[:, :, None])
+        valid &= (pages >= 0)[:, None, None]
+        valid = valid[:, None, None, :, :]           # [B,1,1,C,P]
+        mj, lj, oj = partial_attention_stats(q, kj, vj, valid)
+        m_new = jnp.maximum(m, mj)
+        a_old = jnp.exp(m - m_new)
+        a_new = jnp.exp(mj - m_new)
+        return (m_new, a_old * l + a_new * lj,
+                a_old * o + a_new * oj)
+
+    m, l, o = lax.fori_loop(0, max_pages, fold, (m0, l0, o0))
+    out = merge_attention_stats([(m, l, o)])
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
+        B, C, H, hd).astype(q.dtype)
 
 
 # -- model-level steps (engine step-fn signatures) ----------------------------
@@ -680,3 +777,88 @@ def prefill_slot_paged_chunk(params, tokens, n_real, slot, pos0,
     )[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
     return logits, PagedKVCache(k_new, v_new, cache.table)
+
+
+# -- token-level continuous batching: the mixed ragged step -------------------
+
+
+def run_blocks_mixed_paged(blocks, x, cache: PagedKVCache, pos, q_len,
+                           active, rope_c, rope_s, config: LlamaConfig,
+                           attn: str = "fold"):
+    """run_blocks over a MIXED batch of per-row windows: write each
+    row's window into its pages, attend everything written through the
+    table. x: [B, C, D]; pos/q_len/active: [B]; rope_c/rope_s:
+    [B, C, hd//2] per-row per-column tables; attn: paged_attention_mixed
+    impl ({fold,pallas} — static under jit)."""
+    from cake_tpu.models.llama.model import block_skeleton
+    from cake_tpu.ops.rope import apply_rope
+
+    def body(h, xs):
+        lp, pk, pv = xs
+
+        def attn_fn(q, k, v):
+            q = apply_rope(q, rope_c, rope_s)
+            k = apply_rope(k, rope_c, rope_s)
+            pk2, pv2 = write_windows_pages(pk, pv, k, v, pos, q_len,
+                                           active, cache.table)
+            return (paged_attention_mixed(q, pk2, pv2, cache.table,
+                                          pos, q_len, impl=attn),
+                    (pk2, pv2))
+
+        h, (pk2, pv2) = block_skeleton(lp, h, config, attn_fn)
+        return h, (pk2, pv2)
+
+    x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
+    return x, PagedKVCache(k_new, v_new, cache.table)
+
+
+@_partial(jax.jit, static_argnames=("config", "attn"),
+          donate_argnames=("cache",))
+def mixed_step_paged(params, tokens, pos, q_len, active,
+                     cache: PagedKVCache, rope, config: LlamaConfig,
+                     attn: str = "fold"):
+    """ONE jitted step over a mixed batch of row descriptors — the
+    token-level continuous-batching step that collapses the
+    prefill_slot_paged / prefill_slot_paged_chunk /
+    decode_step_ragged_paged zoo behind a single dispatch seam:
+
+      * a DECODE row carries (pos = current token position, q_len = 1,
+        tokens[:, 0] = last sampled token) — exactly the ragged decode
+        semantics (write the token, attend the pages);
+      * a PREFILL-CHUNK row carries (pos = window start, q_len = real
+        window tokens, tokens[:, :q_len] = the window) — exactly the
+        prefill_slot_paged_chunk semantics at any page offset, a
+        shared-prefix head included (the window attends every position
+        written through the table);
+      * an IDLE row carries (q_len = 0, active = False) and touches
+        neither its pages nor the output the caller reads.
+
+    tokens: [B, C] int32 right-padded windows; pos/q_len: [B] int32;
+    active: [B] bool. Returns ([B, vocab] logits of each row's LAST
+    real token, cache) — decode rows sample their next token from it,
+    a prefill row whose window ends its prompt samples its FIRST token,
+    and mid-prompt rows' logits are simply not consumed. attn selects
+    the paged_attention_mixed impl ({fold,pallas}); fold is the
+    bit-exact reference for the mixed step exactly as it is for decode.
+    """
+    from cake_tpu.ops.norms import rms_norm
+    from cake_tpu.ops.quant import qmatmul
+
+    B, C = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    # per-row per-column rope rows: query i of row b sits at absolute
+    # position pos[b] + i (clamped into the table for padding columns
+    # past the window — their values are garbage nothing reads)
+    T = rope.cos.shape[0]
+    pos_grid = jnp.minimum(pos[:, None] + jnp.arange(C)[None, :], T - 1)
+    rope_c = jnp.take(rope.cos, pos_grid, axis=0)     # [B, C, hd//2]
+    rope_s = jnp.take(rope.sin, pos_grid, axis=0)
+    x, cache = run_blocks_mixed_paged(params["blocks"], x, cache, pos,
+                                      q_len, active, rope_c, rope_s,
+                                      config, attn=attn)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, (jnp.maximum(q_len, 1) - 1).reshape(B, 1, 1).astype(jnp.int32),
+        axis=1)[:, 0]
+    logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
+    return logits, cache

@@ -6,7 +6,10 @@ engine's *step* loop — where all the throughput lives — with four
 pieces, all dependency-free:
 
   * **Step flight recorder** (`StepTelemetry`): one bounded-ring record
-    per engine step (kind prefill/decode/decode_scan/spec, attention
+    per engine step (kind prefill/decode/decode_scan/spec/mixed —
+    mixed records additionally split occupancy into decode rows vs
+    prefill-chunk rows vs idle rows and feed the
+    `cake_mixed_step_rows_total{kind}` counters —, attention
     impl, batch occupancy, tokens emitted, page-pool free/total,
     dispatch wall seconds, device seconds, per-step MFU / HBM
     utilization, whether the step compiled). Served at
@@ -151,6 +154,12 @@ _DEV_HBM_LIMIT = _m.gauge(
     "cake_device_hbm_bytes_limit",
     "HBM byte capacity per device",
     labelnames=("device",))
+_MIXED_ROWS = _m.counter(
+    "cake_mixed_step_rows_total",
+    "Row-slots processed by mixed continuous-batching steps, by row "
+    "kind (decode = one-token decode rows, prefill = prefill-chunk "
+    "rows, idle = empty slots in the launch)",
+    labelnames=("kind",))
 
 
 def refresh_page_gauges(engine) -> None:
@@ -317,8 +326,11 @@ class _JitStep:
 # -- flight recorder ----------------------------------------------------------
 
 # step kinds whose records carry decode throughput (utilization
-# aggregation weights these; prefill is reported per-kind only)
-_DECODE_KINDS = ("decode", "decode_scan", "spec")
+# aggregation weights these; prefill is reported per-kind only).
+# "mixed" belongs here: a mixed step IS the decode step with prefill
+# chunks riding along — excluding it would blind the MFU gauge to the
+# very path token-level continuous batching exists to improve.
+_DECODE_KINDS = ("decode", "decode_scan", "spec", "mixed")
 
 
 def _sig(v: Optional[float], digits: int = 6) -> Optional[float]:
@@ -337,6 +349,7 @@ class StepRecord:
     step: int
     ts: float                      # wall-clock
     kind: str                      # prefill | decode | decode_scan | spec
+                                   # | mixed
     impl: str                      # dense | ring | paged-fold | ... | custom
     rows: int                      # batch occupancy this step
     tokens: int                    # tokens emitted by this step
@@ -348,6 +361,11 @@ class StepRecord:
     pages_free: Optional[int] = None
     pages_total: Optional[int] = None
     compiled: bool = False         # this step compiled a new signature
+    # mixed-step occupancy split (token-level continuous batching):
+    # decode rows vs prefill-chunk rows vs idle rows in the launch
+    rows_decode: Optional[int] = None
+    rows_prefill: Optional[int] = None
+    rows_idle: Optional[int] = None
 
     def to_dict(self) -> Dict:
         out = {
@@ -369,6 +387,10 @@ class StepRecord:
         if self.pages_total is not None:
             out["pages_free"] = self.pages_free
             out["pages_total"] = self.pages_total
+        if self.rows_decode is not None:
+            out["rows_decode"] = self.rows_decode
+            out["rows_prefill"] = self.rows_prefill
+            out["rows_idle"] = self.rows_idle
         return out
 
 
@@ -432,10 +454,16 @@ class StepTelemetry:
                cost: Optional[CostInfo] = None,
                compiled: bool = False,
                pages_free: Optional[int] = None,
-               pages_total: Optional[int] = None) -> StepRecord:
+               pages_total: Optional[int] = None,
+               rows_decode: Optional[int] = None,
+               rows_prefill: Optional[int] = None,
+               rows_idle: Optional[int] = None) -> StepRecord:
         """Append one step record; derives MFU / HBM utilization from
         `cost` and the step's device seconds. Any subset of the three
-        timings may be given; missing ones fall back to the others."""
+        timings may be given; missing ones fall back to the others.
+        rows_decode/rows_prefill/rows_idle carry a mixed step's
+        occupancy split and feed the cake_mixed_step_rows_total
+        counters."""
         wall = wall_s if wall_s is not None else (
             (dispatch_s or 0.0) + (device_s or 0.0))
         disp = dispatch_s if dispatch_s is not None else wall
@@ -454,11 +482,17 @@ class StepTelemetry:
                 dispatch_s=float(disp), device_s=float(dev),
                 wall_s=float(wall), mfu=mfu, hbm_util=hbm,
                 pages_free=pages_free, pages_total=pages_total,
-                compiled=bool(compiled))
+                compiled=bool(compiled),
+                rows_decode=rows_decode, rows_prefill=rows_prefill,
+                rows_idle=rows_idle)
             self._next += 1
             self._ring.append(rec)
         _STEPS_TOTAL.labels(kind=kind).inc()
         _STEP_DISPATCH.labels(kind=kind).observe(disp)
+        for k, v in (("decode", rows_decode), ("prefill", rows_prefill),
+                     ("idle", rows_idle)):
+            if v:
+                _MIXED_ROWS.labels(kind=k).inc(v)
         if mfu is not None:
             _STEP_MFU.labels(kind=kind).set(_sig(mfu))
         if hbm is not None:
@@ -477,19 +511,26 @@ class StepTelemetry:
             recs = recs[:max(0, int(limit))]
         return [r.to_dict() for r in recs]
 
-    def utilization(self, since_step: int = 0) -> Dict[str, float]:
+    def utilization(self, since_step: int = 0, *,
+                    include_prefill: bool = False) -> Dict[str, float]:
         """Wall-time-weighted mean MFU / HBM utilization over the
         ring's decode-side records (decode / decode_scan / spec;
         prefill excluded — its utilization profile is a different
-        question). Records whose dispatch compiled a new signature are
-        excluded — their wall is XLA compile, not decode — and
-        since_step drops everything up to a warmup boundary (pass the
-        post-warmup `summary()["recorded_steps"]`). 0.0 when no
-        remaining record carried cost info — a bench consumer always
-        gets the keys."""
+        question). include_prefill=True widens the aggregate to
+        prefill records too: an A/B against mixed batching needs it,
+        because a mixed record folds its chunk's prefill FLOPs in and
+        the phase-split side must count the same work to compare
+        occupancy rather than aggregation. Records whose dispatch
+        compiled a new signature are excluded — their wall is XLA
+        compile, not decode — and since_step drops everything up to a
+        warmup boundary (pass the post-warmup
+        `summary()["recorded_steps"]`). 0.0 when no remaining record
+        carried cost info — a bench consumer always gets the keys."""
+        kinds = _DECODE_KINDS + ("prefill",) if include_prefill \
+            else _DECODE_KINDS
         with self._lock:
             recs = [r for r in self._ring
-                    if r.kind in _DECODE_KINDS and not r.compiled
+                    if r.kind in kinds and not r.compiled
                     and r.step > since_step]
         out = {"mfu": 0.0, "hbm_util": 0.0}
         for field in ("mfu", "hbm_util"):

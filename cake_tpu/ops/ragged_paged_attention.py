@@ -38,6 +38,13 @@ Layout contract: the pool keeps `models/llama/paged.py`'s
 to [N_pages, page, KV*hd] (free reshape of a contiguous array) so block
 tiles are (page, KV*hd) — lane-aligned when hd is a multiple of 128.
 
+The MIXED variant (`ragged_paged_attention_mixed`) extends the row
+metadata with a per-row query length: one grid processes decode rows
+(q_len=1) and prefill-chunk rows (q_len=C at arbitrary page offset)
+in the same launch — the token-level continuous-batching step the
+engine's `mixed_step_paged` path dispatches, with per-row causal
+masking and the same per-row early exit.
+
 CPU tests run the same kernel with interpret=True
 (tests/test_ragged_paged_attn.py), mirroring flash_attention.py.
 """
@@ -202,6 +209,173 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
       q, kf, vf)
 
 
+def _rpa_mixed_kernel(pos_ref, qlen_ref, table_ref, q_ref, k_ref, v_ref,
+                      o_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                      page_size: int, kv_heads: int, group: int,
+                      head_dim: int, q_width: int):
+    """One (row, page) grid step of the MIXED ragged fold: each row
+    carries q_width query slots of which q_len are real — a decode row
+    (q_len=1) and a prefill-chunk row (q_len=C at arbitrary page
+    offset) fold through the same grid.
+
+    q_ref:   [1, C, H, hd] — the row's query window, first token at
+             absolute position pos (decode rows use column 0 only)
+    k_ref/v_ref: [1, page, KV*hd] — one physical page (flattened minor)
+    scratch: acc [KV*C*G, hd] f32, m/l [KV*C*G, 128] f32, rows ordered
+    (kv, query, group) so each kv head's fold is a contiguous slice;
+    carried across the page axis exactly like the decode kernel.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    C = q_width
+    G = group
+    P = page_size
+    hd = head_dim
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    # last REAL query's absolute position bounds the live page count;
+    # q_len=0 (idle row) clamps to pos so the row still costs one page
+    # of masked compute, never a negative bound
+    last = pos + jnp.maximum(qlen_ref[b], 1) - 1
+    page = table_ref[b, j]
+    live = jnp.logical_and(j * P <= last, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0]                           # [C, H, hd]
+        # per-(query, column) causal mask: query i sits at absolute
+        # position pos + i and attends page slots <= it (current token
+        # included — its KV is written before the kernel runs)
+        qidx = jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 0) // G
+        col = j * P + jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 1)
+        valid = col <= pos + qidx
+        for kv in range(kv_heads):
+            kh = k_ref[0, :, kv * hd:(kv + 1) * hd]          # [P, hd]
+            vh = v_ref[0, :, kv * hd:(kv + 1) * hd]          # [P, hd]
+            qh = q[:, kv * G:(kv + 1) * G, :].reshape(C * G, hd)
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [C*G, P]
+            s = jnp.where(valid, s, NEG_INF)
+            r0 = kv * C * G
+            m_prev = m_ref[r0:r0 + C * G, :1]                # [C*G, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            # a query whose causal horizon precedes this page (or an
+            # all-hole row) has every column masked: m_new stays
+            # NEG_INF and exp(s - m_new) would be exp(0)=1 garbage —
+            # the explicit mask multiply keeps its l at 0 so _finish
+            # emits zeros, matching the fold reference's guard
+            p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+            l_new = (alpha * l_ref[r0:r0 + C * G, :1]
+                     + jnp.sum(p, axis=-1, keepdims=True))
+            out = jax.lax.dot_general(
+                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [C*G, hd]
+            acc_ref[r0:r0 + C * G] = acc_ref[r0:r0 + C * G] * alpha + out
+            m_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                m_new, (C * G, m_ref.shape[1]))
+            l_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                l_new, (C * G, l_ref.shape[1]))
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        for kv in range(kv_heads):
+            r0 = kv * C * G
+            l = l_ref[r0:r0 + C * G, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o = (acc_ref[r0:r0 + C * G] / l).reshape(C, G, hd)
+            o_ref[0, :, kv * G:(kv + 1) * G, :] = o.astype(o_ref.dtype)
+
+
+def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
+                                 scale: float | None = None,
+                                 interpret: bool | None = None):
+    """MIXED ragged attention over a paged KV pool, one Pallas kernel.
+
+    The per-row query-length extension of `ragged_paged_attention`: one
+    grid handles decode rows (q_len=1) and prefill-chunk rows (q_len=C
+    at arbitrary page offset) in the same launch, with per-row causal
+    masking and the same per-row early exit (a row streams only the
+    pages up to ceil((pos + q_len) / page)).
+
+    q:            [B, C, H, hd] — rope applied; every real query
+                  token's KV must already be written to its page (the
+                  write_windows_pages contract). Columns past q_len are
+                  padding: their output is garbage the caller never
+                  reads (the step fn samples at column q_len - 1).
+    pool_k/pool_v:[N_pages, page, KV, hd]
+    table:        [B, max_pages] int32 page ids, -1 = unmapped
+    pos:          [B] int32 — absolute position of each row's FIRST
+                  query token (decode rows: the current token's
+                  position, exactly the decode kernel's pos)
+    q_len:        [B] int32 — real query tokens per row (0 = idle row,
+                  output zeros)
+    Returns [B, C, H, hd] in q.dtype. Numerically matches
+    `models/llama/paged.py:paged_attention_mixed` (the fold reference)
+    to f32 tolerance — tests/test_ragged_paged_attn.py pins the parity.
+    """
+    B, C, H, hd = q.shape
+    N, P, KV, _ = pool_k.shape
+    G = H // KV
+    max_pages = table.shape[1]
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kf = pool_k.reshape(N, P, KV * hd)
+    vf = pool_v.reshape(N, P, KV * hd)
+
+    def kv_index(b, j, pos_ref, qlen_ref, table_ref):
+        # clamp dead pages (past the row's live count) to the LAST live
+        # page — the repeated block index elides the DMA, so a row
+        # streams only the pages its window actually covers
+        last = pos_ref[b] + jnp.maximum(qlen_ref[b], 1) - 1
+        jj = jnp.minimum(j, last // P)
+        page = table_ref[b, jj]
+        return (jnp.maximum(page, 0), 0, 0)
+
+    kernel = functools.partial(
+        _rpa_mixed_kernel, scale=scale, page_size=P, kv_heads=KV,
+        group=G, head_dim=hd, q_width=C)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, C, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, P, KV * hd), kv_index),
+            pl.BlockSpec((1, P, KV * hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, C, H, hd),
+                               lambda b, j, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * C * G, hd), jnp.float32),
+            pltpu.VMEM((KV * C * G, 128), jnp.float32),
+            pltpu.VMEM((KV * C * G, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, C, H, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32), jnp.asarray(q_len, jnp.int32),
+      jnp.asarray(table, jnp.int32), q, kf, vf)
+
+
 def ragged_paged_supported(page_size: int, H: int, KV: int,
                            hd: int) -> bool:
     """Static shape gate for the hardware path (flash_supported
@@ -215,3 +389,31 @@ def ragged_paged_supported(page_size: int, H: int, KV: int,
     if jax.default_backend() != "tpu":
         return True      # interpret mode imposes no tiling constraints
     return hd % 128 == 0 and page_size % 16 == 0
+
+
+def mixed_scratch_bytes(H: int, hd: int, q_width: int) -> int:
+    """f32 VMEM scratch the mixed kernel allocates per grid cell: the
+    [KV*C*G, hd] accumulator plus two [KV*C*G, 128] m/l buffers, and
+    KV*G == H."""
+    return 4 * q_width * H * (hd + 256)
+
+
+# scratch budget for the mixed kernel on silicon: VMEM is ~16 MB/core
+# on the conservative end of the TPU range; half of that is left for
+# the q/kv/out blocks and Mosaic's own double-buffering.
+_MIXED_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def ragged_paged_mixed_supported(page_size: int, H: int, KV: int,
+                                 hd: int, q_width: int) -> bool:
+    """Gate for the MIXED hardware kernel: the decode gate's tiling
+    rules PLUS a VMEM bound. Unlike the C=1 decode kernel, the mixed
+    kernel's scratch scales linearly with the query width C
+    (mixed_scratch_bytes) — a large --prefill-chunk must degrade to the
+    fold reference instead of failing Mosaic allocation at the first
+    mixed dispatch."""
+    if not ragged_paged_supported(page_size, H, KV, hd):
+        return False
+    if jax.default_backend() != "tpu":
+        return True      # interpret mode allocates host memory
+    return mixed_scratch_bytes(H, hd, q_width) <= _MIXED_VMEM_BUDGET

@@ -70,7 +70,7 @@ _RESET_FAILURES = obs_metrics.counter(
 # fold and pallas histograms compare like for like at any decode_scan
 _PAGED_ATTN_STEP = obs_metrics.histogram(
     "cake_paged_attn_step_seconds",
-    "Paged-engine step wall latency by path (prefill|decode)",
+    "Paged-engine step wall latency by path (prefill|decode|mixed)",
     labelnames=("path",))
 
 # page-granular prefix sharing (the paged engine's prompt-cache path):
@@ -266,6 +266,7 @@ class InferenceEngine:
         kv_pages: Optional[int] = None,
         kv_page_size: int = 128,
         paged_attn: Optional[str] = None,
+        mixed_batch: Optional[str] = None,
         prompt_limit: Optional[int] = None,
         decode_budget: Optional[int] = None,
         trace_events: Optional[str] = None,
@@ -439,8 +440,9 @@ class InferenceEngine:
                     "cache= cannot apply")
             from cake_tpu.models.llama.paged import (
                 PageAllocator, PagedKVCache, decode_step_ragged_paged,
-                prefill_prefix_pages, prefill_slot_paged,
-                prefill_slot_paged_chunk, prefill_slot_paged_prefixed,
+                mixed_step_paged, prefill_prefix_pages,
+                prefill_slot_paged, prefill_slot_paged_chunk,
+                prefill_slot_paged_prefixed,
             )
             # paged_attn: {fold,pallas} attention impl for the paged
             # step fns; None/"auto" = pallas on a real TPU, fold
@@ -476,6 +478,11 @@ class InferenceEngine:
                 prefill_slot_paged_prefixed, attn=impl)
             self._prefix_pages_step = partial(prefill_prefix_pages,
                                               attn=impl)
+            # token-level continuous batching (--mixed-batch): ONE
+            # jitted step consumes a batch of (row kind, pos, q_len)
+            # descriptors — decode rows and prefill-chunk rows in the
+            # same launch (models/llama/paged.mixed_step_paged)
+            self._mixed_step_fn = partial(mixed_step_paged, attn=impl)
             self._pager = PageAllocator(kv_pages, kv_page_size)
             self._slot_pages: dict = {}
             # slot -> count of SHARED prefix pages in its table row
@@ -494,6 +501,32 @@ class InferenceEngine:
                      self.cache.memory_bytes() / 2**30
                      * max_slots * max_seq_len / (kv_pages * kv_page_size))
         self.prefill_chunk = prefill_chunk
+        # --mixed-batch {auto,on,off}: token-level continuous batching
+        # for the paged engine — admissions' prefill chunks join the
+        # very next mixed step alongside decode rows instead of waiting
+        # for a decode pause. auto = on for paged serving, off
+        # elsewhere (the dense/ring/spec engines keep their phase
+        # loops); "on" without --kv-pages is a config error, not a
+        # silent no-op.
+        mb = mixed_batch or "auto"
+        if mb not in ("auto", "on", "off"):
+            raise ValueError(
+                f"--mixed-batch must be auto, on or off, got {mb!r}")
+        if mb == "on" and not self.paged:
+            raise ValueError(
+                "--mixed-batch on requires --kv-pages: the mixed "
+                "ragged step dispatches over the paged pool")
+        self._mixed = self.paged and mb != "off"
+        # slot -> in-flight prefill progress (req, remaining window
+        # offsets); teardown paths clear entries via
+        # _release_slot_pages so cancel/preempt/error cannot leave a
+        # ghost chunk row in the next mixed step
+        self._mixed_pending: dict = {}
+        # fixed mixed-chunk width: prompts walk the mixed step C tokens
+        # per iteration — ONE compiled program for every prompt length
+        # (a per-bucket width would recompile the hottest program)
+        self._mixed_chunk = (prefill_chunk if prefill_chunk is not None
+                             else min(256, max_seq_len))
         cache_len = (config.sliding_window if self.ring else max_seq_len)
         if not self.paged:
             self.cache = cache if cache is not None else KVCache.create(
@@ -1365,12 +1398,14 @@ class InferenceEngine:
                     self._wake.wait(timeout=0.02)
                     self._wake.clear()
             try:
-                if prefill_plan and not self._multihost:
+                if self._mixed:
+                    self._do_mixed(prefill_plan, decode_plan)
+                elif prefill_plan and not self._multihost:
                     self._do_prefill_batch(prefill_plan)
                 else:
                     for rid, slot in prefill_plan:
                         self._do_prefill(rid, slot)
-                if decode_plan:
+                if decode_plan and not self._mixed:
                     if self._spec:
                         self._do_decode_spec(decode_plan)
                     else:
@@ -1506,6 +1541,7 @@ class InferenceEngine:
                                         self.cache.page_size)
             self._slot_pages = {}
             self._slot_prefix_pages = {}
+            self._mixed_pending = {}
             self._prefix_pages_shared = 0
             _PREFIX_PAGES_SHARED.set(0)
             with self._rid_lock:
@@ -1553,10 +1589,12 @@ class InferenceEngine:
 
     def _record_step(self, kind: str, *, rows: int, tokens: int,
                      dispatch_s=None, device_s=None, wall_s=None,
-                     js=None) -> None:
+                     js=None, **split) -> None:
         """Append one flight record for the step that just completed,
         attaching the pending dispatch's cost info (js, or the
-        engine-thread mailbox _last_jit) and page-pool occupancy."""
+        engine-thread mailbox _last_jit) and page-pool occupancy.
+        `split` carries the mixed step's occupancy breakdown
+        (rows_decode / rows_prefill / rows_idle)."""
         if js is None:
             js, self._last_jit = self._last_jit, None
         self.flight.record(
@@ -1564,7 +1602,7 @@ class InferenceEngine:
             device_s=device_s, wall_s=wall_s,
             cost=js.cost if js is not None else None,
             compiled=bool(js is not None and js.new),
-            **self._page_kw())
+            **split, **self._page_kw())
 
     # -- SLO scheduling: preemption + shed seams (cake_tpu/sched) --------
 
@@ -1634,6 +1672,9 @@ class InferenceEngine:
         freeing another slot's live context."""
         if not self.paged or slot < 0:
             return
+        # a slot torn down mid-prefill (cancel / preempt / error) must
+        # not ride the next mixed step as a ghost chunk row
+        self._mixed_pending.pop(slot, None)
         pages = self._slot_pages.pop(slot, None)
         if pages:
             self._pager.release(pages)
@@ -1872,6 +1913,195 @@ class InferenceEngine:
         if pend:
             flush()
 
+    # -- token-level continuous batching (--mixed-batch) ------------------
+
+    def _prime_ring(self, slot: int, prime) -> None:
+        """Reset one slot's repeat-penalty ring + step counter, seeding
+        it from `prime` (checkpoint resume / preemption fold): each
+        prior token at its true step index and the counter continuing
+        from there, so subsequent writes land where they always would."""
+        self._ring = self._ring.at[slot].set(-1)
+        self._steps[slot] = 0
+        if prime:
+            N = self._ring.shape[1]
+            row = np.full(N, -1, np.int32)
+            start = max(0, len(prime) - N)
+            for i, t in enumerate(prime[start:], start=start):
+                row[i % N] = t
+            self._ring = self._ring.at[slot].set(jnp.asarray(row))
+            self._steps[slot] = len(prime)
+
+    def _do_mixed(self, prefill_plan, decode_plan) -> None:
+        """One engine iteration of token-level continuous batching:
+        admissions map their pages and join the VERY NEXT device step
+        as prefill-chunk rows alongside the decode rows — no
+        alternating prefill-then-decode phases, so the MXU sees one
+        well-occupied mixed launch instead of two under-occupied ones.
+
+        decode_scan interaction (the K-step-burst admission-delay fix):
+        scan bursts only run while NO prompt is mid-prefill and nobody
+        waits in the queue (_scan_steps_for's queue gate); the moment a
+        request is admitted, the loop falls back to single mixed steps
+        so its chunks ride every iteration instead of stalling behind a
+        K-token scan burst."""
+        for rid, slot in prefill_plan:
+            self._mixed_admit(rid, slot)
+        if not self._mixed_pending:
+            # pure decode: the phase path's programs are strictly
+            # cheaper here (C=1 step, K-step scan bursts) and no
+            # admission is waiting on a step boundary
+            if decode_plan:
+                n = self._scan_steps_for(decode_plan)
+                if n > 1:
+                    self._decode_burst(decode_plan, n)
+                else:
+                    self._do_decode(decode_plan)
+            return
+        self._mixed_dispatch(decode_plan)
+
+    def _mixed_admit(self, rid: int, slot: int) -> None:
+        """Admission half of _do_prefill for the mixed path: page
+        mapping, prefix matching, and sampling-state setup — but NO
+        device dispatch; the prompt's windows ride the next mixed
+        step(s) as chunk rows."""
+        req = self._requests.get(rid)
+        if req is None:  # cancelled between plan and here
+            self.scheduler.cancel(rid)
+            return
+        self.tracer.prefill_start(rid)
+        req.slot = slot
+        self._slot_req[slot] = req
+        ids = req.prompt_ids
+        prime = req.prime_tokens
+        if req.out_tokens:
+            # preempted-and-requeued: recompute-style resume — the
+            # generated tokens fold into the prompt and the penalty
+            # ring reconstructs over the whole transcript (_do_prefill
+            # precedent, serve/checkpoint.resume semantics)
+            ids = list(req.prompt_ids) + list(req.out_tokens)
+            prime = list(req.prime_tokens) + list(req.out_tokens)
+        hit = (self._match_and_validate_prefix(ids)
+               if self._prefix_capable else None)
+        if self.paged and not self._alloc_slot_pages(req, slot, hit):
+            return   # pool exhausted: requeued (or failed) inside
+        off = 0
+        if hit is not None:
+            # shared prefix pages already mapped at the row head
+            # (_alloc_slot_pages): the windows start AFTER them
+            off = len(hit[1][0])
+            self.stats.prefix_hits += 1
+            _PREFIX_PAGED_HITS.inc()
+            _PREFIX_TOKENS_SAVED.inc(off)
+        self._temp[slot] = req.temperature
+        self._top_p[slot] = req.top_p
+        self._penalty[slot] = req.repeat_penalty
+        self._prime_ring(slot, prime)
+        self._pos[slot] = off
+        self._mixed_pending[slot] = {"req": req, "ids": ids, "off": off}
+
+    def _mixed_dispatch(self, decode_plan) -> None:
+        """Build and run ONE mixed step: every decode row contributes
+        its last token (q_len=1), every mid-prefill slot its next
+        window (q_len=n at its current offset); rows whose window ends
+        their prompt sample their first token from the same launch the
+        decode rows sample their next."""
+        t0 = time.perf_counter()
+        B, C = self.max_slots, self._mixed_chunk
+        tokens = np.zeros((B, C), np.int64)
+        pos = np.zeros(B, np.int64)
+        qlen = np.zeros(B, np.int64)
+        active = np.zeros(B, bool)
+        decode_rows: List[int] = []
+        for rid, slot in decode_plan:
+            if slot in self._mixed_pending:
+                continue    # still prefilling: rides as a chunk row
+            req = self._slot_req[slot]
+            if req is None or req.rid != rid:
+                continue
+            tokens[slot, 0] = self._last_tok[slot]
+            pos[slot] = min(self._pos[slot], self.max_seq_len - 1)
+            qlen[slot] = 1
+            active[slot] = True
+            decode_rows.append(slot)
+        chunk_rows: List[int] = []
+        finished: List[int] = []
+        for slot in sorted(self._mixed_pending):
+            p = self._mixed_pending[slot]
+            ids, off = p["ids"], p["off"]
+            n = min(C, len(ids) - off)
+            tokens[slot, :n] = ids[off:off + n]
+            pos[slot] = off
+            qlen[slot] = n
+            active[slot] = True
+            chunk_rows.append(slot)
+            if off + n >= len(ids):
+                finished.append(slot)
+        if not decode_rows and not chunk_rows:
+            return
+        fargs = (self.params, jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(pos, jnp.int32),
+                 jnp.asarray(qlen, jnp.int32), jnp.asarray(active),
+                 self.cache, self.rope, self.config)
+        js = self._obs_jit("mixed_step", (C,), self._mixed_step_fn,
+                           fargs)
+        t0d = time.perf_counter()
+        logits, self.cache = self._mixed_step_fn(*fargs)
+        js.finish(time.perf_counter() - t0d)
+        self._last_jit = js
+        emit_rows = decode_rows + finished
+        # advance the prefill frontiers BEFORE sampling/emit: a
+        # finishing row's _pos must read prompt-end when _emit runs
+        # its window-cap check (the _finish_prefill ordering)
+        for slot in chunk_rows:
+            p = self._mixed_pending[slot]
+            p["off"] += int(qlen[slot])
+            self._pos[slot] = p["off"]
+        if emit_rows:
+            nxt, lp, tids, tlps = self._sample_rows(
+                logits, rows=emit_rows, n_top=self._n_top_for(emit_rows))
+        else:
+            # every row is mid-prompt: nothing samples this step — skip
+            # the masked-sampling program entirely (its outputs would
+            # all be discarded, and it sits on the TTFT path)
+            nxt = lp = tids = tlps = None
+        self.stats.steps += 1
+        dt = time.perf_counter() - t0
+        # split the step wall by TOKEN share so the prefill/decode
+        # accounting stays meaningful under the mixed default (a mixed
+        # step IS both phases in one launch; all-to-decode would report
+        # prefill_time_s == 0 forever, and a per-row split would
+        # undercount a C-token chunk against a 1-token decode row)
+        chunk_toks = int(sum(qlen[s] for s in chunk_rows))
+        total_toks = chunk_toks + len(decode_rows)
+        pf = dt * chunk_toks / total_toks
+        self.stats.prefill_time_s += pf
+        self.stats.decode_time_s += dt - pf
+        self._obs_paged_step("mixed", dt)
+        self._record_step(
+            "mixed", rows=len(decode_rows) + len(chunk_rows),
+            tokens=len(emit_rows), wall_s=dt,
+            rows_decode=len(decode_rows), rows_prefill=len(chunk_rows),
+            rows_idle=B - len(decode_rows) - len(chunk_rows))
+        self._step_stats.step(bytes_out=len(emit_rows))
+
+        def _top(slot):
+            return (list(zip(tids[slot].tolist(), tlps[slot].tolist()))
+                    if tids.size else [])
+
+        for slot in decode_rows:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            self._pos[slot] += 1
+            self._emit(req, int(nxt[slot]), logprob=float(lp[slot]),
+                       top=_top(slot))
+        for slot in finished:
+            p = self._mixed_pending.pop(slot, None)
+            if p is None:
+                continue
+            self._emit(p["req"], int(nxt[slot]),
+                       logprob=float(lp[slot]), top=_top(slot))
+
     def _match_and_validate_prefix(self, ids: List[int]):
         """(pid, (p_ids, k, v)) of the longest matching registered prefix
         that can serve this prompt without clamping over live cache
@@ -2052,23 +2282,10 @@ class InferenceEngine:
             # determinism) instead of a cross-process collective
             logits = np.asarray(logits)
         self._pos[slot] = prompt_len
-        self._steps[slot] = 0
         self._temp[slot] = temp
         self._top_p[slot] = top_p
         self._penalty[slot] = penalty
-        self._ring = self._ring.at[slot].set(-1)
-        if prime:
-            # checkpoint resume: reconstruct the repeat-penalty ring exactly
-            # as the uninterrupted run would have it — each prior token at
-            # its true step index, and the step counter continuing from
-            # there, so subsequent writes land where they always would.
-            N = self._ring.shape[1]
-            row = np.full(N, -1, np.int32)
-            start = max(0, len(prime) - N)
-            for i, t in enumerate(prime[start:], start=start):
-                row[i % N] = t
-            self._ring = self._ring.at[slot].set(jnp.asarray(row))
-            self._steps[slot] = len(prime)
+        self._prime_ring(slot, prime)
         # sample the first token with the slot's own key/options
         sampled = self._sample_rows(
             jnp.broadcast_to(logits, (self.max_slots, logits.shape[-1])),

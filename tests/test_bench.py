@@ -149,6 +149,29 @@ def test_paged_prefix_smoke_tier_reports_sharing():
 
 
 @pytest.mark.slow  # two engine phases under load -> slow lane
+def test_mixed_smoke_tier_reports_both_row_kinds():
+    """The --mixed tier's acceptance contract: the mixed-batching ON
+    phase recorded at least one `mixed` step carrying BOTH row kinds
+    (decode rows AND prefill-chunk rows in one launch — the
+    no-decode-pause observable), and both phases report tok/s, step
+    MFU, and arrival TTFT percentiles. A run where admissions never
+    actually interleaved with decode benches the phase loop twice and
+    fails here."""
+    result = _run_tier("mixed_tiny")
+    assert result["unit"] == "ms" and result["value"] > 0
+    assert result["mixed_steps_both_kinds"] > 0
+    assert result["mixed_tok_s_on"] > 0
+    assert result["mixed_tok_s_off"] > 0
+    # step MFU: the mixed launch carries decode + prefill FLOPs where
+    # the phase loop dispatched a batch-1 prefill — the occupancy win
+    # the tentpole exists for, visible even on the CPU lane
+    assert result["mixed_step_mfu_on"] > result["mixed_step_mfu_off"]
+    for tag in ("on", "off"):
+        assert result[f"mixed_ttft_p50_{tag}_ms"] > 0
+        assert result[f"mixed_ttft_p99_{tag}_ms"] > 0
+
+
+@pytest.mark.slow  # two engine phases under load -> slow lane
 def test_slo_smoke_tier_reports_preemption_win():
     """The --slo tier's acceptance contract: preemption actually
     engaged (preemptions_total > 0) and interactive-class p99 TTFT

@@ -1,0 +1,210 @@
+"""Token-level continuous batching (--mixed-batch): ONE mixed ragged
+step for prefill chunks and decode rows on the paged engine.
+
+Bars:
+  * greedy token equality at f32 KV (the repo convention for
+    token-equality tests): mixed == phase-split == the dense oracle,
+    for both paged-attention impls, multi-window prompts included;
+  * no decode pause: a request admitted mid-decode gets its first
+    chunk in the very next step — a `mixed` flight record carrying
+    BOTH row kinds — including under preemption;
+  * decode_scan interaction (the K-step-burst admission-delay fix):
+    with scan bursts enabled, a waiting admission falls back to single
+    mixed steps instead of stalling K steps per burst — regression
+    measured as decode tokens the resident stream emits between the
+    admission and the arrival's first token.
+"""
+
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+T = 64
+PAGE = 16
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 3)
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=T,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # f32 KV: the token-equality bar must exercise the mixed step,
+        # not bf16 greedy tie-breaks (repo convention, PR 2 lesson)
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+def _run_tokens(eng, prompts, max_new=8):
+    with eng:
+        hs = [eng.submit(p, max_new_tokens=max_new, temperature=0.0,
+                         repeat_penalty=1.0) for p in prompts]
+        assert all(h.wait(timeout=300) for h in hs)
+        return [list(h._req.out_tokens) for h in hs]
+
+
+def _wait_tokens(handle, n, timeout=120.0):
+    t0 = time.perf_counter()
+    while (len(handle._req.out_tokens) < n
+           and time.perf_counter() - t0 < timeout):
+        time.sleep(0.002)
+    assert len(handle._req.out_tokens) >= n, "stream never got going"
+
+
+def _both_kind_steps(eng):
+    return [r for r in eng.flight.dump()
+            if r["kind"] == "mixed" and r.get("rows_decode", 0) > 0
+            and r.get("rows_prefill", 0) > 0]
+
+
+PROMPTS = [[5] * 9, [11] * 14, [3, 7, 9]]
+
+
+def test_mixed_token_equality_vs_dense_and_phase_split(tiny_config,
+                                                       params):
+    """Mixed-step serving == phase-split paged == the dense oracle,
+    greedy at f32 KV, for both attention impls — with prefill_chunk=8
+    so the 14-token prompt walks MULTIPLE mixed windows."""
+    want = _run_tokens(_engine(tiny_config, params), PROMPTS)
+    off = _run_tokens(
+        _engine(tiny_config, params, kv_pages=24, kv_page_size=PAGE,
+                mixed_batch="off"), PROMPTS)
+    assert off == want
+    for impl in ("fold", "pallas"):
+        eng = _engine(tiny_config, params, kv_pages=24,
+                      kv_page_size=PAGE, paged_attn=impl,
+                      prefill_chunk=8, mixed_batch="on")
+        assert eng._mixed
+        got = _run_tokens(eng, PROMPTS)
+        assert got == want, f"paged_attn={impl}"
+        assert eng._pager.free_pages == 24
+        assert eng._mixed_pending == {}
+
+
+def test_mixed_admission_joins_next_step_no_decode_pause(tiny_config,
+                                                         params):
+    """The acceptance bar: a request admitted mid-decode rides the very
+    next step as a chunk row alongside the resident decode row — at
+    least one mixed flight record carries BOTH row kinds, and the
+    arrival's first token lands while the resident stream is still
+    decoding."""
+    eng = _engine(tiny_config, params, kv_pages=24, kv_page_size=PAGE,
+                  prefill_chunk=8)
+    with eng:
+        a = eng.submit([5] * 9, max_new_tokens=40, temperature=0.0,
+                       repeat_penalty=1.0)
+        _wait_tokens(a, 3)
+        b = eng.submit([7] * 20, max_new_tokens=4, temperature=0.0,
+                       repeat_penalty=1.0)        # 3 chunk windows
+        assert b.wait(timeout=300)
+        assert a.wait(timeout=300)
+    assert _both_kind_steps(eng), \
+        "no mixed step carried decode AND prefill rows"
+    # b's first token arrived while a was still decoding: no pause
+    assert b._req.first_token_t < a._req.finish_t
+
+
+def test_mixed_off_keeps_phase_split(tiny_config, params):
+    eng = _engine(tiny_config, params, kv_pages=24, kv_page_size=PAGE,
+                  mixed_batch="off")
+    assert not eng._mixed
+    _run_tokens(eng, [[5] * 9])
+    assert not [r for r in eng.flight.dump() if r["kind"] == "mixed"]
+    kinds = {r["kind"] for r in eng.flight.dump()}
+    assert "prefill" in kinds and "decode" in kinds
+
+
+def test_mixed_on_requires_paged(tiny_config, params):
+    with pytest.raises(ValueError, match="kv-pages"):
+        _engine(tiny_config, params, mixed_batch="on")
+    with pytest.raises(ValueError, match="mixed-batch"):
+        _engine(tiny_config, params, kv_pages=24, kv_page_size=PAGE,
+                mixed_batch="bogus")
+
+
+@pytest.mark.slow  # two engines under staggered load -> slow lane
+def test_mixed_admission_with_preemption_interleaved(tiny_config,
+                                                     params):
+    """Preemption composes with the mixed step: victims release at a
+    mixed-step boundary (the engine preempts between iterations), the
+    interactive arrival's chunks ride alongside the surviving batch
+    slot's decode rows, and the preempted stream's recompute-resume
+    chunks do too — pool conserved throughout."""
+    from cake_tpu.sched import SchedConfig
+
+    eng = _engine(tiny_config, params, max_slots=2, kv_pages=8,
+                  kv_page_size=PAGE, prefill_chunk=8,
+                  priority_classes=True, preemption=True,
+                  sched_config=SchedConfig(preempt_budget=8))
+    with eng:
+        hb = [eng.submit([5 + i] * 9, max_new_tokens=24,
+                         temperature=0.0, repeat_penalty=1.0,
+                         priority="batch") for i in range(2)]
+        for h in hb:
+            _wait_tokens(h, 3)
+        hi = eng.submit([2, 9, 4, 7, 3], max_new_tokens=3,
+                        temperature=0.0, repeat_penalty=1.0,
+                        priority="interactive")
+        assert hi.wait(timeout=300)
+        assert all(h.wait(timeout=600) for h in hb)
+        assert eng.stats.preemptions >= 1
+        assert len(hi._req.out_tokens) >= 1
+    assert _both_kind_steps(eng), \
+        "no mixed step carried decode AND prefill rows"
+    assert eng._pager.free_pages == eng.cache.n_pages
+    assert eng._mixed_pending == {}
+
+
+@pytest.mark.slow  # scan-burst engine under live load -> slow lane
+def test_mixed_decode_scan_admission_latency(tiny_config, params):
+    """The decode_scan bugfix: with K-step scan bursts amortizing
+    dispatch while slots decode alone, an arriving request must flip
+    the loop to single mixed steps — its chunks join every iteration —
+    instead of being delayed K steps per burst. Admission latency is
+    measured in STEPS: the decode tokens the resident stream emits
+    between the submit and the arrival's first token are bounded by
+    the already-in-flight bursts (<= 2K) plus the arrival's own chunk
+    windows, never by extra scan bursts dispatched past the waiting
+    admission."""
+    K = 4
+    eng = _engine(tiny_config, params, kv_pages=24, kv_page_size=PAGE,
+                  prefill_chunk=8, decode_scan_steps=K)
+    with eng:
+        a = eng.submit([5] * 9, max_new_tokens=45, temperature=0.0,
+                       repeat_penalty=1.0)
+        _wait_tokens(a, 2 * K)        # scan bursts are running
+        a_at_submit = len(a._req.out_tokens)
+        a_at_first = []
+
+        def on_b(delta, final):
+            # engine-thread snapshot at b's FIRST emitted token
+            if not a_at_first:
+                a_at_first.append(len(a._req.out_tokens))
+
+        b = eng.submit([7] * 20, max_new_tokens=4, temperature=0.0,
+                       repeat_penalty=1.0, stream=on_b)   # 3 windows
+        assert b.wait(timeout=300)
+        assert a.wait(timeout=300)
+    assert a_at_first, "stream callback never fired"
+    # in-flight chained bursts at submit time can still deliver up to
+    # 2K tokens; after that, b's 3 chunk windows each ride ONE mixed
+    # step (one decode token apiece) — generous slack on top, but far
+    # below the unfixed behavior of whole K-token bursts per window
+    steps_to_first = a_at_first[0] - a_at_submit
+    assert steps_to_first <= 2 * K + 3 + 2, steps_to_first
+    # and b's chunks genuinely rode mixed steps with a decoding
+    assert _both_kind_steps(eng)

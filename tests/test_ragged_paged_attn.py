@@ -13,9 +13,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from cake_tpu.models.llama.paged import paged_attention
+from cake_tpu.models.llama.paged import (
+    paged_attention, paged_attention_mixed,
+)
 from cake_tpu.ops.ragged_paged_attention import (
-    ragged_paged_attention, ragged_paged_supported,
+    ragged_paged_attention, ragged_paged_attention_mixed,
+    ragged_paged_mixed_supported, ragged_paged_supported,
 )
 
 P = 8           # page size
@@ -107,6 +110,102 @@ def test_kernel_parity_bf16_pool():
         atol=3e-2, rtol=3e-2)
 
 
+def _assert_mixed_parity(q, pk, pv, table, pos, qlen, atol=1e-5):
+    """fold reference == interpret-mode mixed kernel, on REAL query
+    columns only (padding columns past q_len are garbage by contract —
+    the step fn samples at column q_len - 1)."""
+    want = np.asarray(paged_attention_mixed(q, pk, pv, table, pos, qlen))
+    got = np.asarray(ragged_paged_attention_mixed(
+        q, pk, pv, table, pos, qlen, interpret=True))
+    for b in range(q.shape[0]):
+        n = int(qlen[b])
+        np.testing.assert_allclose(got[b, :n], want[b, :n],
+                                   atol=atol, rtol=atol)
+
+
+def test_mixed_kernel_parity_decode_and_chunk_rows():
+    """One launch mixing a decode row (q_len=1), a chunk row straddling
+    a page boundary at an arbitrary offset, and a chunk row starting
+    mid-page — the token-level continuous-batching shape."""
+    rng = np.random.default_rng(10)
+    pk, pv = _pool(rng, KV=2, hd=16)
+    C = 6
+    q = jnp.asarray(rng.normal(size=(3, C, 4, 16)), jnp.float32)
+    table = jnp.asarray([[7, 2, 9, -1, -1],
+                         [4, 11, 3, -1, -1],
+                         [1, 8, -1, -1, -1]], jnp.int32)
+    # row0 decode at 2P+5; row1 chunk of 6 from P+3 (straddles into
+    # page 2); row2 chunk of 5 from 3 (mid-page start)
+    pos = jnp.asarray([2 * P + 5, P + 3, 3], jnp.int32)
+    qlen = jnp.asarray([1, 6, 5], jnp.int32)
+    _assert_mixed_parity(q, pk, pv, table, pos, qlen)
+
+
+def test_mixed_kernel_parity_page_boundary_offsets():
+    """Chunk windows whose first token sits exactly at a page edge
+    (last slot of a page / first of the next): the early-exit count
+    must flip at ceil((pos + q_len) / P)."""
+    rng = np.random.default_rng(11)
+    pk, pv = _pool(rng, KV=2, hd=16)
+    C = 4
+    q = jnp.asarray(rng.normal(size=(4, C, 4, 16)), jnp.float32)
+    table = jnp.asarray([[3, 6, 0, 10, 5]] * 4, jnp.int32)
+    pos = jnp.asarray([P - 1, P, 2 * P - 1, 2 * P], jnp.int32)
+    qlen = jnp.asarray([4, 4, 1, 3], jnp.int32)
+    _assert_mixed_parity(q, pk, pv, table, pos, qlen)
+
+
+@pytest.mark.parametrize("H,KV", [(8, 2), (6, 3), (4, 4)])
+def test_mixed_kernel_parity_gqa(H, KV):
+    """GQA group sizes 4, 2 and 1 on a mixed decode+chunk batch."""
+    rng = np.random.default_rng(12)
+    pk, pv = _pool(rng, KV=KV, hd=16)
+    C = 5
+    q = jnp.asarray(rng.normal(size=(2, C, H, 16)), jnp.float32)
+    table = jnp.asarray([[9, 1, 6, -1, -1], [0, 5, 2, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 3, P + 6], jnp.int32)
+    qlen = jnp.asarray([1, 5], jnp.int32)
+    _assert_mixed_parity(q, pk, pv, table, pos, qlen)
+
+
+def test_mixed_kernel_parity_unmapped_holes():
+    """-1 holes inside the live range, a chunk row whose window's own
+    page is mapped but an EARLIER page is a hole, and a fully-dead row
+    (q_len=0) emitting zeros."""
+    rng = np.random.default_rng(13)
+    pk, pv = _pool(rng, KV=2, hd=16)
+    C = 4
+    q = jnp.asarray(rng.normal(size=(3, C, 4, 16)), jnp.float32)
+    table = jnp.asarray([[4, -1, 11, 3, -1],       # hole at page 1
+                         [-1, 2, 7, -1, -1],       # hole at page 0
+                         [-1, -1, -1, -1, -1]],    # dead row
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 2, P + 1, 0], jnp.int32)
+    qlen = jnp.asarray([4, 3, 0], jnp.int32)
+    _assert_mixed_parity(q, pk, pv, table, pos, qlen)
+    dead = ragged_paged_attention_mixed(q, pk, pv, table, pos, qlen,
+                                        interpret=True)[2]
+    np.testing.assert_array_equal(np.asarray(dead),
+                                  np.zeros_like(np.asarray(dead)))
+
+
+def test_mixed_fold_decode_row_bitwise_matches_decode_fold():
+    """A q_len=1 mixed row through the fold reference is bit-identical
+    to the decode fold — the phase-split token-equality bar rests on
+    this."""
+    rng = np.random.default_rng(14)
+    pk, pv = _pool(rng, KV=2, hd=16)
+    q = jnp.asarray(rng.normal(size=(2, 1, 4, 16)), jnp.float32)
+    table = jnp.asarray([[7, 2, -1, -1, -1], [4, 11, 3, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([P + 5, 2 * P + 7], jnp.int32)
+    want = paged_attention(q, pk, pv, table, pos)
+    got = paged_attention_mixed(q, pk, pv, table, pos,
+                                jnp.ones(2, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
 def test_supported_gate():
     assert not ragged_paged_supported(P, H=5, KV=2, hd=16)  # H % KV
     if jax.default_backend() == "tpu":
@@ -118,26 +217,49 @@ def test_supported_gate():
         assert ragged_paged_supported(P, H=4, KV=2, hd=16)
 
 
-def test_engine_pallas_matches_fold(tiny_config, tiny_params):
+def test_mixed_supported_gate_bounds_scratch_vmem(monkeypatch):
+    """The mixed kernel's VMEM scratch scales linearly with the query
+    width C — the gate must send an oversized --prefill-chunk to the
+    fold reference instead of letting Mosaic fail allocation at the
+    first mixed dispatch."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # production-tileable shape (hd=128, page%16): decode-width OK ...
+    assert ragged_paged_mixed_supported(16, H=32, KV=8, hd=128, q_width=1)
+    assert ragged_paged_mixed_supported(16, H=32, KV=8, hd=128, q_width=64)
+    # ... but an 8B-class C=512 chunk allocates ~25 MB of f32 scratch
+    # (4 * C * H * (hd + 256)) — over budget, fold fallback
+    assert not ragged_paged_mixed_supported(16, H=32, KV=8, hd=128,
+                                            q_width=512)
+    # tiling rules still apply before the VMEM bound
+    assert not ragged_paged_mixed_supported(P, H=4, KV=2, hd=16, q_width=1)
+
+
+def test_engine_pallas_matches_fold(tiny_config):
     """Engine-level smoke: a paged engine with paged_attn="pallas"
     produces identical token ids to "fold" on a 2-request workload.
 
-    f32 cache: the parity bar is the KERNEL against the fold at equal
-    storage precision — at bf16, sub-ULP reduction-order differences
-    flip greedy near-ties on random weights (the same environment noise
-    behind the pre-existing paged-vs-dense token flips), which would
-    test the tie, not the kernel."""
+    f32 cache AND f32 params: the parity bar is the KERNEL against the
+    fold at equal numeric precision. With bf16 activations the fold
+    downcasts the f32 pool to the query dtype on read
+    (partial_attention_stats) while the kernel streams the pages at
+    storage precision — a real 1e-2-scale asymmetry that flips greedy
+    near-ties and would test the mixed-precision policy, not the
+    kernel. (Production configs store bf16 pages, where both impls
+    read identical values.)"""
     import jax.numpy as jnp
 
     from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.models.llama.params import init_params
     from cake_tpu.ops.sampling import SamplingConfig
     from cake_tpu.serve.engine import InferenceEngine
 
+    params = init_params(tiny_config, jax.random.PRNGKey(0),
+                         dtype=jnp.float32)
     prompts = [[5] * 9, [3, 7, 9, 11, 2]]
 
     def run(impl):
         eng = InferenceEngine(
-            tiny_config, tiny_params,
+            tiny_config, params,
             ByteTokenizer(tiny_config.vocab_size),
             max_slots=2, max_seq_len=64,
             sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
@@ -154,8 +276,9 @@ def test_engine_pallas_matches_fold(tiny_config, tiny_params):
 
 
 def test_engine_pallas_records_step_histogram(tiny_config, tiny_params):
-    """The paged engine observes cake_paged_attn_step_seconds for both
-    the prefill and decode paths."""
+    """The paged engine observes cake_paged_attn_step_seconds on every
+    path: mixed + decode under the default (--mixed-batch auto), and
+    the classic prefill + decode split with the phase loop pinned."""
     from cake_tpu.models.llama.generator import ByteTokenizer
     from cake_tpu.obs import metrics as obs_metrics
     from cake_tpu.ops.sampling import SamplingConfig
@@ -163,17 +286,26 @@ def test_engine_pallas_records_step_histogram(tiny_config, tiny_params):
 
     fam = obs_metrics.REGISTRY.get("cake_paged_attn_step_seconds")
     assert fam is not None
-    before = {p: fam.labels(path=p).count for p in ("prefill", "decode")}
-    eng = InferenceEngine(
-        tiny_config, tiny_params, ByteTokenizer(tiny_config.vocab_size),
-        max_slots=2, max_seq_len=64,
-        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
-        kv_pages=10, kv_page_size=8, paged_attn="fold")
-    with eng:
-        h = eng.submit([5] * 9, max_new_tokens=4, temperature=0.0,
-                       repeat_penalty=1.0)
-        assert h.wait(timeout=300)
-    assert fam.labels(path="prefill").count > before["prefill"]
+    paths = ("prefill", "decode", "mixed")
+    before = {p: fam.labels(path=p).count for p in paths}
+
+    def run(**kw):
+        eng = InferenceEngine(
+            tiny_config, tiny_params,
+            ByteTokenizer(tiny_config.vocab_size),
+            max_slots=2, max_seq_len=64,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            kv_pages=10, kv_page_size=8, paged_attn="fold", **kw)
+        with eng:
+            h = eng.submit([5] * 9, max_new_tokens=4, temperature=0.0,
+                           repeat_penalty=1.0)
+            assert h.wait(timeout=300)
+
+    run()                            # auto -> mixed step + pure decode
+    assert fam.labels(path="mixed").count > before["mixed"]
     assert fam.labels(path="decode").count > before["decode"]
+    assert fam.labels(path="prefill").count == before["prefill"]
+    run(mixed_batch="off")           # phase-split: prefill + decode
+    assert fam.labels(path="prefill").count > before["prefill"]
     rendered = obs_metrics.REGISTRY.render()
     assert 'cake_paged_attn_step_seconds_bucket{path="decode"' in rendered

@@ -17,8 +17,9 @@ never ship):
   * counter samples are finite and non-negative.
 
 Additionally, telemetry metric families (``cake_step_*``,
-``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``, and the paged
-prefix-sharing ``cake_prefix_*``) must carry real help text (not just
+``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``, the paged
+prefix-sharing ``cake_prefix_*``, and the mixed continuous-batching
+``cake_mixed_*``) must carry real help text (not just
 an echoed name) and appear in the README metrics table — pass
 ``--readme README.md`` to enforce it (the tier-1 hook in
 tests/test_metrics_lint.py does, so an undocumented telemetry metric
@@ -57,7 +58,7 @@ HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 # TTFT)
 DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_device_", "cake_prefix_", "cake_sched_",
-                       "cake_shed_", "cake_preemptions_")
+                       "cake_shed_", "cake_preemptions_", "cake_mixed_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
