@@ -165,6 +165,25 @@ MIXED_TIERS = {
                           wave_gen=16, stagger_s=0.05),
 }
 
+# KV tiering tiers (bench.py --kv-tier): the same offered load at f32
+# vs int8 KV, each phase's page pool sized to the SAME byte budget —
+# int8 pages + per-page scales cost ~1/4 the bytes, so the identical
+# budget holds ~4x the pages and the pool admits more concurrent
+# streams. Each phase also exercises the host tier: a registered
+# prefix goes cold, the oversubscribed wave spills it for admission
+# pages, and a final prefix-matching request restores it.
+KV_TIER_TIERS = {
+    # 16 f32 pages x 128 tokens at 8B is ~512 MiB of pool budget; the
+    # same budget holds ~64 int8 pages. 24 streams of 2 pages each
+    # oversubscribe both phases, so f32 caps at ~7 resident streams
+    # (prefix spilled) while int8 reaches the 16-slot cap.
+    "kvtier_8b": dict(model="8b", quant="int8", max_seq=512, slots=16,
+                      pool_bytes=16 * 2 * 32 * 128 * 8 * 128 * 4,
+                      kv_page_size=128, paged_attn="pallas",
+                      prompt_len=128, gen_tokens=32, prefix_tokens=256,
+                      host_pages=8, wave=24),
+}
+
 # SLO scheduling tiers (bench.py --slo): a mixed-priority saturation
 # run through a --priority-classes engine, measured TWICE — preemption
 # off then on, same offered load — reporting per-class TTFT p50/p99
@@ -182,6 +201,14 @@ SLO_TIERS = {
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
+    # 4 f32 pages of budget -> ~15 int8 pages: streams of 2 pages each
+    # give f32 ~2 resident vs int8 ~7 (the >= 1.8x acceptance bar),
+    # and the 2-page prefix spills/restores in both phases
+    "kvtier_tiny": dict(model="tiny", quant=False, max_seq=128, slots=8,
+                        pool_bytes=4 * 2 * 4 * 16 * 2 * 16 * 4,
+                        kv_page_size=16, paged_attn="fold",
+                        prompt_len=24, gen_tokens=8, prefix_tokens=32,
+                        host_pages=6, wave=10),
     "mixed_tiny": dict(model="tiny", quant=False, max_seq=128, slots=3,
                        kv_pages=24, kv_page_size=16, paged_attn="fold",
                        prompt_len=24, prefill_chunk=8, base_gen=64,
@@ -773,6 +800,131 @@ def run_mixed_tier(name: str, model: str, quant, max_seq: int,
     }
 
 
+def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
+                pool_bytes: int, kv_page_size: int, paged_attn: str,
+                prompt_len: int, gen_tokens: int, prefix_tokens: int,
+                host_pages: int, wave: int) -> dict:
+    """KV tiering A/B (cake_tpu/kv): the same offered load served at
+    f32 KV and at int8 KV, each phase's page pool sized to the SAME
+    byte budget (pool_bytes -> pages per dtype via page_bytes, so int8
+    gets ~4x the pages). Reports max RESIDENT streams per phase (peak
+    concurrently-admitted requests — the capacity win quantized pages
+    exist for), aggregate decode tok/s, and host-tier spill/restore
+    counts: each phase registers a shared prefix, oversubscribes the
+    pool so the cold prefix SPILLS to the host tier under admission
+    pressure, then sends one prefix-matching request so it RESTORES.
+    The headline value is the int8/f32 resident-stream ratio."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.kv.quantized_pool import page_bytes as kv_page_bytes
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+    prefix_ids = _synth_prompt(777, prefix_tokens, V)
+
+    def phase(kv_dtype: str) -> dict:
+        per_page = kv_page_bytes(
+            cfg, kv_page_size,
+            jnp.int8 if kv_dtype == "int8" else jnp.float32)
+        pages = max(2, pool_bytes // per_page)
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            kv_pages=pages, kv_page_size=kv_page_size,
+            paged_attn=paged_attn, kv_dtype=kv_dtype,
+            kv_host_pages=host_pages,
+        )
+        with engine:
+            t0 = time.perf_counter()
+            warm = engine.submit(prompt(99), max_new_tokens=4)
+            assert warm.wait(timeout=900), \
+                f"kv[{kv_dtype}] warmup timed out"
+            log(f"kv[{kv_dtype}] warmup (compile): "
+                f"{time.perf_counter() - t0:.1f}s ({pages} pages)")
+            _settle_decode_stats(engine, 0.0)
+            base_tokens = engine.stats.tokens_generated
+            base_decode = engine.stats.decode_time_s
+            engine.register_prefix(prefix_ids)
+            handles = [engine.submit(prompt(i), max_new_tokens=gen_tokens)
+                       for i in range(wave)]
+            # peak RESIDENT streams: poll slots actually HOLDING pool
+            # pages while the oversubscribed wave drains (scheduler
+            # .active would transiently count a page-starved admission
+            # between its plan and its requeue; _slot_pages entries
+            # exist only after a successful page mapping)
+            peak = 0
+            t0 = time.perf_counter()
+            while (any(not h._req.done.is_set() for h in handles)
+                   and time.perf_counter() - t0 < 900):
+                peak = max(peak, len(engine._slot_pages))
+                time.sleep(0.001)
+            assert all(h.wait(timeout=60) for h in handles), \
+                f"kv[{kv_dtype}] wave timed out"
+            # a prefix-matching tail request streams the (by now
+            # spilled) prefix back from the host tier
+            hp = engine.submit(prefix_ids + prompt(1234)[:8],
+                               max_new_tokens=4)
+            assert hp.wait(timeout=900), \
+                f"kv[{kv_dtype}] prefix-restore request timed out"
+            _settle_decode_stats(engine, base_decode)
+            tokens = engine.stats.tokens_generated - base_tokens
+            decode_s = engine.stats.decode_time_s - base_decode
+            out = {
+                "streams": peak, "pages": pages,
+                "pool_bytes": engine.cache.memory_bytes(),
+                "tok_s": tokens / decode_s if decode_s > 0 else 0.0,
+                "spills": engine.stats.kv_spills,
+                "restores": engine.stats.kv_restores,
+            }
+        log(f"kv[{kv_dtype}]: {out['streams']} resident streams, "
+            f"{out['tok_s']:.1f} tok/s, {out['spills']} spills / "
+            f"{out['restores']} restores ({pages} pages, "
+            f"{out['pool_bytes'] / 2**20:.1f} MiB pool)")
+        return out
+
+    f32 = phase("f32")
+    q8 = phase("int8")
+    ratio = q8["streams"] / max(1, f32["streams"])
+    log(f"kv tiering: int8 {q8['streams']} vs f32 {f32['streams']} "
+        f"resident streams at ~{pool_bytes / 2**20:.0f} MiB pool "
+        f"budget -> {ratio:.2f}x")
+    return {
+        "metric": f"{name}_kv_resident_streams_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "paged_attn": paged_attn,
+        "kv_pool_budget_bytes": pool_bytes,
+        "kv_streams_int8": q8["streams"],
+        "kv_streams_f32": f32["streams"],
+        "kv_pages_int8": q8["pages"],
+        "kv_pages_f32": f32["pages"],
+        "kv_pool_bytes_int8": q8["pool_bytes"],
+        "kv_pool_bytes_f32": f32["pool_bytes"],
+        "kv_tok_s_int8": round(q8["tok_s"], 2),
+        "kv_tok_s_f32": round(f32["tok_s"], 2),
+        "kv_spills_int8": q8["spills"],
+        "kv_spills_f32": f32["spills"],
+        "kv_restores_int8": q8["restores"],
+        "kv_restores_f32": f32["restores"],
+        "kv_host_pages": host_pages,
+        "device_kind": dev.device_kind,
+    }
+
+
 def run_slo_tier(name: str, model: str, quant, max_seq: int,
                  slots: int, prompt_len: int, prefill_chunk: int,
                  batch_gen: int, inter_n: int, inter_gen: int,
@@ -1024,7 +1176,10 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in MIXED_TIERS or name.startswith("mixed_"):
+    if name in KV_TIER_TIERS or name.startswith("kvtier"):
+        kwargs = {**KV_TIER_TIERS, **SMOKE_TIERS}[name]
+        result = run_kv_tier(name, **kwargs)
+    elif name in MIXED_TIERS or name.startswith("mixed_"):
         kwargs = {**MIXED_TIERS, **SMOKE_TIERS}[name]
         result = run_mixed_tier(name, **kwargs)
     elif name in SLO_TIERS or name.startswith("slo_"):
@@ -1216,6 +1371,17 @@ def _mixed_main() -> int:
         fail_error="mixed continuous-batching tier failed")
 
 
+def _kv_tier_main() -> int:
+    """`bench.py --kv-tier`: the KV tiering A/B — one JSON line with
+    resident streams, tok/s, and host-tier spill/restore counts at f32
+    vs int8 KV under the same pool byte budget, headline value the
+    int8/f32 resident-stream ratio. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "kv_resident_streams_ratio", "x",
+        cpu_tier="kvtier_tiny", tpu_tier="kvtier_8b",
+        fail_error="kv tiering tier failed")
+
+
 def _slo_main() -> int:
     """`bench.py --slo`: the mixed-priority SLO scheduling tier — one
     JSON line with per-class TTFT p50/p99 for a preemption-on vs
@@ -1328,6 +1494,8 @@ if __name__ == "__main__":
         probe_main()
     elif os.environ.get(ORCH_ENV):
         tier_main()
+    elif "--kv-tier" in sys.argv:
+        sys.exit(_kv_tier_main())
     elif "--mixed" in sys.argv:
         sys.exit(_mixed_main())
     elif "--slo" in sys.argv:

@@ -16,6 +16,14 @@ from dataclasses import dataclass, field, fields, asdict
 from enum import Enum
 from typing import Optional
 
+# one source of truth for the int8-KV-with-speculation config error:
+# Args.validate raises it on the CLI path, master.make_engine raises it
+# for programmatically-built Args that skipped validate()
+INT8_KV_SPEC_ERROR = (
+    "--kv-dtype int8 is unavailable with --draft-model:"
+    " the speculative engine is gated off the paged "
+    "pool, so there are no KV pages to quantize")
+
 
 class ModelType(str, Enum):
     TEXT = "text"
@@ -55,8 +63,13 @@ class Args:
     repeat_last_n: int = 128
     dtype: str = "bf16"                 # f16 | bf16 | f32 (TPU default bf16)
     # KV-cache storage dtype; fp8 halves KV HBM traffic/footprint (values
-    # upcast into the attention matmul on read). None = same as dtype.
-    kv_dtype: Optional[str] = None      # + f8_e4m3 | f8_e5m2
+    # upcast into the attention matmul on read). "int8" selects the
+    # QUANTIZED paged pool (cake_tpu/kv): int8 KV pages + per-page
+    # per-kv-head f32 scales, ~4x the resident decode streams per pool
+    # byte vs f32 — requires --kv-pages (the page is the quantization
+    # unit) and is a loud config error with --draft-model (the spec
+    # engine is gated off the paged pool). None = same as dtype.
+    kv_dtype: Optional[str] = None      # + f8_e4m3 | f8_e5m2 | int8
     cpu: bool = False
     device_idx: int = 0
     max_seq_len: int = 4096             # reference hard constant (config.rs:6); tunable here
@@ -139,6 +152,14 @@ class Args:
     # "auto" = on for paged serving, off elsewhere; "on" without
     # --kv-pages is a config error; "off" keeps the phase-split loop
     mixed_batch: str = "auto"
+    # --kv-host-pages N: host-RAM spill tier for the paged pool
+    # (cake_tpu/kv/host_tier.py) — preemption victims' pages and cold
+    # shared-prefix pages spill to pinned host memory (LRU, capacity N
+    # pages) and stream back on demand, so a resumed victim decodes
+    # from where it stopped instead of re-prefilling and a cold prefix
+    # re-maps instead of recomputing. Applies to --kv-pages serving
+    # only (the page is the spill unit)
+    kv_host_pages: Optional[int] = None
     # --trace-events PATH: append every request-lifecycle span as one
     # JSON line (obs/tracing.py) — the replayable audit log behind the
     # in-memory ring served at GET /api/v1/requests
@@ -192,10 +213,23 @@ class Args:
             raise ValueError(
                 f"unsupported mixed_batch '{self.mixed_batch}' "
                 "(choose auto, on or off)")
-        if self.kv_dtype is not None:
+        if self.kv_dtype == "int8":
+            # int8 KV is page-granular (per-page scales live in the
+            # paged pool); without --kv-pages there is nothing to
+            # quantize — loud error, not a silent no-op
+            if not self.kv_pages:
+                raise ValueError(
+                    "--kv-dtype int8 requires --kv-pages: int8 KV "
+                    "pages live in the paged pool (cake_tpu/kv)")
+            if self.draft_model is not None:
+                raise ValueError(INT8_KV_SPEC_ERROR)
+        elif self.kv_dtype is not None:
             # single source of truth for storage dtypes
             from cake_tpu.utils.devices import resolve_kv_dtype
             resolve_kv_dtype(self.kv_dtype)
+        if self.kv_host_pages is not None and self.kv_host_pages < 1:
+            raise ValueError(
+                f"--kv-host-pages {self.kv_host_pages} must be >= 1")
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

@@ -267,6 +267,14 @@ def main(argv=None) -> int:
     )
     args, sd_args, img_args = parse_args(argv)
 
+    if getattr(args, "kv_host_pages", None) and not args.kv_pages:
+        # one-shot warning mirroring --step-log: the host KV tier
+        # spills PAGED pool pages, so without --kv-pages the flag does
+        # nothing — say so instead of silently ignoring it
+        logging.getLogger(__name__).warning(
+            "--kv-host-pages has no effect without --kv-pages: the "
+            "host tier spills paged KV pool pages (cake_tpu/kv)")
+
     if args.mode == "worker":
         print(
             "cake-tpu runs the whole topology as one SPMD program over the "
@@ -309,14 +317,18 @@ def main(argv=None) -> int:
             "engine serving (--api); one-shot generation runs a "
             "single request with nothing to schedule")
     if args.kv_pages or args.auto_prefix \
+            or getattr(args, "kv_host_pages", None) \
+            or getattr(args, "kv_dtype", None) == "int8" \
             or getattr(args, "mixed_batch", "auto") == "on":
         # all live in the serving engine (paged pool / prefix registry
-        # / mixed ragged step); a one-shot generation silently ignoring
-        # them would look like the feature "did nothing"
+        # / mixed ragged step / kv tiering); a one-shot generation
+        # silently ignoring them would look like the feature "did
+        # nothing"
         logging.getLogger(__name__).warning(
-            "--kv-pages / --auto-prefix / --mixed-batch apply to "
-            "engine serving (--api); one-shot generation uses the "
-            "sequential generator's dense cache")
+            "--kv-pages / --auto-prefix / --mixed-batch / --kv-dtype "
+            "int8 / --kv-host-pages apply to engine serving (--api); "
+            "one-shot generation uses the sequential generator's "
+            "dense cache")
 
     if args.model_type.value == "image":
         count = [0]

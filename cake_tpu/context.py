@@ -123,8 +123,15 @@ class Context:
         )
         max_seq = min(a.max_seq_len, cfg.max_position_embeddings)
         from cake_tpu.utils.devices import resolve_kv_dtype
-        kv_dtype = (resolve_kv_dtype(a.kv_dtype) if a.kv_dtype
-                    else self.dtype)
+        if a.kv_dtype == "int8":
+            # int8 KV is the PAGED ENGINE's quantized pool (cake_tpu/kv;
+            # master.make_engine passes --kv-dtype through): the
+            # sequential generator's dense cache keeps the compute
+            # dtype — scales are per page, and the dense cache has none
+            kv_dtype = self.dtype
+        else:
+            kv_dtype = (resolve_kv_dtype(a.kv_dtype) if a.kv_dtype
+                        else self.dtype)
 
         kwargs = {}
         if a.sp > 1:

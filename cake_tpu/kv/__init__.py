@@ -1,0 +1,27 @@
+"""KV-cache tiering: quantized KV pages + host-RAM spill.
+
+The paged serving pool (models/llama/paged.py) treats the PAGE as its
+unit of allocation; this package makes the page the unit of two more
+things:
+
+  * quantization (`quantized_pool.py`): an int8 page pool with
+    per-page, per-kv-head symmetric scales — pool bytes drop ~4x vs
+    f32 (~2x vs bf16), so the same HBM budget holds proportionally
+    more resident decode streams;
+  * tiering (`host_tier.py`): an LRU host-RAM spill store behind the
+    refcounted PageAllocator — cold shared-prefix pages and preempted
+    victims' pages stream out to pinned host memory and back on
+    demand, instead of being discarded and recomputed.
+"""
+
+from cake_tpu.kv.host_tier import HostTier
+from cake_tpu.kv.quantized_pool import (
+    QuantPool, QuantizedPagedKVCache, dequantize_pages,
+)
+
+__all__ = [
+    "HostTier",
+    "QuantPool",
+    "QuantizedPagedKVCache",
+    "dequantize_pages",
+]

@@ -1,0 +1,248 @@
+"""Host-RAM spill tier for the paged KV pool.
+
+A fleet serving millions of users is mostly warm shared prefixes and
+parked work — but today a prefix (page-granular sharing, PR 4) or a
+preemption victim's progress (PR 5) survives only while it holds HBM
+pages. The host tier gives the refcounted PageAllocator a second level:
+page contents (raw pool slices — int8 pages + scales, or f32/bf16
+pages; the tier is dtype-blind) are `jax.device_get` into host numpy on
+`spill`, the device pages free for new admissions, and `restore`
+streams them back into freshly-allocated pages on demand — a resumed
+victim decodes from where it stopped instead of recomputing prefill,
+and a cold prefix re-maps instead of re-prefilling.
+
+Capacity is counted in PAGES (`--kv-host-pages N`); an over-capacity
+`put` evicts least-recently-used entries first (everything here is
+recomputable, so eviction is loss of a shortcut, never of data). The
+ENGINE thread owns all calls that pair with allocator/table mutations —
+the tier itself only moves bytes and keeps the LRU map.
+
+Metrics (obs/metrics.py registry; also refreshed at scrape by
+obs/steps.refresh_page_gauges):
+  cake_kv_host_pages{state}   gauge    used | free host pages
+  cake_kv_spill_total{dir}    counter  spill | restore page movements
+  cake_kv_spill_seconds       histogram device<->host copy wall
+  cake_kv_pool_bytes{tier}    gauge    device | host resident bytes
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from cake_tpu.obs import metrics as obs_metrics
+
+log = logging.getLogger(__name__)
+
+_HOST_PAGES = obs_metrics.gauge(
+    "cake_kv_host_pages",
+    "Host-tier KV pages by state (used = spilled pages resident in "
+    "host RAM, free = remaining --kv-host-pages capacity)",
+    labelnames=("state",))
+_SPILLS = obs_metrics.counter(
+    "cake_kv_spill_total",
+    "KV pages moved across the HBM/host boundary, by direction "
+    "(spill = device->host, restore = host->device)",
+    labelnames=("dir",))
+_SPILL_SECONDS = obs_metrics.histogram(
+    "cake_kv_spill_seconds",
+    "Wall seconds per spill/restore page movement (device_get or "
+    "scatter-back, engine-thread)")
+_POOL_BYTES = obs_metrics.gauge(
+    "cake_kv_pool_bytes",
+    "KV pool bytes resident per tier (device = the paged pool incl. "
+    "int8 scale sidecars, host = spilled pages in RAM)",
+    labelnames=("tier",))
+
+
+def refresh_gauges(cache, tier: Optional["HostTier"]) -> None:
+    """Scrape-time refresh of every cake_kv_* gauge — the PUBLIC seam
+    for obs/steps.refresh_page_gauges, so the metric globals above stay
+    module-private. cache is the engine's paged pool (device tier:
+    memory_bytes sums int8 pools + scale sidecars per dtype); tier is
+    the engine's HostTier or None when --kv-host-pages is unset."""
+    _POOL_BYTES.labels("device").set(cache.memory_bytes())
+    if tier is not None:
+        tier._set_gauges()
+
+
+@dataclass
+class SpilledPages:
+    """One spill entry: the raw page contents + resume metadata."""
+
+    n_pages: int
+    # pool slices, device layout preserved: for a quantized pool
+    # (k_q, k_scale, v_q, v_scale), else (k, v) — restore scatters
+    # them back verbatim, so a host round trip is bit-identical
+    arrays: Tuple[np.ndarray, ...]
+    kind: str = "pages"            # "victim" | "prefix"
+    # victim resume state (engine mirrors at preemption time)
+    pos: int = 0
+    last_tok: int = 0
+    n_prefix_tokens: int = 0
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays)
+
+
+class HostTier:
+    """LRU store of spilled KV pages, capacity-bounded in pages."""
+
+    def __init__(self, capacity_pages: int, page_bytes: int = 0):
+        if capacity_pages < 1:
+            raise ValueError(
+                f"--kv-host-pages {capacity_pages} must be >= 1")
+        self.capacity = capacity_pages
+        self.page_bytes = page_bytes
+        self._entries: "OrderedDict[object, SpilledPages]" = OrderedDict()
+        self._used = 0
+        self.spills = 0
+        self.restores = 0
+        self.evictions = 0
+        self._set_gauges()
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def used_pages(self) -> int:
+        return self._used
+
+    @property
+    def free_pages(self) -> int:
+        return self.capacity - self._used
+
+    @property
+    def used_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def can_hold(self, n_pages: int) -> bool:
+        """Whether n_pages could be stored at all (evicting colder
+        entries if needed) — the engine's spill-vs-recompute gate."""
+        return n_pages <= self.capacity
+
+    def _set_gauges(self) -> None:
+        try:
+            _HOST_PAGES.labels("used").set(self._used)
+            _HOST_PAGES.labels("free").set(self.free_pages)
+            _POOL_BYTES.labels("host").set(self.used_bytes)
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            log.debug("host tier gauge update failed", exc_info=True)
+
+    # -- store -------------------------------------------------------------
+
+    def put(self, key, entry: SpilledPages) -> bool:
+        """Store an entry, evicting LRU entries until it fits; False
+        (and no mutation) when it can never fit."""
+        if entry.n_pages > self.capacity:
+            return False
+        self.drop(key)
+        while self._used + entry.n_pages > self.capacity:
+            old_key, old = self._entries.popitem(last=False)
+            self._used -= old.n_pages
+            self.evictions += 1
+            log.debug("host tier: evicted %r (%d pages)", old_key,
+                      old.n_pages)
+        self._entries[key] = entry
+        self._used += entry.n_pages
+        self.spills += entry.n_pages
+        _SPILLS.labels("spill").inc(entry.n_pages)
+        self._set_gauges()
+        return True
+
+    def peek(self, key) -> Optional[SpilledPages]:
+        """Entry lookup WITHOUT removal; refreshes LRU recency."""
+        e = self._entries.get(key)
+        if e is not None:
+            self._entries.move_to_end(key)
+        return e
+
+    def pop(self, key, restored: bool = True) -> Optional[SpilledPages]:
+        """Remove and return an entry (restored=True counts it as a
+        restore; False is a plain discard)."""
+        e = self._entries.pop(key, None)
+        if e is None:
+            return None
+        self._used -= e.n_pages
+        if restored:
+            self.restores += e.n_pages
+            _SPILLS.labels("restore").inc(e.n_pages)
+        self._set_gauges()
+        return e
+
+    def drop(self, key) -> None:
+        self.pop(key, restored=False)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._used = 0
+        self._set_gauges()
+
+    def keys(self) -> List[object]:
+        return list(self._entries.keys())
+
+    # -- device <-> host movement -----------------------------------------
+
+    @staticmethod
+    def fetch_pages(cache, pages) -> Tuple[np.ndarray, ...]:
+        """device_get the contents of `pages` from a paged cache (plain
+        or quantized pool) as host numpy, ONE batched transfer. Layout:
+        quantized -> (k_q, k_scale, v_q, v_scale), else (k, v); every
+        array keeps its [L, n, ...] pool slice shape so restore is a
+        verbatim scatter (bit-identical round trip)."""
+        import jax.numpy as jnp
+        idx = jnp.asarray(list(pages), jnp.int32)
+        k, v = cache.k, cache.v
+        if hasattr(k, "q"):       # QuantPool
+            devs = (jnp.take(k.q, idx, axis=1),
+                    jnp.take(k.scale, idx, axis=1),
+                    jnp.take(v.q, idx, axis=1),
+                    jnp.take(v.scale, idx, axis=1))
+        else:
+            devs = (jnp.take(k, idx, axis=1), jnp.take(v, idx, axis=1))
+        t0 = time.perf_counter()
+        host = jax.device_get(devs)
+        _SPILL_SECONDS.observe(time.perf_counter() - t0)
+        return tuple(np.asarray(a) for a in host)
+
+    @staticmethod
+    def install_pages(cache, pages, arrays: Tuple[np.ndarray, ...]):
+        """Scatter spilled contents back into freshly-allocated pages
+        of (a possibly different generation of) the pool; returns the
+        updated cache. Inverse of fetch_pages — same array order."""
+        import jax.numpy as jnp
+        idx = jnp.asarray(list(pages), jnp.int32)
+        t0 = time.perf_counter()
+        k, v = cache.k, cache.v
+        if hasattr(k, "q"):       # QuantPool
+            kq, ks, vq, vs = arrays
+            cache = cache._replace(
+                k=k._replace(q=k.q.at[:, idx].set(jnp.asarray(kq)),
+                             scale=k.scale.at[:, idx].set(
+                                 jnp.asarray(ks))),
+                v=v._replace(q=v.q.at[:, idx].set(jnp.asarray(vq)),
+                             scale=v.scale.at[:, idx].set(
+                                 jnp.asarray(vs))),
+            )
+        else:
+            hk, hv = arrays
+            cache = cache._replace(
+                k=k.at[:, idx].set(jnp.asarray(hk, k.dtype)),
+                v=v.at[:, idx].set(jnp.asarray(hv, v.dtype)),
+            )
+        # the scatter dispatches asynchronously — without the barrier
+        # every restore sample would time lazy dispatch (~us) while the
+        # actual host->device copy runs inside the next jitted step,
+        # making restores look free next to the blocking device_get in
+        # fetch_pages. Restores are rare (preempt resume / prefix hit),
+        # so the lost overlap is cheap next to an honest histogram.
+        jax.block_until_ready((cache.k, cache.v))
+        _SPILL_SECONDS.observe(time.perf_counter() - t0)
+        return cache

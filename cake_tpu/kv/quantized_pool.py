@@ -1,0 +1,363 @@
+"""int8 KV page pool with per-page, per-kv-head scales.
+
+Decode is HBM-bandwidth-bound and the KV cache is the growing term
+(BENCH_MEASURED: int8 *weights* already run at 1.6x the bf16 roofline;
+the 32-slot config collapses to 151 tok/s from cache thrash). Storing
+KV pages as int8 with one symmetric scale per (layer, page, kv head)
+cuts pool bytes ~4x vs f32 — the same `--kv-pages` byte budget admits
+proportionally more resident streams — while attention reads dequantize
+in registers exactly like `ops/quant.py` weight-only matmuls.
+
+Layout (the paged pool's, with a scale sidecar):
+
+  pool.q:     [L, N_pages, page, KV, hd] int8
+  pool.scale: [L, N_pages, KV]           f32
+
+The scale is PER PAGE, which is what makes spill/restore trivial (a
+page + its scale row is self-contained) but means incremental writes
+must keep the already-quantized page consistent:
+
+  * whole-window writes (prompt prefill: pages fully overwritten) set
+    the page's scale fresh from the window's amax;
+  * incremental writes (decode tokens, chunk windows at arbitrary
+    offsets) GATHER the touched pages, grow the scale monotonically
+    (new_scale = max(old, amax(new)/127)), RE-quantize the resident
+    int8 values by the ratio old/new (one extra rounding, bounded by
+    half a step of the new scale), write the new tokens, and scatter
+    back. The engine zeroes a page's scales at allocation so a fresh
+    page's first write always sets its own scale instead of inheriting
+    a previous occupant's.
+
+`QuantPool` is a NamedTuple, so a stacked [L, ...] pool rides
+`lax.scan` over the block axis unchanged — each layer's body sees a
+per-layer QuantPool leaf pair, and the writers in
+`models/llama/paged.py` dispatch on the leaf type.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# symmetric int8 range and the amax floor (ops/quant.py convention)
+_QMAX = 127.0
+_EPS = 1e-8
+
+
+class QuantPool(NamedTuple):
+    """One int8 page pool half (k or v): values + per-page scales.
+
+    q:     int8, [(L,) N_pages, page, KV, hd]
+    scale: f32,  [(L,) N_pages, KV]
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+class QuantizedPagedKVCache(NamedTuple):
+    """PagedKVCache with int8 pools + scale sidecars. Same property
+    surface as models/llama/paged.PagedKVCache, so the engine and the
+    jitted step fns are layout-blind (NamedTuple pytree; the page
+    TABLE rides along identically)."""
+
+    k: QuantPool
+    v: QuantPool
+    table: jnp.ndarray    # [slots, max_pages] int32, -1 = unmapped
+
+    @property
+    def page_size(self) -> int:
+        return self.k.q.shape[2]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.q.shape[1]
+
+    @property
+    def max_pages(self) -> int:
+        return self.table.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.table.shape[1] * self.k.q.shape[2]
+
+    @classmethod
+    def create(cls, config, slots: int, n_pages: int, page_size: int,
+               max_seq_len: int) -> "QuantizedPagedKVCache":
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq_len "
+                f"{max_seq_len}")
+        L = config.num_hidden_layers
+        KV = config.num_key_value_heads
+        hd = config.head_dim
+        shape = (L, n_pages, page_size, KV, hd)
+        sshape = (L, n_pages, KV)
+        return cls(
+            k=QuantPool(q=jnp.zeros(shape, jnp.int8),
+                        scale=jnp.zeros(sshape, jnp.float32)),
+            v=QuantPool(q=jnp.zeros(shape, jnp.int8),
+                        scale=jnp.zeros(sshape, jnp.float32)),
+            table=jnp.full((slots, max_seq_len // page_size), -1,
+                           jnp.int32),
+        )
+
+    def memory_bytes(self) -> int:
+        """ACTUAL storage bytes: int8 pools summed per dtype PLUS the
+        f32 scale sidecars (the one-dtype `k.nbytes + v.nbytes`
+        shortcut undercounts a mixed-dtype pool)."""
+        return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            (self.k, self.v)))
+
+
+def page_bytes(config, page_size: int, dtype=jnp.float32) -> int:
+    """Storage bytes ONE pool page costs (k + v, all layers, scales
+    included for int8) — the unit the bench `--kv-tier` byte budget and
+    the host tier's accounting both price pages in."""
+    L = config.num_hidden_layers
+    KV = config.num_key_value_heads
+    hd = config.head_dim
+    if dtype == jnp.int8 or dtype == "int8":
+        per = L * page_size * KV * hd * 1 + L * KV * 4
+    else:
+        per = L * page_size * KV * hd * jnp.dtype(dtype).itemsize
+    return 2 * per          # k and v
+
+
+def _quantize_windows(vals: jnp.ndarray):
+    """Quantize whole page windows: vals [..., P, KV, hd] f32-ish ->
+    (q int8 same shape, scale f32 [..., KV]) with amax over (P, hd)."""
+    v32 = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v32), axis=(-3, -1))            # [..., KV]
+    scale = jnp.maximum(amax, _EPS) / _QMAX
+    q = jnp.clip(jnp.round(v32 / scale[..., None, :, None]),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def _requant(q_old: jnp.ndarray, ratio: jnp.ndarray) -> jnp.ndarray:
+    """Re-quantize resident int8 values after a monotone scale growth:
+    q' = round(q * old/new). ratio broadcasts [..., KV] over
+    [..., P, KV, hd]."""
+    return jnp.clip(
+        jnp.round(q_old.astype(jnp.float32) * ratio[..., None, :, None]),
+        -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def dequantize_pages(pool: QuantPool, idx: jnp.ndarray,
+                     fill_zero: bool = False) -> jnp.ndarray:
+    """Gather pages `idx` and dequantize to f32:
+    [*idx.shape, P, KV, hd]. fill_zero routes out-of-range ids to a
+    zero page (the fold's unmapped-page semantics)."""
+    if fill_zero:
+        q = jnp.take(pool.q, idx, axis=0, mode="fill", fill_value=0)
+        s = jnp.take(pool.scale, idx, axis=0, mode="fill",
+                     fill_value=0.0)
+    else:
+        q = jnp.take(pool.q, idx, axis=0)
+        s = jnp.take(pool.scale, idx, axis=0)
+    return q.astype(jnp.float32) * s[..., None, :, None]
+
+
+def reset_page_scales(cache: QuantizedPagedKVCache,
+                      pages) -> QuantizedPagedKVCache:
+    """Zero the scales of freshly-allocated pages (host-computed page
+    list; one tiny eager scatter per admission, the table_set_slot
+    precedent). A fresh page's first incremental write then sets its
+    own scale instead of inheriting a previous occupant's amax —
+    without this, a page recycled from a large-activation request
+    would quantize a new request's small values to ~0."""
+    idx = jnp.asarray(list(pages), jnp.int32)
+    zeros = jnp.zeros((cache.k.scale.shape[0], idx.shape[0],
+                       cache.k.scale.shape[2]), jnp.float32)
+    return cache._replace(
+        k=cache.k._replace(scale=cache.k.scale.at[:, idx].set(zeros)),
+        v=cache.v._replace(scale=cache.v.scale.at[:, idx].set(zeros)),
+    )
+
+
+# -- writers (per-layer pool leaves, models/llama/paged.py contracts) ---------
+
+
+def qwrite_prompt_pages(pool: QuantPool, vals: jnp.ndarray,
+                        table_row: jnp.ndarray,
+                        n_real=None) -> QuantPool:
+    """write_prompt_pages over a quantized pool: page-ALIGNED windows
+    fully overwrite their pages, so each window quantizes fresh (scale
+    from the window's own amax; zero padding cannot raise it) and both
+    q and scale scatter in one parallel write. Unmapped windows route
+    to the out-of-bounds index and drop.
+
+    n_real (traced scalar) marks the real prompt length: BUCKET padding
+    positions carry token-id-0 garbage k/v that is dead data for the
+    f32 pool (overwritten by decode before it can be attended) but
+    would POISON a fresh page scale here — the scale only grows after
+    this write, so a garbage-inflated amax coarsens the page's real
+    tokens for the page's whole life. Padding values are zeroed before
+    quantization instead."""
+    N, P = pool.q.shape[0], pool.q.shape[1]
+    S = vals.shape[1]
+    KV, hd = vals.shape[2], vals.shape[3]
+    if n_real is not None:
+        live = jnp.arange(S)[None, :, None, None] < n_real
+        vals = jnp.where(live, vals, 0)
+    n_win = -(-S // P)
+    pad = n_win * P - S
+    if pad:
+        vals = jnp.pad(vals, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    pages = table_row[:n_win]
+    idx = jnp.where(pages >= 0, pages, N)
+    w = vals[0].reshape(n_win, P, KV, hd)
+    q, scale = _quantize_windows(w)
+    return QuantPool(
+        q=pool.q.at[idx].set(q, mode="drop"),
+        scale=pool.scale.at[idx].set(scale, mode="drop"),
+    )
+
+
+def qupdate_pool_per_row(pool: QuantPool, vals: jnp.ndarray, pos,
+                         active, table) -> QuantPool:
+    """update_pool_per_row over a quantized pool: each active row's
+    decode token lands in ONE page — gather that page + scale, grow
+    the scale to cover the token, re-quantize residents by old/new,
+    write the token, scatter back. Distinct rows own distinct pages so
+    the B round-trips are disjoint; inactive/unmapped rows route to
+    the out-of-bounds index on both the gather (zero/one fill) and the
+    scatter (drop)."""
+    N, P = pool.q.shape[0], pool.q.shape[1]
+    B = vals.shape[0]
+    rows = jnp.arange(B)
+    pages = table[rows, pos // P]
+    offs = pos % P
+    valid = jnp.logical_and(active, pages >= 0)
+    idx = jnp.where(valid, pages, N)
+    qs = jnp.take(pool.q, idx, axis=0, mode="fill",
+                  fill_value=0)                         # [B,P,KV,hd]
+    ss = jnp.take(pool.scale, idx, axis=0, mode="fill",
+                  fill_value=0.0)                       # [B,KV]
+    tok = vals[:, 0].astype(jnp.float32)                # [B,KV,hd]
+    need = jnp.maximum(jnp.max(jnp.abs(tok), axis=-1), _EPS) / _QMAX
+    new_s = jnp.maximum(ss, need)
+    qr = _requant(qs, ss / new_s)
+    qt = jnp.clip(jnp.round(tok / new_s[..., None]),
+                  -_QMAX, _QMAX).astype(jnp.int8)       # [B,KV,hd]
+    mask = (jnp.arange(P)[None, :] == offs[:, None])    # [B,P]
+    qw = jnp.where(mask[..., None, None], qt[:, None], qr)
+    return QuantPool(
+        q=pool.q.at[idx].set(qw, mode="drop"),
+        scale=pool.scale.at[idx].set(new_s, mode="drop"),
+    )
+
+
+def _window_pages_rmw(pool: QuantPool, vals, j_idx, off_idx, wmask_src,
+                      idx, touched):
+    """Shared gather -> rescale -> overwrite -> scatter core for the
+    window writers. vals: [..., C, KV, hd] f32; j_idx/off_idx: window
+    page / in-page offset per position; wmask_src: per-position write
+    validity; idx: [..., W] gather/scatter page ids (OOB = dropped);
+    touched: [..., W] pages that receive >= 1 position."""
+    W = idx.shape[-1]
+    P = pool.q.shape[1]
+    KV, hd = vals.shape[-2], vals.shape[-1]
+    lead = vals.shape[:-3]
+    qs = jnp.take(pool.q, idx, axis=0, mode="fill",
+                  fill_value=0)                    # [..., W, P, KV, hd]
+    ss = jnp.take(pool.scale, idx, axis=0, mode="fill",
+                  fill_value=0.0)                  # [..., W, KV]
+    # place the window's values + mask into page coordinates: every
+    # (page, offset) target is distinct within a row, so one scatter
+    buf = jnp.zeros(lead + (W + 1, P, KV, hd), jnp.float32)
+    msk = jnp.zeros(lead + (W + 1, P), bool)
+    jj = jnp.where(wmask_src, j_idx, W)            # invalid -> dropped row
+    if lead:
+        b = jnp.arange(lead[0])[:, None]
+        buf = buf.at[b, jj, off_idx].set(vals.astype(jnp.float32))
+        msk = msk.at[b, jj, off_idx].set(wmask_src)
+    else:
+        buf = buf.at[jj, off_idx].set(vals.astype(jnp.float32))
+        msk = msk.at[jj, off_idx].set(wmask_src)
+    buf, msk = buf[..., :W, :, :, :], msk[..., :W, :]
+    amax = jnp.max(jnp.where(msk[..., None, None], jnp.abs(buf), 0.0),
+                   axis=(-3, -1))                  # [..., W, KV]
+    need = jnp.maximum(amax, _EPS) / _QMAX
+    new_s = jnp.where(touched[..., None], jnp.maximum(ss, need), ss)
+    qr = _requant(qs, jnp.where(new_s > 0, ss / jnp.maximum(new_s, _EPS),
+                                0.0))
+    qt = jnp.clip(jnp.round(buf / jnp.maximum(new_s, _EPS)[..., None, :,
+                                              None]),
+                  -_QMAX, _QMAX).astype(jnp.int8)
+    qw = jnp.where(msk[..., None, None], qt, qr)
+    return QuantPool(
+        q=pool.q.at[idx].set(qw, mode="drop"),
+        scale=pool.scale.at[idx].set(new_s, mode="drop"),
+    )
+
+
+def qwrite_window_pages(pool: QuantPool, vals: jnp.ndarray,
+                        table_row, pos0, n_real=None) -> QuantPool:
+    """write_window_pages over a quantized pool: one C-token window at
+    absolute position pos0 (any in-page offset). The window touches at
+    most ceil(C/P)+1 consecutive pages — those are gathered, rescaled,
+    overwritten at the window's positions, and scattered back.
+
+    n_real (traced scalar) marks the real tokens in the window: the
+    chunk path pads the last window to bucket width C with token-id-0
+    garbage whose amax would otherwise enter the MONOTONE page scale
+    and permanently coarsen the page's real tokens (the batched mixed
+    writer already masks by q_len). Padding positions neither write
+    nor contribute to the amax, and pages touched only by padding are
+    left alone entirely."""
+    N, P = pool.q.shape[0], pool.q.shape[1]
+    C = vals.shape[1]
+    max_pages = table_row.shape[0]
+    if n_real is None:
+        n_real = C
+    n_real = jnp.asarray(n_real, jnp.int32)
+    W = -(-C // P) + 1
+    pos = pos0 + jnp.arange(C)
+    pidx = pos // P
+    first = pos0 // P
+    win_pidx = first + jnp.arange(W)                      # [W]
+    pages = table_row[jnp.minimum(win_pidx, max_pages - 1)]
+    last = pos0 + jnp.maximum(n_real, 1) - 1
+    touched = ((n_real > 0) & (win_pidx <= last // P)
+               & (win_pidx < max_pages) & (pages >= 0))
+    idx = jnp.where(touched, pages, N)
+    # per-position validity mirrors write_window_pages' drop rule
+    p_pages = table_row[jnp.minimum(pidx, max_pages - 1)]
+    wvalid = ((jnp.arange(C) < n_real)
+              & (pidx < max_pages) & (p_pages >= 0))
+    return _window_pages_rmw(pool, vals[0], pidx - first, pos % P,
+                             wvalid, idx, touched)
+
+
+def qwrite_windows_pages(pool: QuantPool, vals: jnp.ndarray, pos,
+                         q_len, active, table) -> QuantPool:
+    """write_windows_pages over a quantized pool: the batched mixed
+    writer — every row's q_len-token window at its own offset, decode
+    rows (q_len=1) included. Per row the window spans at most
+    ceil(C/P)+1 consecutive pages; rows own disjoint (non-shared)
+    pages, so the batched page round-trips never collide."""
+    N, P = pool.q.shape[0], pool.q.shape[1]
+    B, C = vals.shape[0], vals.shape[1]
+    max_pages = table.shape[1]
+    W = -(-C // P) + 1
+    positions = pos[:, None] + jnp.arange(C)[None, :]     # [B, C]
+    pidx = positions // P
+    first = pos // P                                      # [B]
+    win_pidx = first[:, None] + jnp.arange(W)[None, :]    # [B, W]
+    pages = jnp.take_along_axis(
+        table, jnp.minimum(win_pidx, max_pages - 1), axis=1)
+    last_q = jnp.maximum(q_len, 1) - 1
+    touched = (active[:, None] & (q_len[:, None] > 0)
+               & (win_pidx <= (pos + last_q)[:, None] // P)
+               & (win_pidx < max_pages) & (pages >= 0))
+    idx = jnp.where(touched, pages, N)
+    p_pages = jnp.take_along_axis(
+        table, jnp.minimum(pidx, max_pages - 1), axis=1)
+    wvalid = ((jnp.arange(C)[None, :] < q_len[:, None])
+              & active[:, None] & (pidx < max_pages) & (p_pages >= 0))
+    return _window_pages_rmw(pool, vals, pidx - first[:, None],
+                             positions % P, wvalid, idx, touched)

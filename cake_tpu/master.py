@@ -68,10 +68,19 @@ class Master:
             # draft/verify rounds run BATCHED across slots (spec_round_batched), so
             # concurrent API requests all speculate, stream, and
             # checkpoint like any other engine request
+            if getattr(self.args, "kv_dtype", None) == "int8":
+                # loud config error, not a warning: an operator asking
+                # for int8 KV expects the capacity win, and the spec
+                # engine (gated off the paged pool) cannot deliver it
+                from cake_tpu.args import INT8_KV_SPEC_ERROR
+                raise ValueError(INT8_KV_SPEC_ERROR)
             if getattr(self.args, "kv_pages", None):
                 log.warning("--kv-pages ignored with --draft-model: the "
                             "spec engine's target+draft caches are not "
                             "paged")
+            if getattr(self.args, "kv_host_pages", None):
+                log.warning("--kv-host-pages ignored with --draft-model:"
+                            " the host KV tier spills paged pool pages")
             if getattr(self.args, "auto_prefix", False):
                 log.warning("--auto-prefix ignored with --draft-model: "
                             "prefix caching is not implemented for the "
@@ -125,6 +134,12 @@ class Master:
                             "ctx/tail cache is not paged (the ctx "
                             "region is sequence-sharded, not "
                             "slot-paged)")
+            if (getattr(self.args, "kv_dtype", None) == "int8"
+                    or getattr(self.args, "kv_host_pages", None)):
+                log.warning("--kv-dtype int8 / --kv-host-pages ignored:"
+                            " KV tiering (cake_tpu/kv) applies to the "
+                            "paged pool, and the sp engine's ctx/tail "
+                            "cache is not paged")
             if getattr(self.args, "auto_prefix", False):
                 log.warning("--auto-prefix ignored: prefix caching is "
                             "not implemented for the sp engine's "
@@ -199,6 +214,12 @@ class Master:
             kv_pages=getattr(self.args, "kv_pages", None),
             kv_page_size=getattr(self.args, "kv_page_size", 128),
             paged_attn=getattr(self.args, "paged_attn", "auto"),
+            # KV tiering (cake_tpu/kv): "int8" selects the quantized
+            # page pool; --kv-host-pages arms the host-RAM spill tier
+            # (both are paged-pool features — the engine warns/errors
+            # when --kv-pages is absent)
+            kv_dtype=getattr(self.args, "kv_dtype", None),
+            kv_host_pages=getattr(self.args, "kv_host_pages", None),
             # token-level continuous batching: the paged engine's mixed
             # ragged step (auto = on for --kv-pages serving; "on"
             # without --kv-pages is rejected by the engine with a

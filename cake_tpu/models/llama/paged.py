@@ -35,6 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cake_tpu.kv.quantized_pool import (
+    QuantPool, QuantizedPagedKVCache, dequantize_pages,
+    qupdate_pool_per_row, qwrite_prompt_pages, qwrite_window_pages,
+    qwrite_windows_pages,
+)
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.parallel.context_parallel import (
     merge_attention_stats, partial_attention_stats,
@@ -85,7 +90,12 @@ class PagedKVCache(NamedTuple):
         )
 
     def memory_bytes(self) -> int:
-        return self.k.nbytes + self.v.nbytes
+        """ACTUAL pool storage bytes, summed per leaf — matches the
+        quantized cache's accounting (which adds f32 scale sidecars to
+        the int8 pools) instead of assuming one dtype for the pool."""
+        return sum(leaf.nbytes
+                   for leaf in jax.tree_util.tree_leaves((self.k,
+                                                          self.v)))
 
 
 class PageAllocator:
@@ -190,7 +200,7 @@ def table_set_slot(table: jnp.ndarray, slot: int,
 # -- device ops ---------------------------------------------------------------
 
 
-def write_prompt_pages(pool_k, pool_v, k, v, table_row):
+def write_prompt_pages(pool_k, pool_v, k, v, table_row, n_real=None):
     """Scatter a prompt window's KV ([1, S, KV, hd]) into the pool pages
     of one slot (per layer — callers run this inside the block scan).
 
@@ -202,7 +212,17 @@ def write_prompt_pages(pool_k, pool_v, k, v, table_row):
     are overwritten by decode before they can be attended, exactly like
     dense padding. UNMAPPED pages (id -1) must not be written — page 0
     would alias another slot — so those windows write their page's
-    current contents back (masked write)."""
+    current contents back (masked write).
+
+    A QuantPool (int8 KV tiering, cake_tpu/kv) quantizes on scatter:
+    page-aligned windows fully overwrite their pages, so each window
+    sets its page's per-head scale fresh. n_real (traced scalar, the
+    real token count) matters ONLY there: bucket-padding garbage is
+    dead data in an f32 pool but would inflate the fresh page scales,
+    so the quantized writer zeroes positions >= n_real first."""
+    if isinstance(pool_k, QuantPool):
+        return (qwrite_prompt_pages(pool_k, k, table_row, n_real),
+                qwrite_prompt_pages(pool_v, v, table_row, n_real))
     N, P = pool_k.shape[0], pool_k.shape[1]
     S = k.shape[1]
     KV, hd = k.shape[2], k.shape[3]
@@ -222,7 +242,8 @@ def write_prompt_pages(pool_k, pool_v, k, v, table_row):
     return pk, pv
 
 
-def write_window_pages(pool_k, pool_v, k, v, table_row, pos0):
+def write_window_pages(pool_k, pool_v, k, v, table_row, pos0,
+                       n_real=None):
     """Scatter one prefill window's KV ([1, C, KV, hd]) at absolute
     position `pos0` into one slot's pages (per layer).
 
@@ -233,7 +254,15 @@ def write_window_pages(pool_k, pool_v, k, v, table_row, pos0):
     vectorized scatter covers the window; positions past the slot's
     mapped pages (bucket padding beyond the allocation, or past the
     table entirely) route to the out-of-bounds index and mode="drop"
-    skips them — the paged analog of dense padding semantics."""
+    skips them — the paged analog of dense padding semantics.
+
+    A QuantPool quantizes on scatter via a touched-page read-modify-
+    write (kv/quantized_pool.qwrite_window_pages); n_real (traced
+    scalar) keeps the window's bucket-padding garbage out of the
+    monotone page scales there (dead data for an f32 pool)."""
+    if isinstance(pool_k, QuantPool):
+        return (qwrite_window_pages(pool_k, k, table_row, pos0, n_real),
+                qwrite_window_pages(pool_v, v, table_row, pos0, n_real))
     N, P = pool_k.shape[0], pool_k.shape[1]
     C = k.shape[1]
     max_pages = table_row.shape[0]
@@ -259,7 +288,15 @@ def write_windows_pages(pool_k, pool_v, k, v, pos, q_len, active, table):
     padding columns (i >= q_len), inactive rows, and positions landing
     on unmapped pages all route to the out-of-bounds index N where
     mode="drop" skips them. Distinct rows own distinct pages and a
-    row's positions are distinct, so the targets never collide."""
+    row's positions are distinct, so the targets never collide.
+
+    A QuantPool quantizes on scatter via per-row touched-page
+    read-modify-writes (kv/quantized_pool.qwrite_windows_pages)."""
+    if isinstance(pool_k, QuantPool):
+        return (qwrite_windows_pages(pool_k, k, pos, q_len, active,
+                                     table),
+                qwrite_windows_pages(pool_v, v, pos, q_len, active,
+                                     table))
     N, P = pool_k.shape[0], pool_k.shape[1]
     B, C = k.shape[0], k.shape[1]
     max_pages = table.shape[1]
@@ -284,7 +321,14 @@ def update_pool_per_row(pool_k, pool_v, k, v, pos, active, table):
     scatter (distinct slots own distinct pages, so the B targets are
     disjoint); inactive rows — and rows whose position lands on an
     unmapped page — route to the out-of-bounds index and mode="drop"
-    skips them."""
+    skips them.
+
+    A QuantPool quantizes on scatter: each row's page is gathered,
+    its scale grown to cover the new token, residents re-quantized,
+    and the page scattered back (kv/quantized_pool)."""
+    if isinstance(pool_k, QuantPool):
+        return (qupdate_pool_per_row(pool_k, k, pos, active, table),
+                qupdate_pool_per_row(pool_v, v, pos, active, table))
     N, P = pool_k.shape[0], pool_k.shape[1]
     B = k.shape[0]
     rows = jnp.arange(B)
@@ -317,15 +361,21 @@ def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
     Returns [B, 1, H, hd].
     """
     B, _, H, hd = q.shape
-    P = pool_k.shape[1]
+    quant = isinstance(pool_k, QuantPool)
+    pk_arr = pool_k.q if quant else pool_k
+    N, P, KV = pk_arr.shape[0], pk_arr.shape[1], pk_arr.shape[2]
     max_pages = table.shape[1]
-    KV = pool_k.shape[2]
 
     if impl == "pallas":
         from cake_tpu.ops.ragged_paged_attention import (
             ragged_paged_attention, ragged_paged_supported,
         )
-        if ragged_paged_supported(P, H, KV, hd):
+        if ragged_paged_supported(P, H, KV, hd, quantized=quant,
+                                  n_pages=N):
+            if quant:
+                return ragged_paged_attention(
+                    q, pool_k.q, pool_v.q, table, pos,
+                    scale_k=pool_k.scale, scale_v=pool_v.scale)
             return ragged_paged_attention(q, pool_k, pool_v, table, pos)
     elif impl != "fold":
         raise ValueError(f"unknown paged_attn impl {impl!r}")
@@ -344,10 +394,20 @@ def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
         # the guarantee that dead pages cost NO bandwidth lives in the
         # pallas kernel's index-map clamp, not here; the fold's masking
         # (below) keeps the fill value out of the output either way.
-        idx = jnp.where(pages >= 0, pages, pool_k.shape[0])
-        kj = jnp.take(pool_k, idx, axis=0, mode="fill",
-                      fill_value=0)                  # [B,P,KV,hd]
-        vj = jnp.take(pool_v, idx, axis=0, mode="fill", fill_value=0)
+        idx = jnp.where(pages >= 0, pages, N)
+        if quant:
+            # dequantize in the loop: int8 page * its per-head scale,
+            # in f32 — the bit-exact reference the int8 pallas kernel
+            # is pinned against
+            kj = dequantize_pages(pool_k, idx,
+                                  fill_zero=True).astype(q.dtype)
+            vj = dequantize_pages(pool_v, idx,
+                                  fill_zero=True).astype(q.dtype)
+        else:
+            kj = jnp.take(pool_k, idx, axis=0, mode="fill",
+                          fill_value=0)              # [B,P,KV,hd]
+            vj = jnp.take(pool_v, idx, axis=0, mode="fill",
+                          fill_value=0)
         # validity: absolute slots j*P + t attend when <= pos (causal,
         # current token included) AND the page is mapped
         slots_abs = j * P + jnp.arange(P)            # [P]
@@ -387,15 +447,21 @@ def paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     padding whose output the caller never reads. Returns [B, C, H, hd].
     """
     B, C, H, hd = q.shape
-    P = pool_k.shape[1]
+    quant = isinstance(pool_k, QuantPool)
+    pk_arr = pool_k.q if quant else pool_k
+    N, P, KV = pk_arr.shape[0], pk_arr.shape[1], pk_arr.shape[2]
     max_pages = table.shape[1]
-    KV = pool_k.shape[2]
 
     if impl == "pallas":
         from cake_tpu.ops.ragged_paged_attention import (
             ragged_paged_attention_mixed, ragged_paged_mixed_supported,
         )
-        if ragged_paged_mixed_supported(P, H, KV, hd, C):
+        if ragged_paged_mixed_supported(P, H, KV, hd, C,
+                                        quantized=quant, n_pages=N):
+            if quant:
+                return ragged_paged_attention_mixed(
+                    q, pool_k.q, pool_v.q, table, pos, q_len,
+                    scale_k=pool_k.scale, scale_v=pool_v.scale)
             return ragged_paged_attention_mixed(q, pool_k, pool_v,
                                                 table, pos, q_len)
     elif impl != "fold":
@@ -410,10 +476,17 @@ def paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     def fold(j, carry):
         m, l, o = carry
         pages = table[:, j]                          # [B]
-        idx = jnp.where(pages >= 0, pages, pool_k.shape[0])
-        kj = jnp.take(pool_k, idx, axis=0, mode="fill",
-                      fill_value=0)                  # [B,P,KV,hd]
-        vj = jnp.take(pool_v, idx, axis=0, mode="fill", fill_value=0)
+        idx = jnp.where(pages >= 0, pages, N)
+        if quant:
+            kj = dequantize_pages(pool_k, idx,
+                                  fill_zero=True).astype(q.dtype)
+            vj = dequantize_pages(pool_v, idx,
+                                  fill_zero=True).astype(q.dtype)
+        else:
+            kj = jnp.take(pool_k, idx, axis=0, mode="fill",
+                          fill_value=0)              # [B,P,KV,hd]
+            vj = jnp.take(pool_v, idx, axis=0, mode="fill",
+                          fill_value=0)
         # per-query causality: absolute slot j*P + t attends for query
         # i iff <= pos + i (current token included) AND the page is
         # mapped — the decode fold's mask with a query axis
@@ -462,7 +535,7 @@ def run_blocks_ragged_paged(blocks, x, cache: PagedKVCache, pos, active,
         return h, (pk2, pv2)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
-    return x, PagedKVCache(k_new, v_new, cache.table)
+    return x, cache._replace(k=k_new, v=v_new)
 
 
 def forward_ragged_paged(params, tokens, cache: PagedKVCache, pos,
@@ -543,7 +616,8 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            pk2, pv2 = write_prompt_pages(pk, pv, k, v, table_row)
+            pk2, pv2 = write_prompt_pages(pk, pv, k, v, table_row,
+                                          prompt_len[0])
             if use_flash:
                 return flash_attention(q, k, v, causal=True), (pk2, pv2)
             return gqa_attention(q, k, v, mask=mask), (pk2, pv2)
@@ -558,7 +632,7 @@ def prefill_slot_paged(params, tokens, prompt_len, slot,
         x, (prompt_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
     )[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
-    return logits, PagedKVCache(k_new, v_new, cache.table)
+    return logits, cache._replace(k=k_new, v=v_new)
 
 
 # -- prefix sharing + chunked prefill (page-granular) --------------------------
@@ -615,7 +689,7 @@ def prefill_prefix_pages(params, tokens, table_row,
     _, (k_new, v_new) = lax.scan(body, x,
                                  (params["blocks"], cache.k, cache.v))
     # final norm / lm_head skipped on purpose: only the KV matters here
-    return PagedKVCache(k_new, v_new, cache.table)
+    return cache._replace(k=k_new, v=v_new)
 
 
 @_partial(jax.jit, static_argnames=("config", "n_prefix", "attn"),
@@ -672,14 +746,22 @@ def prefill_slot_paged_prefixed(params, tokens, suffix_len, slot,
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            pk2, pv2 = write_prompt_pages(pk, pv, k, v, suffix_row)
+            pk2, pv2 = write_prompt_pages(pk, pv, k, v, suffix_row,
+                                          suffix_len[0])
             # gather the shared prefix pages (position-ordered by the
             # row) into a dense [1, n_prefix, KV, hd] view — read-only,
-            # pre-write pool (prefix and suffix pages are disjoint)
-            kp = jnp.take(pk, prefix_pages, axis=0).reshape(
-                1, n_prefix, KV, hd).astype(q.dtype)
-            vp = jnp.take(pv, prefix_pages, axis=0).reshape(
-                1, n_prefix, KV, hd).astype(q.dtype)
+            # pre-write pool (prefix and suffix pages are disjoint);
+            # a quantized pool dequantizes page-by-page on the gather
+            if isinstance(pk, QuantPool):
+                kp = dequantize_pages(pk, prefix_pages).reshape(
+                    1, n_prefix, KV, hd).astype(q.dtype)
+                vp = dequantize_pages(pv, prefix_pages).reshape(
+                    1, n_prefix, KV, hd).astype(q.dtype)
+            else:
+                kp = jnp.take(pk, prefix_pages, axis=0).reshape(
+                    1, n_prefix, KV, hd).astype(q.dtype)
+                vp = jnp.take(pv, prefix_pages, axis=0).reshape(
+                    1, n_prefix, KV, hd).astype(q.dtype)
             k_full = jnp.concatenate([kp, k.astype(q.dtype)], axis=1)
             v_full = jnp.concatenate([vp, v.astype(q.dtype)], axis=1)
             if use_flash:
@@ -698,7 +780,7 @@ def prefill_slot_paged_prefixed(params, tokens, suffix_len, slot,
         x, (suffix_len - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
     )[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
-    return logits, PagedKVCache(k_new, v_new, cache.table)
+    return logits, cache._replace(k=k_new, v=v_new)
 
 
 @_partial(jax.jit, static_argnames=("config", "attn"),
@@ -752,15 +834,25 @@ def prefill_slot_paged_chunk(params, tokens, n_real, slot, pos0,
         def attn_fn(q, k, v):
             q = apply_rope(q, rope_c, rope_s)
             k = apply_rope(k, rope_c, rope_s)
-            pk2, pv2 = write_window_pages(pk, pv, k, v, table_row, pos0)
+            pk2, pv2 = write_window_pages(pk, pv, k, v, table_row, pos0,
+                                          n_real[0])
             # post-write gather: the dense view holds every written
-            # position (prefix head, earlier windows, this window)
-            k_full = jnp.take(pk2, gather_idx, axis=0, mode="fill",
-                              fill_value=0).reshape(
-                1, T, KV, hd).astype(q.dtype)
-            v_full = jnp.take(pv2, gather_idx, axis=0, mode="fill",
-                              fill_value=0).reshape(
-                1, T, KV, hd).astype(q.dtype)
+            # position (prefix head, earlier windows, this window);
+            # a quantized pool dequantizes page-by-page on the gather
+            if isinstance(pk2, QuantPool):
+                k_full = dequantize_pages(
+                    pk2, gather_idx, fill_zero=True).reshape(
+                    1, T, KV, hd).astype(q.dtype)
+                v_full = dequantize_pages(
+                    pv2, gather_idx, fill_zero=True).reshape(
+                    1, T, KV, hd).astype(q.dtype)
+            else:
+                k_full = jnp.take(pk2, gather_idx, axis=0, mode="fill",
+                                  fill_value=0).reshape(
+                    1, T, KV, hd).astype(q.dtype)
+                v_full = jnp.take(pv2, gather_idx, axis=0, mode="fill",
+                                  fill_value=0).reshape(
+                    1, T, KV, hd).astype(q.dtype)
             if use_flash:
                 return (flash_attention_cached(q, k_full, v_full, pos0),
                         (pk2, pv2))
@@ -776,7 +868,7 @@ def prefill_slot_paged_chunk(params, tokens, n_real, slot, pos0,
         x, (n_real - 1).reshape(B, 1, 1).astype(jnp.int32), axis=1
     )[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
-    return logits, PagedKVCache(k_new, v_new, cache.table)
+    return logits, cache._replace(k=k_new, v=v_new)
 
 
 # -- token-level continuous batching: the mixed ragged step -------------------
@@ -809,7 +901,7 @@ def run_blocks_mixed_paged(blocks, x, cache: PagedKVCache, pos, q_len,
         return h, (pk2, pv2)
 
     x, (k_new, v_new) = lax.scan(body, x, (blocks, cache.k, cache.v))
-    return x, PagedKVCache(k_new, v_new, cache.table)
+    return x, cache._replace(k=k_new, v=v_new)
 
 
 @_partial(jax.jit, static_argnames=("config", "attn"),

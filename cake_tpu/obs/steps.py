@@ -182,6 +182,12 @@ def refresh_page_gauges(engine) -> None:
                  "slots' table rows (pool pages saved vs unshared "
                  "admission)").set(
             getattr(engine, "_prefix_pages_shared", 0))
+        # KV tiering (cake_tpu/kv): host_tier owns the cake_kv_* gauges
+        # AND their refresh — one public seam, so a scrape converges
+        # without this module re-implementing the tier's accounting
+        from cake_tpu.kv import host_tier as kv_host_tier
+        kv_host_tier.refresh_gauges(engine.cache,
+                                    getattr(engine, "_host_tier", None))
     except Exception:  # noqa: BLE001 — telemetry must never fail serving
         log.debug("page gauge refresh failed", exc_info=True)
 

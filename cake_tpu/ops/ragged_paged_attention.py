@@ -52,6 +52,7 @@ CPU tests run the same kernel with interpret=True
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -137,8 +138,79 @@ def _rpa_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _rpa_kernel_q8(pos_ref, table_ref, sk_ref, sv_ref, q_ref, k_ref,
+                   v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                   page_size: int, kv_heads: int, group: int,
+                   head_dim: int):
+    """int8 variant of _rpa_kernel: the page blocks stream as int8 (a
+    quarter of the f32 DMA bytes — the whole point of KV tiering) and
+    the per-(page, kv-head) scales ride as scalar-prefetched SMEM
+    operands. Because one scale covers a page's every column for a
+    given kv head, dequantization folds into the dot OUTPUTS: the
+    score block scales by scale_k[page, kv] and the value fold by
+    scale_v[page, kv] — no dequantized page copy ever exists."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    page = table_ref[b, j]
+    live = jnp.logical_and(j * page_size <= pos, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0, 0]                        # [H, hd]
+        P = page_size
+        hd = head_dim
+        pid = jnp.maximum(page, 0)
+        col_valid = (j * P + jax.lax.broadcasted_iota(
+            jnp.int32, (1, P), 1)) <= pos      # [1, P]
+        parts = []
+        for kv in range(kv_heads):
+            kh = k_ref[0, :, kv * hd:(kv + 1) * hd].astype(
+                jnp.float32)                           # [P, hd]
+            qh = q[kv * group:(kv + 1) * group].astype(jnp.float32)
+            s_kv = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            parts.append(s_kv * sk_ref[pid, kv])
+        s = jnp.concatenate(parts, axis=0) * scale     # [H, P]
+        s = jnp.where(col_valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                 # [H, P]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        outs = []
+        for kv in range(kv_heads):
+            vh = v_ref[0, :, kv * hd:(kv + 1) * hd].astype(jnp.float32)
+            ph = p[kv * group:(kv + 1) * group]        # [G, P]
+            o_kv = jax.lax.dot_general(
+                ph, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            outs.append(o_kv * sv_ref[pid, kv])
+        acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(outs, axis=0)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
 def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
                            scale: float | None = None,
+                           scale_k=None, scale_v=None,
                            interpret: bool | None = None):
     """Ragged decode attention over a paged KV pool, one Pallas kernel.
 
@@ -148,6 +220,10 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
     pool_k/pool_v:[N_pages, page, KV, hd]
     table:        [B, max_pages] int32 page ids, -1 = unmapped
     pos:          [B] int32 — position of the CURRENT token per row
+    scale_k/scale_v: optional [N_pages, KV] f32 per-page per-kv-head
+                  dequantization scales — present iff the pool is the
+                  int8 KV tier (cake_tpu/kv); pages then stream as
+                  int8 and scales prefetch into SMEM.
     Returns [B, 1, H, hd] in q.dtype. Numerically matches
     `models/llama/paged.py:paged_attention` (the fold reference) to f32
     tolerance — tests/test_ragged_paged_attn.py pins the parity.
@@ -158,6 +234,7 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
     N, P, KV, _ = pool_k.shape
     G = H // KV
     max_pages = table.shape[1]
+    quantized = scale_k is not None
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
     if interpret is None:
@@ -166,7 +243,7 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
     kf = pool_k.reshape(N, P, KV * hd)
     vf = pool_v.reshape(N, P, KV * hd)
 
-    def kv_index(b, j, pos_ref, table_ref):
+    def kv_index(b, j, pos_ref, table_ref, *_scales):
         # clamp dead pages (past the row's live count) to the LAST live
         # page: the repeated block index elides the DMA, so a short row
         # streams only its own pages. Unmapped holes inside the live
@@ -176,11 +253,24 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
         page = table_ref[b, jj]
         return (jnp.maximum(page, 0), 0, 0)
 
-    kernel = functools.partial(
-        _rpa_kernel, scale=scale, page_size=P, kv_heads=KV, group=G,
-        head_dim=hd)
+    if quantized:
+        kernel = functools.partial(
+            _rpa_kernel_q8, scale=scale, page_size=P, kv_heads=KV,
+            group=G, head_dim=hd)
+        n_prefetch = 4
+        operands = (jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(table, jnp.int32),
+                    jnp.asarray(scale_k, jnp.float32),
+                    jnp.asarray(scale_v, jnp.float32), q, kf, vf)
+    else:
+        kernel = functools.partial(
+            _rpa_kernel, scale=scale, page_size=P, kv_heads=KV, group=G,
+            head_dim=hd)
+        n_prefetch = 2
+        operands = (jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(table, jnp.int32), q, kf, vf)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_prefetch,
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
@@ -205,8 +295,7 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(jnp.asarray(pos, jnp.int32), jnp.asarray(table, jnp.int32),
-      q, kf, vf)
+    )(*operands)
 
 
 def _rpa_mixed_kernel(pos_ref, qlen_ref, table_ref, q_ref, k_ref, v_ref,
@@ -297,8 +386,87 @@ def _rpa_mixed_kernel(pos_ref, qlen_ref, table_ref, q_ref, k_ref, v_ref,
             o_ref[0, :, kv * G:(kv + 1) * G, :] = o.astype(o_ref.dtype)
 
 
+def _rpa_mixed_kernel_q8(pos_ref, qlen_ref, table_ref, sk_ref, sv_ref,
+                         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                         l_ref, *, scale: float, page_size: int,
+                         kv_heads: int, group: int, head_dim: int,
+                         q_width: int):
+    """int8 variant of _rpa_mixed_kernel: pages stream as int8 and the
+    per-(page, kv-head) scales prefetch into SMEM (the decode q8
+    kernel's scheme with the mixed kernel's per-row query width) —
+    dequantization folds into the score and value dot outputs, so the
+    mixed step reads a quarter of the f32 page bytes."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    C = q_width
+    G = group
+    P = page_size
+    hd = head_dim
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    last = pos + jnp.maximum(qlen_ref[b], 1) - 1
+    page = table_ref[b, j]
+    live = jnp.logical_and(j * P <= last, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0]                           # [C, H, hd]
+        pid = jnp.maximum(page, 0)
+        qidx = jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 0) // G
+        col = j * P + jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 1)
+        valid = col <= pos + qidx
+        for kv in range(kv_heads):
+            kh = k_ref[0, :, kv * hd:(kv + 1) * hd].astype(
+                jnp.float32)                                 # [P, hd]
+            vh = v_ref[0, :, kv * hd:(kv + 1) * hd].astype(
+                jnp.float32)                                 # [P, hd]
+            qh = q[:, kv * G:(kv + 1) * G, :].reshape(
+                C * G, hd).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (
+                    scale * sk_ref[pid, kv])                 # [C*G, P]
+            s = jnp.where(valid, s, NEG_INF)
+            r0 = kv * C * G
+            m_prev = m_ref[r0:r0 + C * G, :1]                # [C*G, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            # all-masked query rows keep l at 0 so _finish emits
+            # zeros — the mixed f32 kernel's guard, unchanged
+            p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+            l_new = (alpha * l_ref[r0:r0 + C * G, :1]
+                     + jnp.sum(p, axis=-1, keepdims=True))
+            out = jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * sv_ref[pid, kv]
+            acc_ref[r0:r0 + C * G] = acc_ref[r0:r0 + C * G] * alpha + out
+            m_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                m_new, (C * G, m_ref.shape[1]))
+            l_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                l_new, (C * G, l_ref.shape[1]))
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        for kv in range(kv_heads):
+            r0 = kv * C * G
+            l = l_ref[r0:r0 + C * G, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o = (acc_ref[r0:r0 + C * G] / l).reshape(C, G, hd)
+            o_ref[0, :, kv * G:(kv + 1) * G, :] = o.astype(o_ref.dtype)
+
+
 def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
                                  scale: float | None = None,
+                                 scale_k=None, scale_v=None,
                                  interpret: bool | None = None):
     """MIXED ragged attention over a paged KV pool, one Pallas kernel.
 
@@ -328,6 +496,7 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     N, P, KV, _ = pool_k.shape
     G = H // KV
     max_pages = table.shape[1]
+    quantized = scale_k is not None
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
     if interpret is None:
@@ -336,7 +505,7 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     kf = pool_k.reshape(N, P, KV * hd)
     vf = pool_v.reshape(N, P, KV * hd)
 
-    def kv_index(b, j, pos_ref, qlen_ref, table_ref):
+    def kv_index(b, j, pos_ref, qlen_ref, table_ref, *_scales):
         # clamp dead pages (past the row's live count) to the LAST live
         # page — the repeated block index elides the DMA, so a row
         # streams only the pages its window actually covers
@@ -345,11 +514,26 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
         page = table_ref[b, jj]
         return (jnp.maximum(page, 0), 0, 0)
 
-    kernel = functools.partial(
-        _rpa_mixed_kernel, scale=scale, page_size=P, kv_heads=KV,
-        group=G, head_dim=hd, q_width=C)
+    if quantized:
+        kernel = functools.partial(
+            _rpa_mixed_kernel_q8, scale=scale, page_size=P, kv_heads=KV,
+            group=G, head_dim=hd, q_width=C)
+        n_prefetch = 5
+        operands = (jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(q_len, jnp.int32),
+                    jnp.asarray(table, jnp.int32),
+                    jnp.asarray(scale_k, jnp.float32),
+                    jnp.asarray(scale_v, jnp.float32), q, kf, vf)
+    else:
+        kernel = functools.partial(
+            _rpa_mixed_kernel, scale=scale, page_size=P, kv_heads=KV,
+            group=G, head_dim=hd, q_width=C)
+        n_prefetch = 3
+        operands = (jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(q_len, jnp.int32),
+                    jnp.asarray(table, jnp.int32), q, kf, vf)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=n_prefetch,
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, C, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
@@ -372,23 +556,33 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(jnp.asarray(pos, jnp.int32), jnp.asarray(q_len, jnp.int32),
-      jnp.asarray(table, jnp.int32), q, kf, vf)
+    )(*operands)
 
 
 def ragged_paged_supported(page_size: int, H: int, KV: int,
-                           hd: int) -> bool:
+                           hd: int, quantized: bool = False,
+                           n_pages: Optional[int] = None) -> bool:
     """Static shape gate for the hardware path (flash_supported
     precedent): Mosaic wants the block's minor dim to fill 128-wide
-    lanes and the second-minor (page) dim to tile by 16. Production
+    lanes and the second-minor (page) dim to tile by 16 — or by 32 for
+    an int8 pool (the int8 sublane tile is twice as deep). Production
     configs (hd=128, 128-token pages) pass; tiny test configs fall back
     to the fold on silicon and keep exercising the kernel in interpret
-    mode on CPU."""
+    mode on CPU. An int8 pool additionally bounds its whole-pool
+    scale_k/scale_v scalar-prefetch operands against SMEM (pass
+    n_pages to enforce) — an oversized pool must degrade to the fold
+    instead of failing Mosaic allocation at the first dispatch."""
     if H % KV != 0:
         return False
     if jax.default_backend() != "tpu":
         return True      # interpret mode imposes no tiling constraints
-    return hd % 128 == 0 and page_size % 16 == 0
+    page_tile = 32 if quantized else 16
+    if not (hd % 128 == 0 and page_size % page_tile == 0):
+        return False
+    if quantized and n_pages is not None:
+        # two [n_pages, KV] f32 arrays ride SMEM alongside pos+table
+        return 2 * 4 * n_pages * KV <= _SCALE_SMEM_BUDGET
+    return True
 
 
 def mixed_scratch_bytes(H: int, hd: int, q_width: int) -> int:
@@ -403,16 +597,27 @@ def mixed_scratch_bytes(H: int, hd: int, q_width: int) -> int:
 # the q/kv/out blocks and Mosaic's own double-buffering.
 _MIXED_VMEM_BUDGET = 8 * 1024 * 1024
 
+# budget for the int8 kernels' whole-pool scale arrays in SMEM: scalar
+# memory is small (order 1 MB/core); a conservative quarter of it is
+# left to the scales so pos + page table always fit beside them.
+# Production-scale pools pass (4096 pages x 8 kv heads = 256 KB for
+# both arrays); a pathologically page-count-heavy config falls back
+# to the fold.
+_SCALE_SMEM_BUDGET = 256 * 1024
+
 
 def ragged_paged_mixed_supported(page_size: int, H: int, KV: int,
-                                 hd: int, q_width: int) -> bool:
+                                 hd: int, q_width: int,
+                                 quantized: bool = False,
+                                 n_pages: Optional[int] = None) -> bool:
     """Gate for the MIXED hardware kernel: the decode gate's tiling
     rules PLUS a VMEM bound. Unlike the C=1 decode kernel, the mixed
     kernel's scratch scales linearly with the query width C
     (mixed_scratch_bytes) — a large --prefill-chunk must degrade to the
     fold reference instead of failing Mosaic allocation at the first
     mixed dispatch."""
-    if not ragged_paged_supported(page_size, H, KV, hd):
+    if not ragged_paged_supported(page_size, H, KV, hd,
+                                  quantized=quantized, n_pages=n_pages):
         return False
     if jax.default_backend() != "tpu":
         return True      # interpret mode allocates host memory

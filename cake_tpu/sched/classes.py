@@ -74,6 +74,12 @@ class SchedConfig:
     policies: Tuple[ClassPolicy, ...] = DEFAULT_POLICIES
     preempt_budget: int = 2
     shed_window_s: float = 30.0
+    # prefer SPILLING a preemption victim's KV pages to the host tier
+    # (when --kv-host-pages capacity is free) over the recompute fold:
+    # resume then restores pages instead of re-prefilling prompt +
+    # generated tokens (cake_tpu/kv/host_tier.py). False forces the
+    # PR-5 recompute-resume path even with a host tier configured.
+    spill_preempt: bool = True
 
     def policy(self, name: str) -> ClassPolicy:
         for p in self.policies:

@@ -148,6 +148,10 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
     slot: int = -1
+    # spilled-victim resume (cake_tpu/kv host tier): set by
+    # _alloc_slot_pages when the slot's KV was restored from host RAM
+    # — the admission path then skips the recompute prefill entirely
+    _kv_restored: bool = False
     submit_t: float = 0.0
     first_token_t: float = 0.0
     finish_t: float = 0.0
@@ -222,6 +226,10 @@ class EngineStats:
     # rejected by load shedding (cake_tpu/sched)
     preemptions: int = 0
     shed: int = 0
+    # KV host tier (cake_tpu/kv): spill/restore EVENTS (the
+    # cake_kv_spill_total counters count pages)
+    kv_spills: int = 0
+    kv_restores: int = 0
     # speculative engine mode: drafts offered / kept across all slots
     spec_proposed: int = 0
     spec_accepted: int = 0
@@ -266,6 +274,8 @@ class InferenceEngine:
         kv_pages: Optional[int] = None,
         kv_page_size: int = 128,
         paged_attn: Optional[str] = None,
+        kv_dtype: Optional[str] = None,
+        kv_host_pages: Optional[int] = None,
         mixed_batch: Optional[str] = None,
         prompt_limit: Optional[int] = None,
         decode_budget: Optional[int] = None,
@@ -425,6 +435,20 @@ class InferenceEngine:
         # (models/llama/paged.py).
         self.paged = kv_pages is not None
         self.paged_attn: Optional[str] = None
+        # --kv-dtype: storage dtype for the PAGED pool. "int8" selects
+        # the quantized page pool (cake_tpu/kv: int8 pages + per-page
+        # per-kv-head f32 scales — ~4x the resident streams per pool
+        # byte vs f32); other names resolve to a plain pool dtype.
+        # int8 without --kv-pages (the spec engine included: spec is
+        # gated off paged) is a loud config error, not a silent no-op.
+        self.kv_quant = kv_dtype == "int8"
+        if self.kv_quant and not self.paged:
+            raise ValueError(
+                "--kv-dtype int8 requires --kv-pages: int8 KV pages "
+                "live in the paged pool"
+                + (" (speculative serving is gated off the paged "
+                   "engine, so it cannot quantize KV)" if self._spec
+                   else ""))
         if self.paged:
             if kv_pages < 1 or kv_page_size < 1:
                 raise ValueError(
@@ -490,16 +514,52 @@ class InferenceEngine:
             # _slot_pages for the refcounted release)
             self._slot_prefix_pages: dict = {}
             self._prefix_pages_shared = 0
-            self.cache = PagedKVCache.create(
-                config, max_slots, kv_pages, kv_page_size, max_seq_len,
-                dtype=cache_dtype)
-            log.info("paged KV: %d pages x %d tokens, %s attention "
-                     "(%.2f GiB pool; dense %d-slot equivalent would "
-                     "be %.2f GiB)",
+            pool_dtype = cache_dtype
+            if kv_dtype is not None and not self.kv_quant:
+                from cake_tpu.utils.devices import resolve_kv_dtype
+                pool_dtype = resolve_kv_dtype(kv_dtype)
+            if self.kv_quant:
+                from cake_tpu.kv import QuantizedPagedKVCache
+                self.cache = QuantizedPagedKVCache.create(
+                    config, max_slots, kv_pages, kv_page_size,
+                    max_seq_len)
+            else:
+                self.cache = PagedKVCache.create(
+                    config, max_slots, kv_pages, kv_page_size,
+                    max_seq_len, dtype=pool_dtype)
+            self._pool_dtype = pool_dtype
+            log.info("paged KV: %d pages x %d tokens, %s attention, "
+                     "%s storage (%.2f GiB pool; dense %d-slot "
+                     "equivalent would be %.2f GiB)",
                      kv_pages, kv_page_size, impl,
+                     "int8+scales" if self.kv_quant else str(pool_dtype),
                      self.cache.memory_bytes() / 2**30, max_slots,
                      self.cache.memory_bytes() / 2**30
                      * max_slots * max_seq_len / (kv_pages * kv_page_size))
+        # --kv-host-pages: host-RAM spill tier behind the page
+        # allocator (cake_tpu/kv/host_tier.py) — preemption victims'
+        # suffix pages and cold shared-prefix pages spill to pinned
+        # host memory and stream back on demand, instead of being
+        # discarded and recomputed.
+        self._host_tier = None
+        # pid -> monotonic last-hit time (the cold-prefix LRU order)
+        self._prefix_last_hit: dict = {}
+        if kv_host_pages is not None:
+            if not self.paged:
+                log.warning("--kv-host-pages ignored: the host KV tier "
+                            "spills paged pool pages (set --kv-pages)")
+            else:
+                from cake_tpu.kv import HostTier
+                from cake_tpu.kv.quantized_pool import page_bytes
+                self._host_tier = HostTier(
+                    kv_host_pages,
+                    page_bytes=page_bytes(
+                        config, kv_page_size,
+                        jnp.int8 if self.kv_quant else self._pool_dtype))
+                log.info("kv host tier: %d pages (%.1f MiB capacity)",
+                         kv_host_pages,
+                         kv_host_pages * self._host_tier.page_bytes
+                         / 2**20)
         self.prefill_chunk = prefill_chunk
         # --mixed-batch {auto,on,off}: token-level continuous batching
         # for the paged engine — admissions' prefill chunks join the
@@ -547,7 +607,10 @@ class InferenceEngine:
             self._cache_shardings = jax.tree.map(
                 lambda x: (x.shape, x.dtype, x.sharding), self.cache,
                 is_leaf=lambda x: hasattr(x, "sharding"))
-            self._cache_dtype = self.cache[0].dtype
+            # first LEAF, not first field: a quantized paged cache's
+            # first field is a QuantPool pytree, not an array
+            self._cache_dtype = jax.tree_util.tree_leaves(
+                self.cache)[0].dtype
         # SLO-aware scheduling (cake_tpu/sched): priority-class queues
         # with anti-starvation aging replace FIFO admission; preemption
         # recompute-folds a lower-class slot back into the queue when a
@@ -1044,6 +1107,7 @@ class InferenceEngine:
         with self._rid_lock:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
+        self._prefix_last_hit[pid] = time.monotonic()
         row = np.full(self.cache.max_pages, -1, np.int64)
         row[:n_pp] = pages
         try:
@@ -1174,8 +1238,14 @@ class InferenceEngine:
     def _unregister_paged_sync(self, prefix_id: int) -> None:
         with self._rid_lock:
             entry = self._prefixes.pop(prefix_id, None)
+        self._prefix_last_hit.pop(prefix_id, None)
         if entry is not None:
-            self._pager.release(entry[1])
+            if entry[1] is not None:
+                self._pager.release(entry[1])
+            elif self._host_tier is not None:
+                # spilled registration: the pages live in the host
+                # tier, not the pool — drop the host copy instead
+                self._host_tier.drop(("prefix", prefix_id))
 
     def _match_prefix(self, ids: List[int]):
         """Longest registered prefix that is a proper head of `ids`:
@@ -1344,6 +1414,10 @@ class InferenceEngine:
             if req is None:
                 continue
             self.scheduler.cancel(rid)
+            if self._host_tier is not None:
+                # a victim cancelled while parked leaves its spilled
+                # pages orphaned in the LRU — drop them now
+                self._host_tier.drop(("victim", rid))
             if req.slot >= 0 and self._slot_req[req.slot] is req:
                 self._slot_req[req.slot] = None
                 self._release_slot_pages(req.slot)
@@ -1547,10 +1621,20 @@ class InferenceEngine:
             with self._rid_lock:
                 self._prefixes.clear()
                 self._auto_pids.clear()
+            self._prefix_last_hit = {}
+            if self._host_tier is not None:
+                # spilled victims/prefixes belonged to the failed
+                # requests / cleared registry — stale shortcuts only
+                self._host_tier.clear()
+            if self.kv_quant:
+                from cake_tpu.kv import QuantizedPagedKVCache
+                return QuantizedPagedKVCache.create(
+                    self.config, self.max_slots, self.cache.n_pages,
+                    self.cache.page_size, self.max_seq_len)
             return PagedKVCache.create(
                 self.config, self.max_slots, self.cache.n_pages,
                 self.cache.page_size, self.max_seq_len,
-                dtype=self._cache_dtype)
+                dtype=self._pool_dtype)
         fresh = KVCache.create(self.config, self.max_slots,
                                self.cache.max_seq_len
                                if self.ring else self.max_seq_len,
@@ -1655,14 +1739,54 @@ class InferenceEngine:
         self._slot_req[slot] = None
         req.slot = -1
         req.preemptions += 1
+        # spill-over-recompute (cake_tpu/kv host tier): when host pages
+        # are free, the victim's OWNED suffix pages (shared prefix
+        # pages just decref) move to host RAM before release — resume
+        # then restores them and decodes from where it stopped instead
+        # of re-prefilling prompt + generated tokens
+        spilled = self._spill_victim_pages(req, slot)
         self._release_slot_pages(slot)
         self.stats.preemptions += 1
         _PREEMPTIONS.labels(reason=reason).inc()
         self.tracer.span(rid, "preempted", reason=reason,
-                         generated=len(req.out_tokens))
-        log.debug("preempted rid=%d (%s, %d tokens fold into the "
-                  "prompt)", rid, reason, len(req.out_tokens))
+                         generated=len(req.out_tokens),
+                         spilled=spilled)
+        log.debug("preempted rid=%d (%s, %d tokens %s)", rid, reason,
+                  len(req.out_tokens),
+                  "spilled to the host tier" if spilled
+                  else "fold into the prompt")
         return True
+
+    def _spill_victim_pages(self, req: _Request, slot: int) -> bool:
+        """Device->host spill of one preemption victim's owned pages
+        (engine thread; the pages are still live — called BEFORE
+        _release_slot_pages). False = no tier / no room / mid-prefill
+        victim: the recompute fold serves as before."""
+        if (self._host_tier is None
+                or not getattr(self._sched_cfg, "spill_preempt", True)
+                or slot in self._mixed_pending
+                or not req.out_tokens):
+            return False
+        row = self._slot_pages.get(slot) or []
+        n_shared = self._slot_prefix_pages.get(slot, 0)
+        own = row[n_shared:]
+        if not own or not self._host_tier.can_hold(len(own)):
+            return False
+        from cake_tpu.kv.host_tier import SpilledPages
+        try:
+            arrays = self._host_tier.fetch_pages(self.cache, own)
+        except Exception:  # noqa: BLE001 — spill is an optimization
+            log.exception("victim spill failed; falling back to "
+                          "recompute resume")
+            return False
+        ok = self._host_tier.put(("victim", req.rid), SpilledPages(
+            n_pages=len(own), arrays=arrays, kind="victim",
+            pos=int(self._pos[slot]),
+            last_tok=int(self._last_tok[slot]),
+            n_prefix_tokens=n_shared * self._pager.page_size))
+        if ok:
+            self.stats.kv_spills += 1
+        return ok
 
     def _release_slot_pages(self, slot: int) -> None:
         """Refcounted release of a slot's page mappings — idempotent
@@ -1717,13 +1841,56 @@ class InferenceEngine:
                 return self._requeue_for_pages(req, slot, starved=False)
         prefix_pages: List[int] = []
         n_prefix = 0
+        hit_pid = None
         if hit is not None:
+            hit_pid = hit[0]
             p_ids, prefix_pages, _ = hit[1]
             n_prefix = len(p_ids)
+            if prefix_pages is None:
+                # the matched prefix was spilled to the host tier
+                # under page pressure: stream it back before mapping
+                # (engine thread — pool + table are single-writer)
+                prefix_pages = self._restore_prefix(hit_pid)
+                if prefix_pages is None:
+                    # gone from host too, or no pool room for it right
+                    # now: serve this admission without the prefix
+                    hit = None
+                    hit_pid = None
+                    n_prefix = 0
+                    prefix_pages = []
+        # callers must prefill against the hit that was actually
+        # mapped — a restore failure above downgrades it to None, and
+        # dispatching the prefix-path prefill anyway would attend
+        # never-written pages
+        req._effective_hit = hit
         need = len(req.prompt_ids) - n_prefix + req.max_new_tokens
         pages = self._pager.alloc(need)
+        if pages is None and self._host_tier is not None:
+            # consult the host tier before refusing admission: COLD
+            # shared-prefix pages (registry-only references, no slot
+            # mapping them) spill to host RAM, freeing device pages —
+            # the prefix streams back on its next hit instead of being
+            # the reason this request waits
+            missing = (self._pager.pages_for(need)
+                       - self._pager.free_pages)
+            if self._spill_cold_prefixes(missing, keep_pid=hit_pid):
+                pages = self._pager.alloc(need)
         if pages is None:
             return self._requeue_for_pages(req, slot, starved=True)
+        # preempted victim whose pages were spilled (spill-over-
+        # recompute): validated against the CURRENT admission shape —
+        # a prefix evicted/re-registered between spill and resume
+        # changes the row layout, and the stale entry must not restore
+        ent = (self._host_tier.peek(("victim", req.rid))
+               if self._host_tier is not None else None)
+        if ent is not None:
+            if (ent.n_prefix_tokens != n_prefix
+                    or ent.n_pages != len(pages)):
+                self._host_tier.drop(("victim", req.rid))
+                ent = None
+            else:
+                # counted as a restore; _restore_victim installs it
+                ent = self._host_tier.pop(("victim", req.rid))
         if prefix_pages:
             # retain AFTER the suffix alloc: a requeued admission must
             # leave no dangling references behind
@@ -1735,9 +1902,124 @@ class InferenceEngine:
         self._slot_pages[slot] = row
         self.cache = self.cache._replace(
             table=table_set_slot(self.cache.table, slot, row))
+        if self.kv_quant:
+            # fresh pages must not inherit a previous occupant's
+            # scales (kv/quantized_pool.reset_page_scales); a restore
+            # below overwrites them with the spilled scales anyway
+            from cake_tpu.kv.quantized_pool import reset_page_scales
+            self.cache = reset_page_scales(self.cache, pages)
+        if ent is not None:
+            self._restore_victim(req, slot, pages, ent)
         if req.rid == blocked:
             self._page_blocked_rid = None
         return True
+
+    def _restore_victim(self, req: _Request, slot: int,
+                        pages: List[int], ent) -> None:
+        """host->device restore of a spilled preemption victim: the
+        saved page contents scatter into the freshly-mapped suffix
+        pages (bit-identical round trip) and the slot's mirrors resume
+        at the spilled frontier — the next decode step samples exactly
+        the token an uninterrupted run would have. Sets _kv_restored
+        so the admission path skips the recompute prefill. ent: the
+        validated entry _alloc_slot_pages already popped from the
+        host tier."""
+        from cake_tpu.kv.host_tier import HostTier
+        self.cache = HostTier.install_pages(self.cache, pages,
+                                            ent.arrays)
+        self._temp[slot] = req.temperature
+        self._top_p[slot] = req.top_p
+        self._penalty[slot] = req.repeat_penalty
+        self._prime_ring(slot, list(req.prime_tokens)
+                         + list(req.out_tokens))
+        self._pos[slot] = ent.pos
+        self._last_tok[slot] = ent.last_tok
+        self.stats.kv_restores += 1
+        req._kv_restored = True
+        self.tracer.span(req.rid, "kv_restored", pages=ent.n_pages)
+        log.debug("restored rid=%d from the host tier (%d pages, "
+                  "pos %d)", req.rid, ent.n_pages, ent.pos)
+
+    def _spill_cold_prefixes(self, n_pages_needed: int,
+                             keep_pid=None) -> int:
+        """Spill least-recently-hit COLD prefixes (every page at
+        refcount 1 — only the registry holds them) to the host tier
+        until n_pages_needed device pages are freed, skipping keep_pid
+        (the admission's own matched prefix). Engine thread only.
+        Returns the number of pages freed."""
+        if self._host_tier is None or n_pages_needed <= 0:
+            return 0
+        from cake_tpu.kv.host_tier import SpilledPages
+        with self._rid_lock:
+            entries = list(self._prefixes.items())
+        entries.sort(
+            key=lambda kv: self._prefix_last_hit.get(kv[0], 0.0))
+        freed = 0
+        for pid, (p_ids, pages, _extra) in entries:
+            if freed >= n_pages_needed:
+                break
+            if pid == keep_pid or pages is None:
+                continue
+            if any(self._pager.refcount(p) != 1 for p in pages):
+                continue          # hot: some slot maps these pages
+            if not self._host_tier.can_hold(len(pages)):
+                continue
+            try:
+                arrays = self._host_tier.fetch_pages(self.cache, pages)
+            except Exception:  # noqa: BLE001 — spill is optional
+                log.exception("cold prefix spill failed (pid=%d)", pid)
+                continue
+            if not self._host_tier.put(
+                    ("prefix", pid),
+                    SpilledPages(n_pages=len(pages), arrays=arrays,
+                                 kind="prefix")):
+                continue
+            with self._rid_lock:
+                self._prefixes[pid] = (p_ids, None, ("prefix", pid))
+            self._pager.release(pages)
+            self.stats.kv_spills += 1
+            freed += len(pages)
+            log.debug("spilled cold prefix %d (%d pages) to the host "
+                      "tier", pid, len(pages))
+        return freed
+
+    def _restore_prefix(self, pid: int) -> Optional[List[int]]:
+        """host->device restore of a spilled prefix: allocate fresh
+        pool pages, scatter the saved contents back, and re-point the
+        registry entry. None when the host entry was LRU-evicted (the
+        prefix is gone — unregister it so matches stop) or the pool
+        has no room right now (entry kept; the hit degrades to a
+        whole-prompt prefill for this admission)."""
+        if self._host_tier is None:
+            return None
+        from cake_tpu.kv.host_tier import HostTier
+        ent = self._host_tier.peek(("prefix", pid))
+        with self._rid_lock:
+            entry = self._prefixes.get(pid)
+        if entry is None:
+            if ent is not None:
+                self._host_tier.drop(("prefix", pid))
+            return None
+        if ent is None:
+            # evicted from the host tier: the prefix exists nowhere —
+            # drop the registration (auto-prefix re-registers its head
+            # on the next matching request, the stale-pid heal path)
+            with self._rid_lock:
+                self._prefixes.pop(pid, None)
+            return None
+        pages = self._pager.alloc(ent.n_pages * self._pager.page_size)
+        if pages is None:
+            return None
+        ent = self._host_tier.pop(("prefix", pid))
+        self.cache = HostTier.install_pages(self.cache, pages,
+                                            ent.arrays)
+        with self._rid_lock:
+            self._prefixes[pid] = (entry[0], pages, None)
+        self._prefix_last_hit[pid] = time.monotonic()
+        self.stats.kv_restores += 1
+        log.debug("restored prefix %d from the host tier (%d pages)",
+                  pid, ent.n_pages)
+        return pages
 
     def _requeue_for_pages(self, req: _Request, slot: int,
                            starved: bool) -> bool:
@@ -1762,6 +2044,8 @@ class InferenceEngine:
             self._requests.pop(req.rid, None)
             if getattr(self, "_page_blocked_rid", None) == req.rid:
                 self._page_blocked_rid = None
+            if self._host_tier is not None:
+                self._host_tier.drop(("victim", req.rid))
             self.tracer.finish(req.rid, "error", error=str(req.error))
             req.done.set()
         else:
@@ -1811,6 +2095,15 @@ class InferenceEngine:
                if self._prefix_capable else None)
         if self.paged and not self._alloc_slot_pages(req, slot, hit):
             return None   # pool exhausted: requeued (or failed) inside
+        if self.paged:
+            hit = req._effective_hit   # spilled-prefix restore failure
+        if getattr(req, "_kv_restored", False):
+            # spilled preemption victim restored from the host tier:
+            # KV and sampling state already sit at the preemption
+            # frontier — no prefill dispatch at all (the token that
+            # recompute-resume would re-derive was already emitted)
+            req._kv_restored = False
+            return None
         n_top = self._n_top_for([slot])
         if hit is not None:
             hit_pid, entry = hit
@@ -1984,6 +2277,13 @@ class InferenceEngine:
                if self._prefix_capable else None)
         if self.paged and not self._alloc_slot_pages(req, slot, hit):
             return   # pool exhausted: requeued (or failed) inside
+        hit = req._effective_hit       # spilled-prefix restore failure
+        if getattr(req, "_kv_restored", False):
+            # spilled victim restored (see _do_prefill): the slot
+            # resumes mid-decode — it must NOT ride the next mixed
+            # step as a chunk row
+            req._kv_restored = False
+            return
         off = 0
         if hit is not None:
             # shared prefix pages already mapped at the row head
@@ -2113,7 +2413,11 @@ class InferenceEngine:
             return None
         pid, p_ids, k, v = hit
         plan = self._prefix_window_plan(p_ids, ids)
-        return (pid, (p_ids, k, v)) if plan is not None else None
+        if plan is None:
+            return None
+        # LRU recency for the cold-prefix spill policy (host tier)
+        self._prefix_last_hit[pid] = time.monotonic()
+        return (pid, (p_ids, k, v))
 
     def _prefix_window_plan(self, p_ids: List[int], ids: List[int]):
         """(chunk_suffix, C_or_bucket) for a prefix-hit prefill, or None
@@ -2890,6 +3194,8 @@ class InferenceEngine:
             for rid, req in list(self._requests.items()):
                 req.error = err
                 self.scheduler.cancel(rid)
+                if self._host_tier is not None:
+                    self._host_tier.drop(("victim", rid))
                 if req.slot >= 0:
                     self._slot_req[req.slot] = None
                     self._release_slot_pages(req.slot)

@@ -1,0 +1,466 @@
+"""KV-cache tiering (cake_tpu/kv): quantized pages + host-RAM spill.
+
+Contract bars:
+  * quantized writers keep untouched pages BIT-identical and bound the
+    write error by the per-page scale step;
+  * a spill -> restore host round trip is BIT-identical for int8
+    pages + scales (the tier moves raw buffers, never re-quantizes);
+  * a preempted-then-resumed stream restored from the host tier is
+    token-identical to an unpreempted run at f32 KV (the spill analog
+    of PR 5's recompute-resume equality);
+  * int8 KV greedy output is an acceptance/tolerance comparison vs the
+    f32 reference — token equality stays pinned at f32 KV (repo
+    convention since PR 2).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.kv.host_tier import HostTier, SpilledPages
+from cake_tpu.kv.quantized_pool import (
+    QuantPool, QuantizedPagedKVCache, dequantize_pages, page_bytes,
+    qupdate_pool_per_row, qwrite_prompt_pages, qwrite_window_pages,
+    reset_page_scales,
+)
+
+T = 64
+PAGE = 16
+GEN = 24
+BATCH_PROMPT = [5] * 9
+INTER_PROMPT = [2, 9, 4, 7, 3]
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", T)
+    kw.setdefault("kv_pages", 8)
+    kw.setdefault("kv_page_size", PAGE)
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+# -- host tier units ----------------------------------------------------------
+
+
+def _entry(n_pages, seed=0, kind="pages"):
+    rng = np.random.default_rng(seed)
+    return SpilledPages(
+        n_pages=n_pages,
+        arrays=(rng.integers(-127, 127,
+                             size=(2, n_pages, 4), dtype=np.int8),),
+        kind=kind)
+
+
+def test_host_tier_capacity_and_lru():
+    tier = HostTier(4, page_bytes=128)
+    assert tier.can_hold(4) and not tier.can_hold(5)
+    assert tier.put("a", _entry(2))
+    assert tier.put("b", _entry(2))
+    assert tier.free_pages == 0
+    # over-capacity put evicts the LEAST recently used entry
+    tier.peek("a")                       # refresh a's recency
+    assert tier.put("c", _entry(2, seed=1))
+    assert tier.peek("b") is None and tier.peek("a") is not None
+    assert tier.evictions == 1
+    # an entry that can never fit is refused without mutation
+    assert not tier.put("huge", _entry(5))
+    assert tier.used_pages == 4
+    got = tier.pop("a")
+    assert got is not None and tier.used_pages == 2
+    assert tier.restores == 2            # counted in pages
+    tier.clear()
+    assert tier.used_pages == 0 and tier.peek("c") is None
+
+
+def test_host_tier_roundtrip_bit_identical_int8(tiny_config):
+    """fetch_pages -> install_pages into DIFFERENT page ids of a fresh
+    pool generation: int8 values and f32 scales bit-identical."""
+    rng = np.random.default_rng(3)
+    cache = QuantizedPagedKVCache.create(tiny_config, 2, 8, PAGE, T)
+
+    def filled(pool):
+        return QuantPool(
+            q=jnp.asarray(rng.integers(-127, 128, size=pool.q.shape),
+                          jnp.int8),
+            scale=jnp.asarray(rng.random(pool.scale.shape),
+                              jnp.float32))
+
+    cache = cache._replace(k=filled(cache.k), v=filled(cache.v))
+    src = [5, 1, 6]
+    arrays = HostTier.fetch_pages(cache, src)
+    fresh = QuantizedPagedKVCache.create(tiny_config, 2, 8, PAGE, T)
+    dst = [2, 7, 0]
+    fresh = HostTier.install_pages(fresh, dst, arrays)
+    for s, d in zip(src, dst):
+        np.testing.assert_array_equal(
+            np.asarray(cache.k.q[:, s]), np.asarray(fresh.k.q[:, d]))
+        np.testing.assert_array_equal(
+            np.asarray(cache.k.scale[:, s]),
+            np.asarray(fresh.k.scale[:, d]))
+        np.testing.assert_array_equal(
+            np.asarray(cache.v.q[:, s]), np.asarray(fresh.v.q[:, d]))
+        np.testing.assert_array_equal(
+            np.asarray(cache.v.scale[:, s]),
+            np.asarray(fresh.v.scale[:, d]))
+
+
+def test_host_tier_roundtrip_bit_identical_f32(tiny_config):
+    """The tier is dtype-blind: an f32 pool round-trips bit-exact too
+    (what makes spill-resume token-identical at f32 KV)."""
+    from cake_tpu.models.llama.paged import PagedKVCache
+    rng = np.random.default_rng(4)
+    cache = PagedKVCache.create(tiny_config, 2, 8, PAGE, T,
+                                dtype=jnp.float32)
+    cache = cache._replace(
+        k=jnp.asarray(rng.normal(size=cache.k.shape), jnp.float32),
+        v=jnp.asarray(rng.normal(size=cache.v.shape), jnp.float32))
+    arrays = HostTier.fetch_pages(cache, [3, 0])
+    fresh = PagedKVCache.create(tiny_config, 2, 8, PAGE, T,
+                                dtype=jnp.float32)
+    fresh = HostTier.install_pages(fresh, [6, 1], arrays)
+    np.testing.assert_array_equal(np.asarray(cache.k[:, 3]),
+                                  np.asarray(fresh.k[:, 6]))
+    np.testing.assert_array_equal(np.asarray(cache.v[:, 0]),
+                                  np.asarray(fresh.v[:, 1]))
+
+
+# -- quantized pool units -----------------------------------------------------
+
+
+def test_quantized_write_error_bound_and_isolation():
+    """A written window dequantizes within one scale step of the f32
+    values, and pages NOT touched by a later write stay bit-identical
+    (the RMW writers must not drift neighbors)."""
+    rng = np.random.default_rng(5)
+    KV, hd = 2, 16
+    pool = QuantPool(q=jnp.zeros((12, PAGE, KV, hd), jnp.int8),
+                     scale=jnp.zeros((12, KV), jnp.float32))
+    vals = jnp.asarray(rng.normal(size=(1, 2 * PAGE + 3, KV, hd)),
+                       jnp.float32)
+    row = jnp.asarray([7, 2, 9, -1], jnp.int32)
+    pool = qwrite_prompt_pages(pool, vals, row)
+    deq = dequantize_pages(pool, jnp.asarray([7, 2, 9])).reshape(
+        3 * PAGE, KV, hd)[: 2 * PAGE + 3]
+    # symmetric int8: error <= scale/2 = amax/254 per (page, head)
+    assert float(jnp.max(jnp.abs(deq - vals[0]))) < 0.05
+    before = np.asarray(pool.q[7]), np.asarray(pool.scale[7])
+    # decode token into page 9 (single-page RMW)
+    tok = jnp.asarray(rng.normal(size=(1, 1, KV, hd)), jnp.float32)
+    pool2 = qupdate_pool_per_row(
+        pool, tok, jnp.asarray([2 * PAGE + 3], jnp.int32),
+        jnp.asarray([True]), jnp.asarray([[7, 2, 9, -1]], jnp.int32))
+    np.testing.assert_array_equal(before[0], np.asarray(pool2.q[7]))
+    np.testing.assert_array_equal(before[1], np.asarray(pool2.scale[7]))
+    got = dequantize_pages(pool2, jnp.asarray([9]))[0][3]
+    assert float(jnp.max(jnp.abs(got - tok[0, 0]))) < 0.05
+    # window write at an arbitrary offset into fresh scale-reset pages
+    pool3 = qwrite_window_pages(
+        pool2, tok, jnp.asarray([7, 2, 9, -1], jnp.int32),
+        jnp.int32(2 * PAGE + 4))
+    got3 = dequantize_pages(pool3, jnp.asarray([9]))[0][4]
+    assert float(jnp.max(jnp.abs(got3 - tok[0, 0]))) < 0.05
+
+
+def test_bucket_padding_cannot_inflate_scales():
+    """Bucket-padding garbage past n_real must not enter the page
+    scales: scales only grow, so one garbage-inflated amax would
+    coarsen the page's REAL tokens for the page's whole life. Writing
+    a garbage-padded bucket with n_real must be bit-identical to
+    writing the real tokens alone."""
+    rng = np.random.default_rng(6)
+    KV, hd = 2, 16
+    pool0 = QuantPool(q=jnp.zeros((12, PAGE, KV, hd), jnp.int8),
+                      scale=jnp.zeros((12, KV), jnp.float32))
+    row = jnp.asarray([7, 2, 9, -1], jnp.int32)
+
+    # prompt writer: bucket 2 pages, real tokens PAGE+3, tail garbage
+    n_real = PAGE + 3
+    vals = jnp.asarray(rng.normal(size=(1, 2 * PAGE, KV, hd)),
+                       jnp.float32)
+    garbage = vals.at[:, n_real:].mul(100.0)
+    live = jnp.arange(2 * PAGE)[None, :, None, None] < n_real
+    clean = jnp.where(live, vals, 0.0)
+    got = qwrite_prompt_pages(pool0, garbage, row, jnp.int32(n_real))
+    want = qwrite_prompt_pages(pool0, clean, row)
+    np.testing.assert_array_equal(np.asarray(got.q), np.asarray(want.q))
+    np.testing.assert_array_equal(np.asarray(got.scale),
+                                  np.asarray(want.scale))
+    # sanity: without n_real the garbage DOES inflate the tail scale
+    bad = qwrite_prompt_pages(pool0, garbage, row)
+    assert float(jnp.max(jnp.abs(bad.scale - want.scale))) > 0
+
+    # chunk window writer: C-token window, 4 real, huge padding
+    win = jnp.asarray(rng.normal(size=(1, PAGE + 5, KV, hd)),
+                      jnp.float32)
+    win = win.at[:, 4:].mul(100.0)
+    got = qwrite_window_pages(pool0, win, row, jnp.int32(3),
+                              jnp.int32(4))
+    want = qwrite_window_pages(pool0, win[:, :4], row, jnp.int32(3))
+    np.testing.assert_array_equal(np.asarray(got.q), np.asarray(want.q))
+    np.testing.assert_array_equal(np.asarray(got.scale),
+                                  np.asarray(want.scale))
+    bad = qwrite_window_pages(pool0, win, row, jnp.int32(3))
+    assert float(jnp.max(jnp.abs(bad.scale - want.scale))) > 0
+
+
+def test_reset_page_scales_zeroes_only_targets(tiny_config):
+    cache = QuantizedPagedKVCache.create(tiny_config, 2, 8, PAGE, T)
+    ones = jnp.ones_like(cache.k.scale)
+    cache = cache._replace(k=cache.k._replace(scale=ones),
+                           v=cache.v._replace(scale=ones))
+    cache = reset_page_scales(cache, [2, 5])
+    sk = np.asarray(cache.k.scale)
+    assert (sk[:, [2, 5]] == 0).all()
+    assert (sk[:, [0, 1, 3, 4, 6, 7]] == 1).all()
+
+
+def test_memory_bytes_counts_scales(tiny_config):
+    """The satellite fix: storage bytes sum per dtype + scale arrays
+    instead of assuming one dtype for the pool."""
+    from cake_tpu.models.llama.paged import PagedKVCache
+    q8 = QuantizedPagedKVCache.create(tiny_config, 2, 8, PAGE, T)
+    want = (q8.k.q.nbytes + q8.k.scale.nbytes
+            + q8.v.q.nbytes + q8.v.scale.nbytes)
+    assert q8.memory_bytes() == want
+    assert q8.memory_bytes() == 8 * page_bytes(tiny_config, PAGE,
+                                               jnp.int8)
+    f32 = PagedKVCache.create(tiny_config, 2, 8, PAGE, T,
+                              dtype=jnp.float32)
+    assert f32.memory_bytes() == f32.k.nbytes + f32.v.nbytes
+    assert f32.memory_bytes() == 8 * page_bytes(tiny_config, PAGE,
+                                                jnp.float32)
+    # the capacity story in one assert: int8+scales under ~30% of f32
+    assert q8.memory_bytes() < 0.3 * f32.memory_bytes()
+
+
+# -- config plumbing ----------------------------------------------------------
+
+
+def test_kv_dtype_int8_requires_pages(tiny_config, params):
+    with pytest.raises(ValueError, match="requires --kv-pages"):
+        _engine(tiny_config, params, kv_pages=None, kv_dtype="int8")
+
+
+def test_args_validate_int8_rules():
+    from cake_tpu.args import Args
+    with pytest.raises(ValueError, match="requires --kv-pages"):
+        Args(kv_dtype="int8").validate()
+    with pytest.raises(ValueError, match="draft-model"):
+        Args(kv_dtype="int8", kv_pages=64,
+             draft_model="x").validate()
+    with pytest.raises(ValueError, match="kv-host-pages"):
+        Args(kv_host_pages=0).validate()
+    Args(kv_dtype="int8", kv_pages=64, kv_host_pages=4).validate()
+
+
+def test_master_spec_engine_int8_is_loud(tiny_config):
+    """--kv-dtype int8 with the spec engine is a config ERROR (spec is
+    gated off paged), not a silently-ignored flag."""
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.models.llama.params import init_params
+    from cake_tpu.models.llama.speculative import SpeculativeGenerator
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    args = Args(max_slots=2)
+    args.kv_dtype = "int8"      # past validate(), straight to master
+    p = init_params(tiny_config, jax.random.PRNGKey(0))
+    gen = SpeculativeGenerator(
+        tiny_config, p, tiny_config, p,
+        ByteTokenizer(tiny_config.vocab_size), max_seq_len=T,
+        sampling=SamplingConfig(temperature=1.0, repeat_penalty=1.0))
+    master = Master(args, text_generator=gen)
+    with pytest.raises(ValueError, match="draft-model"):
+        master.make_engine()
+
+
+# -- engine: int8 serving -----------------------------------------------------
+
+
+def test_engine_int8_serves_and_conserves_pages(tiny_config, params):
+    """An int8-KV paged engine serves concurrent greedy streams and
+    returns every page at retire (free + live == n_pages)."""
+    eng = _engine(tiny_config, params, kv_dtype="int8")
+    with eng:
+        hs = [eng.submit([5] * 9, max_new_tokens=6),
+              eng.submit([3, 7, 9], max_new_tokens=6)]
+        assert all(h.wait(timeout=300) for h in hs)
+        assert all(len(h.token_ids) > 0 for h in hs)
+        assert eng._pager.free_pages == eng.cache.n_pages
+        assert eng.kv_quant
+    # the pool really is the quantized layout
+    assert eng.cache.k.q.dtype == jnp.int8
+    assert eng.cache.k.scale.dtype == jnp.float32
+
+
+@pytest.mark.slow  # two engine phases -> slow lane
+def test_engine_int8_greedy_acceptance_vs_f32(tiny_config, params):
+    """Tolerance/acceptance vs the f32 reference: same prompts, same
+    config, KV storage flipped f32 -> int8. Token EQUALITY is not the
+    bar (per-page rounding can flip greedy near-ties on a random tiny
+    model); a high agreement fraction and a same-length stream are."""
+    def run(kv_dtype):
+        eng = _engine(tiny_config, params, kv_dtype=kv_dtype)
+        with eng:
+            hs = [eng.submit([11] * 14, max_new_tokens=10),
+                  eng.submit([2, 9, 4, 7, 3], max_new_tokens=10)]
+            assert all(h.wait(timeout=300) for h in hs)
+            return [list(h._req.out_tokens) for h in hs]
+
+    ref, got = run("f32"), run("int8")
+    total = agree = 0
+    for a, b in zip(ref, got):
+        assert len(a) == len(b)
+        total += len(a)
+        agree += sum(x == y for x, y in zip(a, b))
+    assert agree / total >= 0.6, (ref, got)
+
+
+@pytest.mark.slow  # three engine phases under preemption -> slow lane
+def test_preempt_spill_restore_token_identity_f32(tiny_config, params):
+    """THE spill-resume acceptance bar: a batch stream preempted by an
+    interactive arrival, its pages SPILLED to the host tier and
+    RESTORED at resume, emits tokens identical to an unpreempted run
+    (f32 KV; the PR 5 recompute-equality test, spill edition). The
+    host-tier counters prove the spill path actually ran."""
+    from cake_tpu.sched import SchedConfig
+
+    kw = dict(max_slots=1, priority_classes=True,
+              sched_config=SchedConfig(preempt_budget=8),
+              kv_dtype="f32")
+
+    base = _engine(tiny_config, params, **kw)
+    with base:
+        h = base.submit(BATCH_PROMPT, max_new_tokens=GEN,
+                        priority="batch")
+        assert h.wait(timeout=300)
+        assert base.stats.preemptions == 0
+        want = list(h._req.out_tokens)
+
+    eng = _engine(tiny_config, params, preemption=True,
+                  kv_host_pages=8, **kw)
+    with eng:
+        hb = eng.submit(BATCH_PROMPT, max_new_tokens=GEN,
+                        priority="batch")
+        t0 = time.perf_counter()
+        while (len(hb._req.out_tokens) < 4
+               and time.perf_counter() - t0 < 120):
+            time.sleep(0.002)
+        assert len(hb._req.out_tokens) >= 4, "victim never got going"
+        hi = eng.submit(INTER_PROMPT, max_new_tokens=4,
+                        priority="interactive")
+        assert hi.wait(timeout=300) and hb.wait(timeout=300)
+        assert eng.stats.preemptions >= 1, "no preemption happened"
+        assert eng.stats.kv_spills >= 1, "victim was not spilled"
+        assert eng.stats.kv_restores >= 1, "victim was not restored"
+        got = list(hb._req.out_tokens)
+        assert eng._pager.free_pages == eng.cache.n_pages
+        assert eng._host_tier.used_pages == 0
+    assert got == want
+
+
+@pytest.mark.slow  # pool-pressure engine run -> slow lane
+def test_cold_prefix_spills_and_restores(tiny_config, params):
+    """Admission pressure spills a COLD registered prefix to the host
+    tier instead of refusing admission; a later prefix-matching
+    request streams it back and still takes the prefix hit."""
+    eng = _engine(tiny_config, params, max_seq_len=128, kv_pages=6,
+                  kv_dtype="f32", kv_host_pages=4)
+    with eng:
+        pid = eng.register_prefix(list(range(3, 35)))     # 2 pages
+        assert eng._pager.free_pages == 4
+        # two 4-page requests oversubscribe the remaining pool: the
+        # second admission must spill the cold prefix, not wait
+        h1 = eng.submit([9] * 24, max_new_tokens=40)
+        h2 = eng.submit([8] * 24, max_new_tokens=40)
+        assert h1.wait(timeout=300) and h2.wait(timeout=300)
+        assert eng.stats.kv_spills >= 1
+        with eng._rid_lock:
+            assert eng._prefixes[pid][1] is None          # spilled
+        base_hits = eng.stats.prefix_hits
+        h3 = eng.submit(list(range(3, 35)) + [7] * 5,
+                        max_new_tokens=4)
+        assert h3.wait(timeout=300)
+        assert eng.stats.kv_restores >= 1
+        with eng._rid_lock:
+            assert eng._prefixes[pid][1] is not None      # restored
+        assert eng.stats.prefix_hits > base_hits
+        assert eng._pager.free_pages == eng.cache.n_pages - 2
+
+
+@pytest.mark.slow  # two engine phases -> slow lane
+def test_engine_int8_fold_matches_pallas(tiny_config, params):
+    """Engine-level fold==pallas at int8 KV: chunked prefill + mixed
+    steps + decode through the quantized pool emit identical token ids
+    under both attention impls (both read the SAME stored int8 values,
+    so this is kernel parity, not quantization tolerance)."""
+    def run(impl):
+        eng = _engine(tiny_config, params, kv_dtype="int8",
+                      paged_attn=impl, prefill_chunk=8)
+        with eng:
+            hs = [eng.submit([5] * 9, max_new_tokens=6),
+                  eng.submit([3, 7, 9, 11, 2, 8, 6, 1, 9, 4, 3, 2, 7],
+                             max_new_tokens=6)]
+            assert all(h.wait(timeout=300) for h in hs)
+            return [list(h._req.out_tokens) for h in hs]
+
+    assert run("fold") == run("pallas")
+
+
+@pytest.mark.slow  # pool-pressure engine runs -> slow lane
+@pytest.mark.parametrize("mixed", ["off", "on"])
+def test_host_evicted_prefix_degrades_to_full_prefill(
+        tiny_config, params, mixed):
+    """A spilled prefix whose host entry is gone (LRU-evicted) must
+    degrade the admission to a whole-prompt prefill: the stale hit is
+    dropped BEFORE dispatch, so the request never attends the
+    never-written prefix region. Parametrized over both admission
+    paths (_do_prefill and _admit_mixed)."""
+    prompt = list(range(3, 35)) + [7] * 5
+    ref = _engine(tiny_config, params, max_seq_len=128, kv_pages=8,
+                  kv_dtype="f32", mixed_batch=mixed)
+    with ref:
+        h = ref.submit(prompt, max_new_tokens=4)
+        assert h.wait(timeout=300)
+        want = list(h._req.out_tokens)
+
+    eng = _engine(tiny_config, params, max_seq_len=128, kv_pages=6,
+                  kv_dtype="f32", kv_host_pages=4, mixed_batch=mixed)
+    with eng:
+        pid = eng.register_prefix(list(range(3, 35)))     # 2 pages
+        # oversubscribe the pool so the cold prefix spills to host
+        h1 = eng.submit([9] * 24, max_new_tokens=40)
+        h2 = eng.submit([8] * 24, max_new_tokens=40)
+        assert h1.wait(timeout=300) and h2.wait(timeout=300)
+        assert eng.stats.kv_spills >= 1
+        with eng._rid_lock:
+            assert eng._prefixes[pid][1] is None          # spilled
+        eng._host_tier.drop(("prefix", pid))              # "LRU-evicted"
+        base_hits = eng.stats.prefix_hits
+        h3 = eng.submit(prompt, max_new_tokens=4)
+        assert h3.wait(timeout=300)
+        assert list(h3._req.out_tokens) == want           # not garbage
+        assert eng.stats.prefix_hits == base_hits         # no false hit
+        with eng._rid_lock:
+            assert pid not in eng._prefixes               # unregistered
+        assert eng._pager.free_pages == eng.cache.n_pages

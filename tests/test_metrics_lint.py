@@ -109,12 +109,15 @@ def test_step_metric_families_documented_in_readme():
     with real help text AND appear in the README metrics table — an
     undocumented telemetry metric fails tier-1 here."""
     lm = _load()
+    import cake_tpu.kv.host_tier  # noqa: F401 — registers cake_kv_*
     import cake_tpu.obs.steps  # noqa: F401 — registers the families
     from cake_tpu.obs import metrics as m
     readme = (TOOLS.parent / "README.md").read_text()
     text = m.REGISTRY.render()
     assert any(line.startswith("# TYPE cake_steps_total")
                for line in text.splitlines()), "steps module families"
+    assert any(line.startswith("# TYPE cake_kv_spill_total")
+               for line in text.splitlines()), "kv tier families"
     errs = lm.lint_readme_coverage(text, readme)
     assert errs == [], errs
 

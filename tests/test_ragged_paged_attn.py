@@ -234,6 +234,26 @@ def test_mixed_supported_gate_bounds_scratch_vmem(monkeypatch):
     assert not ragged_paged_mixed_supported(P, H=4, KV=2, hd=16, q_width=1)
 
 
+def test_supported_gate_bounds_int8_scale_smem(monkeypatch):
+    """The int8 kernels scalar-prefetch whole-pool [N_pages, KV] f32
+    scale arrays into SMEM — the gate must send a pathologically
+    page-count-heavy pool to the fold instead of letting Mosaic fail
+    SMEM allocation at the first dispatch."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # production-scale pool fits (4096 pages x 8 kv heads = 256 KB)
+    assert ragged_paged_supported(128, H=32, KV=8, hd=128,
+                                  quantized=True, n_pages=4096)
+    assert not ragged_paged_supported(128, H=32, KV=8, hd=128,
+                                      quantized=True, n_pages=100_000)
+    # the bound is int8-only (f32 pools carry no scale operands) and
+    # rides through the mixed gate
+    assert ragged_paged_supported(128, H=32, KV=8, hd=128,
+                                  n_pages=100_000)
+    assert not ragged_paged_mixed_supported(128, H=32, KV=8, hd=128,
+                                            q_width=1, quantized=True,
+                                            n_pages=100_000)
+
+
 def test_engine_pallas_matches_fold(tiny_config):
     """Engine-level smoke: a paged engine with paged_attn="pallas"
     produces identical token ids to "fold" on a 2-request workload.
@@ -309,3 +329,139 @@ def test_engine_pallas_records_step_histogram(tiny_config, tiny_params):
     assert fam.labels(path="prefill").count > before["prefill"]
     rendered = obs_metrics.REGISTRY.render()
     assert 'cake_paged_attn_step_seconds_bucket{path="decode"' in rendered
+
+
+# -- int8 KV parity (cake_tpu/kv quantized pool) ------------------------------
+#
+# The fold over a QuantPool (dequantize per page inside the loop) is
+# the bit-exact reference for the int8 kernels, exactly as the f32
+# fold is for the f32 kernels; the int8 kernels stream int8 pages and
+# apply the per-(page, kv-head) scales to the dot outputs.
+
+
+def _qpools(rng, KV, hd):
+    """Two quantized pools (k, v) built through the production writer
+    (qwrite_prompt_pages), so every page carries its own per-head
+    scale from its own amax."""
+    from cake_tpu.kv.quantized_pool import QuantPool, qwrite_prompt_pages
+
+    def one(seed_vals):
+        pool = QuantPool(q=jnp.zeros((N_PAGES, P, KV, hd), jnp.int8),
+                         scale=jnp.zeros((N_PAGES, KV), jnp.float32))
+        return qwrite_prompt_pages(
+            pool, seed_vals, jnp.arange(N_PAGES, dtype=jnp.int32))
+
+    pk = one(jnp.asarray(rng.normal(size=(1, N_PAGES * P, KV, hd)),
+                         jnp.float32))
+    pv = one(jnp.asarray(rng.normal(size=(1, N_PAGES * P, KV, hd)),
+                         jnp.float32))
+    return pk, pv
+
+
+def _assert_parity_q8(q, pk, pv, table, pos, atol=2e-5):
+    want = paged_attention(q, pk, pv, table, pos)
+    got = ragged_paged_attention(q, pk.q, pv.q, table, pos,
+                                 scale_k=pk.scale, scale_v=pv.scale,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=atol, rtol=atol)
+
+
+def _assert_mixed_parity_q8(q, pk, pv, table, pos, qlen, atol=2e-5):
+    want = np.asarray(paged_attention_mixed(q, pk, pv, table, pos,
+                                            qlen))
+    got = np.asarray(ragged_paged_attention_mixed(
+        q, pk.q, pv.q, table, pos, qlen, scale_k=pk.scale,
+        scale_v=pv.scale, interpret=True))
+    for b in range(q.shape[0]):
+        n = int(qlen[b])
+        np.testing.assert_allclose(got[b, :n], want[b, :n],
+                                   atol=atol, rtol=atol)
+
+
+def test_kernel_parity_int8_page_boundaries():
+    """int8 decode kernel at page-edge positions: the early exit must
+    flip at ceil((pos+1)/P) with scales following the page stream."""
+    rng = np.random.default_rng(20)
+    pk, pv = _qpools(rng, KV=2, hd=16)
+    q = jnp.asarray(rng.normal(size=(4, 1, 4, 16)), jnp.float32)
+    table = jnp.asarray([[3, 6, 0, 10, 5]] * 4, jnp.int32)
+    pos = jnp.asarray([P - 1, P, 2 * P - 1, 2 * P], jnp.int32)
+    _assert_parity_q8(q, pk, pv, table, pos)
+
+
+@pytest.mark.parametrize("H,KV", [(8, 2), (6, 3), (4, 4)])
+def test_kernel_parity_int8_gqa(H, KV):
+    """int8 decode kernel at GQA group sizes 4, 2 and 1: each query
+    group must read its own kv head's scale."""
+    rng = np.random.default_rng(21)
+    pk, pv = _qpools(rng, KV=KV, hd=16)
+    q = jnp.asarray(rng.normal(size=(2, 1, H, 16)), jnp.float32)
+    table = jnp.asarray([[9, 1, 6, -1, -1], [0, 5, -1, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 3, P + 6], jnp.int32)
+    _assert_parity_q8(q, pk, pv, table, pos)
+
+
+def test_kernel_parity_int8_unmapped_holes():
+    """int8 decode kernel with -1 holes inside the live range and a
+    fully-dead row: holes masked (their clamped page-0 scale must not
+    leak), dead row zeros."""
+    rng = np.random.default_rng(22)
+    pk, pv = _qpools(rng, KV=2, hd=16)
+    q = jnp.asarray(rng.normal(size=(3, 1, 4, 16)), jnp.float32)
+    table = jnp.asarray([[4, -1, 11, 3, -1],
+                         [-1, 2, 7, -1, -1],
+                         [-1, -1, -1, -1, -1]], jnp.int32)
+    pos = jnp.asarray([3 * P + 2, 2 * P + 1, P + 4], jnp.int32)
+    _assert_parity_q8(q, pk, pv, table, pos)
+    dead = ragged_paged_attention(q, pk.q, pv.q, table, pos,
+                                  scale_k=pk.scale, scale_v=pv.scale,
+                                  interpret=True)[2]
+    np.testing.assert_array_equal(np.asarray(dead),
+                                  np.zeros_like(np.asarray(dead)))
+
+
+def test_mixed_kernel_parity_int8_offsets_and_holes():
+    """int8 MIXED kernel: a decode row, a chunk row straddling a page
+    boundary at an arbitrary offset, a chunk row behind an unmapped
+    hole, and an idle row (q_len=0) in one launch."""
+    rng = np.random.default_rng(23)
+    pk, pv = _qpools(rng, KV=2, hd=16)
+    C = 6
+    q = jnp.asarray(rng.normal(size=(4, C, 4, 16)), jnp.float32)
+    table = jnp.asarray([[7, 2, 9, -1, -1],
+                         [4, 11, 3, -1, -1],
+                         [-1, 8, 5, -1, -1],
+                         [-1, -1, -1, -1, -1]], jnp.int32)
+    pos = jnp.asarray([2 * P + 5, P + 3, P + 2, 0], jnp.int32)
+    qlen = jnp.asarray([1, 6, 4, 0], jnp.int32)
+    _assert_mixed_parity_q8(q, pk, pv, table, pos, qlen)
+
+
+@pytest.mark.parametrize("H,KV", [(8, 2), (6, 3), (4, 4)])
+def test_mixed_kernel_parity_int8_gqa(H, KV):
+    """int8 mixed kernel at GQA group sizes 4, 2 and 1."""
+    rng = np.random.default_rng(24)
+    pk, pv = _qpools(rng, KV=KV, hd=16)
+    C = 5
+    q = jnp.asarray(rng.normal(size=(2, C, H, 16)), jnp.float32)
+    table = jnp.asarray([[9, 1, 6, -1, -1], [0, 5, 2, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 3, P + 6], jnp.int32)
+    qlen = jnp.asarray([1, 5], jnp.int32)
+    _assert_mixed_parity_q8(q, pk, pv, table, pos, qlen)
+
+
+def test_supported_gate_int8_page_tiling():
+    """On silicon an int8 pool needs page_size % 32 (the int8 sublane
+    tile); interpret mode takes any shape."""
+    if jax.default_backend() == "tpu":
+        assert ragged_paged_supported(128, H=4, KV=2, hd=128,
+                                      quantized=True)
+        assert not ragged_paged_supported(16, H=4, KV=2, hd=128,
+                                          quantized=True)
+        assert ragged_paged_supported(16, H=4, KV=2, hd=128)
+    else:
+        assert ragged_paged_supported(P, H=4, KV=2, hd=16,
+                                      quantized=True)

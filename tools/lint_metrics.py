@@ -53,12 +53,14 @@ HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 # telemetry families that MUST be documented (help text + README
 # metrics table row) — the obs/steps.py surface, the paged
-# prefix-sharing families (serve/engine.py cake_prefix_*), and the SLO
+# prefix-sharing families (serve/engine.py cake_prefix_*), the SLO
 # scheduling families (cake_tpu/sched: preemption / shed / per-class
-# TTFT)
+# TTFT), and the KV tiering families (cake_tpu/kv: quantized pool
+# bytes + host spill tier)
 DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_device_", "cake_prefix_", "cake_sched_",
-                       "cake_shed_", "cake_preemptions_", "cake_mixed_")
+                       "cake_shed_", "cake_preemptions_", "cake_mixed_",
+                       "cake_kv_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
