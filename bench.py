@@ -198,6 +198,29 @@ SLO_TIERS = {
                         standard_n=2, standard_gen=16, stagger_s=0.25),
 }
 
+# Crash-resilience tiers (bench.py --chaos): the same offered load
+# served clean and then under a seeded --fault-plan — two transient
+# crashes injected mid-decode plus one poison request whose prefill
+# keeps failing (match_len keys the rule to its unique prompt length).
+# The contract this tier exists for: the injected transient crashes
+# cost ZERO requests (everything in flight recovers via the
+# fold-tokens-into-prompt resubmit), the poison request alone is
+# quarantined, and recovery latency stays bounded (reported p50/p99).
+CHAOS_TIERS = {
+    # nth= decode-call indices land the two crashes mid-wave (the
+    # 4-token warmup consumes the first ~4 decode calls); the poison
+    # prompt is 96 tokens — shorter than every wave prompt, so no
+    # folded resubmit prefill can ever collide with its match_len
+    "chaos_8b_int8": dict(model="8b", quant="int8", max_seq=512,
+                          slots=4, prompt_len=128, prefill_chunk=128,
+                          gen_tokens=64, wave=6, poison_len=96,
+                          fault_plan=("seed=11"
+                                      ";engine.decode:nth=20:transient"
+                                      ";engine.decode:nth=48:transient"
+                                      ";engine.prefill:always:transient"
+                                      ":match_len=96:times=3")),
+}
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
@@ -217,6 +240,18 @@ SMOKE_TIERS = {
                      prompt_len=24, prefill_chunk=16, batch_gen=64,
                      inter_n=6, inter_gen=4, standard_n=1,
                      standard_gen=6, stagger_s=0.05),
+    # f32 cache so the chaos phase's greedy streams must come back
+    # token-identical to the clean phase (the recovery contract, not
+    # bf16 tie-breaks); poison_len 11 < prompt_len 16, so no folded
+    # resubmit prefill can collide with the poison rule's match_len
+    "chaos_tiny": dict(model="tiny", quant=False, max_seq=128, slots=2,
+                       prompt_len=16, prefill_chunk=16, gen_tokens=16,
+                       wave=4, poison_len=11, cache_f32=True,
+                       fault_plan=("seed=11"
+                                   ";engine.decode:nth=8:transient"
+                                   ";engine.decode:nth=14:transient"
+                                   ";engine.prefill:always:transient"
+                                   ":match_len=11:times=3")),
     "paged_prefix_tiny": dict(model="tiny", quant=False, max_seq=128,
                               slots=2, kv_pages=16, kv_page_size=16,
                               paged_attn="fold", prefix_len=32,
@@ -1032,6 +1067,113 @@ def run_slo_tier(name: str, model: str, quant, max_seq: int,
     return result
 
 
+def run_chaos_tier(name: str, model: str, quant, max_seq: int,
+                   slots: int, prompt_len: int, prefill_chunk: int,
+                   gen_tokens: int, wave: int, fault_plan: str,
+                   poison_len: int = 0,
+                   cache_f32: bool = False) -> dict:
+    """Crash-resilience A/B (cake_tpu/faults + serve/engine recovery):
+    the same offered load served clean, then under a seeded transient
+    -crash --fault-plan (plus one poison request whose prefill keeps
+    failing, when poison_len > 0). Reports recovered / failed /
+    quarantined request counts, recovery-latency p50/p99, and whether
+    the chaos phase's greedy streams stayed token-identical to the
+    clean phase. prefill_chunk keeps the folded resubmit prefills —
+    whose lengths vary with how many tokens each victim had generated
+    — on ONE compiled window program, so recovery latency measures
+    the reset + resubmit loop, not mid-chaos compiles."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+    from cake_tpu.serve.errors import RecoveryConfig
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+
+    def phase(plan) -> dict:
+        kw = {"cache_dtype": jnp.float32} if cache_f32 else {}
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefill_chunk=prefill_chunk, fault_plan=plan,
+            # quick consecutive-reset backoff; a storm cap well above
+            # the planned injection count (the tier measures recovery,
+            # not the breaker)
+            recovery_config=RecoveryConfig(backoff_base_s=0.05,
+                                           storm_resets=16), **kw)
+        with engine:
+            t0 = time.perf_counter()
+            warm = engine.submit(prompt(99), max_new_tokens=4)
+            assert warm.wait(timeout=900), "chaos warmup timed out"
+            log(f"chaos[{'faulty' if plan else 'clean'}] warmup "
+                f"(compile): {time.perf_counter() - t0:.1f}s")
+            handles = [engine.submit(prompt(i), max_new_tokens=gen_tokens)
+                       for i in range(wave)]
+            if poison_len:
+                handles.append(engine.submit(prompt(7777)[:poison_len],
+                                             max_new_tokens=gen_tokens))
+            assert all(h.wait(timeout=900) for h in handles), \
+                "chaos wave timed out"
+            failed = [h for h in handles if h._req.error is not None]
+            out = {
+                "tokens": [list(h._req.out_tokens)
+                           for h in handles[:wave]],
+                "failed": len(failed),
+                "recoveries": engine.stats.recoveries,
+                "recovered": engine.stats.requests_recovered,
+                "quarantined": engine.stats.poisoned,
+                "injections": (engine._faults.total
+                               if engine._faults is not None else 0),
+                "recovery_s": list(engine.recovery_seconds),
+            }
+        log(f"chaos[{'faulty' if plan else 'clean'}]: "
+            f"{out['injections']} injections, {out['recoveries']} "
+            f"recoveries, {out['recovered']} requests recovered, "
+            f"{out['quarantined']} quarantined, {out['failed']} failed")
+        return out
+
+    clean = phase(None)
+    chaos = phase(fault_plan)
+    rec = chaos["recovery_s"]
+    result = {
+        "metric": f"{name}_recovered_requests",
+        "value": chaos["recovered"],
+        "unit": "requests",
+        "vs_baseline": 0.0,
+        "chaos_plan": fault_plan,
+        "chaos_injections": chaos["injections"],
+        "chaos_recoveries": chaos["recoveries"],
+        "chaos_recovered": chaos["recovered"],
+        "chaos_quarantined": chaos["quarantined"],
+        "chaos_failed": chaos["failed"],
+        "chaos_clean_failed": clean["failed"],
+        "chaos_tokens_match": chaos["tokens"] == clean["tokens"],
+        "device_kind": dev.device_kind,
+    }
+    if rec:
+        result["chaos_recovery_p50_ms"] = round(_pct(rec, 0.5) * 1e3, 1)
+        result["chaos_recovery_p99_ms"] = round(_pct(rec, 0.99) * 1e3, 1)
+    log(f"chaos: {chaos['recovered']} recovered / "
+        f"{chaos['quarantined']} quarantined / {chaos['failed']} failed "
+        f"(clean failed {clean['failed']}); tokens_match="
+        f"{result['chaos_tokens_match']}, recovery p50/p99 "
+        f"{result.get('chaos_recovery_p50_ms')}/"
+        f"{result.get('chaos_recovery_p99_ms')}ms")
+    return result
+
+
 def run_sd_tier(name: str, version: str, height: int | None = None,
                 width: int | None = None, steps_a: int = 20,
                 steps_b: int = 40) -> dict:
@@ -1176,7 +1318,10 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in KV_TIER_TIERS or name.startswith("kvtier"):
+    if name in CHAOS_TIERS or name.startswith("chaos"):
+        kwargs = {**CHAOS_TIERS, **SMOKE_TIERS}[name]
+        result = run_chaos_tier(name, **kwargs)
+    elif name in KV_TIER_TIERS or name.startswith("kvtier"):
         kwargs = {**KV_TIER_TIERS, **SMOKE_TIERS}[name]
         result = run_kv_tier(name, **kwargs)
     elif name in MIXED_TIERS or name.startswith("mixed_"):
@@ -1382,6 +1527,18 @@ def _kv_tier_main() -> int:
         fail_error="kv tiering tier failed")
 
 
+def _chaos_main() -> int:
+    """`bench.py --chaos`: the crash-resilience tier — one JSON line
+    with recovered / failed / quarantined request counts, recovery
+    latency p50/p99, and a clean-vs-chaos token-identity flag under
+    the same offered load with a seeded --fault-plan injected.
+    CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "recovered_requests", "requests",
+        cpu_tier="chaos_tiny", tpu_tier="chaos_8b_int8",
+        fail_error="chaos crash-resilience tier failed")
+
+
 def _slo_main() -> int:
     """`bench.py --slo`: the mixed-priority SLO scheduling tier — one
     JSON line with per-class TTFT p50/p99 for a preemption-on vs
@@ -1500,6 +1657,8 @@ if __name__ == "__main__":
         sys.exit(_mixed_main())
     elif "--slo" in sys.argv:
         sys.exit(_slo_main())
+    elif "--chaos" in sys.argv:
+        sys.exit(_chaos_main())
     elif "--paged-prefix" in sys.argv:
         sys.exit(_paged_prefix_main())
     elif "--paged-attn" in sys.argv:

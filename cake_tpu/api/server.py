@@ -32,6 +32,7 @@ from cake_tpu.args import ImageGenerationArgs
 from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.obs import steps as obs_steps
 from cake_tpu.obs import tracing as obs_tracing
+from cake_tpu.serve.errors import EngineRequestError
 
 log = logging.getLogger(__name__)
 
@@ -242,7 +243,20 @@ class ApiServer:
                     return DISCONNECTED
             if final:
                 break
-        h.text()  # raises if the engine failed the request
+        try:
+            h.text()  # raises if the engine failed the request
+        except Exception as e:  # noqa: BLE001
+            # the headers are long gone: an open SSE stream gets a
+            # TERMINAL error event (typed + retryable flag) instead of
+            # a silent close the client cannot tell from success
+            try:
+                send_chunk({"error": {
+                    "message": str(e), "type": type(e).__name__,
+                    "retryable": bool(getattr(e, "retryable", False)),
+                }})
+            except OSError:
+                return DISCONNECTED
+            return None
         try:
             # the finish chunk flushes entries finalized after the last
             # text-bearing delta (e.g. an EOS-terminated request whose
@@ -294,7 +308,26 @@ class ApiServer:
                 out["queue_depth_by_class"] = depths()
                 out["preemptions"] = st.preemptions
                 out["requests_shed"] = st.shed
+            if hasattr(self.engine, "recovery_state"):
+                # crash-recovery / reset-storm-breaker state (+ the
+                # armed fault plan, when chaos is on)
+                out["recovery"] = self.engine.recovery_state()
         return out
+
+    def _engine_retry_after(self, priority=None) -> float:
+        """Honest Retry-After for a transient engine reset: the shed
+        controller's measured-service-rate estimate for the REQUEST'S
+        priority class when shedding is on (the same computation
+        behind the 429 path), else a 1s floor."""
+        shed = getattr(self.engine, "_shed", None) \
+            if self.engine is not None else None
+        if shed is not None:
+            try:
+                return shed.estimate_retry_after(
+                    priority or "standard", self.engine.queue_depth)
+            except Exception:  # noqa: BLE001 — estimate, not contract
+                log.debug("retry-after estimate failed", exc_info=True)
+        return 1.0
 
     def cluster(self) -> dict:
         import jax
@@ -477,6 +510,22 @@ def make_handler(api: ApiServer):
             self.wfile.write(data)
             api._count(self.path, code)
 
+        def _retry_json(self, code: int, retry_after_s: float,
+                        obj: dict):
+            """_json plus a Retry-After header (ceil'd to whole
+            seconds, floor 1) — the shared shape of the 429 overload
+            and 503 engine-reset responses; retry_after_s also rides
+            the body as retry_after_s."""
+            retry = max(1, int(-(-retry_after_s // 1)))
+            data = json.dumps({**obj, "retry_after_s": retry}).encode()
+            self.send_response(code)
+            self.send_header("Retry-After", str(retry))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            api._count(self.path, code)
+
         def _limit_arg(self):
             """Optional ?limit=N capping a ring dump (the rings are
             already bounded; this just trims the response)."""
@@ -574,20 +623,31 @@ def make_handler(api: ApiServer):
                 # the backlog drains inside the class SLO at the
                 # measured service rate (sched/shed.py), not a
                 # hardcoded constant — for shed AND queue-full alike
-                retry = max(1, int(-(-e.retry_after // 1)))   # ceil
-                data = json.dumps({
+                self._retry_json(429, e.retry_after, {
                     "error": ("request shed: server saturated for "
                               "this priority class" if e.shed
                               else "queue full"),
-                    "retry_after_s": retry,
-                }).encode()
-                self.send_response(429)
-                self.send_header("Retry-After", str(retry))
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-                api._count(self.path, 429)
+                })
+            except EngineRequestError as e:
+                # typed engine failures (serve/errors.py): a RETRYABLE
+                # one (transient reset, storm-breaker stop) is 503 +
+                # an honest computed Retry-After — the request itself
+                # was fine; a non-retryable one (poison request) is a
+                # terminal 500 the client must not blindly resubmit
+                log.warning("engine failed request: %s", e)
+                if getattr(self, "_stream_started", False):
+                    return  # the stream already carried its error event
+                if not e.retryable:
+                    return self._json(500, {
+                        "error": str(e), "retryable": False})
+                # body["priority"] holds the merged body/header class
+                # (set by _chat before submit), so the estimate is for
+                # the failing request's own lane — matching the 429
+                # path's per-class computation
+                self._retry_json(
+                    503,
+                    api._engine_retry_after(body.get("priority")),
+                    {"error": str(e), "retryable": True})
             except Exception as e:  # noqa: BLE001
                 log.exception("request failed")
                 if getattr(self, "_stream_started", False):

@@ -199,6 +199,22 @@ class Args:
     # surface as HTTP 429 with an honest computed Retry-After
     # (cake_tpu/sched/shed.py)
     shed: bool = False
+    # --fault-plan SPEC: deterministic fault injection (cake_tpu/faults)
+    # — "seed=N;site:trigger:error[:opts];..." names where/when/what
+    # the serving stack should fail (e.g.
+    # "seed=7;engine.decode:nth=12:transient"), so every chaos
+    # experiment is reproducible from its command line. Sites cover
+    # engine step dispatch, the control channel, the host KV tier and
+    # the page allocator; unset = the plane is a no-op.
+    fault_plan: Optional[str] = None
+    # --recovery / --no-recovery: crash recovery for the serving
+    # engine — on a step failure, reset and RESUBMIT in-flight
+    # requests via the checkpoint fold-tokens-into-prompt path
+    # instead of failing them all; repeatedly-implicated requests are
+    # quarantined as poison, and a reset storm trips a breaker
+    # (snapshot + clean stop). None = auto: on wherever the fold works
+    # (off for speculative and windowed serving)
+    recovery: Optional[bool] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -230,6 +246,12 @@ class Args:
         if self.kv_host_pages is not None and self.kv_host_pages < 1:
             raise ValueError(
                 f"--kv-host-pages {self.kv_host_pages} must be >= 1")
+        if self.fault_plan:
+            # parse NOW so a malformed plan is a loud startup error,
+            # not a crash after the model loaded (a chaos run that
+            # silently injects nothing is worse than no chaos run)
+            from cake_tpu.faults import FaultPlan
+            FaultPlan.parse(self.fault_plan)
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

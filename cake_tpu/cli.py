@@ -171,6 +171,12 @@ def _serve_multihost(master, args) -> int:
         addr, _, rest = payload.partition("|")
         token, _, hb_addr = rest.partition("|")
         client = ControlClient(addr, token=token or None)
+        if getattr(args, "fault_plan", None):
+            # follower-side chaos: control.recv rules fire in this
+            # process (the plan string is identical on every host, so
+            # the experiment stays reproducible)
+            from cake_tpu.faults import build_injector
+            client.faults = build_injector(args.fault_plan)
         beat = (HeartbeatSender(hb_addr, f"proc{jax.process_index()}")
                 if hb_addr else None)
         try:
@@ -179,8 +185,25 @@ def _serve_multihost(master, args) -> int:
             else:
                 # with a cross-process placement this replays every
                 # engine step; without one no step ops ever arrive and
-                # the loop just blocks until the coordinator's stop
-                engine.run_follower_loop(client)
+                # the loop just blocks until the coordinator's stop.
+                # Liveness deadline: a coordinator that dies between
+                # ops (no FIN) used to hang this process in recv()
+                # forever — quiet intervals now re-check the heartbeat
+                # channel (the monitor lives in the coordinator
+                # process) and exit with a clear error when it is gone
+                # the window must cover the sender's worst-case quiet
+                # gap (a monitor blip parks the sender in a capped
+                # backoff sleep — it is NOT evidence the coordinator
+                # died), else the two features defeat each other
+                hb_window = max(args.heartbeat_timeout,
+                                beat.worst_case_gap_s
+                                if beat is not None else 5.0)
+                engine.run_follower_loop(
+                    client,
+                    op_timeout_s=hb_window if beat is not None else None,
+                    liveness=(
+                        (lambda: beat.alive_within(hb_window))
+                        if beat is not None else None))
         finally:
             if beat is not None:
                 beat.close()
@@ -329,6 +352,15 @@ def main(argv=None) -> int:
             "int8 / --kv-host-pages apply to engine serving (--api); "
             "one-shot generation uses the sequential generator's "
             "dense cache")
+    if getattr(args, "fault_plan", None) \
+            or getattr(args, "recovery", None) is not None:
+        # the fault plane's sites and the recovery loop live in the
+        # serving engine; a one-shot generation injecting nothing
+        # would read as "chaos found no bugs" — be loud instead
+        logging.getLogger(__name__).warning(
+            "--fault-plan / --recovery apply to engine serving "
+            "(--api); one-shot generation dispatches no engine steps "
+            "to inject into or recover")
 
     if args.model_type.value == "image":
         count = [0]

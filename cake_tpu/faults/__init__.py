@@ -1,0 +1,24 @@
+"""Deterministic fault injection for the serving stack (cake_tpu/faults).
+
+A ``--fault-plan`` spec names WHERE (sites threaded through the hot
+paths), WHEN (nth call / step count / seeded probability / always) and
+WHAT (transient, simulated OOM, simulated wedge) should fail — so every
+chaos experiment is reproducible from its command line and no test ever
+monkeypatches engine internals to simulate a crash. Disabled (no plan)
+the plane is a single ``is not None`` test per site.
+
+See plan.py for the spec grammar and injector.py for runtime semantics.
+"""
+
+from cake_tpu.faults.injector import FaultInjector, build_injector
+from cake_tpu.faults.plan import (
+    ERRORS, SITES, TRIGGERS, FaultPlan, FaultRule, InjectedFault,
+    InjectedOOM, InjectedTransient, InjectedWedge,
+)
+
+__all__ = [
+    "ERRORS", "SITES", "TRIGGERS",
+    "FaultInjector", "FaultPlan", "FaultRule",
+    "InjectedFault", "InjectedOOM", "InjectedTransient", "InjectedWedge",
+    "build_injector",
+]

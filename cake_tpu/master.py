@@ -46,11 +46,14 @@ class Master:
             return cls(args, image_generator=ctx.load_image_model())
         return cls(args, text_generator=ctx.load_text_model())
 
-    def make_engine(self, max_slots: Optional[int] = None):
+    def make_engine(self, max_slots: Optional[int] = None,
+                    **engine_kwargs):
         """Build a continuous-batching engine sharing the loaded LLM's
         params (no weight copy; the engine allocates its own batched KV
         cache). Used by the REST server so N requests decode together
         instead of serialising on a lock like the reference (api/text.rs:67).
+        engine_kwargs pass through to InferenceEngine on every flavor
+        (e.g. recovery_config for crash-recovery tuning).
         """
         if self.llm is None:
             raise RuntimeError("no text generator loaded")
@@ -103,11 +106,13 @@ class Master:
                 spec_gamma=g.gamma,
                 **self._trace_kwargs(),
                 **self._sched_kwargs(),
+                **self._fault_kwargs(),
                 # passed through so the engine's own guard WARNS that
                 # multi-step scans don't apply in speculative mode
                 # (each round already advances up to gamma+1 tokens),
                 # instead of the flag silently vanishing
                 decode_scan_steps=self.args.decode_scan,
+                **engine_kwargs,
             )
         fwd = getattr(g, "_forward_fn", None)
         if fwd is not None and g.parallel is None:
@@ -159,10 +164,12 @@ class Master:
                 prompt_limit=ctx_len, decode_budget=tail_len,
                 **self._trace_kwargs(),
                 **self._sched_kwargs(),
+                **self._fault_kwargs(),
                 # passed through so the engine's no-chunk-fn guard WARNS
                 # that --prefill-chunk has no sp variant, instead of the
                 # flag silently vanishing
                 prefill_chunk=getattr(self.args, "prefill_chunk", None),
+                **engine_kwargs,
             )
         slots = max_slots or getattr(self.args, "max_slots", 8)
         kwargs = {}
@@ -227,7 +234,9 @@ class Master:
             mixed_batch=getattr(self.args, "mixed_batch", "auto"),
             **self._trace_kwargs(),
             **self._sched_kwargs(),
+            **self._fault_kwargs(),
             **kwargs,
+            **engine_kwargs,
         )
 
     def _trace_kwargs(self) -> dict:
@@ -251,6 +260,16 @@ class Master:
                                      False),
             preemption=getattr(self.args, "preemption", None),
             shed=getattr(self.args, "shed", False),
+        )
+
+    def _fault_kwargs(self) -> dict:
+        """Fault-injection + crash-recovery knobs (--fault-plan /
+        --recovery), plumbed to every engine flavor; the engine warns
+        and keeps the legacy fail-all path where the resume fold does
+        not exist (speculative, windowed ctx+tail layouts)."""
+        return dict(
+            fault_plan=getattr(self.args, "fault_plan", None),
+            recovery=getattr(self.args, "recovery", None),
         )
 
     # -- text ----------------------------------------------------------------

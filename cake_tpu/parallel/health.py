@@ -42,6 +42,14 @@ _WATCHDOG_STALLS = obs_metrics.counter(
     "Progress-watchdog stall detections (engine stopped advancing "
     "with active requests)")
 
+# reconnect storms are the classic monitor-restart failure mode; the
+# counter makes a flapping heartbeat channel visible per worker
+_HEARTBEAT_RECONNECTS = obs_metrics.counter(
+    "cake_heartbeat_reconnects_total",
+    "Heartbeat-sender reconnection attempts after a lost or refused "
+    "monitor connection, by worker",
+    labelnames=("worker",))
+
 
 # -- device probe ------------------------------------------------------------
 
@@ -194,30 +202,90 @@ class HeartbeatMonitor:
 
 class HeartbeatSender:
     """Worker-side pinger: connects to the monitor and sends `name\\n`
-    every interval_s from a daemon thread until close()."""
+    every interval_s from a daemon thread until close().
 
-    def __init__(self, address: str, name: str, interval_s: float = 2.0):
+    CONNECT_TIMEOUT_S bounds each (re)dial; worst_case_gap_s budgets
+    it, so raising one without the other cannot silently shrink the
+    follower liveness window below the sender's real quiet gap.
+
+    Reconnects back off exponentially (capped, with seeded per-worker
+    jitter): a restarted monitor on a large fleet used to get every
+    sender re-dialing in interval_s lockstep — a thundering herd right
+    when the coordinator is busiest coming back. The jitter stream is
+    seeded from the worker name, so a chaos run's reconnect schedule
+    is reproducible."""
+
+    CONNECT_TIMEOUT_S = 5.0
+
+    def __init__(self, address: str, name: str, interval_s: float = 2.0,
+                 max_backoff_s: float = 30.0):
+        import random as _random
+
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._name = name
         self._interval = interval_s
+        self._max_backoff = max_backoff_s
+        self._failures = 0        # consecutive connect/send failures
+        self.reconnects = 0       # lifetime reconnect attempts
+        # deterministic per-worker jitter: same worker name -> same
+        # desynchronization offsets, run after run
+        self._rng = _random.Random(
+            int.from_bytes(name.encode()[:8].ljust(8, b"\0"), "big"))
+        # monotonic time of the last SUCCESSFUL send — the follower
+        # liveness probe (engine.run_follower_loop) reads it: the
+        # monitor lives in the coordinator process, so a recent
+        # successful send proves the peer is up
+        self._last_ok: float = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"cake-heartbeat-{name}")
         self._thread.start()
+
+    def alive_within(self, threshold_s: float) -> bool:
+        """True when a heartbeat send succeeded within threshold_s —
+        evidence the monitor (and so the coordinator process hosting
+        it) is alive."""
+        return (self._last_ok > 0
+                and time.monotonic() - self._last_ok < threshold_s)
+
+    @property
+    def worst_case_gap_s(self) -> float:
+        """Upper bound on the quiet gap between SUCCESSFUL sends while
+        the monitor stays reachable: one send interval, plus a full
+        backoff sleep at the cap with its 1.5x jitter, plus one
+        connect timeout. A liveness threshold below this misreads a
+        sender mid-backoff (monitor blipped, already back) as a dead
+        coordinator."""
+        return (self._interval + 1.5 * self._max_backoff
+                + self.CONNECT_TIMEOUT_S)
 
     def _run(self) -> None:
         sock = None
         while not self._stop.is_set():
             try:
                 if sock is None:
-                    sock = socket.create_connection(self._addr, timeout=5.0)
+                    if self._failures:
+                        self.reconnects += 1
+                        _HEARTBEAT_RECONNECTS.labels(
+                            worker=self._name).inc()
+                    sock = socket.create_connection(
+                        self._addr, timeout=self.CONNECT_TIMEOUT_S)
                 sock.sendall(f"{self._name}\n".encode())
+                self._failures = 0
+                self._last_ok = time.monotonic()
+                self._stop.wait(self._interval)
             except OSError:
                 if sock is not None:
                     sock.close()
                     sock = None
-            self._stop.wait(self._interval)
+                self._failures += 1
+                # capped exponential backoff + jitter: spread the
+                # fleet's re-dials instead of stampeding the monitor
+                delay = min(self._max_backoff,
+                            self._interval * (2.0 ** (self._failures - 1)))
+                delay *= 0.5 + self._rng.random()   # 0.5x..1.5x
+                self._stop.wait(delay)
         if sock is not None:
             sock.close()
 

@@ -1,3 +1,11 @@
 from cake_tpu.serve.engine import EngineStats, InferenceEngine, RequestHandle
+from cake_tpu.serve.errors import (
+    EngineRequestError, EngineResetError, PoisonRequestError,
+    RecoveryConfig,
+)
 
-__all__ = ["InferenceEngine", "RequestHandle", "EngineStats"]
+__all__ = [
+    "InferenceEngine", "RequestHandle", "EngineStats",
+    "EngineRequestError", "EngineResetError", "PoisonRequestError",
+    "RecoveryConfig",
+]

@@ -59,17 +59,6 @@ def _send_msg(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n:
-        part = sock.recv(n)
-        if not part:
-            return None  # peer closed
-        chunks.append(part)
-        n -= len(part)
-    return b"".join(chunks)
-
-
 class ControlServer:
     """Coordinator side: accepts one connection per follower, then
     `publish()`es each op to all of them in dispatch order (TCP keeps
@@ -99,6 +88,10 @@ class ControlServer:
         self._accept_timeout = accept_timeout
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
+        # deterministic fault injection (cake_tpu/faults): the engine's
+        # attach_control points this at its injector so a --fault-plan
+        # can fail the op publish exactly like a dead follower would
+        self.faults = None
 
     @property
     def port(self) -> int:
@@ -163,6 +156,8 @@ class ControlServer:
     def publish(self, op: dict) -> None:
         """Send one op to every follower. Called from the engine thread
         immediately before it dispatches the corresponding device step."""
+        if self.faults is not None:
+            self.faults.check("control.publish")
         payload = json.dumps(op).encode()
         with self._lock:
             for conn in self._conns:
@@ -229,23 +224,50 @@ class ControlClient:
         raise ConnectionError(
             f"could not reach control server at {address}: {last}")
 
+    # follower-side fault injection point (cake_tpu/faults): cli wires
+    # the follower's --fault-plan here so a chaos run can fail an op
+    # receive exactly like a truncated stream would
+    faults = None
+    # partial-frame carry-over: bytes consumed before a recv() timeout
+    # are KEPT here and resumed by the next call — the liveness retry
+    # loop must never re-enter mid-frame and desync the op stream, and
+    # a coordinator that dies WITHOUT a FIN mid-frame must still hit
+    # the timeout (no unbounded blocking read anywhere)
+    _rbuf = b""
+
     def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
         """Next op, or None when the coordinator closed the channel.
-        With a timeout, raises socket.timeout if no op arrives in time
-        (used by the follower's failure-recovery wait)."""
+        With a timeout, raises socket.timeout when the wait for more
+        frame bytes exceeds it — whether the frame has started or not
+        (a mid-frame peer death with no FIN must not hang the
+        follower); partially-read bytes persist in _rbuf, so a retry
+        resumes the SAME frame instead of desyncing the stream."""
+        if self.faults is not None:
+            self.faults.check("control.recv")
+
+        def fill(n: int) -> bool:
+            """Grow _rbuf to n bytes; False = clean close. Timeouts
+            propagate with everything read so far preserved."""
+            while len(self._rbuf) < n:
+                part = self._sock.recv(n - len(self._rbuf))
+                if not part:
+                    return False
+                self._rbuf += part
+            return True
+
         self._sock.settimeout(timeout)
         try:
-            head = _recv_exact(self._sock, _LEN.size)
+            if not fill(_LEN.size):
+                return None
+            (n,) = _LEN.unpack(self._rbuf[:_LEN.size])
+            if n > MAX_OP_BYTES:
+                raise ValueError(f"oversized control op: {n} bytes")
+            if not fill(_LEN.size + n):
+                return None
         finally:
             self._sock.settimeout(None)
-        if head is None:
-            return None
-        (n,) = _LEN.unpack(head)
-        if n > MAX_OP_BYTES:
-            raise ValueError(f"oversized control op: {n} bytes")
-        payload = _recv_exact(self._sock, n)
-        if payload is None:
-            return None
+        payload = self._rbuf[_LEN.size:]
+        self._rbuf = b""
         return json.loads(payload)
 
     def close(self) -> None:

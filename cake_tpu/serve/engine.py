@@ -112,6 +112,32 @@ _SCHED_TTFT = obs_metrics.histogram(
     "wait and any preemption-induced requeues)",
     labelnames=("class",))
 
+# crash recovery (cake_tpu/faults + _attempt_recovery): the observables
+# behind the "one transient fault must not wipe a batch" contract —
+# recovery outcomes, requests carried across a reset, and requests
+# quarantined as poison so their batch could recover
+_RECOVERIES = obs_metrics.counter(
+    "cake_engine_recoveries_total",
+    "Engine step-failure recovery attempts by outcome (recovered = "
+    "reset + in-flight requests resubmitted; storm_breaker = too many "
+    "resets in the window, snapshot + clean stop; reset_failed = the "
+    "rebuild itself failed, engine stopped)",
+    labelnames=("outcome",))
+_RECOVERED_REQUESTS = obs_metrics.counter(
+    "cake_requests_recovered_total",
+    "In-flight requests carried across an engine reset via the "
+    "fold-tokens-into-prompt resubmit (no client-visible failure)")
+_POISON_REQUESTS = obs_metrics.counter(
+    "cake_poison_requests_total",
+    "Requests quarantined with a typed non-retryable error, by reason "
+    "(implicated = present in implication_budget consecutive failed "
+    "steps; resubmit_failed = recovery could not requeue it)",
+    labelnames=("reason",))
+_RECOVERY_SECONDS = obs_metrics.histogram(
+    "cake_engine_recovery_seconds",
+    "Wall seconds from deciding to recover to every surviving request "
+    "requeued (backoff wait + cache rebuild + resubmission)")
+
 
 @dataclass
 class _Request:
@@ -141,6 +167,10 @@ class _Request:
     # times this request's slot has been reclaimed for a higher class
     priority: str = "standard"
     preemptions: int = 0
+    # crash-implication tracking (_attempt_recovery): consecutive
+    # failed steps this request was dispatched in; reset to 0 by any
+    # step that emits for it, quarantined as poison at the budget
+    crash_count: int = 0
     out_tokens: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
     # per emitted token: [(alt_token_id, alt_logprob), ...] top-N list
@@ -230,6 +260,12 @@ class EngineStats:
     # cake_kv_spill_total counters count pages)
     kv_spills: int = 0
     kv_restores: int = 0
+    # crash recovery (cake_tpu/faults): successful reset+resubmit
+    # cycles, requests carried across them, and requests quarantined
+    # as poison so the rest of their batch could recover
+    recoveries: int = 0
+    requests_recovered: int = 0
+    poisoned: int = 0
     # speculative engine mode: drafts offered / kept across all slots
     spec_proposed: int = 0
     spec_accepted: int = 0
@@ -287,6 +323,9 @@ class InferenceEngine:
         preemption: Optional[bool] = None,
         shed: bool = False,
         sched_config: Optional[SchedConfig] = None,
+        fault_plan: Optional[str] = None,
+        recovery: Optional[bool] = None,
+        recovery_config=None,
     ):
         self.config = config
         self.params = params
@@ -637,6 +676,44 @@ class InferenceEngine:
                 "windowed (ctx+tail) layouts cannot fold generated "
                 "tokens back into the prompt window")
             self._preemption = False
+        # crash recovery (the fail-everything replacement): on a step
+        # failure, snapshot-classify-reset-RESUBMIT the in-flight
+        # requests through the checkpoint fold-tokens-into-prompt path
+        # instead of failing them all. Auto-on wherever the fold works
+        # (the same flavors preemption can resume); speculative and
+        # windowed (ctx+tail) engines keep the legacy fail-all path.
+        from cake_tpu.serve.errors import RecoveryConfig
+        self._recovery_cfg = recovery_config or RecoveryConfig()
+        if recovery is None:
+            self._recover = can_preempt
+        else:
+            self._recover = bool(recovery)
+            if self._recover and not can_preempt:
+                log.warning(
+                    "crash recovery disabled: %s",
+                    "speculative serving has no recompute-resume fold"
+                    if self._spec else
+                    "windowed (ctx+tail) layouts cannot fold generated "
+                    "tokens back into the prompt window")
+                self._recover = False
+        # reset-storm breaker state: monotonic times of recent resets
+        # (recovered OR legacy), consecutive-reset counter for backoff,
+        # and a bounded recovery-latency log for bench --chaos
+        self._reset_times: List[float] = []
+        self._consec_resets = 0
+        self.recovery_seconds: List[float] = []
+        self._breaker_tripped = False
+        # deterministic fault injection (cake_tpu/faults): None without
+        # a --fault-plan — every site guard is then one attribute test
+        from cake_tpu.faults import build_injector
+        self._faults = build_injector(fault_plan)
+        if self._faults is not None:
+            log.warning("fault plan armed: %s",
+                        self._faults.plan.describe())
+        # rids dispatched in the CURRENT device step — the blast radius
+        # the recovery path implicates on failure (overwritten by every
+        # dispatch; a failure before any dispatch implicates nobody)
+        self._implicated: Sequence = ()
         self._shed = ShedController(self._sched_cfg) if shed else None
         # rank of a page-starved higher-class admission awaiting a
         # victim; consumed at the TOP of the next engine iteration (a
@@ -784,10 +861,17 @@ class InferenceEngine:
                 "multi-host control cannot be attached after prefix "
                 "registrations (registrations are not replayed)")
         self._control = control
+        # a --fault-plan with control.publish rules fires inside the
+        # channel itself, so the failure shape (publish raises) is the
+        # one a dead follower produces
+        if self._faults is not None:
+            control.faults = self._faults
         self._multihost = True
 
     def run_follower_loop(self, client,
-                          reset_wait_s: float = 120.0) -> None:
+                          reset_wait_s: float = 120.0,
+                          op_timeout_s: Optional[float] = None,
+                          liveness=None) -> None:
         """Non-coordinator side: replay the coordinator's op stream.
         Blocks until the coordinator publishes a stop or closes the
         channel. The engine thread is never started here — this process
@@ -799,7 +883,17 @@ class InferenceEngine:
         arrives within reset_wait_s, the failure was follower-local
         (asymmetric); the only safe move is to disconnect, which makes
         the coordinator's next publish raise and fail its requests
-        instead of hanging its next collective forever."""
+        instead of hanging its next collective forever.
+
+        op_timeout_s/liveness: the follower liveness deadline. A
+        coordinator that dies BETWEEN ops (kill -9, kernel panic —
+        no FIN ever arrives) used to hang this process in recv()
+        forever. With op_timeout_s set, each quiet interval re-checks
+        `liveness()` (cli wires it to the heartbeat channel: the
+        monitor lives in the coordinator process, so a sendall that
+        still succeeds proves the peer is up); a quiet interval with
+        liveness gone exits with a clear error instead of hanging. An
+        idle-but-alive coordinator just keeps the loop waiting."""
         import socket as _socket
 
         self._multihost = True
@@ -807,8 +901,17 @@ class InferenceEngine:
         failed = False
         while True:
             try:
-                op = client.recv(timeout=reset_wait_s if failed else None)
+                op = client.recv(
+                    timeout=reset_wait_s if failed else op_timeout_s)
             except (_socket.timeout, TimeoutError):
+                if not failed:
+                    if liveness is not None and liveness():
+                        continue    # quiet but provably alive: keep on
+                    log.error(
+                        "engine follower: no op for %.0fs and the "
+                        "coordinator shows no liveness; exiting "
+                        "instead of hanging the process", op_timeout_s)
+                    return
                 log.error("engine follower: op failed and no reset came "
                           "within %.0fs; disconnecting", reset_wait_s)
                 return
@@ -898,9 +1001,12 @@ class InferenceEngine:
         including this delta. The handle's wait()/text() gives the
         blocking interface."""
         if self._stop.is_set():
-            # post-stop submits (e.g. an HTTP handler racing shutdown) must
-            # not mutate state under a checkpoint snapshot
-            raise RuntimeError("engine stopped")
+            # post-stop submits (e.g. an HTTP handler racing shutdown)
+            # must not mutate state under a checkpoint snapshot; typed
+            # + retryable so the API can 503 (a stopped engine is a
+            # restart away from serving this same request)
+            from cake_tpu.serve.errors import EngineResetError
+            raise EngineResetError("engine stopped")
         # validate the class EVERY time (unknown values must 400 at the
         # API); the class only orders admission when the SLO scheduler
         # is on, but it always labels the TTFT histogram
@@ -1472,6 +1578,11 @@ class InferenceEngine:
                     self._wake.wait(timeout=0.02)
                     self._wake.clear()
             try:
+                if self._faults is not None:
+                    # chaos plane, top-of-iteration site (step= triggers
+                    # key off the engine step counter)
+                    self._faults.check("engine.step",
+                                       step=self.stats.steps)
                 if self._mixed:
                     self._do_mixed(prefill_plan, decode_plan)
                 elif prefill_plan and not self._multihost:
@@ -1497,15 +1608,22 @@ class InferenceEngine:
                     # capture must not resurrect already-errored
                     # requests in a later fatal's snapshot
                     self._fail_recs = None
+                if self._consec_resets:
+                    # a successful iteration ends the reset episode:
+                    # the next failure backs off from scratch
+                    self._consec_resets = 0
+                # the iteration's dispatches all landed: a failure in
+                # the NEXT iteration before any dispatch (engine.step
+                # site, planning/admission code) must implicate nobody
+                # — not this iteration's requests
+                self._implicated = ()
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
                 # capture the request records FIRST (cheap, pure
-                # Python), fail the clients immediately (the reset
-                # publish below can block for minutes against a
-                # network-partitioned follower's full TCP buffer — the
-                # waiters must not wait behind it), and only if the
-                # publish proves the failure fatal write the captured
-                # records as the pre-fail snapshot. Transient
+                # Python — the reset publish below can block for
+                # minutes against a network-partitioned follower's
+                # full TCP buffer), and only if the failure proves
+                # fatal write them as the pre-fail snapshot. Transient
                 # reset-and-continue errors write nothing: a stale
                 # snapshot would resurrect long-errored requests after
                 # a later unclean exit.
@@ -1520,42 +1638,299 @@ class InferenceEngine:
                     # then the registry is empty, so the monitor's
                     # snapshot falls back to this capture
                     self._fail_recs = (time.monotonic(), recs)
-                self._fail_all(e)
-                fatal = False
-                try:
-                    self._publish({"op": "reset"})
-                except Exception:  # noqa: BLE001
-                    # followers unreachable: the SPMD mesh is no longer
-                    # fully driven — stop serving instead of hanging
-                    # the next collective
-                    log.exception("control publish failed; stopping")
-                    fatal = True
-                if fatal:
-                    with self._ckpt_lock:
-                        self._snapshot_before_fail(requests=recs)
-                    self._stop.set()
+                if not self._continue_after_failure(e, recs):
                     return
-                try:
-                    self._reset_after_error()
-                except Exception:  # noqa: BLE001
-                    # the rebuild itself failed (OOM rebuilding the
-                    # cache, a dead device): the engine cannot serve
-                    # again — snapshot what the first failure captured
-                    # and stop CLEANLY, instead of the raise silently
-                    # killing the thread with no checkpoint and no
-                    # metric (the API would 200 /health while every
-                    # request hangs in the queue forever)
-                    log.exception("post-error engine reset failed; "
-                                  "stopping the engine")
-                    _RESET_FAILURES.inc()
-                    self.stats.errors += 1
-                    self.stats.last_error = "reset failed"
-                    with self._ckpt_lock:
-                        self._snapshot_before_fail(requests=recs)
-                    self._stop.set()
-                    return
-                self.stats.errors += 1
-                self.stats.last_error = f"{type(e).__name__}: {e}"
+
+    # -- crash recovery (cake_tpu/faults + the fail-everything fix) ------
+
+    def _note_reset(self) -> bool:
+        """Record one reset in the storm window; True = the breaker
+        trips (too many resets in storm_window_s: the fault is not
+        transient, stop cleanly instead of thrashing)."""
+        cfg = self._recovery_cfg
+        now = time.monotonic()
+        self._reset_times.append(now)
+        cut = now - cfg.storm_window_s
+        while self._reset_times and self._reset_times[0] < cut:
+            self._reset_times.pop(0)
+        return (self._recover
+                and len(self._reset_times) >= cfg.storm_resets)
+
+    def _continue_after_failure(self, e: Exception, recs) -> bool:
+        """Post-failure policy: transparent recovery (reset + resubmit
+        the in-flight requests), or the legacy fail-everything path
+        (recovery off / flavor without the fold), or — on a reset
+        storm — breaker-open snapshot + clean stop. Returns False when
+        the engine must stop."""
+        from cake_tpu.serve.errors import as_engine_error
+        storm = self._note_reset()
+        if self._recover and not storm and not self._stop.is_set():
+            return self._attempt_recovery(e, recs)
+        err = as_engine_error(e)
+        if storm:
+            log.error("reset storm: %d resets within %.0fs — breaker "
+                      "open; snapshotting in-flight requests and "
+                      "stopping cleanly", len(self._reset_times),
+                      self._recovery_cfg.storm_window_s)
+            self._breaker_tripped = True
+            _RECOVERIES.labels(outcome="storm_breaker").inc()
+            self.stats.errors += 1
+            self.stats.last_error = f"{type(e).__name__}: {e}"
+            return self._stop_with_snapshot(recs, err)
+        # legacy fail-everything: release the waiters FIRST (the reset
+        # publish can block for minutes against a network-partitioned
+        # follower's full TCP buffer), then prove the mesh is still
+        # drivable, then rebuild
+        self._fail_all(err)
+        fatal = False
+        try:
+            self._publish({"op": "reset"})
+        except Exception:  # noqa: BLE001
+            # followers unreachable: the SPMD mesh is no longer fully
+            # driven — stop serving instead of hanging the next
+            # collective
+            log.exception("control publish failed; stopping")
+            fatal = True
+        if fatal:
+            return self._stop_with_snapshot(recs)
+        try:
+            self._reset_after_error()
+        except Exception:  # noqa: BLE001
+            # the rebuild itself failed (OOM rebuilding the cache, a
+            # dead device): the engine cannot serve again — snapshot
+            # what the first failure captured and stop CLEANLY,
+            # instead of the raise silently killing the thread with no
+            # checkpoint and no metric (the API would 200 /health
+            # while every request hangs in the queue forever)
+            log.exception("post-error engine reset failed; "
+                          "stopping the engine")
+            _RESET_FAILURES.inc()
+            self.stats.errors += 1
+            self.stats.last_error = "reset failed"
+            return self._stop_with_snapshot(recs)
+        self.stats.errors += 1
+        self.stats.last_error = f"{type(e).__name__}: {e}"
+        return True
+
+    def _stop_with_snapshot(self, recs,
+                            err: Optional[Exception] = None) -> bool:
+        """The unrecoverable-failure tail shared by every stop branch:
+        fail any still-waiting clients FIRST (omitted when the caller
+        already released them), persist the pre-fail capture, stop the
+        engine thread. Always returns False — the
+        _continue_after_failure 'engine must stop' contract — so
+        callers can `return self._stop_with_snapshot(...)`."""
+        if err is not None:
+            self._fail_all(err)
+        # best-effort stop op: a breaker/reset-failed stop leaves this
+        # PROCESS alive (the API keeps serving 503s, heartbeats keep
+        # answering), so followers would otherwise wait forever on a
+        # healthy channel that carries no more ops — their liveness
+        # deadline cannot see an engine-only death. Safe to publish
+        # here: this runs on the engine thread just before its loop
+        # exits, so no step op can follow it on the wire.
+        try:
+            self._publish({"op": "stop"})
+        except Exception:  # noqa: BLE001
+            log.warning("control: stop publish failed (followers will "
+                        "exit on channel close)")
+        with self._ckpt_lock:
+            self._snapshot_before_fail(requests=recs)
+        self._stop.set()
+        return False
+
+    def _attempt_recovery(self, e: Exception, recs) -> bool:
+        """The fail-everything replacement: implicate the failing
+        dispatch's requests, publish the reset (multi-host followers
+        replay it so the SPMD programs line up), back off if resets
+        are consecutive, rebuild device state, then RESUBMIT every
+        surviving request through the checkpoint fold-tokens-into-
+        prompt path — greedy streams complete token-identical across
+        the crash. Returns False when the engine must stop."""
+        from cake_tpu.serve.errors import as_engine_error
+        t0 = time.perf_counter()
+        implicated = [rid for rid, _slot in self._implicated]
+        self._implicated = ()
+        for rid in implicated:
+            req = self._requests.get(rid)
+            if req is not None and not req.done.is_set():
+                req.crash_count += 1
+        try:
+            self._publish({"op": "reset"})
+        except Exception:  # noqa: BLE001
+            log.exception("control publish failed; stopping")
+            return self._stop_with_snapshot(recs, as_engine_error(e))
+        # exponential backoff between CONSECUTIVE resets (the first is
+        # immediate): a persistent fault must not spin the engine
+        # thread through rebuild loops at full speed. Interruptible —
+        # a stop() during the wait still tears down promptly.
+        cfg = self._recovery_cfg
+        self._consec_resets += 1
+        if self._consec_resets > 1:
+            delay = min(cfg.backoff_cap_s,
+                        cfg.backoff_base_s
+                        * (2.0 ** (self._consec_resets - 2)))
+            log.warning("recovery: consecutive reset #%d, backing off "
+                        "%.2fs", self._consec_resets, delay)
+            if self._stop.wait(delay):
+                self._fail_all(as_engine_error(e))
+                return False
+        try:
+            self._reset_after_error()
+        except Exception:  # noqa: BLE001
+            log.exception("post-error engine reset failed; "
+                          "stopping the engine")
+            _RESET_FAILURES.inc()
+            _RECOVERIES.labels(outcome="reset_failed").inc()
+            self.stats.errors += 1
+            self.stats.last_error = "reset failed"
+            return self._stop_with_snapshot(recs, as_engine_error(e))
+        n_rec, n_poison = self._resubmit_after_reset(e)
+        self.stats.errors += 1
+        self.stats.last_error = f"{type(e).__name__}: {e}"
+        self.stats.recoveries += 1
+        dt = time.perf_counter() - t0
+        _RECOVERY_SECONDS.observe(dt)
+        if len(self.recovery_seconds) < 512:
+            self.recovery_seconds.append(dt)
+        _RECOVERIES.labels(outcome="recovered").inc()
+        log.warning("recovered from step failure (%s: %s): %d "
+                    "request(s) resubmitted, %d quarantined, %.3fs",
+                    type(e).__name__, e, n_rec, n_poison, dt)
+        self._wake.set()
+        return True
+
+    def _resubmit_after_reset(self, cause: Exception):
+        """Rebuild the request-side bookkeeping after a reset:
+        quarantine poison requests (implicated in implication_budget
+        consecutive failed steps), requeue everyone else with their
+        generated tokens folded into the prompt — priority class,
+        seniority (SLO requeue) and preempt budget all survive because
+        the SAME _Request object is resubmitted. Engine thread only.
+        Returns (resubmitted, quarantined) counts."""
+        from cake_tpu.serve.errors import (
+            PoisonRequestError, as_engine_error,
+        )
+        cfg = self._recovery_cfg
+        cause_s = f"{type(cause).__name__}: {cause}"
+        # every slot mapping died with the rebuilt cache (the paged
+        # reset already rebuilt pager/table/pending; dense slots are
+        # only this list)
+        self._slot_req = [None] * self.max_slots
+        self._page_blocked_rid = None
+        self._pending_page_preempt = None
+        if not self.paged:
+            self._mixed_pending.clear()
+        n_rec = n_poison = 0
+        for rid, req in sorted(self._requests.items()):
+            if req.done.is_set():
+                continue
+            req.slot = -1
+            req._kv_restored = False
+            if req.crash_count >= cfg.implication_budget:
+                self._drop_request(
+                    req, PoisonRequestError(rid, req.crash_count,
+                                            cause_s),
+                    poison_reason="implicated")
+                n_poison += 1
+                continue
+            remaining = req.max_new_tokens - len(req.out_tokens)
+            if remaining <= 0:
+                # was retiring in the failed step — it already holds
+                # every token it asked for; finish it normally
+                self._finish_recovered(req)
+                n_rec += 1
+                continue
+            n_tok = len(req.prompt_ids) + len(req.out_tokens)
+            if self._slo:
+                # requeue preserves the original enqueue time
+                # (seniority) and the preemption count; False just
+                # means the request was still QUEUED — nothing to do
+                self.scheduler.requeue(rid, n_tok, remaining)
+                ok = True
+            else:
+                # FIFO scheduler has no requeue: cancel + resubmit in
+                # rid order restores the original arrival order
+                self.scheduler.cancel(rid)
+                ok = self.scheduler.submit(rid, n_tok, remaining)
+            if not ok:
+                self._drop_request(req, as_engine_error(cause),
+                                   poison_reason="resubmit_failed")
+                n_poison += 1
+                continue
+            self.tracer.span(rid, "crash_recovered",
+                             generated=len(req.out_tokens),
+                             crashes=req.crash_count)
+            _RECOVERED_REQUESTS.inc()
+            self.stats.requests_recovered += 1
+            n_rec += 1
+        return n_rec, n_poison
+
+    def _drop_request(self, req: _Request, err: Exception,
+                      poison_reason: Optional[str] = None) -> None:
+        """Fail ONE request with a typed error during recovery
+        (quarantine / resubmit failure) — the per-request sibling of
+        _fail_all's teardown. Engine thread only; slots were already
+        cleared by the reset."""
+        req.error = err
+        self.scheduler.cancel(req.rid)
+        if self._host_tier is not None:
+            self._host_tier.drop(("victim", req.rid))
+        self._requests.pop(req.rid, None)
+        if poison_reason is not None:
+            self.stats.poisoned += 1
+            _POISON_REQUESTS.labels(reason=poison_reason).inc()
+            log.error("quarantined rid=%d as poison (%s): %s",
+                      req.rid, poison_reason, err)
+        self.tracer.finish(req.rid, "error", error=str(err),
+                           output_tokens=len(req.out_tokens))
+        req.done.set()
+
+    def _finish_recovered(self, req: _Request) -> None:
+        """Retire a request whose budget was already exhausted when
+        the step failed: it has every token it asked for — deliver the
+        final delta instead of resubmitting a zero-budget prefill."""
+        if req.stream is not None:
+            delta = self._incremental_text(req, final=True)
+            try:
+                if req.stream_wants_count:
+                    req.stream(delta, True, len(req.out_tokens))
+                else:
+                    req.stream(delta, True)
+            except Exception:  # noqa: BLE001
+                log.exception("stream callback failed rid=%d", req.rid)
+        req.finish_t = time.perf_counter()
+        self.scheduler.cancel(req.rid)
+        self._requests.pop(req.rid, None)
+        self.stats.requests_completed += 1
+        if self._shed is not None:
+            # a retirement like any other: the shed controller's
+            # measured service rate must count it, or post-recovery
+            # Retry-After estimates inflate
+            self._shed.observe_retire()
+        self.tracer.finish(req.rid, "retired",
+                           output_tokens=len(req.out_tokens))
+        req.done.set()
+
+    def recovery_state(self) -> dict:
+        """Recovery/breaker introspection for /api/v1/health."""
+        cfg = self._recovery_cfg
+        out = {
+            "enabled": self._recover,
+            "recoveries": self.stats.recoveries,
+            "requests_recovered": self.stats.requests_recovered,
+            "poisoned": self.stats.poisoned,
+            "consecutive_resets": self._consec_resets,
+            "breaker": {
+                "tripped": self._breaker_tripped,
+                "resets_in_window": len(self._reset_times),
+                "storm_resets": cfg.storm_resets,
+                "window_s": cfg.storm_window_s,
+            },
+        }
+        if self._faults is not None:
+            out["fault_plan"] = self._faults.describe()
+        return out
 
     def _reset_after_error(self) -> None:
         # the jitted steps donate the cache/keys/ring buffers; after a
@@ -1774,6 +2149,11 @@ class InferenceEngine:
             return False
         from cake_tpu.kv.host_tier import SpilledPages
         try:
+            if self._faults is not None:
+                # inside the try: an injected fetch fault exercises the
+                # documented degradation (fall back to recompute)
+                self._faults.check("host_tier.fetch",
+                                   step=self.stats.steps)
             arrays = self._host_tier.fetch_pages(self.cache, own)
         except Exception:  # noqa: BLE001 — spill is an optimization
             log.exception("victim spill failed; falling back to "
@@ -1825,6 +2205,10 @@ class InferenceEngine:
         large one forever (the requeue path re-enters the scheduler's
         FIFO at the tail, preserving relative order across cycles)."""
         from cake_tpu.models.llama.paged import table_set_slot
+        if self._faults is not None:
+            # chaos site for the admission allocator (an injected OOM
+            # here surfaces exactly like a real allocation failure)
+            self._faults.check("pager.alloc", step=self.stats.steps)
         blocked = getattr(self, "_page_blocked_rid", None)
         if blocked is not None and blocked not in self._requests:
             blocked = self._page_blocked_rid = None  # cancelled/failed
@@ -1925,6 +2309,12 @@ class InferenceEngine:
         validated entry _alloc_slot_pages already popped from the
         host tier."""
         from cake_tpu.kv.host_tier import HostTier
+        if self._faults is not None:
+            # an injected install fault propagates into the iteration
+            # failure — the recovery path resubmits the victim through
+            # the recompute fold (the entry was already popped)
+            self._faults.check("host_tier.install",
+                               step=self.stats.steps)
         self.cache = HostTier.install_pages(self.cache, pages,
                                             ent.arrays)
         self._temp[slot] = req.temperature
@@ -1965,6 +2355,9 @@ class InferenceEngine:
             if not self._host_tier.can_hold(len(pages)):
                 continue
             try:
+                if self._faults is not None:
+                    self._faults.check("host_tier.fetch",
+                                       step=self.stats.steps)
                 arrays = self._host_tier.fetch_pages(self.cache, pages)
             except Exception:  # noqa: BLE001 — spill is optional
                 log.exception("cold prefix spill failed (pid=%d)", pid)
@@ -2010,6 +2403,9 @@ class InferenceEngine:
         pages = self._pager.alloc(ent.n_pages * self._pager.page_size)
         if pages is None:
             return None
+        if self._faults is not None:
+            self._faults.check("host_tier.install",
+                               step=self.stats.steps)
         ent = self._host_tier.pop(("prefix", pid))
         self.cache = HostTier.install_pages(self.cache, pages,
                                             ent.arrays)
@@ -2088,6 +2484,13 @@ class InferenceEngine:
             # state as an uninterrupted run would have them
             ids = list(req.prompt_ids) + list(req.out_tokens)
             prime = list(req.prime_tokens) + list(req.out_tokens)
+        # this admission is the failure blast radius from here on; the
+        # fault site carries the prefill length so match_len= rules can
+        # target one request's prefill (the poison-request drill)
+        self._implicated = ((rid, slot),)
+        if self._faults is not None:
+            self._faults.check("engine.prefill", step=self.stats.steps,
+                               n_tokens=len(ids))
         # match BEFORE page admission: a paged prefix hit changes the
         # allocation itself (suffix + budget pages only, prefix pages
         # mapped shared)
@@ -2165,6 +2568,14 @@ class InferenceEngine:
         pend_js = []   # each admission's _JitStep, in pend order
 
         def flush():
+            # the whole GROUP is the failure blast radius: a deferred
+            # prefill error (dispatched async above) materializes at
+            # this device_get, after later admissions overwrote the
+            # per-admission _implicated — without this, an organic
+            # poison prefill would charge its crash to whichever
+            # admission happened to defer last
+            self._implicated = tuple(
+                (req.rid, slot) for (req, _t0, slot, _dev) in pend)
             hosts = jax.device_get([dev for (_, _, _, dev) in pend])
             # one wall-clock interval per GROUP: the admissions overlap
             # (dispatched back to back, fetched together), so summing
@@ -2273,6 +2684,11 @@ class InferenceEngine:
             # precedent, serve/checkpoint.resume semantics)
             ids = list(req.prompt_ids) + list(req.out_tokens)
             prime = list(req.prime_tokens) + list(req.out_tokens)
+        # blast radius + content-keyed fault site (see _do_prefill)
+        self._implicated = ((rid, slot),)
+        if self._faults is not None:
+            self._faults.check("engine.prefill", step=self.stats.steps,
+                               n_tokens=len(ids))
         hit = (self._match_and_validate_prefix(ids)
                if self._prefix_capable else None)
         if self.paged and not self._alloc_slot_pages(req, slot, hit):
@@ -2306,6 +2722,14 @@ class InferenceEngine:
         their prompt sample their first token from the same launch the
         decode rows sample their next."""
         t0 = time.perf_counter()
+        # blast radius: every decode row AND every mid-prefill slot
+        # rides this one launch
+        self._implicated = tuple(
+            [(rid, slot) for rid, slot in decode_plan]
+            + [(p["req"].rid, slot)
+               for slot, p in self._mixed_pending.items()])
+        if self._faults is not None:
+            self._faults.check("engine.mixed", step=self.stats.steps)
         B, C = self.max_slots, self._mixed_chunk
         tokens = np.zeros((B, C), np.int64)
         pos = np.zeros(B, np.int64)
@@ -2642,6 +3066,9 @@ class InferenceEngine:
         latency feature; the engine's win is CONCURRENCY — many clients
         speculate together — plus API streaming and checkpoint/resume
         composition."""
+        self._implicated = decode_plan
+        if self._faults is not None:
+            self._faults.check("engine.decode", step=self.stats.steps)
         from cake_tpu.models.llama.speculative import spec_round_batched
 
         t0 = time.perf_counter()
@@ -2779,6 +3206,9 @@ class InferenceEngine:
 
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()
+        self._implicated = decode_plan
+        if self._faults is not None:
+            self._faults.check("engine.decode", step=self.stats.steps)
         rows = [s for _, s in decode_plan]
         n_top = self._n_top_for(rows)
         self._publish({"op": "decode", "rows": rows, "n_top": n_top})
@@ -2872,6 +3302,9 @@ class InferenceEngine:
         (synchronous: dispatch, fetch, emit — the multi-host lockstep
         path; single-host serving uses _decode_burst instead)."""
         t0 = time.perf_counter()
+        self._implicated = decode_plan
+        if self._faults is not None:
+            self._faults.check("engine.decode", step=self.stats.steps)
         rows = [s for _, s in decode_plan]
         n_top = self._n_top_for(rows)
         budget = self._scan_budget(decode_plan, n)
@@ -2903,6 +3336,9 @@ class InferenceEngine:
         an earlier not-yet-fetched scan — lockstep multi-host serving
         keeps the synchronous _do_decode_scan path instead."""
         t0 = time.perf_counter()
+        self._implicated = decode_plan
+        if self._faults is not None:
+            self._faults.check("engine.decode", step=self.stats.steps)
         rows = [s for _, s in decode_plan]
         n_top = self._n_top_for(rows)
         # tokens dispatched in not-yet-fetched scans, per slot: added at
@@ -3141,6 +3577,10 @@ class InferenceEngine:
         else:
             self.tracer.token(req.rid)
         req.out_tokens.append(token_id)
+        if req.crash_count:
+            # a step that emits for this request succeeded: the crash
+            # implication is no longer CONSECUTIVE — forgiven
+            req.crash_count = 0
         self.stats.tokens_generated += 1
         eos = token_id in self.config.eos_token_ids
         hit_cap = (self._pos[req.slot] + 1 >= self.max_seq_len)
@@ -3188,6 +3628,10 @@ class InferenceEngine:
         # — a transient reset-and-continue error must not leave a stale
         # snapshot that resurrects long-errored requests after a later
         # unclean exit.
+        from cake_tpu.serve.errors import as_engine_error
+        # clients always see the TYPED form: a retryable engine reset
+        # maps to 503 + Retry-After at the API instead of a bare 500
+        err = as_engine_error(err)
         with self._ckpt_lock:
             if snapshot:
                 self._snapshot_before_fail()

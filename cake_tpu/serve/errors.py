@@ -1,0 +1,88 @@
+"""Typed request-failure errors + recovery tuning for the engine.
+
+Before this module, a request killed by an engine failure carried
+whatever raw exception happened to escape the step — the API could only
+map everything to a generic 500. Now every request failed by
+``_fail_all`` or the quarantine path carries an ``EngineRequestError``
+with an explicit ``retryable`` flag: the API maps retryable failures
+(transient engine resets, storm-breaker stops) to 503 + an honest
+computed Retry-After, and non-retryable ones (a poison request that
+kept crashing the step it was in) to a terminal client error.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class EngineRequestError(RuntimeError):
+    """Base class for engine-originated request failures.
+
+    retryable: True when the SAME request can reasonably be resubmitted
+    (the failure was the engine's state, not the request); the API
+    surfaces it as 503 + Retry-After instead of a 500."""
+
+    retryable = False
+
+    def __init__(self, msg: str, *, retryable=None):
+        super().__init__(msg)
+        if retryable is not None:
+            self.retryable = bool(retryable)
+
+
+class EngineResetError(EngineRequestError):
+    """The engine failed and reset (or stopped) out from under this
+    request — transient from the client's point of view: retry."""
+
+    retryable = True
+
+
+class PoisonRequestError(EngineRequestError):
+    """This request was implicated in `implication_budget` consecutive
+    failed steps and quarantined so the rest of the batch could
+    recover. NOT retryable: resubmitting the same request would crash
+    the engine again."""
+
+    retryable = False
+
+    def __init__(self, rid: int, crashes: int, cause: str):
+        super().__init__(
+            f"request {rid} quarantined after being implicated in "
+            f"{crashes} consecutive failed engine steps (poison "
+            f"request; last failure: {cause})")
+        self.rid = rid
+        self.crashes = crashes
+
+
+def as_engine_error(err: Exception) -> EngineRequestError:
+    """Wrap an arbitrary step failure in the typed, retryable-flagged
+    form clients see — idempotent for already-typed errors."""
+    if isinstance(err, EngineRequestError):
+        return err
+    return EngineResetError(
+        f"engine failure: {type(err).__name__}: {err}")
+
+
+@dataclass(frozen=True)
+class RecoveryConfig:
+    """Knobs for the crash-recovery loop (serve/engine._attempt_recovery).
+
+    implication_budget: a request implicated in this many CONSECUTIVE
+      failed steps is quarantined as poison (2 = one retry: the first
+      failure could be anyone's; a second with the same request in the
+      blast radius is on it).
+    backoff_base_s/backoff_cap_s: exponential backoff between
+      consecutive resets (first reset is immediate; the k-th waits
+      min(base * 2^(k-2), cap)) so a persistent fault cannot spin the
+      engine thread through reset storms at full speed.
+    storm_resets/storm_window_s: the reset-storm breaker — this many
+      resets inside the window means the fault is not transient:
+      snapshot in-flight requests and stop cleanly (the pre-recovery
+      behavior) instead of burning the pool forever.
+    """
+
+    implication_budget: int = 2
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 10.0
+    storm_resets: int = 5
+    storm_window_s: float = 60.0

@@ -241,3 +241,28 @@ def test_kv_tier_smoke_reports_capacity_win():
         assert result[f"kv_tok_s_{tag}"] > 0
         assert result[f"kv_spills_{tag}"] > 0
         assert result[f"kv_restores_{tag}"] > 0
+
+
+@pytest.mark.slow  # two engine phases under injected chaos -> slow lane
+def test_chaos_smoke_tier_recovers_without_losing_requests():
+    """The --chaos tier's acceptance contract: the injected transient
+    crashes cost ZERO requests — in-flight streams recover via the
+    fold-tokens-into-prompt resubmit (recovered > 0), the ONLY failed
+    request is the quarantined poison one (failed == quarantined), the
+    clean phase failed nothing, and at f32 KV the chaos phase's greedy
+    streams came back token-identical to the clean phase. A run where
+    recovery silently stopped engaging (or started failing bystanders)
+    benches the legacy fail-everything path and fails here."""
+    result = _run_tier("chaos_tiny")
+    assert result["unit"] == "requests" and result["value"] > 0
+    assert result["chaos_injections"] > 0
+    assert result["chaos_recoveries"] > 0
+    assert result["chaos_recovered"] > 0
+    # the poison request is the ONLY casualty
+    assert result["chaos_quarantined"] == 1
+    assert result["chaos_failed"] == result["chaos_quarantined"]
+    assert result["chaos_clean_failed"] == 0
+    # f32 KV: recovery is token-identical, not approximately-resumed
+    assert result["chaos_tokens_match"] is True
+    assert result["chaos_recovery_p50_ms"] > 0
+    assert result["chaos_recovery_p99_ms"] >= result["chaos_recovery_p50_ms"]

@@ -114,12 +114,15 @@ def test_server_restores_checkpoint_on_start(params, tmp_path):
     # produce a genuine interrupted-run snapshot (v2 fingerprints include a
     # params digest, so hand-written records can't fake one)
     eng0 = _engine(params).start()
-    h0 = eng0.submit(PROMPT, max_new_tokens=6)
+    # a budget the engine cannot finish between polls: with the jit
+    # cache warm from earlier modules, a 6-token request could retire
+    # inside one 10ms sleep, leaving nothing in flight to snapshot
+    h0 = eng0.submit(PROMPT, max_new_tokens=40)
     deadline = time.time() + 60
     while len(h0.token_ids) < 2 and time.time() < deadline:
-        time.sleep(0.01)
+        time.sleep(0.001)
     eng0.stop()
-    assert 0 < len(h0.token_ids) < 6
+    assert 0 < len(h0.token_ids) < 40
     path = tmp_path / "server.ckpt"
     checkpoint.save(eng0, str(path))
 

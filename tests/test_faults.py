@@ -1,0 +1,595 @@
+"""Deterministic fault injection (cake_tpu/faults) + crash recovery.
+
+Plan/injector units are pure Python (parse errors, seeded-trigger
+determinism, the disabled plane's no-op fast path). Engine acceptance
+pins the recovery contract: an injected transient crash mid-decode
+costs ZERO requests — every in-flight greedy stream completes
+token-identical at f32 KV to an uninjected run (dense AND paged with a
+shared-prefix slot), a poison request is quarantined after its
+implication budget while cohabitants recover, and a reset storm trips
+the breaker into a clean stop. Everything is driven through
+``fault_plan`` specs — no monkeypatching of engine internals. The API
+drill covers the typed-error surface: poison -> terminal 500, breaker
+/ stopped engine -> 503 + honest Retry-After, and an open SSE stream
+gets a terminal error event instead of a silent close.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax.numpy as jnp
+
+from cake_tpu.faults import FaultPlan, build_injector
+from cake_tpu.faults.plan import (
+    InjectedFault, InjectedOOM, InjectedTransient, InjectedWedge, SITES,
+)
+from cake_tpu.serve.errors import (
+    EngineResetError, PoisonRequestError, RecoveryConfig,
+)
+
+T = 64
+PAGE = 16
+
+
+# -- plan parsing ------------------------------------------------------------
+
+def test_parse_round_trip():
+    p = FaultPlan.parse("seed=42;engine.decode:nth=12:transient;"
+                        "control.publish:p=0.01:oom;"
+                        "engine.prefill:always:wedge:secs=0.5:times=3"
+                        ":match_len=17")
+    assert p.seed == 42 and len(p.rules) == 3
+    r0, r1, r2 = p.rules
+    assert (r0.site, r0.trigger, r0.value, r0.error) == (
+        "engine.decode", "nth", 12, "transient")
+    assert (r1.site, r1.trigger, r1.error) == (
+        "control.publish", "p", "oom")
+    assert r1.value == pytest.approx(0.01)
+    assert (r2.trigger, r2.error, r2.secs, r2.times, r2.match_len) == (
+        "always", "wedge", 0.5, 3, 17)
+    # describe() re-parses to the same plan (the health/bench echo is
+    # itself a valid spec)
+    again = FaultPlan.parse(p.describe())
+    assert again == p
+
+
+def test_parse_none_and_empty_mean_no_plan():
+    assert FaultPlan.parse(None) is None
+    assert FaultPlan.parse("") is None
+    assert FaultPlan.parse("   ") is None
+    assert build_injector(None) is None
+    assert build_injector("  ") is None
+
+
+@pytest.mark.parametrize("spec,frag", [
+    ("bogus.site:always:transient", "unknown site"),
+    ("engine.decode:transient", "needs a trigger"),
+    ("engine.decode:nth=3", "needs an error kind"),
+    ("engine.decode:nth=3:p=0.5:transient", "more than one trigger"),
+    ("engine.decode:nth=3:transient:oom", "more than one error"),
+    ("engine.decode:p=1.5:transient", "p must be in"),
+    ("engine.decode:p=oops:transient", "takes a number"),
+    ("engine.decode:nth=0:transient", "nth must be >= 1"),
+    ("engine.decode:always=5:transient", "takes no value"),
+    ("engine.decode:nth=3:transient:wat=1", "unknown field"),
+    ("engine.decode:nth=3:transient:times=0", "times must be >= 1"),
+    ("engine.decode:nth=3:transient:secs=-1", "secs must be >= 0"),
+    ("engine.decode", "at least site:trigger:error"),
+    ("seed=7", "seed but no rules"),
+    ("seed=x;engine.decode:nth=1:transient", "takes an integer"),
+    # context-keyed rules on sites that never supply that context
+    # would parse cleanly and then never fire — rejected loudly
+    ("control.publish:step=100:transient", "no engine step counter"),
+    ("control.recv:step=5:oom", "no engine step counter"),
+    ("engine.decode:nth=5:transient:match_len=96", "n_tokens"),
+    ("pager.alloc:always:oom:match_len=4", "n_tokens"),
+])
+def test_parse_rejects_malformed_rules(spec, frag):
+    with pytest.raises(ValueError, match=frag):
+        FaultPlan.parse(spec)
+
+
+def test_args_validate_rejects_malformed_plan():
+    from cake_tpu.args import Args
+    with pytest.raises(ValueError, match="unknown site"):
+        Args(fault_plan="bogus.site:always:transient").validate()
+    # a well-formed plan passes startup validation
+    Args(fault_plan="seed=1;engine.decode:nth=2:transient").validate()
+
+
+# -- injector triggers + determinism -----------------------------------------
+
+def _firings(spec, site, n, **ctx):
+    """Indices (0-based) of the calls to `site` that raised."""
+    inj = build_injector(spec)
+    fired = []
+    for i in range(n):
+        try:
+            inj.check(site, **ctx)
+        except InjectedFault:
+            fired.append(i)
+    return fired
+
+
+def test_nth_fires_on_exactly_the_nth_call():
+    assert _firings("engine.decode:nth=3:transient",
+                    "engine.decode", 10) == [2]
+
+
+def test_two_nth_rules_same_site_keep_their_call_indices():
+    """Every active rule counts every matching call even when an
+    earlier rule claimed it, so a second nth= rule fires on the call
+    its spec names — not one later per earlier firing."""
+    spec = "engine.decode:nth=5:transient;engine.decode:nth=6:oom"
+    inj = build_injector(spec)
+    fired = {}
+    for i in range(10):
+        try:
+            inj.check("engine.decode")
+        except InjectedFault as e:
+            fired[i] = type(e).__name__
+    assert fired == {4: "InjectedTransient", 5: "InjectedOOM"}
+
+
+def test_always_capped_by_times():
+    assert _firings("engine.decode:always:transient:times=2",
+                    "engine.decode", 10) == [0, 1]
+
+
+def test_step_trigger_fires_at_threshold():
+    inj = build_injector("engine.step:step=5:transient")
+    for s in range(5):
+        inj.check("engine.step", step=s)   # below threshold: no fire
+    with pytest.raises(InjectedTransient):
+        inj.check("engine.step", step=5)
+    inj.check("engine.step", step=6)       # times=1 spent
+
+
+def test_match_len_filters_context():
+    spec = "engine.prefill:always:transient:match_len=7:times=99"
+    inj = build_injector(spec)
+    inj.check("engine.prefill", n_tokens=6)    # no match, no fire
+    inj.check("engine.prefill", n_tokens=None)
+    with pytest.raises(InjectedTransient):
+        inj.check("engine.prefill", n_tokens=7)
+
+
+def test_unknown_site_calls_are_free():
+    inj = build_injector("engine.decode:always:transient:times=99")
+    for _ in range(5):
+        inj.check("control.publish")   # no rule for this site
+    assert inj.total == 0
+
+
+def test_probability_rule_is_seed_deterministic():
+    spec = "seed=9;engine.decode:p=0.3:transient:times=1000"
+    a = _firings(spec, "engine.decode", 200)
+    b = _firings(spec, "engine.decode", 200)
+    assert a == b
+    assert 20 < len(a) < 120   # p=0.3 over 200 calls, loose bounds
+    # rule streams are per-rule: other sites' calls between matching
+    # calls must not perturb WHICH matching calls fire
+    inj = build_injector(
+        spec + ";control.recv:p=0.5:oom:times=1000")
+    fired = []
+    for i in range(200):
+        try:
+            inj.check("control.recv")
+        except InjectedOOM:
+            pass
+        try:
+            inj.check("engine.decode")
+        except InjectedTransient:
+            fired.append(i)
+    assert fired == a
+
+
+def test_different_seeds_fire_differently():
+    a = _firings("seed=1;engine.decode:p=0.3:transient:times=1000",
+                 "engine.decode", 200)
+    b = _firings("seed=2;engine.decode:p=0.3:transient:times=1000",
+                 "engine.decode", 200)
+    assert a != b
+
+
+def test_wedge_holds_the_caller_then_raises():
+    inj = build_injector("engine.decode:nth=1:wedge:secs=0.05")
+    t0 = time.perf_counter()
+    with pytest.raises(InjectedWedge):
+        inj.check("engine.decode")
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_oom_error_kind_and_records():
+    inj = build_injector("seed=4;pager.alloc:nth=2:oom")
+    inj.check("pager.alloc", step=7)
+    with pytest.raises(InjectedOOM, match="RESOURCE_EXHAUSTED"):
+        inj.check("pager.alloc", step=8)
+    d = inj.describe()
+    assert d["injections_total"] == 1
+    assert d["injections_by_site"] == {"pager.alloc": 1}
+    assert FaultPlan.parse(d["plan"]).seed == 4
+    (rec,) = inj.records
+    assert (rec.site, rec.kind, rec.call, rec.step) == (
+        "pager.alloc", "oom", 2, 8)
+
+
+# -- disabled plane: the no-op fast path -------------------------------------
+
+def test_disabled_plane_call_sites_are_attribute_guarded():
+    """Pin the zero-per-step-work contract structurally: every
+    injector call site in the hot paths sits behind an `is not None`
+    attribute test, so without --fault-plan the plane costs exactly
+    one attribute read per site — no injector object, no lock, no
+    rule scan."""
+    import cake_tpu.serve.control as control
+    import cake_tpu.serve.engine as engine
+    for mod, attr in ((engine, "_faults"), (control, "faults")):
+        src = open(mod.__file__).readlines()
+        needles = [i for i, ln in enumerate(src)
+                   if f"{attr}.check(" in ln]
+        assert needles, f"no fault sites found in {mod.__name__}"
+        for i in needles:
+            window = "".join(src[max(0, i - 6):i + 1])
+            assert f"{attr} is not None" in window, (
+                f"{mod.__name__}:{i + 1} calls {attr}.check() without "
+                "an `is not None` guard — the disabled plane must stay "
+                "a single attribute test")
+
+
+def test_sites_frozen_and_documented():
+    # the engine/control/kv call sites reference these names by string;
+    # renaming one without updating SITES must fail loudly here
+    assert {"engine.step", "engine.prefill", "engine.decode",
+            "engine.mixed", "control.publish", "control.recv",
+            "host_tier.fetch", "host_tier.install",
+            "pager.alloc"} == set(SITES)
+
+
+# -- engine acceptance: recovery is transparent ------------------------------
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    import jax
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+P1 = [5] * 9
+P2 = [2, 9, 4, 7, 3]
+GEN = 12
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("recovery_config",
+                  RecoveryConfig(backoff_base_s=0.01))
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=T,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # f32 KV: greedy token equality must exercise the recovery
+        # fold, not bf16 tie-breaks
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+def _run_wave(tiny_config, params, fault_plan=None, prompts=(P1, P2),
+              gen=GEN, **kw):
+    eng = _engine(tiny_config, params, fault_plan=fault_plan, **kw)
+    with eng:
+        hs = [eng.submit(list(p), max_new_tokens=gen, temperature=0.0,
+                         repeat_penalty=1.0) for p in prompts]
+        assert all(h.wait(timeout=600) for h in hs), "wave timed out"
+        toks = [list(h._req.out_tokens) for h in hs]
+        errs = [h._req.error for h in hs]
+        return toks, errs, eng
+
+
+@pytest.fixture(scope="module")
+def dense_clean(tiny_config, params):
+    toks, errs, eng = _run_wave(tiny_config, params)
+    assert errs == [None, None]
+    # no --fault-plan: the injection plane does not exist at all
+    assert eng._faults is None
+    assert eng.stats.recoveries == 0
+    return toks
+
+
+def test_transient_crash_recovery_dense_token_identical(
+        tiny_config, params, dense_clean):
+    toks, errs, eng = _run_wave(
+        tiny_config, params,
+        fault_plan="seed=3;engine.decode:nth=3:transient")
+    assert eng._faults.total == 1, "the planned crash never fired"
+    assert errs == [None, None], "a transient crash failed requests"
+    assert toks == dense_clean
+    assert eng.stats.recoveries == 1
+    assert eng.stats.requests_recovered == 2
+    assert eng.stats.poisoned == 0
+    assert eng.recovery_seconds and eng.recovery_seconds[0] > 0
+    st = eng.recovery_state()
+    assert st["enabled"] and not st["breaker"]["tripped"]
+    assert st["fault_plan"]["injections_total"] == 1
+
+
+def test_poison_quarantined_while_cohabitant_recovers(
+        tiny_config, params, dense_clean):
+    """P2's prefill (5 tokens) keeps failing: after the implication
+    budget (2 consecutive failed steps) it is quarantined with a
+    typed, non-retryable error — and P1, in flight through both
+    crashes, still completes token-identical to the clean run."""
+    toks, errs, eng = _run_wave(
+        tiny_config, params,
+        fault_plan="engine.prefill:always:transient:match_len=5:times=4")
+    assert errs[0] is None
+    assert isinstance(errs[1], PoisonRequestError)
+    assert errs[1].retryable is False
+    assert errs[1].crashes == 2
+    assert toks[0] == dense_clean[0]
+    assert eng.stats.poisoned == 1
+    assert eng.stats.recoveries == 2
+
+
+def test_transient_crash_recovery_paged_with_shared_prefix(
+        tiny_config, params):
+    """The paged engine recovers too: a shared-prefix slot and a plain
+    slot both cross an injected mid-decode crash token-identically,
+    and the refcounted page pool drains back to fully free."""
+    prefix = [7] * PAGE
+
+    def run(plan):
+        eng = _engine(tiny_config, params, fault_plan=plan,
+                      kv_pages=12, kv_page_size=PAGE)
+        with eng:
+            eng.register_prefix(prefix)
+            hs = [eng.submit(prefix + [3, 1, 4], max_new_tokens=10,
+                             temperature=0.0, repeat_penalty=1.0),
+                  eng.submit(P1, max_new_tokens=10,
+                             temperature=0.0, repeat_penalty=1.0)]
+            assert all(h.wait(timeout=600) for h in hs)
+            toks = [list(h._req.out_tokens) for h in hs]
+            errs = [h._req.error for h in hs]
+            stats = (eng.stats.recoveries, eng.stats.requests_recovered,
+                     eng._pager.free_pages, eng.cache.n_pages)
+        return toks, errs, stats
+
+    clean, cerrs, cstats = run(None)
+    assert cerrs == [None, None] and cstats[0] == 0
+    toks, errs, stats = run("seed=1;engine.decode:nth=2:transient")
+    assert errs == [None, None]
+    assert toks == clean
+    assert stats[0] == 1 and stats[1] == 2
+    # pool conserved across crash + recovery + drain
+    assert stats[2] == stats[3]
+
+
+def test_reset_storm_trips_breaker_into_clean_stop(tiny_config, params):
+    """A fault that never goes away: every decode fails. The engine
+    recovers storm_resets-1 times, then the breaker opens — requests
+    fail with the typed retryable reset error, the engine stops
+    cleanly, and post-stop submits are refused with the same typed
+    error (a restart away from serving, so the API can 503)."""
+    eng = _engine(
+        tiny_config, params,
+        fault_plan="engine.decode:always:transient:times=10",
+        recovery_config=RecoveryConfig(
+            implication_budget=99,   # isolate the breaker, not poison
+            backoff_base_s=0.01, storm_resets=3, storm_window_s=60.0))
+    with eng:
+        h = eng.submit(P1, max_new_tokens=4, temperature=0.0,
+                       repeat_penalty=1.0)
+        assert h.wait(timeout=600)
+        assert isinstance(h._req.error, EngineResetError)
+        assert h._req.error.retryable is True
+        st = eng.recovery_state()
+        assert st["breaker"]["tripped"] is True
+        assert st["breaker"]["resets_in_window"] >= 3
+        assert eng.stats.recoveries == 2   # the two pre-breaker resets
+        with pytest.raises(EngineResetError):
+            eng.submit(P2, max_new_tokens=2)
+
+
+# -- API surface: typed errors, SSE terminal event, honest 503 ---------------
+
+@pytest.fixture(scope="module")
+def chaos_served():
+    """A served engine whose EVERY prefill fails (times=99) with
+    implication_budget=1: each request is quarantined on its own
+    reset, and the third reset trips the storm breaker. Prefills fail
+    before dispatch, so this server never compiles a step."""
+    import jax
+    from cake_tpu.api.server import start
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import (
+        ByteTokenizer, LlamaGenerator,
+    )
+    from cake_tpu.models.llama.params import init_params
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    p = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, p, ByteTokenizer(cfg.vocab_size),
+                         max_seq_len=256,
+                         sampling=SamplingConfig(temperature=0.0),
+                         cache_dtype=jnp.float32)
+    master = Master(
+        Args(sample_len=4,
+             fault_plan="engine.prefill:always:transient:times=99"),
+        text_generator=gen)
+    engine = master.make_engine(
+        max_slots=2,
+        recovery_config=RecoveryConfig(
+            implication_budget=1, backoff_base_s=0.01,
+            storm_resets=3, storm_window_s=60.0))
+    httpd = start(master, address="127.0.0.1:0", block=False,
+                  engine=engine)
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}", engine
+    httpd.shutdown()
+
+
+BODY = {"messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3}
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url + "/api/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_api_typed_error_drill(chaos_served):
+    """One ordered drill through the typed-error surface (each POST
+    costs one engine reset, and the third trips the breaker — the
+    sequencing IS the scenario, so it lives in one test)."""
+    url, engine = chaos_served
+
+    # 1) poison request (budget 1): terminal 500, explicitly
+    #    non-retryable — a client must not blindly resubmit it
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, BODY)
+    assert ei.value.code == 500
+    obj = json.loads(ei.value.read())
+    assert obj["retryable"] is False
+    assert "quarantined" in obj["error"]
+
+    # 2) an open SSE stream gets a TERMINAL error event (typed +
+    #    retryable flag), not a silent close
+    resp = _post(url, {**BODY, "stream": True})
+    assert resp.status == 200
+    events = [json.loads(ln[len(b"data: "):])
+              for ln in resp.read().splitlines()
+              if ln.startswith(b"data: ") and ln != b"data: [DONE]"]
+    errs = [e["error"] for e in events if "error" in e]
+    assert errs, f"no terminal error event in {events!r}"
+    assert errs[-1]["retryable"] is False
+    assert errs[-1]["type"] == "PoisonRequestError"
+
+    # 3) third reset in the window: the breaker opens — the innocent
+    #    request fails RETRYABLE, mapped to 503 + honest Retry-After
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, BODY)
+    assert ei.value.code == 503
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    obj = json.loads(ei.value.read())
+    assert obj["retryable"] is True
+
+    # 4) /api/v1/health reports the recovery/breaker state + the armed
+    #    plan's injection counts
+    health = json.loads(urllib.request.urlopen(
+        url + "/api/v1/health", timeout=30).read())
+    rec = health["recovery"]
+    assert rec["enabled"] is True
+    assert rec["breaker"]["tripped"] is True
+    assert rec["poisoned"] == 2
+    assert rec["fault_plan"]["injections_total"] >= 3
+
+    # 5) the engine is stopped (breaker): post-stop submits map to the
+    #    same typed retryable 503 — a restart away from serving
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, BODY)
+    assert ei.value.code == 503
+    assert int(ei.value.headers["Retry-After"]) >= 1
+
+    # 6) metrics: the families behind the drill all moved
+    text = urllib.request.urlopen(
+        url + "/api/v1/metrics", timeout=30).read().decode()
+    assert 'cake_fault_injections_total{site="engine.prefill"}' in text
+    assert 'cake_poison_requests_total{reason="implicated"}' in text
+    assert 'cake_engine_recoveries_total{outcome="storm_breaker"}' in text
+    assert "# TYPE cake_engine_recovery_seconds histogram" in text
+
+
+# -- follower liveness deadline (serve/control satellite) --------------------
+
+def test_follower_liveness_deadline_exits_instead_of_hanging(
+        tiny_config, params):
+    """A coordinator that dies BETWEEN ops (kill -9: no FIN, no stop
+    op) used to hang the follower in recv() forever. With a liveness
+    deadline, a quiet interval whose liveness probe is gone exits with
+    a clear error — while an idle-but-alive coordinator keeps the
+    loop waiting until its stop op."""
+    import threading
+
+    from cake_tpu.serve.control import ControlClient, ControlServer
+
+    srv = ControlServer(n_followers=1, host="127.0.0.1", token="t")
+    acc = threading.Thread(target=srv.accept_followers, daemon=True)
+    acc.start()
+    client = ControlClient(f"127.0.0.1:{srv.port}", token="t")
+    acc.join(timeout=10)
+    assert not acc.is_alive(), "follower never connected"
+    try:
+        eng = _engine(tiny_config, params)
+        # liveness gone: the loop must return promptly, not hang
+        t0 = time.perf_counter()
+        eng.run_follower_loop(client, op_timeout_s=0.25,
+                              liveness=lambda: False)
+        dt = time.perf_counter() - t0
+        assert 0.2 <= dt < 5.0
+        # alive-but-idle: quiet intervals continue; the stop op (sent
+        # from the second probe) then ends the loop cleanly
+        calls = []
+
+        def alive():
+            calls.append(1)
+            if len(calls) == 2:
+                srv.publish({"op": "stop"})
+            return True
+
+        eng.run_follower_loop(client, op_timeout_s=0.2, liveness=alive)
+        assert len(calls) >= 2
+    finally:
+        client.close()
+        srv.close()
+
+
+# -- heartbeat backoff (parallel/health.py satellite) ------------------------
+
+def test_heartbeat_sender_backs_off_with_seeded_jitter():
+    """With no monitor listening, reconnect attempts space out
+    exponentially (capped) instead of re-dialing every interval_s in
+    lockstep; the jitter stream is seeded by worker name, so two
+    senders with different names desynchronize deterministically."""
+    import socket as _socket
+
+    from cake_tpu.parallel.health import HeartbeatSender
+
+    # a port with nothing listening: bind-then-close reserves a free one
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    sender = HeartbeatSender(f"127.0.0.1:{port}", "w0",
+                             interval_s=0.01, max_backoff_s=0.1)
+    try:
+        t0 = time.perf_counter()
+        while sender.reconnects < 3 and time.perf_counter() - t0 < 10:
+            time.sleep(0.005)
+        assert sender.reconnects >= 3, "sender never retried"
+        assert sender._failures >= 3
+        assert not sender.alive_within(60.0)   # never connected
+        # the per-name rng is deterministic: same name -> same stream
+        import random
+        seed = int.from_bytes(b"w0".ljust(8, b"\0")[:8], "big")
+        assert sender._rng.__class__ is random.Random
+        assert random.Random(seed).random() != random.Random(
+            int.from_bytes(b"w1".ljust(8, b"\0")[:8], "big")).random()
+    finally:
+        sender.close()

@@ -109,8 +109,11 @@ def test_step_metric_families_documented_in_readme():
     with real help text AND appear in the README metrics table — an
     undocumented telemetry metric fails tier-1 here."""
     lm = _load()
+    import cake_tpu.faults.injector  # noqa: F401 — cake_fault_*
     import cake_tpu.kv.host_tier  # noqa: F401 — registers cake_kv_*
     import cake_tpu.obs.steps  # noqa: F401 — registers the families
+    import cake_tpu.parallel.health  # noqa: F401 — cake_heartbeat_*
+    import cake_tpu.serve.engine  # noqa: F401 — recovery families
     from cake_tpu.obs import metrics as m
     readme = (TOOLS.parent / "README.md").read_text()
     text = m.REGISTRY.render()
@@ -118,6 +121,10 @@ def test_step_metric_families_documented_in_readme():
                for line in text.splitlines()), "steps module families"
     assert any(line.startswith("# TYPE cake_kv_spill_total")
                for line in text.splitlines()), "kv tier families"
+    assert any(line.startswith("# TYPE cake_fault_injections_total")
+               for line in text.splitlines()), "fault plane families"
+    assert any(line.startswith("# TYPE cake_engine_recoveries_total")
+               for line in text.splitlines()), "recovery families"
     errs = lm.lint_readme_coverage(text, readme)
     assert errs == [], errs
 

@@ -264,10 +264,15 @@ def test_engine_paged_page_accounting_invariant(tiny_config, params):
     mid-decode request, and an ERRORED request (device failure ->
     _fail_all + reset) — PageAllocator.free_pages returns to its
     initial value and no slot holds a page mapping. Any leak on the
-    cancel/error release paths shows up here as a shrunken pool."""
+    cancel/error release paths shows up here as a shrunken pool.
+    recovery=False pins the LEGACY fail-all error path (with crash
+    recovery — the default — a one-shot failure resubmits the request
+    and it completes; that path's accounting is pinned by
+    test_faults.py's paged recovery test)."""
     import time as _time
 
-    eng = _engine(tiny_config, params, kv_pages=6, kv_page_size=PAGE)
+    eng = _engine(tiny_config, params, kv_pages=6, kv_page_size=PAGE,
+                  recovery=False)
     with eng:
         # retire path
         done = eng.submit([5] * 9, max_new_tokens=4, temperature=0.0,
