@@ -45,7 +45,7 @@ KNOWN_ROUTES = frozenset({
     "/api/v1/chat/completions", "/v1/chat/completions", "/api/v1/image",
     "/api/v1/health", "/api/v1/cluster", "/v1/models", "/api/v1/models",
     "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
-    "/api/v1/profile",
+    "/api/v1/profile", "/api/v1/autotune",
 })
 
 
@@ -312,7 +312,48 @@ class ApiServer:
                 # crash-recovery / reset-storm-breaker state (+ the
                 # armed fault plan, when chaos is on)
                 out["recovery"] = self.engine.recovery_state()
+            if hasattr(self.engine, "current_config"):
+                # the LIVE effective engine config (slots, decode_scan,
+                # kv_pages, kv_dtype, mixed_batch, attn impl) so
+                # operators can see what the autotuner chose; the epoch
+                # pairs with per-request trace attribution
+                out["engine_config"] = (
+                    self.engine.current_config().to_dict())
+                out["config_epoch"] = getattr(self.engine,
+                                              "config_epoch", 0)
+                out["autotune"] = getattr(self.engine, "autotune_mode",
+                                          "off")
         return out
+
+    def autotune(self) -> dict:
+        """GET /api/v1/autotune: mode, live config, window signals and
+        the switch/decision history (cake_tpu/autotune)."""
+        if self.engine is None or not hasattr(self.engine,
+                                              "autotune_state"):
+            return {"mode": "off",
+                    "note": "engine-less serving has no autotuner"}
+        return self.engine.autotune_state()
+
+    def autotune_switch(self, body: dict) -> dict:
+        """POST /api/v1/autotune {"config": {...}}: manual live
+        switch. 400 on a malformed/invalid config or when --autotune
+        is off; 409 (SwitchInFlightError, mapped by the handler) while
+        another switch is in flight."""
+        if self.engine is None or not hasattr(self.engine,
+                                              "reconfigure"):
+            raise ValueError("engine-less serving has no autotuner")
+        if getattr(self.engine, "autotune_mode", "off") == "off":
+            raise ValueError(
+                "autotune is off; restart with --autotune manual (or "
+                "auto) to enable live config switching")
+        cfg = body.get("config")
+        if not isinstance(cfg, dict):
+            raise ValueError('body must be {"config": {...}} with the '
+                             "switchable engine knobs")
+        switched = self.engine.reconfigure(cfg, reason="manual")
+        return {"switched": bool(switched),
+                "config": self.engine.current_config().to_dict(),
+                "epoch": self.engine.config_epoch}
 
     def _engine_retry_after(self, priority=None) -> float:
         """Honest Retry-After for a transient engine reset: the shed
@@ -556,6 +597,8 @@ def make_handler(api: ApiServer):
                 return self._json(200, api.requests(self._limit_arg()))
             if self.path.split("?", 1)[0] == "/api/v1/steps":
                 return self._json(200, api.steps(self._limit_arg()))
+            if self.path == "/api/v1/autotune":
+                return self._json(200, api.autotune())
             if self.path in ("/v1/models", "/api/v1/models"):
                 # OpenAI client compatibility: SDKs list models on init
                 return self._json(200, {
@@ -607,6 +650,17 @@ def make_handler(api: ApiServer):
                     # the /v1 alias serves OpenAI SDKs pointed at
                     # base_url=.../v1 (they discover via /v1/models)
                     return self._chat(body)
+                if self.path == "/api/v1/autotune":
+                    from cake_tpu.serve.errors import SwitchInFlightError
+                    try:
+                        return self._json(200, api.autotune_switch(body))
+                    except SwitchInFlightError as e:
+                        # one switch at a time: folding every stream is
+                        # expensive and a queued second switch would
+                        # thrash — the client retries after this one
+                        return self._json(409, {"error": str(e)})
+                    # ValueError (bad config / autotune off) falls to
+                    # the generic 400 below
                 if self.path == "/api/v1/image":
                     return self._json(200, api.image(body))
                 return self._json(404, {"error": "not found"})

@@ -215,6 +215,21 @@ class Args:
     # (snapshot + clean stop). None = auto: on wherever the fold works
     # (off for speculative and windowed serving)
     recovery: Optional[bool] = None
+    # --autotune {off,manual,auto}: live engine-config hot-switching
+    # (cake_tpu/autotune). "manual" arms POST /api/v1/autotune (an
+    # operator switches slots/decode-scan/kv-pages/kv-dtype/
+    # mixed-batch/paged-attn under load: in-flight streams fold their
+    # generated tokens into their prompts — the checkpoint-resume fold
+    # — and requeue with seniority/class preserved, token-identical at
+    # f32 KV); "auto" additionally runs the policy controller: an
+    # offered-load regime -> config table (--autotune-policy, fitted
+    # offline by tools/autotune_fit.py) consulted over sliding-window
+    # signals with hysteresis, cooldown and a one-shot rollback guard
+    autotune: str = "off"
+    # --autotune-policy PATH: the piecewise policy table for --autotune
+    # auto (JSON: {"version": 1, "regimes": [{"max_offered_rps": ...,
+    # "config": {...}}, ...]}; cake_tpu/autotune/search.py)
+    autotune_policy: Optional[str] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -246,6 +261,20 @@ class Args:
         if self.kv_host_pages is not None and self.kv_host_pages < 1:
             raise ValueError(
                 f"--kv-host-pages {self.kv_host_pages} must be >= 1")
+        if self.autotune not in ("off", "manual", "auto"):
+            raise ValueError(
+                f"unsupported autotune '{self.autotune}' "
+                "(choose off, manual or auto)")
+        if self.autotune == "auto":
+            if not self.autotune_policy:
+                raise ValueError(
+                    "--autotune auto requires --autotune-policy "
+                    "(fit one with tools/autotune_fit.py)")
+            # parse NOW so a malformed/missing policy is a loud startup
+            # error, not a crash after the model loaded (the
+            # --fault-plan precedent)
+            from cake_tpu.autotune import PolicyTable
+            PolicyTable.load(self.autotune_policy)
         if self.fault_plan:
             # parse NOW so a malformed plan is a loud startup error,
             # not a crash after the model loaded (a chaos run that

@@ -1,0 +1,36 @@
+"""Online autotuner: joint config search + live hot-switching.
+
+Three parts (ISSUE 9 / ROADMAP item 3, after Sandwich in PAPERS.md):
+
+  * ``space``      — the declarative ``EngineConfig`` point, validity
+                     rules (reusing args.py validation) and the
+                     switch-legality guard;
+  * ``search``     — the offline fitter: BENCH / step-log measurements
+                     -> a piecewise ``PolicyTable`` (offered-load
+                     regime -> best config), written to the
+                     ``--autotune-policy`` file by tools/autotune_fit;
+  * ``controller`` — the online loop: sliding-window signals with
+                     hysteresis + cooldown + a one-shot rollback
+                     guard, driving ``engine.reconfigure()`` between
+                     iterations.
+
+The hot-switch seam itself lives in serve/engine.py
+(``InferenceEngine.reconfigure``): in-flight requests fold their
+generated tokens into their prompts (exactly the PR 8 recovery path
+minus backoff and crash implication), the jitted step fns + KV pool
+rebuild under the new config, and everything requeues with seniority,
+class and preempt budget preserved — greedy streams complete
+token-identical at f32 KV across a switch.
+"""
+
+from cake_tpu.autotune.controller import (  # noqa: F401
+    CONFIG_INFO, ROLLBACKS, SWITCH_SECONDS, SWITCHES, AutotuneController,
+    AutotuneSignals, ControllerConfig, set_config_info,
+)
+from cake_tpu.autotune.search import (  # noqa: F401
+    Observation, PolicyTable, extract_observations, fit,
+    observations_from_step_log,
+)
+from cake_tpu.autotune.space import (  # noqa: F401
+    EngineConfig, config_key, switch_guard, validate_config,
+)

@@ -1,0 +1,266 @@
+"""Online autotune controller: sliding-window signals -> switch/rollback.
+
+The engine thread drives this between iterations (engine._autotune_tick):
+every ``interval_s`` it gathers one ``AutotuneSignals`` sample from the
+telemetry the repo already has — step MFU / HBM utilization (obs/steps
+flight recorder), page-pool occupancy, per-class queue depth and shed
+rate (cake_tpu/sched), arrival TTFT percentiles (obs/tracing) — and asks
+``decide()`` whether to move. The controller is pure host-side state (no
+device work, no threads of its own), so tests drive it on synthetic
+signal streams with a fake clock.
+
+Decision discipline (the reason this is safe to run against live load):
+
+  * **hysteresis** — a target config must win ``hold`` CONSECUTIVE
+    samples before a switch is proposed; one noisy window moves nothing.
+  * **cooldown** — at least ``cooldown_s`` between switches; a switch
+    pays a fold-and-re-prefill of every in-flight stream, so flapping
+    is strictly worse than either config.
+  * **rollback guard** — after an autonomous switch the controller
+    compares the measured service rate over the next
+    ``rollback_window`` samples against the pre-switch window; if it
+    dropped below ``rollback_frac`` of the old regime's rate, it
+    reverts ONCE and pins the offending config (never re-proposed) —
+    the policy table was fitted offline and can be wrong online.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cake_tpu.autotune.search import PolicyTable
+from cake_tpu.autotune.space import EngineConfig, config_key
+from cake_tpu.obs import metrics as obs_metrics
+
+# the cake_autotune_* families (README "Autotuning" metrics rows;
+# tools/lint_metrics.py --readme enforces them)
+SWITCHES = obs_metrics.counter(
+    "cake_autotune_switches_total",
+    "Live engine config switches, by reason (auto = policy-driven, "
+    "manual = POST /api/v1/autotune, rollback = the guard reverting a "
+    "switch whose measured service rate regressed)",
+    labelnames=("reason",))
+ROLLBACKS = obs_metrics.counter(
+    "cake_autotune_rollbacks_total",
+    "Autonomous switches reverted by the rollback guard (the offending "
+    "config is pinned and never re-proposed)")
+SWITCH_SECONDS = obs_metrics.histogram(
+    "cake_autotune_switch_seconds",
+    "Wall seconds for one live config switch: fold every in-flight "
+    "stream into its prompt, rebuild step fns + KV pool, requeue")
+CONFIG_INFO = obs_metrics.gauge(
+    "cake_autotune_config_info",
+    "Live effective engine config as key=value info labels (value 1 "
+    "for the current config's pairs, 0 for superseded ones)",
+    labelnames=("key",))
+
+
+def set_config_info(cfg: EngineConfig) -> None:
+    """Publish the live config through cake_autotune_config_info: each
+    knob becomes a ``key="name=value"`` child set to 1; children from a
+    superseded config drop to 0 (the Prometheus info-metric pattern —
+    a scrape always shows exactly one live value per knob)."""
+    live = {f"{k}={v}" for k, v in cfg.to_dict().items()}
+    for (val,), _ in CONFIG_INFO.samples().items():
+        if val not in live:
+            CONFIG_INFO.labels(key=val).set(0)
+    for val in sorted(live):
+        CONFIG_INFO.labels(key=val).set(1)
+
+
+@dataclass
+class AutotuneSignals:
+    """One sliding-window sample of the engine's load/health signals."""
+
+    t: float
+    offered_rps: float = 0.0      # request arrivals per second
+    service_tps: float = 0.0      # generated tokens per second
+    completed_rps: float = 0.0    # retirements per second
+    queue_depth: int = 0
+    queue_depth_by_class: Dict[str, int] = field(default_factory=dict)
+    mfu: float = 0.0
+    hbm_util: float = 0.0
+    pages_in_use_frac: float = 0.0
+    shed_rps: float = 0.0
+    ttft_p99_s: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        out = {
+            "t": round(self.t, 3),
+            "offered_rps": round(self.offered_rps, 3),
+            "service_tps": round(self.service_tps, 3),
+            "completed_rps": round(self.completed_rps, 3),
+            "queue_depth": self.queue_depth,
+            "mfu": round(self.mfu, 4),
+            "hbm_util": round(self.hbm_util, 4),
+            "pages_in_use_frac": round(self.pages_in_use_frac, 4),
+            "shed_rps": round(self.shed_rps, 3),
+        }
+        if self.queue_depth_by_class:
+            out["queue_depth_by_class"] = dict(self.queue_depth_by_class)
+        if self.ttft_p99_s is not None:
+            out["ttft_p99_s"] = round(self.ttft_p99_s, 6)
+        return out
+
+
+@dataclass
+class ControllerConfig:
+    interval_s: float = 2.0       # engine sampling cadence
+    window: int = 5               # samples per sliding decision window
+    hold: int = 2                 # hysteresis: consecutive wins to switch
+    cooldown_s: float = 30.0      # min seconds between switches
+    rollback_window: int = 3      # post-switch samples before the verdict
+    rollback_frac: float = 0.7    # revert when post < frac * pre rate
+    log_size: int = 64            # retained decision-log entries
+
+
+class AutotuneController:
+    """Policy-driven switch/rollback decisions over a signal window.
+
+    Thread model: ``decide``/``on_switched``/``pin`` run on the engine
+    thread; ``state()`` is read by API handler threads — one lock
+    covers the mutable window/log."""
+
+    def __init__(self, policy: PolicyTable, current: EngineConfig,
+                 config: Optional[ControllerConfig] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self.config = config or ControllerConfig()
+        self._now = now_fn
+        self._mu = threading.Lock()
+        self._current = current
+        self._window: deque = deque(maxlen=max(1, self.config.window))
+        self._log: deque = deque(maxlen=max(1, self.config.log_size))
+        self._target_key: Optional[tuple] = None
+        self._streak = 0
+        self._last_switch_t: Optional[float] = None
+        self._pinned: set = set()
+        # armed rollback guard: (previous config, pre-switch rate,
+        # samples seen since the switch)
+        self._guard: Optional[Tuple[EngineConfig, float, int]] = None
+
+    # -- decisions (engine thread) ----------------------------------------
+
+    def window_service_tps(self) -> float:
+        with self._mu:
+            xs = [s.service_tps for s in self._window]
+        return sum(xs) / len(xs) if xs else 0.0
+
+    def window_offered_rps(self) -> float:
+        with self._mu:
+            xs = [s.offered_rps for s in self._window]
+        return sum(xs) / len(xs) if xs else 0.0
+
+    def decide(self, sig: AutotuneSignals
+               ) -> Optional[Tuple[EngineConfig, str]]:
+        """Ingest one sample; return (target config, reason) when the
+        engine should switch now, else None. reason is "auto" for a
+        policy-driven move and "rollback" for the guard reverting."""
+        with self._mu:
+            self._window.append(sig)
+        rb = self._check_rollback(sig)
+        if rb is not None:
+            return rb, "rollback"
+        now = sig.t
+        cfg = self.config
+        if (self._last_switch_t is not None
+                and now - self._last_switch_t < cfg.cooldown_s):
+            return None
+        if self._guard is not None:
+            return None  # verdict pending: no new move until it rules
+        target = self.policy.lookup(self.window_offered_rps())
+        tkey = config_key(target)
+        if tkey == config_key(self._current) or tkey in self._pinned:
+            self._target_key, self._streak = None, 0
+            return None
+        if tkey == self._target_key:
+            self._streak += 1
+        else:
+            self._target_key, self._streak = tkey, 1
+        if self._streak < cfg.hold:
+            return None
+        return target, "auto"
+
+    def _check_rollback(self, sig: AutotuneSignals
+                        ) -> Optional[EngineConfig]:
+        if self._guard is None:
+            return None
+        prev_cfg, pre_rate, seen = self._guard
+        seen += 1
+        self._guard = (prev_cfg, pre_rate, seen)
+        if seen < self.config.rollback_window:
+            return None
+        with self._mu:
+            post = list(self._window)[-self.config.rollback_window:]
+        post_rate = (sum(s.service_tps for s in post) / len(post)
+                     if post else 0.0)
+        bad = self._current
+        self._guard = None
+        if pre_rate > 0 and post_rate < self.config.rollback_frac * pre_rate:
+            # revert ONCE and pin: the fitted policy was wrong online
+            # for this regime — never re-propose the offending config
+            self._pinned.add(config_key(bad))
+            self._note("rollback", frm=bad, to=prev_cfg,
+                       pre_tps=pre_rate, post_tps=post_rate)
+            return prev_cfg
+        self._note("accepted", frm=prev_cfg, to=bad,
+                   pre_tps=pre_rate, post_tps=post_rate)
+        return None
+
+    def on_switched(self, new: EngineConfig, old: EngineConfig,
+                    pre_rate: float, reason: str) -> None:
+        """The engine completed a switch: update current, start the
+        cooldown, and (for autonomous moves only) arm the rollback
+        guard with the old regime's measured rate. Rollback and manual
+        switches arm nothing — the guard fires exactly once."""
+        self._current = new
+        self._last_switch_t = self._now()
+        self._target_key, self._streak = None, 0
+        if reason == "auto":
+            self._guard = (old, pre_rate, 0)
+        else:
+            self._guard = None
+        self._note("switch", frm=old, to=new, reason=reason,
+                   pre_tps=pre_rate)
+
+    def pin(self, cfg: EngineConfig, why: str = "switch failed") -> None:
+        """Ban a config (e.g. the engine refused the switch because an
+        in-flight stream cannot fit its pool)."""
+        self._pinned.add(config_key(cfg))
+        self._note("pinned", to=cfg, reason=why)
+
+    # -- introspection (any thread) ---------------------------------------
+
+    def _note(self, action: str, frm: Optional[EngineConfig] = None,
+              to: Optional[EngineConfig] = None, **fields) -> None:
+        entry = {"t": round(time.time(), 3), "action": action, **fields}
+        if frm is not None:
+            entry["from"] = frm.to_dict()
+        if to is not None:
+            entry["to"] = to.to_dict()
+        with self._mu:
+            self._log.append(entry)
+
+    def decision_log(self) -> List[dict]:
+        with self._mu:
+            return list(self._log)
+
+    def state(self) -> dict:
+        with self._mu:
+            window = [s.to_dict() for s in self._window]
+            log = list(self._log)
+        return {
+            "current": self._current.to_dict(),
+            "window": window,
+            "offered_rps": round(self.window_offered_rps(), 3),
+            "service_tps": round(self.window_service_tps(), 3),
+            "cooldown_s": self.config.cooldown_s,
+            "hold": self.config.hold,
+            "pinned": len(self._pinned),
+            "guard_armed": self._guard is not None,
+            "decisions": log,
+        }

@@ -352,6 +352,13 @@ def main(argv=None) -> int:
             "int8 / --kv-host-pages apply to engine serving (--api); "
             "one-shot generation uses the sequential generator's "
             "dense cache")
+    if getattr(args, "autotune", "off") != "off":
+        # the autotuner hot-switches a LIVE engine's config between
+        # iterations; a one-shot generation has no engine and no load
+        # to adapt to — be loud instead of the flag silently vanishing
+        logging.getLogger(__name__).warning(
+            "--autotune applies to engine serving (--api); one-shot "
+            "generation has no live engine to reconfigure")
     if getattr(args, "fault_plan", None) \
             or getattr(args, "recovery", None) is not None:
         # the fault plane's sites and the recovery loop live in the

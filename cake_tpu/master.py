@@ -93,6 +93,11 @@ class Master:
                 log.warning("--mixed-batch ignored with --draft-model: "
                             "the mixed ragged step is a paged-engine "
                             "path and the spec engine is not paged")
+            if getattr(self.args, "autotune", "off") != "off":
+                log.warning("--autotune ignored with --draft-model: "
+                            "speculative serving has no hot-switch "
+                            "fold (the draft cache cannot be rebuilt "
+                            "mid-round)")
             slots = max_slots or getattr(self.args, "max_slots", 8)
             return InferenceEngine(
                 g.config, g.params, g.tokenizer,
@@ -153,6 +158,11 @@ class Master:
                 log.warning("--mixed-batch ignored: the sp engine's "
                             "ctx/tail cache is not paged, so there is "
                             "no mixed ragged step to dispatch")
+            if getattr(self.args, "autotune", "off") != "off":
+                log.warning("--autotune ignored: the sp engine's "
+                            "custom step fns own their cache contract; "
+                            "only the built-in dense/paged engines can "
+                            "hot-switch configs")
             log.info("sp engine: %d slots, ctx window %d + decode tail "
                      "%d", slots, ctx_len, tail_len)
             return InferenceEngine(
@@ -232,6 +242,11 @@ class Master:
             # without --kv-pages is rejected by the engine with a
             # named reason instead of silently vanishing)
             mixed_batch=getattr(self.args, "mixed_batch", "auto"),
+            # live config hot-switching (cake_tpu/autotune): the
+            # engine itself warns and disables on flavors without the
+            # fold (ring/custom step fns)
+            autotune=getattr(self.args, "autotune", "off"),
+            autotune_policy=getattr(self.args, "autotune_policy", None),
             **self._trace_kwargs(),
             **self._sched_kwargs(),
             **self._fault_kwargs(),

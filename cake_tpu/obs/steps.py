@@ -426,6 +426,18 @@ class StepTelemetry:
         self._peak = peak_flops
         self._bps = hbm_bps
 
+    def rebind(self, *, impl: Optional[str] = None,
+               key_prefix: Optional[tuple] = None) -> None:
+        """Re-namespace this recorder after a live engine config switch
+        (serve/engine.reconfigure): the ring, the --step-log appender
+        and the accountant survive — only the impl tag and the
+        signature prefix move, so the new config's compiled programs
+        can never alias the old config's in the seen-set."""
+        if impl is not None:
+            self.impl = impl
+        if key_prefix is not None:
+            self._prefix = tuple(key_prefix)
+
     # -- jit/cost accounting ------------------------------------------------
 
     def jit_step(self, fn_name: str, key: tuple, cost_cb) -> _JitStep:

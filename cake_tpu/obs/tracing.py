@@ -89,6 +89,11 @@ class TraceRecord:
     # annotations (checkpoint resume, decode-budget truncation, ...)
     resumed: bool = False
     truncated: bool = False
+    # engine config epoch at admission (cake_tpu/autotune): a live
+    # config switch bumps the engine's epoch, so a trace whose spans
+    # include a "reconfigured" event is attributable to both configs —
+    # admitted under this epoch, finished under a later one
+    config_epoch: int = 0
     wall_start: float = 0.0
     _last_token_t: float = 0.0
 
@@ -132,6 +137,7 @@ class TraceRecord:
             "rid": self.rid,
             "status": self.status,
             "priority": self.priority,
+            "config_epoch": self.config_epoch,
             "prompt_tokens": self.prompt_tokens,
             "max_new_tokens": self.max_new_tokens,
             "output_tokens": self.output_tokens,
@@ -190,11 +196,13 @@ class RequestTracer:
     # -- lifecycle hooks (called by the engine) ---------------------------
 
     def admit(self, rid: int, prompt_tokens: int,
-              max_new_tokens: int, priority: str = "standard") -> None:
+              max_new_tokens: int, priority: str = "standard",
+              config_epoch: int = 0) -> None:
         now = time.perf_counter()
         rec = TraceRecord(rid=rid, prompt_tokens=prompt_tokens,
                           max_new_tokens=max_new_tokens,
                           priority=priority,
+                          config_epoch=config_epoch,
                           wall_start=time.time())
         rec.spans.append(("admitted", now))
         rec.spans.append(("queued", now))
@@ -315,6 +323,18 @@ class RequestTracer:
         if limit is not None:
             recs = recs[:max(0, int(limit))]
         return [r.to_dict() for r in recs]
+
+    def recent_ttfts(self, n: int = 32) -> List[float]:
+        """TTFT seconds of the newest <= n finished-and-retired
+        requests (the autotune controller's arrival-latency signal —
+        cheap: one pass over the bounded ring's tail)."""
+        out: List[float] = []
+        with self._lock:
+            recs = list(self._done)[-max(1, int(n)):]
+        for r in recs:
+            if r.status == "retired" and r.ttft_s is not None:
+                out.append(r.ttft_s)
+        return out
 
     @property
     def active_count(self) -> int:

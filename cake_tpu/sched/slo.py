@@ -143,6 +143,22 @@ class SLOScheduler:
 
     # -- SLO extensions ----------------------------------------------------
 
+    def resize(self, max_slots: int) -> None:
+        """Change the decode-slot count in place — the live engine
+        reconfiguration seam (serve/engine.reconfigure): every active
+        request must already be requeued (seniority-preserving), so
+        only the free-slot map changes. Queued entries, enqueue times
+        and preemption counts are untouched."""
+        if max_slots <= 0:
+            raise ValueError("max_slots must be positive")
+        with self._mu:
+            if self._active:
+                raise RuntimeError(
+                    f"resize with {self._active} active request(s): "
+                    "requeue them first (reconfigure does)")
+            self.max_slots = max_slots
+            self._slots = [0] * max_slots
+
     def requeue(self, rid: int, prompt_len: int, max_new_tokens: int,
                 preempted: bool = False) -> bool:
         """Move an ACTIVE request back to its class queue, preserving

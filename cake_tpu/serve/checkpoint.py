@@ -162,12 +162,28 @@ def snapshot(engine, requests: Optional[List[Dict]] = None) -> Dict:
     """Capture engine request state. Call with the engine stopped (or at
     least quiesced): the engine thread mutates request state per step.
     requests: pre-captured snapshot_requests() records (pre-fail path)."""
-    return {
+    snap = {
         "version": SNAPSHOT_VERSION,
         "engine": _fingerprint(engine),
         "requests": (snapshot_requests(engine) if requests is None
                      else requests),
     }
+    # informational only — the LIVE effective engine config at snapshot
+    # time (cake_tpu/autotune). Deliberately OUTSIDE the fingerprint:
+    # the whole point of the fold-tokens-into-prompt resume is that a
+    # snapshot restores into a DIFFERENT config (more slots, a paged
+    # pool, a post-switch engine) token-identically, so the config must
+    # never gate compatibility — it just tells the operator what the
+    # requests were being served under (and which autotune epoch).
+    cfg_fn = getattr(engine, "current_config", None)
+    if cfg_fn is not None:
+        try:
+            snap["engine_config"] = cfg_fn().to_dict()
+            snap["config_epoch"] = getattr(engine, "config_epoch", 0)
+        except Exception:  # noqa: BLE001 — metadata, never the save
+            log.debug("snapshot: engine config capture failed",
+                      exc_info=True)
+    return snap
 
 
 def write(snap: Dict, path: str) -> None:

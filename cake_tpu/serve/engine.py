@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, List, Optional, Sequence
@@ -266,6 +267,10 @@ class EngineStats:
     recoveries: int = 0
     requests_recovered: int = 0
     poisoned: int = 0
+    # live reconfiguration (cake_tpu/autotune): completed hot switches
+    # and guard-driven reverts (engine.reconfigure)
+    config_switches: int = 0
+    config_rollbacks: int = 0
     # speculative engine mode: drafts offered / kept across all slots
     spec_proposed: int = 0
     spec_accepted: int = 0
@@ -326,6 +331,9 @@ class InferenceEngine:
         fault_plan: Optional[str] = None,
         recovery: Optional[bool] = None,
         recovery_config=None,
+        autotune: Optional[str] = None,
+        autotune_policy=None,
+        autotune_config=None,
     ):
         self.config = config
         self.params = params
@@ -481,6 +489,15 @@ class InferenceEngine:
         # int8 without --kv-pages (the spec engine included: spec is
         # gated off paged) is a loud config error, not a silent no-op.
         self.kv_quant = kv_dtype == "int8"
+        # config identity the live-reconfiguration seam (reconfigure /
+        # cake_tpu/autotune) needs verbatim: the configured storage
+        # name, the base cache dtype, the host-tier capacity and the
+        # custom-step marker — a rebuilt pool must resolve exactly as
+        # the startup one did
+        self._kv_dtype_name = kv_dtype
+        self._base_cache_dtype = cache_dtype
+        self._kv_host_pages = kv_host_pages
+        self._custom_steps = step_fns is not None
         if self.kv_quant and not self.paged:
             raise ValueError(
                 "--kv-dtype int8 requires --kv-pages: int8 KV pages "
@@ -488,11 +505,10 @@ class InferenceEngine:
                 + (" (speculative serving is gated off the paged "
                    "engine, so it cannot quantize KV)" if self._spec
                    else ""))
+        self._host_tier = None
+        # pid -> monotonic last-hit time (the cold-prefix LRU order)
+        self._prefix_last_hit: dict = {}
         if self.paged:
-            if kv_pages < 1 or kv_page_size < 1:
-                raise ValueError(
-                    f"--kv-pages {kv_pages} / --kv-page-size "
-                    f"{kv_page_size} must be >= 1")
             if step_fns is not None or self.ring or self._spec:
                 raise ValueError(
                     "--kv-pages requires the built-in dense single-"
@@ -501,104 +517,11 @@ class InferenceEngine:
                 raise ValueError(
                     "--kv-pages builds its own page pool; a pre-placed "
                     "cache= cannot apply")
-            from cake_tpu.models.llama.paged import (
-                PageAllocator, PagedKVCache, decode_step_ragged_paged,
-                mixed_step_paged, prefill_prefix_pages,
-                prefill_slot_paged, prefill_slot_paged_chunk,
-                prefill_slot_paged_prefixed,
-            )
-            # paged_attn: {fold,pallas} attention impl for the paged
-            # step fns; None/"auto" = pallas on a real TPU, fold
-            # elsewhere (interpret-mode pallas on CPU is slow). The
-            # choice rides the jitted steps as a STATIC arg, so both
-            # variants keep the same traced signature and the engine's
-            # dispatch plumbing is impl-blind.
-            impl = paged_attn or "auto"
-            if impl == "auto":
-                impl = ("pallas" if jax.default_backend() == "tpu"
-                        else "fold")
-            if impl not in ("fold", "pallas"):
-                raise ValueError(
-                    f"--paged-attn must be fold or pallas, got {impl!r}")
-            self.paged_attn = impl
-            self._prefill_slot = partial(prefill_slot_paged, attn=impl)
-            self._decode_step = partial(decode_step_ragged_paged,
-                                        attn=impl)
-            self._decode_scan_impl = (_decode_scan_paged
-                                      if impl == "fold"
-                                      else _decode_scan_paged_pallas)
-            # chunked paged prefill: long prompts admit in C-token
-            # windows (the old "paged prompts prefill whole-window"
-            # restriction is gone); prefill_chunk was already validated
-            # above against the builtin contract, which is unchanged
-            self._prefill_chunk_step = partial(prefill_slot_paged_chunk,
-                                               attn=impl)
-            # page-granular prefix sharing: registered prefixes (and
-            # auto_prefix_system heads) prefill ONCE into pool pages and
-            # are mapped read-only into every matching slot's table row
-            # (_alloc_slot_pages). _prefix_capable stays True.
-            self._paged_prefixed_step = partial(
-                prefill_slot_paged_prefixed, attn=impl)
-            self._prefix_pages_step = partial(prefill_prefix_pages,
-                                              attn=impl)
-            # token-level continuous batching (--mixed-batch): ONE
-            # jitted step consumes a batch of (row kind, pos, q_len)
-            # descriptors — decode rows and prefill-chunk rows in the
-            # same launch (models/llama/paged.mixed_step_paged)
-            self._mixed_step_fn = partial(mixed_step_paged, attn=impl)
-            self._pager = PageAllocator(kv_pages, kv_page_size)
-            self._slot_pages: dict = {}
-            # slot -> count of SHARED prefix pages in its table row
-            # (gauge bookkeeping; the pages themselves ride
-            # _slot_pages for the refcounted release)
-            self._slot_prefix_pages: dict = {}
-            self._prefix_pages_shared = 0
-            pool_dtype = cache_dtype
-            if kv_dtype is not None and not self.kv_quant:
-                from cake_tpu.utils.devices import resolve_kv_dtype
-                pool_dtype = resolve_kv_dtype(kv_dtype)
-            if self.kv_quant:
-                from cake_tpu.kv import QuantizedPagedKVCache
-                self.cache = QuantizedPagedKVCache.create(
-                    config, max_slots, kv_pages, kv_page_size,
-                    max_seq_len)
-            else:
-                self.cache = PagedKVCache.create(
-                    config, max_slots, kv_pages, kv_page_size,
-                    max_seq_len, dtype=pool_dtype)
-            self._pool_dtype = pool_dtype
-            log.info("paged KV: %d pages x %d tokens, %s attention, "
-                     "%s storage (%.2f GiB pool; dense %d-slot "
-                     "equivalent would be %.2f GiB)",
-                     kv_pages, kv_page_size, impl,
-                     "int8+scales" if self.kv_quant else str(pool_dtype),
-                     self.cache.memory_bytes() / 2**30, max_slots,
-                     self.cache.memory_bytes() / 2**30
-                     * max_slots * max_seq_len / (kv_pages * kv_page_size))
-        # --kv-host-pages: host-RAM spill tier behind the page
-        # allocator (cake_tpu/kv/host_tier.py) — preemption victims'
-        # suffix pages and cold shared-prefix pages spill to pinned
-        # host memory and stream back on demand, instead of being
-        # discarded and recomputed.
-        self._host_tier = None
-        # pid -> monotonic last-hit time (the cold-prefix LRU order)
-        self._prefix_last_hit: dict = {}
-        if kv_host_pages is not None:
-            if not self.paged:
-                log.warning("--kv-host-pages ignored: the host KV tier "
-                            "spills paged pool pages (set --kv-pages)")
-            else:
-                from cake_tpu.kv import HostTier
-                from cake_tpu.kv.quantized_pool import page_bytes
-                self._host_tier = HostTier(
-                    kv_host_pages,
-                    page_bytes=page_bytes(
-                        config, kv_page_size,
-                        jnp.int8 if self.kv_quant else self._pool_dtype))
-                log.info("kv host tier: %d pages (%.1f MiB capacity)",
-                         kv_host_pages,
-                         kv_host_pages * self._host_tier.page_bytes
-                         / 2**20)
+            self._setup_paged_exec(kv_pages, kv_page_size, paged_attn,
+                                   kv_host_pages)
+        elif kv_host_pages is not None:
+            log.warning("--kv-host-pages ignored: the host KV tier "
+                        "spills paged pool pages (set --kv-pages)")
         self.prefill_chunk = prefill_chunk
         # --mixed-batch {auto,on,off}: token-level continuous batching
         # for the paged engine — admissions' prefill chunks join the
@@ -635,21 +558,7 @@ class InferenceEngine:
                                           cache_len, dtype=cache_dtype)
         # remember placement so the post-error rebuild (see _run) restores
         # an identically-sharded cache even after donation freed the buffers
-        if isinstance(self.cache, KVCache):
-            self._cache_shardings = KVCache(k=self.cache.k.sharding,
-                                            v=self.cache.v.sharding)
-            self._cache_dtype = self.cache.k.dtype
-        else:
-            # custom cache pytree (e.g. the sp engine's SPEngineCache):
-            # capture (shape, dtype, sharding) NOW — donation frees the
-            # buffers, and a post-error rebuild cannot read them then
-            self._cache_shardings = jax.tree.map(
-                lambda x: (x.shape, x.dtype, x.sharding), self.cache,
-                is_leaf=lambda x: hasattr(x, "sharding"))
-            # first LEAF, not first field: a quantized paged cache's
-            # first field is a QuantPool pytree, not an array
-            self._cache_dtype = jax.tree_util.tree_leaves(
-                self.cache)[0].dtype
+        self._capture_cache_identity()
         # SLO-aware scheduling (cake_tpu/sched): priority-class queues
         # with anti-starvation aging replace FIFO admission; preemption
         # recompute-folds a lower-class slot back into the queue when a
@@ -720,6 +629,10 @@ class InferenceEngine:
         # mid-wave preemption would leave already-planned decode rows
         # writing through a released page-table row)
         self._pending_page_preempt: Optional[int] = None
+        # retained for live reconfiguration: a hot switch that changes
+        # max_slots rebuilds/resizes the scheduler at the same queue
+        # capacity (reconfigure)
+        self._max_queue = max_queue
         self.scheduler = make_scheduler(
             max_slots, max_queue, priority_classes=self._slo,
             config=self._sched_cfg)
@@ -808,6 +721,59 @@ class InferenceEngine:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+        # live reconfiguration (cake_tpu/autotune): --autotune
+        # {off,manual,auto}. `manual` arms POST /api/v1/autotune;
+        # `auto` additionally runs the policy controller from the
+        # engine thread (_autotune_tick). The hot-switch seam
+        # (reconfigure) exists regardless of the mode — checkpoint
+        # restore and tests drive it directly.
+        self.config_epoch = 0
+        self._switch_lock = threading.Lock()
+        self._switch_inflight = False
+        self._switch_log: deque = deque(maxlen=64)
+        mode = autotune or "off"
+        if mode not in ("off", "manual", "auto"):
+            raise ValueError(
+                f"--autotune must be off, manual or auto, got {mode!r}")
+        if mode != "off" and not self._reconfig_supported():
+            log.warning("--autotune disabled: %s",
+                        self._reconfig_refusal())
+            mode = "off"
+        self.autotune_mode = mode
+        if mode != "off":
+            # publish the STARTUP config through the info gauge: the
+            # "live effective config" contract must hold before (and
+            # without) any switch, not only after the first one
+            from cake_tpu.autotune import set_config_info
+            set_config_info(self.current_config())
+        self._autotuner = None
+        self._autotune_last = 0.0
+        # (t, submitted, completed, tokens, shed) deltas for the
+        # signal gather (_gather_autotune_signals)
+        self._autotune_prev: Optional[tuple] = None
+        if mode == "auto":
+            from cake_tpu.autotune import (
+                AutotuneController, ControllerConfig, PolicyTable,
+            )
+            if autotune_policy is None:
+                raise ValueError(
+                    "--autotune auto requires --autotune-policy (fit "
+                    "one with tools/autotune_fit.py)")
+            if isinstance(autotune_policy, str):
+                policy = PolicyTable.load(autotune_policy)
+            elif isinstance(autotune_policy, dict):
+                policy = PolicyTable.from_dict(autotune_policy).validate()
+            else:
+                policy = autotune_policy
+            policy.validate(max_seq_len=self.max_seq_len)
+            self._autotuner = AutotuneController(
+                policy, self.current_config(),
+                config=autotune_config or ControllerConfig())
+            log.info("autotune: auto mode, %d policy regime(s), "
+                     "interval %.1fs",
+                     len(policy.regimes),
+                     self._autotuner.config.interval_s)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -1027,19 +993,6 @@ class InferenceEngine:
             # windowed layouts cap generation by the tail capacity, not
             # by max_seq - prompt
             max_new = min(max_new, self.decode_budget)
-        if self.paged and (self._pager.pages_for(len(ids) + max_new)
-                           > self.cache.n_pages):
-            # can NEVER be admitted (need exceeds the whole pool) —
-            # fail fast instead of requeueing forever. A shared prefix
-            # does not change this bound: the prefix is page-aligned,
-            # so prefix pages + suffix pages == the contiguous page
-            # count exactly (sharing saves FREE pages at admission,
-            # not table-row size)
-            raise ValueError(
-                f"request needs "
-                f"{self._pager.pages_for(len(ids) + max_new)} kv pages; "
-                f"the pool has {self.cache.n_pages} total (raise "
-                "--kv-pages or lower max_tokens)")
         with self._rid_lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -1061,20 +1014,6 @@ class InferenceEngine:
                 raise ValueError(
                     "logprobs are unavailable in speculative serving "
                     "(accepted drafts are not sampled step-by-step)")
-        if self._shed is not None:
-            # AFTER every validation above: an invalid request must get
-            # its deterministic 400, never a 429 inviting a retry of
-            # something that can never succeed (and must not pollute
-            # the shed counters)
-            depth = (self.scheduler.depth_ahead(cls)
-                     if hasattr(self.scheduler, "depth_ahead")
-                     else self.scheduler.queue_depth)
-            dec = self._shed.decide(cls, depth)
-            if not dec.admit:
-                self.stats.shed += 1
-                _SHED_REQUESTS.labels(cls).inc()
-                raise ShedError(cls, dec.retry_after_s,
-                                est_wait_s=dec.est_wait_s)
         req = _Request(
             rid=rid, prompt_ids=ids, max_new_tokens=max_new,
             temperature=eff_temp if eff_temp is not None else 0.0,
@@ -1088,24 +1027,63 @@ class InferenceEngine:
             want_top=want_top_logprobs,
             priority=cls,
         )
-        # register BEFORE scheduler.submit: the engine thread may plan the
-        # rid immediately, and _do_prefill treats an unknown rid as cancelled
-        self._requests[rid] = req
-        # trace BEFORE scheduler.submit: the engine thread may plan the
-        # rid immediately, and prefill_start on an unknown rid would
-        # silently drop the span (no queue-wait/prefill observation)
-        self.tracer.admit(rid, len(ids), max_new, priority=cls)
-        ok = (self.scheduler.submit(rid, len(ids), max_new, priority=cls)
-              if self._slo else
-              self.scheduler.submit(rid, len(ids), max_new))
-        if not ok:
-            self._requests.pop(rid, None)
-            self.tracer.drop(rid)
-            retry = 1.0
+        # admission critical section: a LIVE config switch
+        # (_reconfigure_sync) replaces the pool/pager/scheduler on the
+        # engine thread while THIS runs on a handler thread — the lock
+        # makes each admission land fully before or fully after a
+        # switch (never half-registered across the scheduler swap, and
+        # the pool bound below always reads one consistent pool)
+        with self._switch_lock:
+            if self.paged and (self._pager.pages_for(len(ids) + max_new)
+                               > self.cache.n_pages):
+                # can NEVER be admitted (need exceeds the whole pool) —
+                # fail fast instead of requeueing forever. A shared
+                # prefix does not change this bound: the prefix is
+                # page-aligned, so prefix pages + suffix pages == the
+                # contiguous page count exactly (sharing saves FREE
+                # pages at admission, not table-row size)
+                raise ValueError(
+                    f"request needs "
+                    f"{self._pager.pages_for(len(ids) + max_new)} kv "
+                    f"pages; the pool has {self.cache.n_pages} total "
+                    "(raise --kv-pages or lower max_tokens)")
             if self._shed is not None:
-                retry = self._shed.estimate_retry_after(
-                    cls, self.scheduler.queue_depth)
-            raise QueueFullError(retry_after=retry)
+                # AFTER every validation above: an invalid request must
+                # get its deterministic 400, never a 429 inviting a
+                # retry of something that can never succeed (and must
+                # not pollute the shed counters)
+                depth = (self.scheduler.depth_ahead(cls)
+                         if hasattr(self.scheduler, "depth_ahead")
+                         else self.scheduler.queue_depth)
+                dec = self._shed.decide(cls, depth)
+                if not dec.admit:
+                    self.stats.shed += 1
+                    _SHED_REQUESTS.labels(cls).inc()
+                    raise ShedError(cls, dec.retry_after_s,
+                                    est_wait_s=dec.est_wait_s)
+            # register BEFORE scheduler.submit: the engine thread may
+            # plan the rid immediately, and _do_prefill treats an
+            # unknown rid as cancelled
+            self._requests[rid] = req
+            # trace BEFORE scheduler.submit (prefill_start on an
+            # unknown rid would silently drop the span). config_epoch
+            # attributes the trace to the engine config that admitted
+            # it (a hot switch bumps the epoch, so traces spanning one
+            # are distinguishable — cake_tpu/autotune).
+            self.tracer.admit(rid, len(ids), max_new, priority=cls,
+                              config_epoch=self.config_epoch)
+            ok = (self.scheduler.submit(rid, len(ids), max_new,
+                                        priority=cls)
+                  if self._slo else
+                  self.scheduler.submit(rid, len(ids), max_new))
+            if not ok:
+                self._requests.pop(rid, None)
+                self.tracer.drop(rid)
+                retry = 1.0
+                if self._shed is not None:
+                    retry = self._shed.estimate_retry_after(
+                        cls, self.scheduler.queue_depth)
+                raise QueueFullError(retry_after=retry)
         self._set_queue_gauges()
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
@@ -1557,6 +1535,11 @@ class InferenceEngine:
         while not self._stop.is_set():
             self._drain_cancellations()
             self._drain_commands()
+            if self._autotuner is not None:
+                # between iterations only — a switch folds every slot,
+                # so it must never land mid-wave (the preemption
+                # invariant); the tick itself is a no-op off-interval
+                self._autotune_tick()
             if self._slo and self._preemption:
                 # between iterations only: no device work is in flight,
                 # so a reclaimed slot cannot be mid-decode through a
@@ -1931,6 +1914,592 @@ class InferenceEngine:
         if self._faults is not None:
             out["fault_plan"] = self._faults.describe()
         return out
+
+    # -- live reconfiguration (cake_tpu/autotune) ------------------------
+
+    def _setup_paged_exec(self, kv_pages: int, kv_page_size: int,
+                          paged_attn: Optional[str],
+                          kv_host_pages: Optional[int]) -> None:
+        """Build the paged execution state — step-fn partials, page
+        allocator, pool cache, host tier — from the geometry knobs.
+        The SINGLE source for __init__ AND the live hot-switch seam
+        (_apply_exec_config): a reconfigured pool must resolve exactly
+        as a startup one would. Requires self.paged/self.kv_quant/
+        self._kv_dtype_name/self._base_cache_dtype already set."""
+        from cake_tpu.models.llama.paged import (
+            PageAllocator, PagedKVCache, decode_step_ragged_paged,
+            mixed_step_paged, prefill_prefix_pages,
+            prefill_slot_paged, prefill_slot_paged_chunk,
+            prefill_slot_paged_prefixed,
+        )
+        if kv_pages < 1 or kv_page_size < 1:
+            raise ValueError(
+                f"--kv-pages {kv_pages} / --kv-page-size "
+                f"{kv_page_size} must be >= 1")
+        # paged_attn: {fold,pallas} attention impl for the paged step
+        # fns; None/"auto" resolves via the ONE shared rule
+        # (autotune/space.resolve_paged_attn — the autotuner's config
+        # comparison key must never resolve "auto" differently from
+        # this dispatch setup). The choice rides the jitted steps as a
+        # STATIC arg, so both variants keep the same traced signature
+        # and the engine's dispatch plumbing is impl-blind.
+        from cake_tpu.autotune.space import resolve_paged_attn
+        impl = resolve_paged_attn(paged_attn)
+        if impl not in ("fold", "pallas"):
+            raise ValueError(
+                f"--paged-attn must be fold or pallas, got {impl!r}")
+        self.paged_attn = impl
+        self._prefill_slot = partial(prefill_slot_paged, attn=impl)
+        self._decode_step = partial(decode_step_ragged_paged, attn=impl)
+        self._decode_scan_impl = (_decode_scan_paged if impl == "fold"
+                                  else _decode_scan_paged_pallas)
+        # chunked paged prefill: long prompts admit in C-token windows
+        self._prefill_chunk_step = partial(prefill_slot_paged_chunk,
+                                           attn=impl)
+        # page-granular prefix sharing: registered prefixes (and
+        # auto_prefix_system heads) prefill ONCE into pool pages and
+        # are mapped read-only into every matching slot's table row
+        # (_alloc_slot_pages). _prefix_capable stays True.
+        self._paged_prefixed_step = partial(
+            prefill_slot_paged_prefixed, attn=impl)
+        self._prefix_pages_step = partial(prefill_prefix_pages,
+                                          attn=impl)
+        # token-level continuous batching (--mixed-batch): ONE jitted
+        # step consumes a batch of (row kind, pos, q_len) descriptors —
+        # decode rows and prefill-chunk rows in the same launch
+        self._mixed_step_fn = partial(mixed_step_paged, attn=impl)
+        self._pager = PageAllocator(kv_pages, kv_page_size)
+        self._slot_pages = {}
+        # slot -> count of SHARED prefix pages in its table row (gauge
+        # bookkeeping; the pages themselves ride _slot_pages for the
+        # refcounted release)
+        self._slot_prefix_pages = {}
+        self._prefix_pages_shared = 0
+        self._prefix_last_hit = {}
+        pool_dtype = self._base_cache_dtype
+        if self._kv_dtype_name is not None and not self.kv_quant:
+            from cake_tpu.utils.devices import resolve_kv_dtype
+            pool_dtype = resolve_kv_dtype(self._kv_dtype_name)
+        if self.kv_quant:
+            from cake_tpu.kv import QuantizedPagedKVCache
+            self.cache = QuantizedPagedKVCache.create(
+                self.config, self.max_slots, kv_pages, kv_page_size,
+                self.max_seq_len)
+        else:
+            self.cache = PagedKVCache.create(
+                self.config, self.max_slots, kv_pages, kv_page_size,
+                self.max_seq_len, dtype=pool_dtype)
+        self._pool_dtype = pool_dtype
+        log.info("paged KV: %d pages x %d tokens, %s attention, "
+                 "%s storage (%.2f GiB pool; dense %d-slot "
+                 "equivalent would be %.2f GiB)",
+                 kv_pages, kv_page_size, impl,
+                 "int8+scales" if self.kv_quant else str(pool_dtype),
+                 self.cache.memory_bytes() / 2**30, self.max_slots,
+                 self.cache.memory_bytes() / 2**30
+                 * self.max_slots * self.max_seq_len
+                 / (kv_pages * kv_page_size))
+        # --kv-host-pages: host-RAM spill tier behind the page
+        # allocator (cake_tpu/kv/host_tier.py) — preemption victims'
+        # suffix pages and cold shared-prefix pages spill to pinned
+        # host memory and stream back on demand, instead of being
+        # discarded and recomputed.
+        self._host_tier = None
+        if kv_host_pages is not None:
+            from cake_tpu.kv import HostTier
+            from cake_tpu.kv.quantized_pool import page_bytes
+            self._host_tier = HostTier(
+                kv_host_pages,
+                page_bytes=page_bytes(
+                    self.config, kv_page_size,
+                    jnp.int8 if self.kv_quant else pool_dtype))
+            log.info("kv host tier: %d pages (%.1f MiB capacity)",
+                     kv_host_pages,
+                     kv_host_pages * self._host_tier.page_bytes / 2**20)
+
+    def _capture_cache_identity(self) -> None:
+        """Record the cache's placement/dtype so post-error and
+        post-switch rebuilds restore identically-sharded zeros even
+        after donation freed the live buffers."""
+        if isinstance(self.cache, KVCache):
+            self._cache_shardings = KVCache(k=self.cache.k.sharding,
+                                            v=self.cache.v.sharding)
+            self._cache_dtype = self.cache.k.dtype
+        else:
+            # custom cache pytree (e.g. the sp engine's SPEngineCache):
+            # capture (shape, dtype, sharding) NOW — donation frees the
+            # buffers, and a post-error rebuild cannot read them then
+            self._cache_shardings = jax.tree.map(
+                lambda x: (x.shape, x.dtype, x.sharding), self.cache,
+                is_leaf=lambda x: hasattr(x, "sharding"))
+            # first LEAF, not first field: a quantized paged cache's
+            # first field is a QuantPool pytree, not an array
+            self._cache_dtype = jax.tree_util.tree_leaves(
+                self.cache)[0].dtype
+
+    def _reconfig_supported(self) -> bool:
+        return (not self._custom_steps and not self.ring
+                and not self._spec and not self._multihost)
+
+    def _reconfig_refusal(self) -> str:
+        if self._spec:
+            return ("speculative serving has no hot-switch fold (the "
+                    "draft cache cannot be rebuilt mid-round)")
+        if self.ring:
+            return ("ring (sliding-window) caches own their layout; "
+                    "a rebuilt ring cannot replay folded positions")
+        if self._multihost:
+            return ("multi-host serving replays a fixed op stream; "
+                    "followers cannot rebuild mid-stream")
+        return ("custom step fns own their cache contract; only the "
+                "built-in dense/paged engines can hot-switch")
+
+    def current_config(self):
+        """The LIVE effective engine config as an autotune point
+        (cake_tpu/autotune.EngineConfig) — what /api/v1/health and
+        GET /api/v1/autotune report."""
+        from cake_tpu.autotune.space import EngineConfig
+        kv_dtype = None
+        if self.paged:
+            if self.kv_quant:
+                kv_dtype = "int8"
+            elif self._pool_dtype != self._base_cache_dtype:
+                # report the storage name only when it actually
+                # differs from what an UNSET --kv-dtype resolves to —
+                # a policy config omitting kv_dtype must compare equal
+                # to an engine whose explicit name resolved to the
+                # default (config_key spell-normalization)
+                kv_dtype = self._kv_dtype_name
+        return EngineConfig(
+            slots=self.max_slots,
+            decode_scan=self._decode_scan,
+            kv_pages=self.cache.n_pages if self.paged else None,
+            kv_page_size=(self._pager.page_size if self.paged else 128),
+            kv_dtype=kv_dtype,
+            mixed_batch="on" if self._mixed else "off",
+            paged_attn=self.paged_attn or "auto",
+        )
+
+    def reconfigure(self, config, reason: str = "manual") -> bool:
+        """Hot-switch the engine to a new EngineConfig under live load:
+        fold every in-flight request's generated tokens into its prompt
+        (exactly the PR 8 recovery resubmit minus backoff and crash
+        implication), tear down and rebuild the jitted step fns + KV
+        pool under the new knobs, and requeue with seniority, class and
+        preempt budget preserved. Greedy streams complete
+        token-identical at f32 KV across the switch (dense AND paged,
+        shared-prefix slots included — tests/test_autotune_engine.py).
+
+        Thread-safe: routed onto the engine thread between iterations
+        when the engine is live; a concurrent switch raises
+        SwitchInFlightError (the API's 409). Returns True when a
+        switch happened, False for a no-op (already at `config`)."""
+        from cake_tpu.autotune.space import EngineConfig
+        from cake_tpu.serve.errors import SwitchInFlightError
+        cfg = (config if isinstance(config, EngineConfig)
+               else EngineConfig.from_dict(dict(config)))
+        if (self._thread is not None and self._thread.is_alive()
+                and threading.current_thread() is not self._thread):
+            with self._switch_lock:
+                if self._switch_inflight:
+                    raise SwitchInFlightError(
+                        "a config switch is already in flight")
+                self._switch_inflight = True
+            try:
+                return self._run_on_engine_thread(
+                    lambda: self._reconfigure_sync(cfg, reason))
+            finally:
+                with self._switch_lock:
+                    self._switch_inflight = False
+        return self._reconfigure_sync(cfg, reason)
+
+    def _reconfigure_sync(self, new, reason: str) -> bool:
+        """Engine-thread body of reconfigure() — between iterations
+        only (no device work in flight, exactly the preemption
+        invariant)."""
+        from cake_tpu.autotune import (
+            SWITCH_SECONDS, SWITCHES, set_config_info,
+        )
+        from cake_tpu.autotune.space import (
+            config_key, switch_guard, validate_config,
+        )
+        # default-aware keys: a policy spelling the engine's default
+        # pool dtype explicitly must be a no-op, not a pointless fold
+        base = np.dtype(self._base_cache_dtype).name
+        cur = self.current_config()
+        if (config_key(new, default_kv_dtype=base)
+                == config_key(cur, default_kv_dtype=base)):
+            return False
+        if not self._reconfig_supported():
+            raise ValueError("live reconfiguration is unavailable: "
+                             + self._reconfig_refusal())
+        guard = switch_guard(cur, new)
+        if guard is not None:
+            raise ValueError(guard)
+        validate_config(new, max_seq_len=self.max_seq_len)
+        if (self.prefill_chunk is not None
+                and self.max_seq_len % self.prefill_chunk != 0):
+            raise ValueError("prefill_chunk no longer divides "
+                             "max_seq_len")  # unreachable; belt+braces
+        t0 = time.perf_counter()
+        # the whole mutation runs under _switch_lock: handler-thread
+        # submit() takes the same lock around its registration, so an
+        # admission lands fully before this switch (fit-checked below
+        # and carried) or fully after it (validated by submit's own
+        # fail-fast against the NEW pool) — never half-registered
+        # across the scheduler/pool swap
+        with self._switch_lock:
+            # ZERO dropped streams is the contract: refuse a pool no
+            # in-flight request fits instead of quietly failing it
+            # (the same bound submit() enforces at admission)
+            if new.kv_pages is not None:
+                per = new.kv_page_size
+                for req in list(self._requests.values()):
+                    if req.done.is_set():
+                        continue
+                    need = -(-(len(req.prompt_ids)
+                               + req.max_new_tokens) // per)
+                    if need > new.kv_pages:
+                        raise ValueError(
+                            f"refusing switch: rid={req.rid} needs "
+                            f"{need} kv pages, the proposed pool has "
+                            f"{new.kv_pages} (no stream may be "
+                            "dropped)")
+            folded = self._prepare_fold(new)
+            applied, apply_err = new, None
+            try:
+                self._apply_exec_config(new)
+            except Exception as e:  # noqa: BLE001 — e.g. the new pool
+                # OOMs after the old one was freed: restore the OLD
+                # config's geometry (zeros pool — the folded streams
+                # re-prefill from token ids either way) instead of
+                # leaving the engine cacheless and unservable
+                log.exception("reconfigure rebuild failed; restoring "
+                              "the previous config")
+                applied, apply_err = cur, e
+                self._apply_exec_config(cur)
+            carried = self._requeue_folded(applied, folded)
+        if apply_err is not None:
+            self._wake.set()
+            raise ValueError(
+                f"switch to {new.to_dict()} failed; previous config "
+                f"restored with {carried} stream(s) requeued: "
+                f"{apply_err}") from apply_err
+        self.config_epoch += 1
+        self.stats.config_switches += 1
+        dt = time.perf_counter() - t0
+        SWITCHES.labels(reason=reason).inc()
+        SWITCH_SECONDS.observe(dt)
+        set_config_info(self.current_config())
+        entry = {"t": round(time.time(), 3), "reason": reason,
+                 "from": cur.to_dict(), "to": new.to_dict(),
+                 "seconds": round(dt, 4), "carried": carried,
+                 "epoch": self.config_epoch}
+        self._switch_log.append(entry)
+        if self._autotuner is not None and reason == "manual":
+            # keep the auto controller's view of "current" in sync with
+            # an operator's switch (it would otherwise keep proposing
+            # moves relative to the superseded config); manual reasons
+            # never arm the rollback guard — the operator's call stands
+            self._autotuner.on_switched(
+                new, cur, self._autotuner.window_service_tps(), reason)
+        log.warning("engine reconfigured (%s) in %.3fs: %s -> %s, "
+                    "%d stream(s) carried (epoch %d)", reason, dt,
+                    cur.to_dict(), new.to_dict(), carried,
+                    self.config_epoch)
+        self._wake.set()
+        return True
+
+    def _prepare_fold(self, new) -> set:
+        """Host-side half of the fold: clear every slot's mappings,
+        release pages through the OLD allocator (before the rebuild
+        replaces it), and drop state the old pool's bytes back
+        (spilled pages, the prefix registry). After this, every
+        unfinished request is slotless and will re-prefill from token
+        ids — so it is safe regardless of whether the rebuild lands
+        the NEW config or rolls back to the old geometry. Caller holds
+        _switch_lock, engine thread only. Returns the rids that held
+        slots — the streams the switch actually folds (queued requests
+        just ride along untouched)."""
+        folded = set()
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            if req is not None:
+                req.slot = -1
+                folded.add(req.rid)
+            self._release_slot_pages(slot)
+        self._mixed_pending.clear()
+        self._page_blocked_rid = None
+        self._pending_page_preempt = None
+        self._page_starved = False
+        self._implicated = ()
+        if self._host_tier is not None:
+            # spilled pages are OLD-pool layout/dtype; a restore into
+            # the rebuilt pool would scatter stale bytes
+            self._host_tier.clear()
+        if self.paged or new.kv_pages is not None:
+            # the paged registry points at pool pages that die with the
+            # old pool (and a dense registry's (k, v) entries mean
+            # nothing to a paged successor) — auto-prefix heads
+            # re-register on their next request
+            with self._rid_lock:
+                self._prefixes.clear()
+                self._auto_pids.clear()
+            self._prefix_last_hit = {}
+            self._prefix_pages_shared = 0
+            _PREFIX_PAGES_SHARED.set(0)
+        return folded
+
+    def _requeue_folded(self, applied, folded: set) -> int:
+        """Scheduler half of the fold, AFTER the rebuild landed: fold
+        every unfinished request into its prompt and requeue under the
+        config that was actually applied (the target, or the restored
+        old geometry if the rebuild failed) — the recovery resubmit
+        minus backoff/implication: seniority and class survive (SLO
+        requeue), preempt budgets are untouched, nothing is
+        quarantined. Caller holds _switch_lock (handler-thread
+        submit() serializes against the scheduler swap on the same
+        lock). Returns the number of streams the switch actually
+        FOLDED (requests that held a slot — `folded` from
+        _prepare_fold; queued requests requeue/resubmit too but are
+        not counted or trace-stamped: the switch never touched them)."""
+        carried = 0
+        if self._slo:
+            for rid, req in sorted(self._requests.items()):
+                if req.done.is_set():
+                    continue
+                req._kv_restored = False
+                remaining = req.max_new_tokens - len(req.out_tokens)
+                if remaining <= 0:
+                    # was retiring this iteration — it already holds
+                    # every token it asked for
+                    self._finish_recovered(req)
+                    continue
+                # requeue preserves the original enqueue time
+                # (seniority) and the preemption count; False just
+                # means the request was still QUEUED — nothing to do
+                active = self.scheduler.requeue(
+                    rid, len(req.prompt_ids) + len(req.out_tokens),
+                    remaining)
+                if active or rid in folded:
+                    self.tracer.span(rid, "reconfigured",
+                                     generated=len(req.out_tokens))
+                    carried += 1
+            self.scheduler.resize(applied.slots)
+        else:
+            # FIFO has no requeue: rebuild the scheduler at the new
+            # slot count and resubmit in rid order (arrival order).
+            # Capacity must cover QUEUED + formerly-ACTIVE requests:
+            # active slots did not count against the old queue cap, so
+            # a full queue plus occupied slots would overflow a
+            # same-capacity rebuild and drop the overflow — widen to
+            # whatever is unfinished right now (at most old_slots over
+            # the configured cap; later rebuilds use _max_queue again)
+            unfinished = sum(1 for r in self._requests.values()
+                             if not r.done.is_set())
+            self.scheduler = make_scheduler(
+                applied.slots, max(self._max_queue, unfinished),
+                priority_classes=False, config=self._sched_cfg)
+            for rid, req in sorted(self._requests.items()):
+                if req.done.is_set():
+                    continue
+                req._kv_restored = False
+                remaining = req.max_new_tokens - len(req.out_tokens)
+                if remaining <= 0:
+                    self._finish_recovered(req)
+                    continue
+                if not self.scheduler.submit(
+                        rid, len(req.prompt_ids) + len(req.out_tokens),
+                        remaining):
+                    # capacity was sized above: cannot happen — but a
+                    # dropped stream must be LOUD
+                    from cake_tpu.serve.errors import as_engine_error
+                    self._drop_request(req, as_engine_error(
+                        RuntimeError("reconfigure resubmit failed")))
+                    continue
+                if rid in folded:
+                    self.tracer.span(rid, "reconfigured",
+                                     generated=len(req.out_tokens))
+                    carried += 1
+        return carried
+
+    def _apply_exec_config(self, new) -> None:
+        """Rebuild the config-dependent execution state under the new
+        knobs: step fns, KV cache/pool, per-slot mirrors, PRNG keys and
+        the flight recorder's config namespace. Engine thread only,
+        after _fold_all_for_switch (no slot holds device state)."""
+        from cake_tpu.models.llama.model import prefill_slot_chunk
+        B = new.slots
+        self.max_slots = B
+        self._decode_scan = max(1, new.decode_scan)
+        self.paged = new.kv_pages is not None
+        self.kv_quant = new.kv_dtype == "int8"
+        self._kv_dtype_name = new.kv_dtype
+        self._mixed = self.paged and (new.mixed_batch or "auto") != "off"
+        # free the OLD cache/pool BEFORE building the new one: unlike
+        # _reset_after_error (where donation already consumed the
+        # buffers), reconfigure's old pool is fully live — keeping
+        # both resident would transiently double KV HBM and OOM
+        # exactly under the memory pressure a switch is meant to
+        # relieve. Safe: every slot was folded (the resume re-prefills
+        # from token ids, no old-pool bytes needed); dense prefix
+        # entries live outside the cache and are kept/cleared above.
+        for leaf in jax.tree_util.tree_leaves(self.cache):
+            if hasattr(leaf, "delete"):
+                try:
+                    leaf.delete()
+                except Exception:  # noqa: BLE001 — already-donated
+                    pass
+        self.cache = None
+        if self.paged:
+            self._setup_paged_exec(new.kv_pages, new.kv_page_size,
+                                   new.paged_attn, self._kv_host_pages)
+        else:
+            self.paged_attn = None
+            self._host_tier = None
+            self._prefill_slot = prefill_slot
+            self._decode_step = decode_step_ragged
+            self._decode_scan_impl = _decode_scan
+            self._prefill_chunk_step = prefill_slot_chunk
+            self.cache = KVCache.create(self.config, B, self.max_seq_len,
+                                        dtype=self._base_cache_dtype)
+        self._prefix_capable = True
+        self._mixed_chunk = (self.prefill_chunk
+                             if self.prefill_chunk is not None
+                             else min(256, self.max_seq_len))
+        self._capture_cache_identity()
+        # per-slot mirrors at the new width
+        self._pos = np.zeros(B, np.int64)
+        self._last_tok = np.zeros(B, np.int64)
+        self._steps = np.zeros(B, np.int64)
+        self._temp = np.full(B, self.defaults.temperature or 0.0,
+                             np.float32)
+        self._top_p = np.ones(B, np.float32)
+        self._penalty = np.full(B, self.defaults.repeat_penalty,
+                                np.float32)
+        self._ring = jnp.full((B, self.defaults.repeat_last_n), -1,
+                              jnp.int32)
+        self._slot_req = [None] * B
+        # fold a reset counter into the rebuild key exactly like
+        # _reset_after_error: restoring the startup keys would replay
+        # already-consumed sampling streams
+        self._reset_count += 1
+        self._keys = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(self._key_seed),
+                               self._reset_count), B)
+        self._last_jit = None
+        # re-namespace the jit accountant so the new config's compiled
+        # signatures can never alias the old config's
+        flavor = (f"paged-{self.paged_attn}" if self.paged else "dense")
+        self.flight.rebind(
+            impl=flavor,
+            key_prefix=(self.config, B, self.max_seq_len,
+                        str(self._cache_dtype), flavor))
+
+    def autotune_state(self) -> dict:
+        """GET /api/v1/autotune: mode, live config, switch/decision
+        history, and (auto mode) the controller's window signals."""
+        out = {
+            "mode": self.autotune_mode,
+            "epoch": self.config_epoch,
+            "config": self.current_config().to_dict(),
+            "switches": self.stats.config_switches,
+            "rollbacks": self.stats.config_rollbacks,
+            "switch_in_flight": self._switch_inflight,
+            "switch_log": list(self._switch_log),
+        }
+        at = self._autotuner
+        if at is not None:
+            out["controller"] = at.state()
+            out["policy"] = at.policy.to_dict()
+        return out
+
+    def _gather_autotune_signals(self, now: float):
+        """One sliding-window sample from telemetry the engine already
+        keeps: arrival/service deltas from EngineStats, MFU/HBM from
+        the flight recorder, queue depth from the scheduler, pool
+        occupancy from the allocator, TTFT from the tracer ring."""
+        from cake_tpu.autotune import AutotuneSignals
+        st = self.stats
+        submitted = self._next_rid - 1
+        cur = (now, submitted, st.requests_completed,
+               st.tokens_generated, st.shed)
+        prev, self._autotune_prev = self._autotune_prev, cur
+        if prev is None:
+            prev = cur
+        dt = max(1e-6, now - prev[0])
+        util = self.flight.utilization(include_prefill=True)
+        pages_frac = 0.0
+        if self.paged:
+            total = self.cache.n_pages
+            pages_frac = (total - self._pager.free_pages) / total
+        depths = getattr(self.scheduler, "class_depths", None)
+        ttfts = self.tracer.recent_ttfts(32)
+        p99 = None
+        if ttfts:
+            xs = sorted(ttfts)
+            p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        return AutotuneSignals(
+            t=now,
+            offered_rps=(submitted - prev[1]) / dt,
+            completed_rps=(st.requests_completed - prev[2]) / dt,
+            service_tps=(st.tokens_generated - prev[3]) / dt,
+            queue_depth=self.scheduler.queue_depth,
+            queue_depth_by_class=depths() if depths else {},
+            mfu=util["mfu"], hbm_util=util["hbm_util"],
+            pages_in_use_frac=pages_frac,
+            shed_rps=(st.shed - prev[4]) / dt,
+            ttft_p99_s=p99,
+        )
+
+    def _autotune_tick(self) -> None:
+        """Auto-mode controller drive, called from the engine loop
+        between iterations: sample signals every interval, apply the
+        controller's switch/rollback decision inline (this IS the
+        engine thread, so the switch happens at a step boundary)."""
+        from cake_tpu.autotune import ROLLBACKS
+        at = self._autotuner
+        if at is None:
+            return
+        now = time.monotonic()
+        if now - self._autotune_last < at.config.interval_s:
+            return
+        self._autotune_last = now
+        decision = at.decide(self._gather_autotune_signals(now))
+        if decision is None:
+            return
+        target, reason = decision
+        old = self.current_config()
+        pre_rate = at.window_service_tps()
+        try:
+            if not self._reconfigure_sync(target, reason):
+                # spelled-differently-but-identical target (the
+                # engine's default-aware key normalization caught it):
+                # adopt the target spelling as "current" so the
+                # controller stops re-proposing the no-op every tick
+                at.on_switched(target, old, pre_rate, "noop")
+                return
+        except Exception as e:  # noqa: BLE001
+            if reason == "rollback":
+                # a REFUSED revert (e.g. a stream admitted under the
+                # new pool no longer fits the old one) must NOT pin
+                # the known-good pre-switch config: stay put — the
+                # regressed config is already pinned, so once load
+                # drains the policy re-proposes the good one normally
+                log.warning("rollback revert refused; staying on the "
+                            "current config: %s", e)
+            else:
+                # an unswitchable policy target must not spin: pin it
+                # so the controller stops proposing it
+                log.warning("autotune switch refused (%s); pinning: "
+                            "%s", reason, e)
+                at.pin(target, why=str(e))
+            return
+        at.on_switched(target, old, pre_rate, reason)
+        if reason == "rollback":
+            ROLLBACKS.inc()
+            self.stats.config_rollbacks += 1
 
     def _reset_after_error(self) -> None:
         # the jitted steps donate the cache/keys/ring buffers; after a

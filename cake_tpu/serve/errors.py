@@ -54,6 +54,12 @@ class PoisonRequestError(EngineRequestError):
         self.crashes = crashes
 
 
+class SwitchInFlightError(RuntimeError):
+    """A live config switch (engine.reconfigure / cake_tpu/autotune)
+    is already in flight; the API maps this to HTTP 409 on
+    POST /api/v1/autotune. Retry after the current switch lands."""
+
+
 def as_engine_error(err: Exception) -> EngineRequestError:
     """Wrap an arbitrary step failure in the typed, retryable-flagged
     form clients see — idempotent for already-typed errors."""

@@ -113,6 +113,9 @@ def test_unreachable_backend_falls_back_to_cpu():
     assert result["backend"] == "cpu_fallback"
     assert result["value"] > 0          # a real cpu measurement, not 0.0
     assert result["unit"] == "tokens/s"
+    # top-level degraded marker: driver rounds reading this line can
+    # machine-distinguish a dead-tunnel fallback from a regression
+    assert result["degraded"] is True
 
 
 @pytest.mark.slow  # bench subprocess + engine compile -> slow lane
@@ -241,6 +244,31 @@ def test_kv_tier_smoke_reports_capacity_win():
         assert result[f"kv_tok_s_{tag}"] > 0
         assert result[f"kv_spills_{tag}"] > 0
         assert result[f"kv_restores_{tag}"] > 0
+
+
+@pytest.mark.slow  # two engine phases + a live hot switch -> slow lane
+def test_autotune_smoke_tier_switches_without_losing_streams():
+    """The --autotune tier's acceptance contract: the mid-run offered-
+    load shift triggered >= 1 AUTONOMOUS switch (the policy controller
+    moved the engine from slots_lo to slots_hi), no stream was lost
+    across it, and at f32 KV the autotuned run's greedy streams came
+    back token-identical to the pinned run. A run where the controller
+    silently stopped proposing (or the switch dropped a stream)
+    benches the pinned config twice and fails here."""
+    result = _run_tier("autotune_tiny")
+    assert result["unit"] == "switches" and result["value"] >= 1
+    assert result["autotune_switches"] >= 1
+    assert result["autotune_streams_lost"] == 0
+    assert result["autotune_final_slots"] == 4   # lo (2) -> hi (4)
+    # f32 KV: the hot switch is token-identical, not approximately-resumed
+    assert result["autotune_tokens_match"] is True
+    # per-phase numbers for both runs, and fitter-ingestible records
+    for tag in ("pinned", "auto"):
+        for ph in ("low", "high"):
+            assert result[f"{ph}_tok_s_{tag}"] > 0
+            assert result[f"{ph}_ttft_p99_{tag}_ms"] > 0
+    assert all("config" in o and o["tok_s"] > 0
+               for o in result["autotune_observations"])
 
 
 @pytest.mark.slow  # two engine phases under injected chaos -> slow lane

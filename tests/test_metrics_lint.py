@@ -109,6 +109,7 @@ def test_step_metric_families_documented_in_readme():
     with real help text AND appear in the README metrics table — an
     undocumented telemetry metric fails tier-1 here."""
     lm = _load()
+    import cake_tpu.autotune.controller  # noqa: F401 — cake_autotune_*
     import cake_tpu.faults.injector  # noqa: F401 — cake_fault_*
     import cake_tpu.kv.host_tier  # noqa: F401 — registers cake_kv_*
     import cake_tpu.obs.steps  # noqa: F401 — registers the families
@@ -125,6 +126,8 @@ def test_step_metric_families_documented_in_readme():
                for line in text.splitlines()), "fault plane families"
     assert any(line.startswith("# TYPE cake_engine_recoveries_total")
                for line in text.splitlines()), "recovery families"
+    assert any(line.startswith("# TYPE cake_autotune_switches_total")
+               for line in text.splitlines()), "autotune families"
     errs = lm.lint_readme_coverage(text, readme)
     assert errs == [], errs
 

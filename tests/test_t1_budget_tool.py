@@ -92,6 +92,58 @@ def test_cli_on_fixture_file(tmp_path):
     assert tool.main([str(tmp_path / "missing.log")]) == 2
 
 
+SLOW_BOX_LOG = GOOD_LOG.replace("10.21s call", "21.70s call")
+
+
+def test_fast_box_parse_keeps_nominal_cap(capsys):
+    # scale 1 (a fast box): 21.7s breaches the 15s cap — the original
+    # verdict is unchanged by the calibration machinery
+    tool = _load()
+    assert tool.check(SLOW_BOX_LOG, 15.0, 840.0, 0.9, scale=1.0) == 1
+    out = capsys.readouterr().out
+    assert "BUDGET FAIL" in out and "test_streams" in out
+
+
+def test_slow_box_parse_scales_cap_and_names_scaled_tests(capsys):
+    # the PR 7/8 condition: a slow box stretches a pre-existing heavy
+    # test past 15s with no code change — under the calibrated scale
+    # the SAME log passes, and the scaled test is NAMED in warnings
+    tool = _load()
+    assert tool.check(SLOW_BOX_LOG, 15.0, 840.0, 0.9, scale=2.0,
+                      scale_source="CAKE_T1_SCALE=2") == 0
+    cap = capsys.readouterr()
+    assert "BUDGET OK" in cap.out
+    assert "test_streams" in cap.err          # named, never silent
+    assert "within the scaled" in cap.err
+    # the total cap is ABSOLUTE: scale must not relax it
+    over = SLOW_BOX_LOG.replace("in 729.36s", "in 851.02s")
+    assert tool.check(over, 15.0, 840.0, 0.9, scale=2.0) == 1
+
+
+def test_scale_json_fields_and_env_override(tmp_path, capsys):
+    import json
+    tool = _load()
+    s = tool.summarize(SLOW_BOX_LOG, 15.0, 840.0, 0.9, scale=2.0)
+    assert s["rc"] == 0 and s["scale"] == 2.0
+    assert s["scaled_tests"] == ["tests/test_engine.py::test_streams "
+                                 "call"]
+    # env override beats the probe and is clamped to [1, 4]
+    assert tool.calibrate_scale({"CAKE_T1_SCALE": "2.5"})[0] == 2.5
+    assert tool.calibrate_scale({"CAKE_T1_SCALE": "9"})[0] == 4.0
+    assert tool.calibrate_scale({"CAKE_T1_SCALE": "0.1"})[0] == 1.0
+    assert tool.calibrate_scale({"CAKE_T1_SCALE": "zzz"})[0] == 1.0
+    # no env: the probe produces a clamped, positive scale
+    scale, source = tool.calibrate_scale({})
+    assert 1.0 <= scale <= 4.0 and "probe" in source
+    # CLI: explicit --scale skips calibration, rides the JSON line
+    p = tmp_path / "t1.log"
+    p.write_text(SLOW_BOX_LOG)
+    assert tool.main([str(p), "--json", "--scale", "2"]) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["scale"] == 2.0 and line["rc"] == 0
+    assert tool.main([str(p), "--json", "--scale", "1"]) == 1
+
+
 def test_json_summary_mode(tmp_path, capsys):
     import json
     tool = _load()

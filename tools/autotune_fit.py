@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Fit an --autotune-policy table from measured serving data.
+
+The offline half of the online autotuner (cake_tpu/autotune, ISSUE 9 /
+Sandwich in PAPERS.md): ingest (config, offered load, throughput)
+observations from BENCH-style JSON files and/or --step-log flight
+recorder captures, bucket the offered-load axis into regimes, pick the
+best measured config per regime, and write the piecewise policy file
+the live controller consults (--autotune auto --autotune-policy PATH).
+
+Inputs:
+
+  * ``--bench FILE [FILE ...]`` — JSON documents scanned recursively
+    for observation records: any dict carrying ``config`` (EngineConfig
+    JSON) plus ``tok_s`` (and optionally ``offered_rps``). The
+    ``bench.py --autotune`` tier emits these under
+    ``autotune_observations``; hand-built sweep files work the same.
+  * ``--step-log PATH --step-config JSON`` — one flight-recorder JSONL
+    per engine config (the recorder has no config column): the log is
+    sliced into ``--window`` second windows, each contributing one
+    observation under the named config. Repeat the pair per config.
+
+Usage:
+    python tools/autotune_fit.py --bench BENCH_r*.json \
+        --out policy.json
+    python tools/autotune_fit.py \
+        --step-log s16.jsonl --step-config '{"slots": 16}' \
+        --step-log s32.jsonl --step-config '{"slots": 32}' \
+        --out policy.json --regimes 3
+
+Exit status: 0 = policy written, 1 = fit failed (no usable
+observations), 2 = bad arguments / unreadable input.
+
+tests/test_autotune.py lints this tool on fixture files in tier-1, per
+the tools-as-tests policy (lint_metrics.py precedent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench", nargs="*", default=[],
+                    help="BENCH-style JSON files to scan for "
+                         "observation records")
+    ap.add_argument("--step-log", action="append", default=[],
+                    help="--step-log JSONL capture (pair each with a "
+                         "--step-config)")
+    ap.add_argument("--step-config", action="append", default=[],
+                    help="EngineConfig JSON the paired --step-log was "
+                         "captured under")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="step-log slice width, seconds (default 10)")
+    ap.add_argument("--regimes", type=int, default=4,
+                    help="max offered-load regimes (default 4)")
+    ap.add_argument("--out", required=True,
+                    help="policy file to write (--autotune-policy)")
+    args = ap.parse_args(argv)
+
+    from cake_tpu.autotune import (
+        EngineConfig, PolicyTable, extract_observations, fit,
+        observations_from_step_log,
+    )
+
+    if len(args.step_log) != len(args.step_config):
+        print("autotune_fit: each --step-log needs a matching "
+              "--step-config (the recorder has no config column)",
+              file=sys.stderr)
+        return 2
+    obs = []
+    for path in args.bench:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"autotune_fit: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        found = extract_observations(doc)
+        print(f"autotune_fit: {path}: {len(found)} observation(s)")
+        obs.extend(found)
+    for path, cfg_json in zip(args.step_log, args.step_config):
+        try:
+            cfg = EngineConfig.from_dict(json.loads(cfg_json))
+        except (ValueError, TypeError) as e:
+            print(f"autotune_fit: bad --step-config {cfg_json!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            found = observations_from_step_log(path, cfg,
+                                               window_s=args.window)
+        except OSError as e:
+            print(f"autotune_fit: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"autotune_fit: {path}: {len(found)} window(s) under "
+              f"{cfg.to_dict()}")
+        obs.extend(found)
+
+    try:
+        policy: PolicyTable = fit(obs, max_regimes=args.regimes)
+    except ValueError as e:
+        print(f"autotune_fit: fit failed: {e}", file=sys.stderr)
+        return 1
+    policy.save(args.out)
+    for r in policy.regimes:
+        bound = r.get("max_offered_rps")
+        print(f"autotune_fit: regime <= "
+              f"{'inf' if bound is None else bound} req/s -> "
+              f"{r['config'].to_dict()} "
+              f"(~{r.get('expected_tok_s', '?')} tok/s over "
+              f"{r.get('n_observations', '?')} obs)")
+    print(f"autotune_fit: wrote {len(policy.regimes)} regime(s) to "
+          f"{args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,10 +18,24 @@ A soft warning is printed (stderr) when the total passes
 it fails. Durations lines are optional — without them only the total
 is checked (and their absence is noted).
 
+**Per-test cap calibration** (the PR 7/8 false-failure fix): the 15s
+per-test cap was tuned on a fast box, and slow sessions of the SAME
+environment pushed pre-existing heavy tests (sd txt2img, qwen2 golden
+setup) past it without any code change. The cap now scales by a
+box-speed factor: ``CAKE_T1_SCALE`` (explicit override), else a cheap
+~0.3s timing probe (a fixed pure-Python workload vs its fast-box
+nominal), clamped to [1.0, 4.0] — so a slow box relaxes the PER-TEST
+cap proportionally while the ABSOLUTE 840s total cap stays untouched
+(the 870s kill does not care how slow the box is). Tests that only
+pass because of the scale are listed in the warnings ("within the
+scaled cap") so the relaxation is always visible, never silent.
+
 Usage:
     python tools/check_t1_budget.py /tmp/_t1.log
     python tools/check_t1_budget.py --max-test 15 --max-total 840 LOG
     python tools/check_t1_budget.py --json /tmp/_t1.log   # one JSON line
+    CAKE_T1_SCALE=2 python tools/check_t1_budget.py LOG   # slow box
+    python tools/check_t1_budget.py --scale 1 LOG  # no calibration
 
 ``--json`` prints ONE machine-readable summary line on stdout
 ({"rc", "total_s", "violations", "warnings", "n_durations"}) with the
@@ -39,9 +53,55 @@ per the tools-as-tests policy (lint_metrics.py precedent).
 from __future__ import annotations
 
 import argparse
+import os
 import re
 import sys
-from typing import List, Tuple
+import time
+from typing import List, Optional, Tuple
+
+# fast-box nominal for the calibration probe below: the workload runs
+# in ~0.10s on the boxes the 15s cap was tuned on, set UNDER that so
+# the derived scale carries headroom — the heavy tests the cap guards
+# are XLA-compile-bound, which degrades faster than pure-Python on a
+# slow box (measured: a session whose probe read ~1.6x ran the qwen2
+# golden setup ~1.75x slower), and the probe itself jitters ~10%
+# between runs. The resulting ~25% cap relaxation on a reference box
+# is acceptable: the absolute 840s total cap stays the hard backstop.
+# Bounded so a pathological probe can neither tighten the cap below
+# its tuned value nor void it entirely.
+PROBE_NOMINAL_S = 0.08
+SCALE_MIN, SCALE_MAX = 1.0, 4.0
+
+
+def probe_seconds() -> float:
+    """Best-of-3 timing of a fixed pure-Python workload — CPU-bound,
+    allocation-free, deterministic, so it tracks interpreter speed on
+    the box (the same thing that stretches every test's wall time)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = 0
+        for i in range(2_000_000):
+            acc += i * i
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def calibrate_scale(env=None) -> Tuple[float, str]:
+    """(per-test cap scale, human-readable source). CAKE_T1_SCALE wins
+    (CI pins it for reproducible verdicts); else the timing probe."""
+    env = os.environ if env is None else env
+    raw = env.get("CAKE_T1_SCALE")
+    if raw:
+        try:
+            v = float(raw)
+        except ValueError:
+            return 1.0, f"ignored unparseable CAKE_T1_SCALE={raw!r}"
+        return (max(SCALE_MIN, min(SCALE_MAX, v)),
+                f"CAKE_T1_SCALE={raw}")
+    t = probe_seconds()
+    scale = max(SCALE_MIN, min(SCALE_MAX, t / PROBE_NOMINAL_S))
+    return scale, f"probe {t:.3f}s vs {PROBE_NOMINAL_S:.2f}s nominal"
 
 # `1.23s call     tests/test_x.py::test_y` (pytest --durations output)
 DURATION_RE = re.compile(
@@ -72,24 +132,40 @@ def parse_log(text: str) -> Tuple[float | None, List[Tuple[float, str, str]]]:
 
 
 def summarize(text: str, max_test: float, max_total: float,
-              warn_frac: float) -> dict:
+              warn_frac: float, scale: float = 1.0) -> dict:
     """Pure verdict: {"rc", "total_s", "violations", "warnings",
-    "n_durations"} — the single source both output modes render."""
+    "n_durations", "scale", "scaled_tests"} — the single source both
+    output modes render. `scale` relaxes the PER-TEST cap only (slow
+    boxes run every test proportionally slower); the total cap is
+    absolute — the 870s kill does not scale."""
     total, durations = parse_log(text)
     if total is None:
         return {
             "rc": 2, "total_s": None, "n_durations": len(durations),
+            "scale": scale, "scaled_tests": [],
             "violations": [
                 "no pytest summary line found — truncated or killed "
                 "run (the 870s timeout produces exactly this)"],
             "warnings": [],
         }
-    violations, warnings = [], []
+    scale = max(1.0, float(scale))
+    cap = max_test * scale
+    violations, warnings, scaled = [], [], []
     for secs, phase, test in durations:
-        if secs > max_test:
+        if secs > cap:
             violations.append(
                 f"{test} {phase} took {secs:.1f}s "
-                f"(> {max_test:.0f}s per-test cap)")
+                f"(> {cap:.1f}s per-test cap"
+                + (f" = {max_test:.0f}s x {scale:.2f} box scale)"
+                   if scale > 1.0 else ")"))
+        elif secs > max_test:
+            # passed ONLY because of the box-speed scale: name it so
+            # the relaxation is visible, never silent
+            scaled.append(f"{test} {phase}")
+            warnings.append(
+                f"{test} {phase} took {secs:.1f}s — over the "
+                f"{max_test:.0f}s nominal cap, within the scaled "
+                f"{cap:.1f}s cap ({scale:.2f}x box scale)")
     if total > max_total:
         violations.append(
             f"suite total {total:.1f}s exceeds {max_total:.0f}s "
@@ -106,15 +182,19 @@ def summarize(text: str, max_test: float, max_total: float,
             "enforcement)")
     return {
         "rc": 1 if violations else 0, "total_s": total,
-        "n_durations": len(durations),
+        "n_durations": len(durations), "scale": scale,
+        "scaled_tests": scaled,
         "violations": violations, "warnings": warnings,
     }
 
 
 def check(text: str, max_test: float, max_total: float,
           warn_frac: float, out=sys.stdout, err=sys.stderr,
-          as_json: bool = False) -> int:
-    s = summarize(text, max_test, max_total, warn_frac)
+          as_json: bool = False, scale: float = 1.0,
+          scale_source: str = "") -> int:
+    s = summarize(text, max_test, max_total, warn_frac, scale=scale)
+    if scale_source:
+        s["scale_source"] = scale_source
     if as_json:
         import json
         print(json.dumps(s), file=out)
@@ -128,9 +208,12 @@ def check(text: str, max_test: float, max_total: float,
         print(f"BUDGET WARN: {w}", file=err)
     if s["rc"] == 0:
         n = s["n_durations"]
+        cap = max_test * max(1.0, scale)
         print(f"BUDGET OK: total {s['total_s']:.1f}s <= "
               f"{max_total:.0f}s"
-              + (f"; slowest of {n} phases within {max_test:.0f}s"
+              + (f"; slowest of {n} phases within {cap:.1f}s"
+                 + (f" (cap scaled {scale:.2f}x: {scale_source})"
+                    if scale > 1.0 and scale_source else "")
                  if n else ""), file=out)
     return s["rc"]
 
@@ -148,6 +231,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print one machine-readable JSON summary line "
                          "instead of the human messages")
+    ap.add_argument("--scale", type=float, default=None,
+                    help="explicit per-test cap scale (skips "
+                         "calibration; 1 = the nominal cap). Default: "
+                         "CAKE_T1_SCALE env, else a ~0.3s timing probe")
     args = ap.parse_args(argv)
     if args.log == "-":
         text = sys.stdin.read()
@@ -166,8 +253,12 @@ def main(argv=None) -> int:
                 print(f"BUDGET: cannot read {args.log}: {e}",
                       file=sys.stderr)
             return 2
+    if args.scale is not None:
+        scale, source = max(1.0, args.scale), f"--scale {args.scale}"
+    else:
+        scale, source = calibrate_scale()
     return check(text, args.max_test, args.max_total, args.warn_frac,
-                 as_json=args.json)
+                 as_json=args.json, scale=scale, scale_source=source)
 
 
 if __name__ == "__main__":

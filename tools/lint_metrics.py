@@ -65,7 +65,8 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_kv_", "cake_fault_",
                        "cake_engine_recoveries_",
                        "cake_engine_recovery_", "cake_poison_",
-                       "cake_requests_", "cake_heartbeat_")
+                       "cake_requests_", "cake_heartbeat_",
+                       "cake_autotune_")
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
