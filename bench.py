@@ -1048,6 +1048,11 @@ def run_slo_tier(name: str, model: str, quant, max_seq: int,
             assert warm.wait(timeout=900), "slo warmup timed out"
             log(f"slo[{'on' if preempt else 'off'}] warmup (compile): "
                 f"{time.perf_counter() - t0:.1f}s")
+            # goodput accounting baseline AFTER warmup: the phase's
+            # goodput/raw tok/s diffs the load window only
+            tg0 = engine.stats.tokens_generated
+            good0 = engine.slo.goodput_total()
+            t_load = time.perf_counter()
             batch = [engine.submit(prompt(i), max_new_tokens=batch_gen,
                                    priority="batch")
                      for i in range(slots)]
@@ -1070,10 +1075,21 @@ def run_slo_tier(name: str, model: str, quant, max_seq: int,
                 time.sleep(stagger_s)
             assert all(h.wait(timeout=900)
                        for h in batch + inter + std), "slo load timed out"
+            dt = max(1e-6, time.perf_counter() - t_load)
             return {"preemptions": engine.stats.preemptions,
                     "interactive": [h.ttft for h in inter],
                     "standard": [h.ttft for h in std],
-                    "batch": [h.ttft for h in batch]}
+                    "batch": [h.ttft for h in batch],
+                    # goodput vs raw throughput (obs/slo.py): tokens
+                    # from requests that met their class SLO targets,
+                    # over the same wall window — goodput <= raw by
+                    # construction; attainment is the 10m window (the
+                    # whole phase fits inside it)
+                    "tok_s": (engine.stats.tokens_generated - tg0) / dt,
+                    "goodput_tok_s":
+                        (engine.slo.goodput_total() - good0) / dt,
+                    "attainment":
+                        engine.slo.attainment_by_class("10m")}
 
     off = phase(False)
     on = phase(True)
@@ -1093,6 +1109,11 @@ def run_slo_tier(name: str, model: str, quant, max_seq: int,
                     pct(xs, 0.5) * 1e3, 1)
                 result[f"{cls}_ttft_p99_{tag}_ms"] = round(
                     pct(xs, 0.99) * 1e3, 1)
+    for tag, ph in (("on", on), ("off", off)):
+        result[f"tok_s_{tag}"] = round(ph["tok_s"], 2)
+        result[f"goodput_tok_s_{tag}"] = round(ph["goodput_tok_s"], 2)
+        result[f"attainment_{tag}"] = {
+            c: round(v, 4) for c, v in sorted(ph["attainment"].items())}
     result["value"] = result["interactive_ttft_p99_on_ms"]
     log(f"slo: interactive TTFT p99 {result['value']:.1f}ms with "
         f"preemption ({on['preemptions']} preemptions) vs "
@@ -1257,7 +1278,8 @@ def run_autotune_tier(name: str, model: str, quant, max_seq: int,
         {"max_offered_rps": None, "config": hi}]}
 
     def phase(tag: str, engine, handles, n, gen, stagger, base) -> dict:
-        st0 = (engine.stats.tokens_generated, time.perf_counter())
+        st0 = (engine.stats.tokens_generated, time.perf_counter(),
+               engine.slo.goodput_total())
         batch = []
         for i in range(n):
             batch.append(engine.submit(prompt(base + i),
@@ -1269,7 +1291,13 @@ def run_autotune_tier(name: str, model: str, quant, max_seq: int,
         handles.extend(batch)
         ttfts = [h.ttft for h in batch]
         return {"tok_s": (engine.stats.tokens_generated - st0[0]) / dt,
-                "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 1)}
+                "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 1),
+                # goodput (obs/slo.py): tokens from requests that met
+                # their class SLO, same wall window — <= tok_s always
+                "goodput_tok_s":
+                    (engine.slo.goodput_total() - st0[2]) / dt,
+                "attainment":
+                    engine.slo.attainment_by_class("10m")}
 
     def run(autotuned: bool) -> dict:
         kw = {"cache_dtype": jnp.float32} if cache_f32 else {}
@@ -1350,6 +1378,11 @@ def run_autotune_tier(name: str, model: str, quant, max_seq: int,
                 run_out[ph]["tok_s"], 2)
             result[f"{ph}_ttft_p99_{tag}_ms"] = \
                 run_out[ph]["ttft_p99_ms"]
+            result[f"{ph}_goodput_tok_s_{tag}"] = round(
+                run_out[ph]["goodput_tok_s"], 2)
+            result[f"{ph}_attainment_{tag}"] = {
+                c: round(v, 4) for c, v in
+                sorted(run_out[ph]["attainment"].items())}
     log(f"autotune: {auto['switches']} switch(es) under the load "
         f"shift, tokens_match={result['autotune_tokens_match']}, "
         f"high-phase {result['high_tok_s_auto']} tok/s auto vs "

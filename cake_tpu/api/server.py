@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
 import time
 import uuid
@@ -45,8 +46,14 @@ KNOWN_ROUTES = frozenset({
     "/api/v1/chat/completions", "/v1/chat/completions", "/api/v1/image",
     "/api/v1/health", "/api/v1/cluster", "/v1/models", "/api/v1/models",
     "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
-    "/api/v1/profile", "/api/v1/autotune",
+    "/api/v1/profile", "/api/v1/autotune", "/api/v1/events",
+    "/api/v1/requests/{rid}/timeline",
 })
+
+# rid-bearing paths are counted under their TEMPLATE: a per-rid route
+# label would grow one metric series per request — exactly the
+# cardinality explosion tools/lint_metrics.py bans rid labels for
+_TIMELINE_RE = re.compile(r"^/api/v1/requests/(\d+)/timeline$")
 
 
 class ApiServer:
@@ -80,6 +87,8 @@ class ApiServer:
 
     def _count(self, path: str, code: int) -> None:
         route = path.split("?", 1)[0]
+        if _TIMELINE_RE.match(route):
+            route = "/api/v1/requests/{rid}/timeline"
         if route not in KNOWN_ROUTES:
             route = "other"
         self._m_http.labels(route=route, status=str(code)).inc()
@@ -312,6 +321,11 @@ class ApiServer:
                 # crash-recovery / reset-storm-breaker state (+ the
                 # armed fault plan, when chaos is on)
                 out["recovery"] = self.engine.recovery_state()
+            slo = getattr(self.engine, "slo", None)
+            if slo is not None:
+                # per-class targets, rolling attainment and goodput
+                # tokens (obs/slo.py) — the serving-quality block
+                out["slo"] = slo.snapshot()
             if hasattr(self.engine, "current_config"):
                 # the LIVE effective engine config (slots, decode_scan,
                 # kv_pages, kv_dtype, mixed_batch, attn impl) so
@@ -463,15 +477,62 @@ class ApiServer:
             # one registration site (no-op without the SLO scheduler)
             self.engine._set_queue_gauges()
             obs_steps.refresh_page_gauges(self.engine)
+            slo = getattr(self.engine, "slo", None)
+            if slo is not None:
+                # both attainment windows converge at scrape time even
+                # between retirements (a quiet minute must roll the 1m
+                # window forward, not freeze the last busy value)
+                slo.refresh_gauges()
         return m.REGISTRY.render()
 
-    def requests(self, limit: Optional[int] = None) -> dict:
+    def requests(self, limit: Optional[int] = None,
+                 rid: Optional[int] = None, cls: Optional[str] = None,
+                 since: Optional[int] = None) -> dict:
         """Per-request lifecycle traces (GET /api/v1/requests): active
-        requests first, then the finished ring, newest first."""
+        requests first, then the finished ring, newest first —
+        oldest-first with ?since= (cursor pagination pages forward).
+        ?rid= / ?class= / ?since= filter (since is a rid cursor:
+        strictly newer admissions only — poll with the previous
+        response's `cursor`). The cursor is derived from the RETURNED
+        records (a rid admitted mid-request, or truncated by ?limit=,
+        stays strictly above it — never skipped)."""
         if self.engine is None:
             return {"requests": [], "note": "engine-less serving has "
                     "no request tracer"}
-        return {"requests": self.engine.tracer.dump(limit)}
+        recs = self.engine.tracer.dump(limit, rid=rid, cls=cls,
+                                       since=since)
+        if recs:
+            cursor = max(r["rid"] for r in recs)
+        else:
+            cursor = since if since is not None else 0
+        return {"requests": recs, "cursor": cursor}
+
+    def request_timeline(self, rid: int) -> Optional[dict]:
+        """Per-request explain (GET /api/v1/requests/{rid}/timeline):
+        the request's trace spans, bus events and step records merged
+        into one time-ordered view (obs/timeline.py). None -> 404."""
+        if self.engine is None or not hasattr(self.engine,
+                                              "request_timeline"):
+            return None
+        return self.engine.request_timeline(rid)
+
+    def events(self, rid: Optional[int] = None,
+               type: Optional[str] = None,
+               since: Optional[int] = None,
+               limit: Optional[int] = None) -> dict:
+        """Cross-subsystem event dump (GET /api/v1/events): ascending
+        seq, ?rid= / ?type= / ?since= filtered (obs/events.py); the
+        response `cursor` is the newest seq — pass it back as ?since=
+        to read only what is new."""
+        bus = getattr(self.engine, "events", None) \
+            if self.engine is not None else None
+        if bus is None:
+            return {"events": [], "cursor": 0,
+                    "note": "event bus disabled (--event-ring 0) or "
+                            "engine-less serving"}
+        evs, cursor = bus.snapshot(rid=rid, type=type, since=since,
+                                   limit=limit)
+        return {"events": evs, "cursor": cursor}
 
     def steps(self, limit: Optional[int] = None) -> dict:
         """Step flight-recorder dump (GET /api/v1/steps): newest step
@@ -567,17 +628,29 @@ def make_handler(api: ApiServer):
             self.wfile.write(data)
             api._count(self.path, code)
 
-        def _limit_arg(self):
-            """Optional ?limit=N capping a ring dump (the rings are
-            already bounded; this just trims the response)."""
+        def _query(self) -> dict:
+            """First value of each query param (the filter endpoints'
+            input; repeated params keep the first — filters are
+            scalar)."""
             if "?" not in self.path:
-                return None
+                return {}
             from urllib.parse import parse_qs
-            q = parse_qs(self.path.split("?", 1)[1])
-            try:
-                return int(q.get("limit", [None])[0])
-            except (TypeError, ValueError):
+            return {k: v[0] for k, v in
+                    parse_qs(self.path.split("?", 1)[1]).items() if v}
+
+        @staticmethod
+        def _int_arg(q: dict, key: str):
+            """Integer query param or None; a malformed value is a 400
+            (silently ignoring ?rid=abc would dump everything — the
+            opposite of what the caller asked)."""
+            v = q.get(key)
+            if v is None:
                 return None
+            try:
+                return int(v)
+            except ValueError:
+                raise ValueError(f"?{key}= must be an integer, got "
+                                 f"{v!r}")
 
         def _read_body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
@@ -589,14 +662,57 @@ def make_handler(api: ApiServer):
                 raise ValueError("invalid JSON body")
 
         def do_GET(self):
+            route = self.path.split("?", 1)[0]
             if self.path == "/api/v1/health":
                 return self._json(200, api.health())
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
-            if self.path.split("?", 1)[0] == "/api/v1/requests":
-                return self._json(200, api.requests(self._limit_arg()))
-            if self.path.split("?", 1)[0] == "/api/v1/steps":
-                return self._json(200, api.steps(self._limit_arg()))
+            if route == "/api/v1/requests":
+                q = self._query()
+                try:
+                    cls = q.get("class")
+                    if cls is not None:
+                        from cake_tpu.sched.classes import (
+                            validate_priority,
+                        )
+                        validate_priority(cls)
+                    return self._json(200, api.requests(
+                        limit=self._int_arg(q, "limit"),
+                        rid=self._int_arg(q, "rid"), cls=cls,
+                        since=self._int_arg(q, "since")))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+            m = _TIMELINE_RE.match(route)
+            if m:
+                tl = api.request_timeline(int(m.group(1)))
+                if tl is None:
+                    return self._json(404, {
+                        "error": f"unknown rid {m.group(1)} (not "
+                                 "admitted, or fell out of the "
+                                 "finished-trace ring)"})
+                return self._json(200, tl)
+            if route == "/api/v1/events":
+                q = self._query()
+                try:
+                    t = q.get("type")
+                    if t is not None:
+                        from cake_tpu.obs.events import EVENT_TYPES
+                        if t not in EVENT_TYPES:
+                            raise ValueError(
+                                f"unknown event type {t!r} (choose "
+                                f"one of {', '.join(EVENT_TYPES)})")
+                    return self._json(200, api.events(
+                        rid=self._int_arg(q, "rid"), type=t,
+                        since=self._int_arg(q, "since"),
+                        limit=self._int_arg(q, "limit")))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+            if route == "/api/v1/steps":
+                try:
+                    return self._json(200, api.steps(
+                        self._int_arg(self._query(), "limit")))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
             if self.path == "/api/v1/autotune":
                 return self._json(200, api.autotune())
             if self.path in ("/v1/models", "/api/v1/models"):

@@ -175,6 +175,25 @@ class Args:
     # --step-ring N: step flight-recorder records retained in memory
     # for GET /api/v1/steps
     step_ring: int = 512
+    # --event-log PATH: append every cross-subsystem serving event
+    # (preempted, kv_spill/kv_restore, prefix_hit, recovered/poisoned,
+    # reconfigured, shed, fault_injected, recompile — obs/events.py)
+    # as one JSON line; the lossless sink behind the bounded ring at
+    # GET /api/v1/events
+    event_log: Optional[str] = None
+    # --event-ring N: events retained in memory for GET /api/v1/events;
+    # 0 disables the event bus entirely (every publish site is then one
+    # attribute test, the --fault-plan discipline)
+    event_ring: int = 1024
+    # --slo-targets SPEC: per-class latency SLOs for attainment +
+    # goodput accounting (obs/slo.py) —
+    # "interactive=ttft:0.1,e2e:2;standard=ttft:1,e2e:30;..." names a
+    # class's TTFT / e2e targets in seconds; unnamed classes keep the
+    # defaults. Drives cake_slo_attainment{class,window},
+    # cake_slo_*_total burn-rate counters and
+    # cake_goodput_tokens_total{class}, and the autotuner's
+    # quality-aware policy lookup
+    slo_targets: Optional[str] = None
     # --profile-dir DIR: where POST /api/v1/profile writes its
     # jax.profiler capture; None = a fresh temp dir per capture
     profile_dir: Optional[str] = None
@@ -281,6 +300,16 @@ class Args:
             # silently injects nothing is worse than no chaos run)
             from cake_tpu.faults import FaultPlan
             FaultPlan.parse(self.fault_plan)
+        if self.slo_targets:
+            # same discipline as --fault-plan: a malformed SLO spec is
+            # a loud startup error, not a serving run silently
+            # accounting against the defaults
+            from cake_tpu.obs.slo import parse_slo_targets
+            parse_slo_targets(self.slo_targets)
+        if self.event_ring < 0:
+            raise ValueError(
+                f"--event-ring {self.event_ring} must be >= 0 "
+                "(0 disables the event bus)")
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

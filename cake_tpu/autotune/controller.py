@@ -87,6 +87,18 @@ class AutotuneSignals:
     pages_in_use_frac: float = 0.0
     shed_rps: float = 0.0
     ttft_p99_s: Optional[float] = None
+    # quality signals (obs/slo.py, via the engine's SLO accountant +
+    # scheduler): per-class TTFT p99, per-class rolling SLO attainment
+    # and the scheduler's aging pressure — what lets the policy lookup
+    # and the rollback guard key on quality, not just offered rps
+    ttft_p99_by_class: Dict[str, float] = field(default_factory=dict)
+    attainment: Dict[str, float] = field(default_factory=dict)
+    queue_pressure: float = 0.0
+
+    def min_attainment(self) -> Optional[float]:
+        """Worst-class attainment this sample, None without data —
+        the rollback guard's scalar quality verdict input."""
+        return min(self.attainment.values()) if self.attainment else None
 
     def to_dict(self) -> dict:
         out = {
@@ -104,6 +116,14 @@ class AutotuneSignals:
             out["queue_depth_by_class"] = dict(self.queue_depth_by_class)
         if self.ttft_p99_s is not None:
             out["ttft_p99_s"] = round(self.ttft_p99_s, 6)
+        if self.ttft_p99_by_class:
+            out["ttft_p99_by_class"] = {
+                c: round(v, 6) for c, v in self.ttft_p99_by_class.items()}
+        if self.attainment:
+            out["attainment"] = {
+                c: round(v, 4) for c, v in self.attainment.items()}
+        if self.queue_pressure:
+            out["queue_pressure"] = round(self.queue_pressure, 4)
         return out
 
 
@@ -140,8 +160,9 @@ class AutotuneController:
         self._last_switch_t: Optional[float] = None
         self._pinned: set = set()
         # armed rollback guard: (previous config, pre-switch rate,
+        # pre-switch worst-class attainment (None without SLO data),
         # samples seen since the switch)
-        self._guard: Optional[Tuple[EngineConfig, float, int]] = None
+        self._guard: Optional[tuple] = None
 
     # -- decisions (engine thread) ----------------------------------------
 
@@ -154,6 +175,32 @@ class AutotuneController:
         with self._mu:
             xs = [s.offered_rps for s in self._window]
         return sum(xs) / len(xs) if xs else 0.0
+
+    def window_quality(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """(ttft_p99_by_class, attainment) aggregated over the window:
+        per class the WORST value seen — max TTFT p99, min attainment —
+        so one bad-but-real sample inside the window keeps escalating a
+        quality-guarded lookup (hysteresis, not the aggregate, is the
+        noise filter)."""
+        ttft: Dict[str, float] = {}
+        attain: Dict[str, float] = {}
+        with self._mu:
+            samples = list(self._window)
+        for s in samples:
+            for c, v in s.ttft_p99_by_class.items():
+                ttft[c] = max(ttft.get(c, 0.0), v)
+            for c, v in s.attainment.items():
+                attain[c] = min(attain.get(c, 1.0), v)
+        return ttft, attain
+
+    def _window_min_attainment(self) -> Optional[float]:
+        """Mean worst-class attainment over the window's samples that
+        carry attainment data (None without any) — the pre/post series
+        the rollback guard compares."""
+        with self._mu:
+            xs = [a for a in (s.min_attainment() for s in self._window)
+                  if a is not None]
+        return sum(xs) / len(xs) if xs else None
 
     def decide(self, sig: AutotuneSignals
                ) -> Optional[Tuple[EngineConfig, str]]:
@@ -172,7 +219,10 @@ class AutotuneController:
             return None
         if self._guard is not None:
             return None  # verdict pending: no new move until it rules
-        target = self.policy.lookup(self.window_offered_rps())
+        ttft_by_cls, attain = self.window_quality()
+        target = self.policy.lookup(self.window_offered_rps(),
+                                    ttft_p99_by_class=ttft_by_cls,
+                                    attainment=attain)
         tkey = config_key(target)
         if tkey == config_key(self._current) or tkey in self._pinned:
             self._target_key, self._streak = None, 0
@@ -189,26 +239,45 @@ class AutotuneController:
                         ) -> Optional[EngineConfig]:
         if self._guard is None:
             return None
-        prev_cfg, pre_rate, seen = self._guard
+        prev_cfg, pre_rate, pre_attain, seen = self._guard
         seen += 1
-        self._guard = (prev_cfg, pre_rate, seen)
+        self._guard = (prev_cfg, pre_rate, pre_attain, seen)
         if seen < self.config.rollback_window:
             return None
         with self._mu:
             post = list(self._window)[-self.config.rollback_window:]
         post_rate = (sum(s.service_tps for s in post) / len(post)
                      if post else 0.0)
+        attains = [a for a in (s.min_attainment() for s in post)
+                   if a is not None]
+        post_attain = sum(attains) / len(attains) if attains else None
         bad = self._current
         self._guard = None
-        if pre_rate > 0 and post_rate < self.config.rollback_frac * pre_rate:
+        rate_bad = (pre_rate > 0
+                    and post_rate < self.config.rollback_frac * pre_rate)
+        # quality verdict (obs/slo.py attainment riding the signals):
+        # a switch that kept tok/s but collapsed SLO attainment — e.g.
+        # bigger batches starving interactive TTFT — regressed the
+        # thing serving exists for, and must revert just the same
+        attain_bad = (pre_attain is not None and post_attain is not None
+                      and pre_attain > 0
+                      and post_attain
+                      < self.config.rollback_frac * pre_attain)
+        if rate_bad or attain_bad:
             # revert ONCE and pin: the fitted policy was wrong online
             # for this regime — never re-propose the offending config
             self._pinned.add(config_key(bad))
             self._note("rollback", frm=bad, to=prev_cfg,
-                       pre_tps=pre_rate, post_tps=post_rate)
+                       pre_tps=pre_rate, post_tps=post_rate,
+                       pre_attainment=pre_attain,
+                       post_attainment=post_attain,
+                       cause=("attainment" if attain_bad and not rate_bad
+                              else "service_rate"))
             return prev_cfg
         self._note("accepted", frm=prev_cfg, to=bad,
-                   pre_tps=pre_rate, post_tps=post_rate)
+                   pre_tps=pre_rate, post_tps=post_rate,
+                   pre_attainment=pre_attain,
+                   post_attainment=post_attain)
         return None
 
     def on_switched(self, new: EngineConfig, old: EngineConfig,
@@ -221,7 +290,10 @@ class AutotuneController:
         self._last_switch_t = self._now()
         self._target_key, self._streak = None, 0
         if reason == "auto":
-            self._guard = (old, pre_rate, 0)
+            # the guard compares service rate AND worst-class SLO
+            # attainment against the old regime's window
+            self._guard = (old, pre_rate,
+                           self._window_min_attainment(), 0)
         else:
             self._guard = None
         self._note("switch", frm=old, to=new, reason=reason,

@@ -24,15 +24,27 @@ Inputs the fitter understands:
 
 Policy file format (``--autotune-policy``)::
 
-    {"version": 1,
+    {"version": 2,
      "regimes": [
-       {"max_offered_rps": 2.0,  "config": {"slots": 8, ...}},
+       {"max_offered_rps": 2.0,  "config": {"slots": 8, ...},
+        "max_ttft_p99_s": {"interactive": 0.2},
+        "min_attainment": 0.95},
        {"max_offered_rps": null, "config": {"slots": 32, ...}}]}
 
 Regimes are sorted by ascending boundary; ``lookup(offered_rps)``
 returns the first regime whose boundary covers the load (``null`` =
 catch-all). The fitter guarantees a catch-all regime so lookup is
 total.
+
+Version 2 adds optional per-regime **quality guards** (the goodput
+layer, obs/slo.py): ``max_ttft_p99_s`` and ``min_attainment``, each a
+bare number (applies to every class the live signals report) or a
+``{class: bound}`` mapping. A regime whose offered-load boundary covers
+the current load but whose quality guards FAIL is skipped — lookup
+falls through toward the catch-all, so a server missing its interactive
+TTFT target escalates to a bigger config even while offered rps alone
+says the small one suffices. Version-1 files (no guards) load
+unchanged.
 """
 
 from __future__ import annotations
@@ -46,7 +58,14 @@ from cake_tpu.autotune.space import EngineConfig, config_key, validate_config
 
 log = logging.getLogger(__name__)
 
-POLICY_VERSION = 1
+POLICY_VERSION = 2
+# version-1 files (no quality guards) read identically; writes are
+# always the current version
+READABLE_VERSIONS = (1, 2)
+
+# the per-regime quality-guard keys and their comparison direction
+# (True = the live value must stay BELOW the bound)
+_GUARD_KEYS = (("max_ttft_p99_s", True), ("min_attainment", False))
 
 # step-record kinds that generate tokens / admit prompts — mirrors the
 # obs/steps.py flight-recorder vocabulary
@@ -99,14 +118,60 @@ class PolicyTable:
                 '("max_offered_rps": null) so every load maps somewhere')
         for r in self.regimes:
             validate_config(r["config"], max_seq_len=max_seq_len)
+            for key, _below in _GUARD_KEYS:
+                g = r.get(key)
+                if g is None:
+                    continue
+                vals = (g.values() if isinstance(g, dict) else (g,))
+                if not all(isinstance(v, (int, float))
+                           and not isinstance(v, bool) and v > 0
+                           for v in vals):
+                    raise ValueError(
+                        f"policy regime {key} must be a positive "
+                        "number or a {class: number} mapping, got "
+                        f"{g!r}")
         return self
 
-    def lookup(self, offered_rps: float) -> EngineConfig:
-        for r in self.regimes:
+    @staticmethod
+    def _guards_ok(regime: dict,
+                   ttft_p99_by_class: Optional[Dict[str, float]],
+                   attainment: Optional[Dict[str, float]]) -> bool:
+        """Whether the live quality signals let this regime hold. A
+        guard with no corresponding live signal passes — quality can
+        only ESCALATE a lookup, never block it on missing data."""
+        for key, below, live in (
+                ("max_ttft_p99_s", True, ttft_p99_by_class),
+                ("min_attainment", False, attainment)):
+            g = regime.get(key)
+            if g is None or not live:
+                continue
+            bounds = g if isinstance(g, dict) else {c: g for c in live}
+            for cls, bound in bounds.items():
+                v = live.get(cls)
+                if v is None:
+                    continue
+                if (v > bound) if below else (v < bound):
+                    return False
+        return True
+
+    def lookup(self, offered_rps: float,
+               ttft_p99_by_class: Optional[Dict[str, float]] = None,
+               attainment: Optional[Dict[str, float]] = None
+               ) -> EngineConfig:
+        """First regime whose offered-load boundary covers the load AND
+        whose quality guards pass against the live signals (obs/slo.py
+        attainment + TTFT p99 by class, via AutotuneSignals). A
+        covering regime failing its guards is skipped — the lookup
+        escalates toward the catch-all, which is returned
+        unconditionally (lookup stays total even when every guard
+        fails: there is no bigger config to escalate to)."""
+        for r in self.regimes[:-1]:
             bound = r.get("max_offered_rps")
-            if bound is None or offered_rps <= bound:
+            if bound is not None and offered_rps > bound:
+                continue
+            if self._guards_ok(r, ttft_p99_by_class, attainment):
                 return r["config"]
-        return self.regimes[-1]["config"]  # unreachable after validate
+        return self.regimes[-1]["config"]
 
     def to_dict(self) -> dict:
         return {"version": POLICY_VERSION,
@@ -120,10 +185,11 @@ class PolicyTable:
 
     @classmethod
     def from_dict(cls, d: dict) -> "PolicyTable":
-        if d.get("version") != POLICY_VERSION:
+        if d.get("version") not in READABLE_VERSIONS:
             raise ValueError(
                 f"unsupported policy version {d.get('version')!r} "
-                f"(this build reads version {POLICY_VERSION})")
+                f"(this build reads versions "
+                f"{', '.join(map(str, READABLE_VERSIONS))})")
         return cls(regimes=list(d.get("regimes", ())))
 
     @classmethod

@@ -330,6 +330,15 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--step-log applies to engine serving (--api); one-shot "
             "generation records no step flight")
+    if getattr(args, "event_log", None) \
+            or getattr(args, "slo_targets", None):
+        # the event bus and the SLO accountant live in the serving
+        # engine; a one-shot generation would write an empty event log
+        # and account nothing — mirror the --step-log warning
+        logging.getLogger(__name__).warning(
+            "--event-log / --slo-targets apply to engine serving "
+            "(--api); one-shot generation publishes no events and "
+            "accounts no SLOs")
     if args.priority_classes or args.preemption or args.shed:
         # the whole scheduling subsystem lives in the serving engine
         # (priority queues / preemption / shed admission); a one-shot

@@ -64,6 +64,11 @@ class FaultInjector:
 
     plan: FaultPlan
     records: List[InjectionRecord] = field(default_factory=list)
+    # obs/events.EventBus (None = no bus attached — same one-attribute-
+    # test discipline as the call sites' own `_faults is not None`):
+    # every firing publishes a fault_injected event so chaos shows up
+    # on the same timeline as what it broke
+    events: Optional[object] = None
 
     def __post_init__(self):
         self._lock = threading.Lock()
@@ -126,6 +131,10 @@ class FaultInjector:
         if fire is None:
             return
         _INJECTIONS.labels(site=site).inc()
+        if self.events is not None:
+            self.events.publish("fault_injected", site=site,
+                                kind=fire.rule.error, call=call,
+                                step=step)
         kind = fire.rule.error
         if kind == "oom":
             raise InjectedOOM(site)

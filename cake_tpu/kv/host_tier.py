@@ -96,7 +96,8 @@ class SpilledPages:
 class HostTier:
     """LRU store of spilled KV pages, capacity-bounded in pages."""
 
-    def __init__(self, capacity_pages: int, page_bytes: int = 0):
+    def __init__(self, capacity_pages: int, page_bytes: int = 0,
+                 events=None):
         if capacity_pages < 1:
             raise ValueError(
                 f"--kv-host-pages {capacity_pages} must be >= 1")
@@ -107,7 +108,24 @@ class HostTier:
         self.spills = 0
         self.restores = 0
         self.evictions = 0
+        # obs/events.EventBus (None = disabled plane, one attribute
+        # test per publish site): put/pop are THE spill/restore seams
+        # every caller funnels through, so kv_spill/kv_restore events
+        # published here cover victim AND cold-prefix movements
+        self._events = events
         self._set_gauges()
+
+    def _publish(self, type: str, key, entry: SpilledPages) -> None:
+        # ("victim", rid) keys link the event to its request; prefix
+        # entries carry the pid as a field instead (no rid exists)
+        rid = pid = None
+        if isinstance(key, tuple) and len(key) == 2:
+            if key[0] == "victim":
+                rid = key[1]
+            elif key[0] == "prefix":
+                pid = key[1]
+        self._events.publish(type, rid=rid, kind=entry.kind,
+                             pages=entry.n_pages, pid=pid)
 
     # -- accounting --------------------------------------------------------
 
@@ -154,6 +172,8 @@ class HostTier:
         self._used += entry.n_pages
         self.spills += entry.n_pages
         _SPILLS.labels("spill").inc(entry.n_pages)
+        if self._events is not None:
+            self._publish("kv_spill", key, entry)
         self._set_gauges()
         return True
 
@@ -174,6 +194,8 @@ class HostTier:
         if restored:
             self.restores += e.n_pages
             _SPILLS.labels("restore").inc(e.n_pages)
+            if self._events is not None:
+                self._publish("kv_restore", key, e)
         self._set_gauges()
         return e
 

@@ -255,14 +255,18 @@ class Master:
         )
 
     def _trace_kwargs(self) -> dict:
-        """Request-lifecycle tracing + step-telemetry knobs, plumbed to
-        every engine flavor identically (--trace-events / --trace-ring
-        / --step-log / --step-ring)."""
+        """Request-lifecycle tracing + step-telemetry + event-bus +
+        SLO-accounting knobs, plumbed to every engine flavor
+        identically (--trace-events / --trace-ring / --step-log /
+        --step-ring / --event-log / --event-ring / --slo-targets)."""
         return dict(
             trace_events=getattr(self.args, "trace_events", None),
             trace_ring=getattr(self.args, "trace_ring", 256),
             step_log=getattr(self.args, "step_log", None),
             step_ring=getattr(self.args, "step_ring", 512),
+            event_log=getattr(self.args, "event_log", None),
+            event_ring=getattr(self.args, "event_ring", 1024),
+            slo_targets=getattr(self.args, "slo_targets", None),
         )
 
     def _sched_kwargs(self) -> dict:

@@ -19,13 +19,30 @@ real measurement substrate, dependency-free:
     XLA cost-analysis MFU / HBM-utilization accounting, jit-recompile
     counters, per-device HBM gauges, and the single-flight live
     profiler capture behind `POST /api/v1/profile`.
+  * `obs.events` — the cross-subsystem event bus: typed,
+    request-linked events (preempted, kv_spill/kv_restore, prefix_hit,
+    recovered/poisoned, reconfigured, shed, fault_injected, recompile)
+    in a bounded ring at `GET /api/v1/events` with an optional
+    `--event-log` JSONL sink.
+  * `obs.timeline` — the per-request explain: one merged time-ordered
+    view of a request's trace spans, bus events and step records
+    (`GET /api/v1/requests/{rid}/timeline`).
+  * `obs.slo` — SLO attainment + goodput accounting (`--slo-targets`):
+    rolling per-class attainment gauges, burn-rate counters, and
+    goodput (tokens from requests that met their class SLO) feeding
+    the autotune controller's quality signals.
   * `obs.jsonl` — the shared append-only JSONL writer (fsync on close)
-    and corrupt-tail-tolerant reader both event logs use.
+    and corrupt-tail-tolerant reader all three event logs use.
 """
 
+from cake_tpu.obs.events import EVENT_TYPES, Event, EventBus  # noqa: F401
 from cake_tpu.obs.jsonl import JsonlAppender, read_jsonl  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
     histogram,
 )
+from cake_tpu.obs.slo import (  # noqa: F401
+    DEFAULT_TARGETS, SLOAccountant, SLOTarget, parse_slo_targets,
+)
+from cake_tpu.obs.timeline import build_timeline  # noqa: F401
 from cake_tpu.obs.tracing import RequestTracer, TraceRecord  # noqa: F401

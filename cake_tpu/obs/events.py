@@ -1,0 +1,193 @@
+"""Cross-subsystem event bus: typed, request-linked serving events.
+
+Five subsystems can delay or rewrite a request mid-flight — preemption
+(cake_tpu/sched), KV spill/restore (cake_tpu/kv), crash recovery and
+config hot-switches (serve/engine), and load shedding — but until this
+module their telemetry was siloed per metric family: a counter says
+*how many* requests were preempted, never *which* ones, so "why was
+this request's TTFT 400ms?" was unanswerable from the API. The bus is
+the request-linked complement: every subsystem publishes one typed
+event per incident, carrying the rid where one exists, into a bounded
+thread-safe ring served at ``GET /api/v1/events`` (filterable by
+``?rid= / ?type= / ?since=`` cursor) and optionally appended as JSONL
+(``--event-log``, the shared obs/jsonl.py writer). The per-request
+explain endpoint (obs/timeline.py) stitches these events with the
+tracer's lifecycle spans and the flight recorder's step records into
+one time-ordered view.
+
+Event vocabulary (typed: an unknown type raises at the publish site,
+because a misspelled type would silently vanish from every ``?type=``
+filter):
+
+    preempted       a decoding slot was reclaimed for a higher class
+    kv_spill        KV pages moved device -> host RAM
+    kv_restore      KV pages streamed back host -> device
+    prefix_hit      an admission reused a registered prefix's KV
+    recovered       a crashed request was resubmitted via the fold
+    poisoned        a request was quarantined as crash-implicated
+    reconfigured    a live config switch folded/requeued the request
+                    (one summary event with rid=None carries from/to)
+    shed            admission rejected by per-class load shedding
+    fault_injected  the --fault-plan chaos plane fired at a site
+    recompile       a step fn compiled a new jit signature
+
+Cost discipline (the --fault-plan injector pattern): publishers hold
+``events = None`` when the bus is disabled (``--event-ring 0``) and
+every call site guards ``if <bus> is not None`` — the disabled plane
+costs exactly one attribute test per site, pinned by a source-scan
+test. Metrics stay rid-free by design: the bus carries rids, the
+``cake_events_total{type}`` counter carries only the type (a rid-valued
+label would grow one series per request — tools/lint_metrics.py bans
+the label outright).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from cake_tpu.obs import metrics as _m
+from cake_tpu.obs.jsonl import JsonlAppender
+
+# the typed vocabulary — every publisher names one of these
+EVENT_TYPES = (
+    "preempted", "kv_spill", "kv_restore", "prefix_hit", "recovered",
+    "poisoned", "reconfigured", "shed", "fault_injected", "recompile",
+)
+
+EVENTS_TOTAL = _m.counter(
+    "cake_events_total",
+    "Serving events published on the cross-subsystem event bus, by "
+    "event type (obs/events.py; rids ride the events themselves, "
+    "never a metric label)",
+    labelnames=("type",))
+EVENTS_DROPPED = _m.counter(
+    "cake_events_dropped_total",
+    "Events evicted from the bounded in-memory event ring before being "
+    "read (raise --event-ring, or attach --event-log for a lossless "
+    "JSONL sink)")
+
+
+@dataclass
+class Event:
+    """One published event. ``seq`` is the ring-wide monotonic cursor
+    (GET /api/v1/events?since= pagination); ``ts`` is wall-clock so
+    the timeline stitcher can merge events with tracer spans."""
+
+    seq: int
+    ts: float
+    type: str
+    rid: Optional[int] = None
+    fields: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        out = {"seq": self.seq, "ts": round(self.ts, 6),
+               "type": self.type}
+        if self.rid is not None:
+            out["rid"] = self.rid
+        out.update(self.fields)
+        return out
+
+
+class EventBus:
+    """Bounded, thread-safe ring of typed request-linked events.
+
+    capacity bounds the in-memory ring (evictions count into
+    cake_events_dropped_total); log_path additionally appends every
+    event as one JSON line through the shared obs/jsonl.py writer
+    (lazily opened, fsync on close, fail-open on OSError — a broken
+    log file degrades to a logged warning, never a failed publish)."""
+
+    def __init__(self, capacity: int = 1024,
+                 log_path: Optional[str] = None,
+                 observe_metrics: bool = True):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._next_seq = 1
+        self._log = JsonlAppender(log_path) if log_path else None
+        self._observe = observe_metrics
+
+    def publish(self, type: str, rid: Optional[int] = None,
+                **fields) -> Event:
+        """Append one event. Unknown types raise ValueError — a typo'd
+        type would silently vanish from every ?type= filter, so the
+        vocabulary is closed. None-valued fields are dropped (callers
+        pass optional context unconditionally)."""
+        if type not in EVENT_TYPES:
+            raise ValueError(
+                f"unknown event type {type!r} (obs/events.EVENT_TYPES)")
+        ev = Event(seq=0, ts=time.time(), type=type,
+                   rid=int(rid) if rid is not None else None,
+                   fields={k: v for k, v in fields.items()
+                           if v is not None})
+        with self._lock:
+            ev.seq = self._next_seq
+            self._next_seq += 1
+            dropped = len(self._ring) == self._ring.maxlen
+            self._ring.append(ev)
+        if self._observe:
+            EVENTS_TOTAL.labels(type=type).inc()
+            if dropped:
+                EVENTS_DROPPED.inc()
+        if self._log is not None:
+            self._log.append(ev.to_dict())
+        return ev
+
+    # -- export -----------------------------------------------------------
+
+    def dump(self, rid: Optional[int] = None,
+             type: Optional[str] = None,
+             since: Optional[int] = None,
+             limit: Optional[int] = None) -> List[Dict]:
+        """Events in publish order (ascending seq); see snapshot()."""
+        return self.snapshot(rid=rid, type=type, since=since,
+                             limit=limit)[0]
+
+    def snapshot(self, rid: Optional[int] = None,
+                 type: Optional[str] = None,
+                 since: Optional[int] = None,
+                 limit: Optional[int] = None):
+        """(events, cursor) in publish order (ascending seq). Filters
+        compose: rid= exact, type= exact, since= strictly-greater seq.
+        limit= keeps the FIRST n matches — the page right after
+        `since`; keeping the newest would make a limited cursor poll
+        skip the truncated older events forever. The cursor is safe to
+        pass back as `since`: the last RETURNED seq when the page was
+        truncated, else the ring's newest seq AT THE SNAPSHOT (events
+        published after the snapshot stay strictly above it — nothing
+        is ever skipped)."""
+        with self._lock:
+            evs = list(self._ring)
+            snap_cursor = self._next_seq - 1
+        out = []
+        for ev in evs:
+            if rid is not None and ev.rid != rid:
+                continue
+            if type is not None and ev.type != type:
+                continue
+            if since is not None and ev.seq <= since:
+                continue
+            out.append(ev.to_dict())
+        truncated = limit is not None and len(out) > max(0, int(limit))
+        if limit is not None:
+            out = out[:max(0, int(limit))]
+        if not truncated:
+            cursor = snap_cursor
+        elif out:
+            cursor = out[-1]["seq"]
+        else:                      # limit=0: no progress was made
+            cursor = since if since is not None else 0
+        return out, cursor
+
+    @property
+    def cursor(self) -> int:
+        """Highest seq published so far (0 = nothing yet)."""
+        with self._lock:
+            return self._next_seq - 1
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()

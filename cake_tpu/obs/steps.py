@@ -56,7 +56,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from cake_tpu.obs import metrics as _m
 from cake_tpu.obs.jsonl import JsonlAppender
@@ -372,6 +372,10 @@ class StepRecord:
     rows_decode: Optional[int] = None
     rows_prefill: Optional[int] = None
     rows_idle: Optional[int] = None
+    # rids whose rows this step's dispatched batch contained (bounded
+    # by the engine's slot count) — the per-request explain endpoint
+    # (obs/timeline.py) selects a request's steps through this
+    rids: Optional[Tuple[int, ...]] = None
 
     def to_dict(self) -> Dict:
         out = {
@@ -397,6 +401,8 @@ class StepRecord:
             out["rows_decode"] = self.rows_decode
             out["rows_prefill"] = self.rows_prefill
             out["rows_idle"] = self.rows_idle
+        if self.rids is not None:
+            out["rids"] = list(self.rids)
         return out
 
 
@@ -415,7 +421,8 @@ class StepTelemetry:
                  key_prefix: tuple = (),
                  peak_flops: Optional[float] = None,
                  hbm_bps: Optional[float] = None,
-                 accountant: Optional[JitAccountant] = None):
+                 accountant: Optional[JitAccountant] = None,
+                 events=None):
         self.impl = impl
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
@@ -425,6 +432,11 @@ class StepTelemetry:
         self._prefix = tuple(key_prefix)
         self._peak = peak_flops
         self._bps = hbm_bps
+        # obs/events.EventBus (None = disabled plane, one attribute
+        # test per publish): new jit signatures publish a "recompile"
+        # event, so a shape-leak recompilation storm shows up on the
+        # event timeline, not only as a rising counter
+        self._events = events
 
     def rebind(self, *, impl: Optional[str] = None,
                key_prefix: Optional[tuple] = None) -> None:
@@ -447,6 +459,8 @@ class StepTelemetry:
         typically `lambda: lower_cost(fn, args, kwargs)`."""
         new, cost = self._acct.begin(
             fn_name, self._prefix + (fn_name,) + tuple(key), cost_cb)
+        if new and self._events is not None:
+            self._events.publish("recompile", fn=fn_name, impl=self.impl)
         return _JitStep(new, cost, self._acct)
 
     def _peaks(self) -> Tuple[float, float]:
@@ -475,13 +489,15 @@ class StepTelemetry:
                pages_total: Optional[int] = None,
                rows_decode: Optional[int] = None,
                rows_prefill: Optional[int] = None,
-               rows_idle: Optional[int] = None) -> StepRecord:
+               rows_idle: Optional[int] = None,
+               rids: Optional[Sequence[int]] = None) -> StepRecord:
         """Append one step record; derives MFU / HBM utilization from
         `cost` and the step's device seconds. Any subset of the three
         timings may be given; missing ones fall back to the others.
         rows_decode/rows_prefill/rows_idle carry a mixed step's
         occupancy split and feed the cake_mixed_step_rows_total
-        counters."""
+        counters. rids: the requests whose rows rode this dispatch
+        (the per-request explain's step linkage)."""
         wall = wall_s if wall_s is not None else (
             (dispatch_s or 0.0) + (device_s or 0.0))
         disp = dispatch_s if dispatch_s is not None else wall
@@ -502,7 +518,9 @@ class StepTelemetry:
                 pages_free=pages_free, pages_total=pages_total,
                 compiled=bool(compiled),
                 rows_decode=rows_decode, rows_prefill=rows_prefill,
-                rows_idle=rows_idle)
+                rows_idle=rows_idle,
+                rids=(tuple(int(r) for r in rids)
+                      if rids is not None else None))
             self._next += 1
             self._ring.append(rec)
         _STEPS_TOTAL.labels(kind=kind).inc()
@@ -527,6 +545,15 @@ class StepTelemetry:
             recs = list(reversed(self._ring))
         if limit is not None:
             recs = recs[:max(0, int(limit))]
+        return [r.to_dict() for r in recs]
+
+    def records_for(self, rid: int) -> List[Dict]:
+        """Ring records whose dispatched batch contained `rid`, oldest
+        first — the per-request explain's step stream (bounded by the
+        ring capacity, like every other dump)."""
+        with self._lock:
+            recs = [r for r in self._ring
+                    if r.rids is not None and rid in r.rids]
         return [r.to_dict() for r in recs]
 
     def utilization(self, since_step: int = 0, *,

@@ -185,13 +185,21 @@ class RequestTracer:
 
     def __init__(self, capacity: int = 256,
                  events_path: Optional[str] = None,
-                 observe_metrics: bool = True):
+                 observe_metrics: bool = True,
+                 slo=None):
         self._lock = threading.Lock()
         self._active: Dict[int, TraceRecord] = {}
         self._done: deque = deque(maxlen=max(1, int(capacity)))
         self._events = (JsonlAppender(events_path)
                         if events_path else None)
         self._observe = observe_metrics
+        # obs/slo.SLOAccountant: finish() is THE retire seam every
+        # path funnels through (normal emit, recovery's exhausted-
+        # budget finish), so attainment/goodput accounting hooked here
+        # sees each request exactly once, with latencies measured from
+        # the ORIGINAL admission span (resubmits append spans to the
+        # same record — the clock never resets on requeue)
+        self._slo = slo
 
     # -- lifecycle hooks (called by the engine) ---------------------------
 
@@ -291,6 +299,13 @@ class RequestTracer:
                              (REQUEST_PREFILL, rec.prefill_s)):
                     if v is not None:
                         h.observe(v)
+        if self._slo is not None and status != "cancelled":
+            # cancelled = the client went away; the server attained
+            # nothing and missed nothing. Errors are unconditional
+            # misses (slo="failed").
+            self._slo.observe(rec.priority, rec.ttft_s, rec.e2e_s,
+                              rec.output_tokens,
+                              failed=(status == "error"))
         self._event(rec, status, error=error,
                     output_tokens=rec.output_tokens, e2e_s=_r(rec.e2e_s),
                     queue_wait_s=_r(rec.queue_wait_s))
@@ -313,16 +328,43 @@ class RequestTracer:
 
     # -- export -----------------------------------------------------------
 
-    def dump(self, limit: Optional[int] = None) -> List[Dict]:
+    def dump(self, limit: Optional[int] = None,
+             rid: Optional[int] = None,
+             cls: Optional[str] = None,
+             since: Optional[int] = None) -> List[Dict]:
         """All records, newest first: active requests, then the finished
-        ring."""
+        ring. Filters compose (GET /api/v1/requests): rid= exact,
+        cls= priority class, since= strictly-greater rid — rids are
+        monotonic per engine, so `since=<response cursor>` is a cursor
+        that reads only requests admitted after the previous poll.
+        With since= the order flips to OLDEST-first and limit= keeps
+        the first n (the page right after the cursor — newest-first
+        truncation would skip the older records forever); without it,
+        newest-first is the natural dashboard view."""
         with self._lock:
             recs = (sorted(self._active.values(),
                            key=lambda r: r.rid, reverse=True)
                     + list(reversed(self._done)))
+        if rid is not None:
+            recs = [r for r in recs if r.rid == rid]
+        if cls is not None:
+            recs = [r for r in recs if r.priority == cls]
+        if since is not None:
+            recs = sorted((r for r in recs if r.rid > since),
+                          key=lambda r: r.rid)
         if limit is not None:
             recs = recs[:max(0, int(limit))]
         return [r.to_dict() for r in recs]
+
+    def get(self, rid: int) -> Optional[Dict]:
+        """One record by rid (active or finished), or None — the
+        timeline endpoint's lookup."""
+        with self._lock:
+            rec = self._active.get(rid)
+            if rec is None:
+                rec = next((r for r in self._done if r.rid == rid),
+                           None)
+            return rec.to_dict() if rec is not None else None
 
     def recent_ttfts(self, n: int = 32) -> List[float]:
         """TTFT seconds of the newest <= n finished-and-retired

@@ -221,6 +221,25 @@ class SLOScheduler:
             return ((self._score(ea, now), ea["seq"])
                     < (self._score(eb, now), eb["seq"]))
 
+    def queue_pressure(self, now: Optional[float] = None) -> float:
+        """Dimensionless admission pressure for the autotune signal
+        gather (AutotuneSignals.queue_pressure): the maximum aging a
+        queued request has accumulated, in rank steps — 0.0 when the
+        queue is empty, 1.0 when some request has waited one full
+        aging_s, climbing without bound as the backlog ages. Unlike
+        raw depth, this is comparable across classes (a batch request
+        ages 2x slower than an interactive one by default) and rises
+        exactly when the anti-starvation machinery is working hardest
+        — the signal offered rps alone cannot see."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            if not self._queued:
+                return 0.0
+            return max(
+                max(0.0, now - self._reqs[r]["enq_t"])
+                / self._reqs[r]["aging_s"]
+                for r in self._queued)
+
     def class_depths(self) -> Dict[str, int]:
         """Queued requests per class (the cake_queue_depth gauge)."""
         out = {p.name: 0 for p in self.config.policies}

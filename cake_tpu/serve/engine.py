@@ -40,6 +40,8 @@ import numpy as np
 from cake_tpu.models.chat import History, Message
 from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.obs import steps as obs_steps
+from cake_tpu.obs.events import EventBus
+from cake_tpu.obs.slo import SLOAccountant, parse_slo_targets
 from cake_tpu.obs.tracing import RequestTracer
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.config import LlamaConfig
@@ -324,6 +326,9 @@ class InferenceEngine:
         trace_ring: int = 256,
         step_log: Optional[str] = None,
         step_ring: int = 512,
+        event_log: Optional[str] = None,
+        event_ring: int = 1024,
+        slo_targets=None,
         priority_classes: bool = False,
         preemption: Optional[bool] = None,
         shed: bool = False,
@@ -498,6 +503,23 @@ class InferenceEngine:
         self._base_cache_dtype = cache_dtype
         self._kv_host_pages = kv_host_pages
         self._custom_steps = step_fns is not None
+        # cross-subsystem event bus (obs/events.py), created BEFORE the
+        # paged setup so the host tier can attach to it: preemption,
+        # KV spill/restore, prefix hits, recovery, switches, shedding,
+        # fault injections and recompiles all publish request-linked
+        # events here (GET /api/v1/events; --event-log JSONL sink).
+        # --event-ring 0 disables the plane: self.events is then None
+        # and every publish site costs one attribute test (the
+        # --fault-plan injector discipline, pinned by a source scan)
+        self.events = (EventBus(capacity=event_ring, log_path=event_log)
+                       if event_ring > 0 else None)
+        # SLO attainment + goodput accounting (obs/slo.py): per-class
+        # targets from --slo-targets (defaults otherwise), fed from
+        # the tracer's finish seam so TTFT/e2e verdicts use the
+        # ORIGINAL admission clock across resubmits
+        self.slo = SLOAccountant(
+            slo_targets if isinstance(slo_targets, dict)
+            else parse_slo_targets(slo_targets))
         if self.kv_quant and not self.paged:
             raise ValueError(
                 "--kv-dtype int8 requires --kv-pages: int8 KV pages "
@@ -617,6 +639,9 @@ class InferenceEngine:
         from cake_tpu.faults import build_injector
         self._faults = build_injector(fault_plan)
         if self._faults is not None:
+            # firings ride the event bus too (None stays None: the
+            # injector's publish site guards `is not None` like ours)
+            self._faults.events = self.events
             log.warning("fault plan armed: %s",
                         self._faults.plan.describe())
         # rids dispatched in the CURRENT device step — the blast radius
@@ -643,7 +668,8 @@ class InferenceEngine:
         # sp step fns) is traced identically. trace_events: optional
         # JSONL event log path (--trace-events).
         self.tracer = RequestTracer(capacity=trace_ring,
-                                    events_path=trace_events)
+                                    events_path=trace_events,
+                                    slo=self.slo)
         from cake_tpu.utils.profiling import StepStats
         self._step_stats = StepStats(name="engine", window=100)
         # step-level flight recorder + jit compile/cost accounting
@@ -660,7 +686,8 @@ class InferenceEngine:
         self.flight = obs_steps.StepTelemetry(
             impl=flavor, capacity=step_ring, log_path=step_log,
             key_prefix=(config, max_slots, max_seq_len,
-                        str(self._cache_dtype), flavor))
+                        str(self._cache_dtype), flavor),
+            events=self.events)
         # latest dispatch's _JitStep (engine-thread-only mailbox between
         # the device-call seam and the step record that follows it)
         self._last_jit = None
@@ -798,6 +825,8 @@ class InferenceEngine:
         self._drain_cancellations()
         self.tracer.close()
         self.flight.close()
+        if self.events is not None:
+            self.events.close()
         if self._control is not None:
             # published only after the engine thread has exited, so no
             # step op can be ordered after the stop on the wire
@@ -1059,6 +1088,13 @@ class InferenceEngine:
                 if not dec.admit:
                     self.stats.shed += 1
                     _SHED_REQUESTS.labels(cls).inc()
+                    if self.events is not None:
+                        self.events.publish(
+                            "shed", rid=rid, priority=cls,
+                            retry_after_s=round(dec.retry_after_s, 3),
+                            est_wait_s=(round(dec.est_wait_s, 3)
+                                        if dec.est_wait_s is not None
+                                        else None))
                     raise ShedError(cls, dec.retry_after_s,
                                     est_wait_s=dec.est_wait_s)
             # register BEFORE scheduler.submit: the engine thread may
@@ -1844,6 +1880,10 @@ class InferenceEngine:
             self.tracer.span(rid, "crash_recovered",
                              generated=len(req.out_tokens),
                              crashes=req.crash_count)
+            if self.events is not None:
+                self.events.publish("recovered", rid=rid,
+                                    generated=len(req.out_tokens),
+                                    crashes=req.crash_count)
             _RECOVERED_REQUESTS.inc()
             self.stats.requests_recovered += 1
             n_rec += 1
@@ -1863,6 +1903,10 @@ class InferenceEngine:
         if poison_reason is not None:
             self.stats.poisoned += 1
             _POISON_REQUESTS.labels(reason=poison_reason).inc()
+            if self.events is not None:
+                self.events.publish("poisoned", rid=req.rid,
+                                    reason=poison_reason,
+                                    crashes=req.crash_count)
             log.error("quarantined rid=%d as poison (%s): %s",
                       req.rid, poison_reason, err)
         self.tracer.finish(req.rid, "error", error=str(err),
@@ -1914,6 +1958,25 @@ class InferenceEngine:
         if self._faults is not None:
             out["fault_plan"] = self._faults.describe()
         return out
+
+    # -- per-request explain (obs/timeline.py) ---------------------------
+
+    def request_timeline(self, rid: int) -> Optional[dict]:
+        """GET /api/v1/requests/{rid}/timeline: one merged,
+        time-ordered view of the request's trace spans, its event-bus
+        events and the step records whose batch contained it — the
+        single call that attributes a slow TTFT to its actual causes
+        (preempted twice, prefix spilled then restored, folded by a
+        config switch, ...). None when the rid is unknown (fell out of
+        the finished ring, or never admitted) — the API's 404."""
+        from cake_tpu.obs.timeline import build_timeline
+        trace = self.tracer.get(rid)
+        if trace is None:
+            return None
+        events = (self.events.dump(rid=rid)
+                  if self.events is not None else [])
+        return build_timeline(trace, events,
+                              self.flight.records_for(rid))
 
     # -- live reconfiguration (cake_tpu/autotune) ------------------------
 
@@ -2012,7 +2075,10 @@ class InferenceEngine:
                 kv_host_pages,
                 page_bytes=page_bytes(
                     self.config, kv_page_size,
-                    jnp.int8 if self.kv_quant else pool_dtype))
+                    jnp.int8 if self.kv_quant else pool_dtype),
+                # spill/restore publish on the engine's event bus
+                # (present on first setup AND on a reconfigure rebuild)
+                events=getattr(self, "events", None))
             log.info("kv host tier: %d pages (%.1f MiB capacity)",
                      kv_host_pages,
                      kv_host_pages * self._host_tier.page_bytes / 2**20)
@@ -2196,6 +2262,15 @@ class InferenceEngine:
                  "seconds": round(dt, 4), "carried": carried,
                  "epoch": self.config_epoch}
         self._switch_log.append(entry)
+        if self.events is not None:
+            # engine-level summary event (rid=None) beside the
+            # per-request ones _requeue_folded published: one line
+            # answers what switched, to what, and how many streams rode
+            self.events.publish("reconfigured", reason=reason,
+                                epoch=self.config_epoch,
+                                carried=carried,
+                                seconds=round(dt, 4),
+                                to=new.to_dict())
         if self._autotuner is not None and reason == "manual":
             # keep the auto controller's view of "current" in sync with
             # an operator's switch (it would otherwise keep proposing
@@ -2285,6 +2360,10 @@ class InferenceEngine:
                 if active or rid in folded:
                     self.tracer.span(rid, "reconfigured",
                                      generated=len(req.out_tokens))
+                    if self.events is not None:
+                        self.events.publish(
+                            "reconfigured", rid=rid,
+                            generated=len(req.out_tokens))
                     carried += 1
             self.scheduler.resize(applied.slots)
         else:
@@ -2321,6 +2400,10 @@ class InferenceEngine:
                 if rid in folded:
                     self.tracer.span(rid, "reconfigured",
                                      generated=len(req.out_tokens))
+                    if self.events is not None:
+                        self.events.publish(
+                            "reconfigured", rid=rid,
+                            generated=len(req.out_tokens))
                     carried += 1
         return carried
 
@@ -2440,6 +2523,7 @@ class InferenceEngine:
         if ttfts:
             xs = sorted(ttfts)
             p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        pressure = getattr(self.scheduler, "queue_pressure", None)
         return AutotuneSignals(
             t=now,
             offered_rps=(submitted - prev[1]) / dt,
@@ -2451,6 +2535,12 @@ class InferenceEngine:
             pages_in_use_frac=pages_frac,
             shed_rps=(st.shed - prev[4]) / dt,
             ttft_p99_s=p99,
+            # quality signals (obs/slo.py + sched aging pressure): what
+            # the policy's v2 guards and the rollback guard key on —
+            # the 1m window matches the controller's decision horizon
+            ttft_p99_by_class=self.slo.ttft_p99_by_class("1m"),
+            attainment=self.slo.attainment_by_class("1m"),
+            queue_pressure=pressure() if pressure is not None else 0.0,
         )
 
     def _autotune_tick(self) -> None:
@@ -2622,7 +2712,8 @@ class InferenceEngine:
         attaching the pending dispatch's cost info (js, or the
         engine-thread mailbox _last_jit) and page-pool occupancy.
         `split` carries the mixed step's occupancy breakdown
-        (rows_decode / rows_prefill / rows_idle)."""
+        (rows_decode / rows_prefill / rows_idle) and the dispatched
+        rows' `rids` (the per-request explain's step linkage)."""
         if js is None:
             js, self._last_jit = self._last_jit, None
         self.flight.record(
@@ -2695,6 +2786,11 @@ class InferenceEngine:
         self.tracer.span(rid, "preempted", reason=reason,
                          generated=len(req.out_tokens),
                          spilled=spilled)
+        if self.events is not None:
+            self.events.publish("preempted", rid=rid, reason=reason,
+                                priority=req.priority,
+                                generated=len(req.out_tokens),
+                                spilled=spilled)
         log.debug("preempted rid=%d (%s, %d tokens %s)", rid, reason,
                   len(req.out_tokens),
                   "spilled to the host tier" if spilled
@@ -3094,6 +3190,9 @@ class InferenceEngine:
                 req.repeat_penalty, prime, n_top=n_top,
                 entry=entry, defer=defer)
             self.stats.prefix_hits += 1
+            if self.events is not None:
+                self.events.publish("prefix_hit", rid=rid, pid=hit_pid,
+                                    tokens_saved=len(entry[0]))
         else:
             # covers whole-prompt AND chunked prefill — _prefill_device
             # picks between them from (prefill_chunk, len) alone, the
@@ -3115,7 +3214,8 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self.stats.prefill_time_s += dt
         self._obs_paged_step("prefill", dt)
-        self._record_step("prefill", rows=1, tokens=1, wall_s=dt)
+        self._record_step("prefill", rows=1, tokens=1, wall_s=dt,
+                          rids=(rid,))
         self._emit(req, tok, logprob=lp, top=top)
         return None
 
@@ -3168,6 +3268,7 @@ class InferenceEngine:
                 "prefill", rows=len(pend), tokens=len(pend), wall_s=dt,
                 cost=cost,
                 compiled=any(js is not None and js.new for js in pend_js),
+                rids=[req.rid for (req, _t0, _s, _d) in pend],
                 **self._page_kw())
             for (req, t0, slot, _), host in zip(pend, hosts):
                 tok, lp, top = self._finish_prefill_complete(slot, host)
@@ -3277,6 +3378,9 @@ class InferenceEngine:
             self.stats.prefix_hits += 1
             _PREFIX_PAGED_HITS.inc()
             _PREFIX_TOKENS_SAVED.inc(off)
+            if self.events is not None:
+                self.events.publish("prefix_hit", rid=req.rid,
+                                    pid=hit[0], tokens_saved=off)
         self._temp[slot] = req.temperature
         self._top_p[slot] = req.top_p
         self._penalty[slot] = req.repeat_penalty
@@ -3374,7 +3478,8 @@ class InferenceEngine:
             "mixed", rows=len(decode_rows) + len(chunk_rows),
             tokens=len(emit_rows), wall_s=dt,
             rows_decode=len(decode_rows), rows_prefill=len(chunk_rows),
-            rows_idle=B - len(decode_rows) - len(chunk_rows))
+            rows_idle=B - len(decode_rows) - len(chunk_rows),
+            rids=[r for r, _s in self._implicated])
         self._step_stats.step(bytes_out=len(emit_rows))
 
         def _top(slot):
@@ -3725,7 +3830,8 @@ class InferenceEngine:
             self._record_step("spec", rows=len(plan),
                               tokens=round_tokens, dispatch_s=disp_k,
                               device_s=fetch, wall_s=disp_k + fetch,
-                              js=js_k)
+                              js=js_k,
+                              rids=[req.rid for req, _s in plan])
 
         # double-buffered chained rounds (single-host; multi-host spec
         # has no engine), via the shared _drive_burst driver: round k+1
@@ -3787,7 +3893,8 @@ class InferenceEngine:
         self.stats.decode_time_s += dt
         self._obs_paged_step("decode", dt)
         self._record_step("decode", rows=len(decode_plan),
-                          tokens=len(decode_plan), wall_s=dt)
+                          tokens=len(decode_plan), wall_s=dt,
+                          rids=[r for r, _s in decode_plan])
         self._step_stats.step(bytes_out=len(decode_plan))
         for rid, slot in decode_plan:
             req = self._slot_req[slot]
@@ -3891,7 +3998,8 @@ class InferenceEngine:
         self.stats.decode_time_s += dt
         self._obs_paged_step("decode", dt / n)
         self._record_step("decode_scan", rows=len(decode_plan),
-                          tokens=int(budget.sum()), wall_s=dt)
+                          tokens=int(budget.sum()), wall_s=dt,
+                          rids=[r for r, _s in decode_plan])
         self._complete_scan(decode_plan, n, fetched, budget)
 
     def _decode_burst(self, decode_plan, n: int) -> None:
@@ -3953,7 +4061,8 @@ class InferenceEngine:
             self._record_step("decode_scan", rows=len(rows),
                               tokens=int(budget_k.sum()),
                               dispatch_s=disp_k, device_s=fetch,
-                              wall_s=disp_k + fetch, js=js_k)
+                              wall_s=disp_k + fetch, js=js_k,
+                              rids=[r for r, _s in decode_plan])
             self._complete_scan(decode_plan, n, fetched, budget_k)
             for _, slot in decode_plan:
                 shipped[slot] = (shipped.get(slot, 0)

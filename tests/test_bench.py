@@ -200,6 +200,14 @@ def test_slo_smoke_tier_reports_preemption_win():
         for tag in ("on", "off"):
             assert result[f"{cls}_ttft_p50_{tag}_ms"] > 0
             assert result[f"{cls}_ttft_p99_{tag}_ms"] > 0
+    # goodput accounting (obs/slo.py): tokens from SLO-met requests
+    # only, so goodput <= raw by construction; attainment in [0, 1]
+    for tag in ("on", "off"):
+        assert result[f"tok_s_{tag}"] > 0
+        assert 0.0 <= result[f"goodput_tok_s_{tag}"] \
+            <= result[f"tok_s_{tag}"]
+        att = result[f"attainment_{tag}"]
+        assert att and all(0.0 <= v <= 1.0 for v in att.values())
 
 
 def test_paged_attn_microbench_rejects_bad_impl():
@@ -267,6 +275,11 @@ def test_autotune_smoke_tier_switches_without_losing_streams():
         for ph in ("low", "high"):
             assert result[f"{ph}_tok_s_{tag}"] > 0
             assert result[f"{ph}_ttft_p99_{tag}_ms"] > 0
+            # goodput <= raw, attainment in [0, 1] (obs/slo.py)
+            assert 0.0 <= result[f"{ph}_goodput_tok_s_{tag}"] \
+                <= result[f"{ph}_tok_s_{tag}"]
+            att = result[f"{ph}_attainment_{tag}"]
+            assert att and all(0.0 <= v <= 1.0 for v in att.values())
     assert all("config" in o and o["tok_s"] > 0
                for o in result["autotune_observations"])
 

@@ -1,0 +1,147 @@
+"""tools/bench_compare.py as a tier-1 gate: the per-tier BENCH diff
+with its rc 0/1/2 contract and the degraded-round skip (the "driver
+rounds often read 0.0 over a dead tunnel" footgun, made
+machine-checkable)."""
+
+import importlib.util
+import json
+import pathlib
+
+TOOLS = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", TOOLS / "bench_compare.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tier(metric, **fields):
+    return {"metric": metric, "value": fields.get("value", 1.0),
+            "unit": "x", **fields}
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_extract_walks_any_shape():
+    bc = _load()
+    doc = {
+        "round_start": {"line": _tier("a_tok_s", value=5.0)},
+        "reruns": [
+            {"cmd": "x", "line": _tier("b", ttft_p99_ms=10.0)},
+            {"line": _tier("a_tok_s", value=7.0)},   # rerun wins
+        ],
+        "parsed": _tier("c", mfu=0.5),
+    }
+    tiers = bc.extract_tiers(doc)
+    assert set(tiers) == {"a_tok_s", "b", "c"}
+    assert tiers["a_tok_s"]["value"] == 7.0
+
+
+def test_no_regression_rc0(tmp_path):
+    bc = _load()
+    old = _write(tmp_path, "old.json",
+                 [_tier("t", engine_decode_tok_s=100.0,
+                        ttft_p99_ms=50.0, mfu=0.5)])
+    new = _write(tmp_path, "new.json",
+                 [_tier("t", engine_decode_tok_s=104.0,
+                        ttft_p99_ms=48.0, mfu=0.52)])
+    assert bc.main([old, new]) == 0
+
+
+def test_regression_rc1_each_direction(tmp_path):
+    bc = _load()
+    base = _tier("t", engine_decode_tok_s=100.0,
+                 inter_ttft_p99_ms=50.0, mfu=0.5)
+    old = _write(tmp_path, "old.json", [base])
+    # throughput drop beyond 10%
+    new = _write(tmp_path, "tok.json",
+                 [{**base, "engine_decode_tok_s": 80.0}])
+    assert bc.main([old, new]) == 1
+    # TTFT p99 is lower-is-better: a RISE is the regression
+    new = _write(tmp_path, "ttft.json",
+                 [{**base, "inter_ttft_p99_ms": 90.0}])
+    assert bc.main([old, new]) == 1
+    # ...and a fall is fine
+    new = _write(tmp_path, "ttft_ok.json",
+                 [{**base, "inter_ttft_p99_ms": 20.0}])
+    assert bc.main([old, new]) == 0
+    # MFU drop
+    new = _write(tmp_path, "mfu.json", [{**base, "mfu": 0.3}])
+    assert bc.main([old, new]) == 1
+    # within tolerance: rc 0; a wider --tol forgives a real drop
+    new = _write(tmp_path, "tol.json",
+                 [{**base, "engine_decode_tok_s": 95.0}])
+    assert bc.main([old, new]) == 0
+    new = _write(tmp_path, "tol2.json",
+                 [{**base, "engine_decode_tok_s": 80.0}])
+    assert bc.main([old, new, "--tol", "0.5"]) == 0
+
+
+def test_degraded_tiers_skipped(tmp_path):
+    """THE footgun this tool exists for: a tunnel-outage round reads
+    0.0 with "degraded": true — it must be SKIPPED, never reported as
+    a regression."""
+    bc = _load()
+    good = _tier("t", engine_decode_tok_s=100.0)
+    old = _write(tmp_path, "old.json", [good])
+    new = _write(tmp_path, "new.json",
+                 [{**good, "engine_decode_tok_s": 0.0,
+                   "value": 0.0, "degraded": True}])
+    assert bc.main([old, new]) == 0
+    summary = bc.compare(bc.extract_tiers([good]),
+                         bc.extract_tiers([{**good, "degraded": True}]))
+    assert summary["skipped_degraded"] == ["t"]
+    assert summary["compared"] == []
+    # degraded on the OLD side skips too
+    summary = bc.compare(bc.extract_tiers([{**good, "degraded": True}]),
+                         bc.extract_tiers([good]))
+    assert summary["skipped_degraded"] == ["t"]
+
+
+def test_zero_old_values_not_compared():
+    bc = _load()
+    old = {"t": _tier("t", engine_decode_tok_s=0.0)}
+    new = {"t": _tier("t", engine_decode_tok_s=100.0)}
+    s = bc.compare(old, new)
+    assert s["regressions"] == [] and s["improvements"] == []
+
+
+def test_disjoint_tiers_rc0_with_notes(tmp_path):
+    bc = _load()
+    old = _write(tmp_path, "old.json", [_tier("only_old", tok_s=1.0)])
+    new = _write(tmp_path, "new.json", [_tier("only_new", tok_s=2.0)])
+    assert bc.main([old, new]) == 0
+    s = bc.compare(bc.extract_tiers([_tier("only_old")]),
+                   bc.extract_tiers([_tier("only_new")]))
+    assert s["only_old"] == ["only_old"] and s["only_new"] == ["only_new"]
+
+
+def test_unusable_input_rc2(tmp_path):
+    bc = _load()
+    good = _write(tmp_path, "g.json", [_tier("t", tok_s=1.0)])
+    assert bc.main(["/nonexistent.json", good]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bc.main([str(bad), good]) == 2
+    empty = _write(tmp_path, "empty.json", {"no": "tiers"})
+    assert bc.main([empty, good]) == 2
+    assert bc.main([good]) == 2                 # usage
+    assert bc.main([good, good, "--tol", "x"]) == 2
+
+
+def test_real_round_files_are_ingestible():
+    """The builder-captured round files in the repo root parse into
+    tier records as-is (the walking extractor's real-world contract)."""
+    bc = _load()
+    root = TOOLS.parent
+    doc = json.loads((root / "BENCH_r05_builder.json").read_text())
+    tiers = bc.extract_tiers(doc)
+    assert tiers, "no tier records found in BENCH_r05_builder.json"
+    assert all("metric" in t for t in tiers.values())

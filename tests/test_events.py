@@ -1,0 +1,208 @@
+"""Cross-subsystem event bus (obs/events.py): ring semantics, filters,
+the JSONL sink, the typed vocabulary, and the publish-site discipline
+(every site attribute-guarded so `--event-ring 0` costs one attribute
+test per site — the --fault-plan injector pattern, pinned structurally
+by a source scan)."""
+
+import pytest
+
+from cake_tpu.obs.events import EVENT_TYPES, EventBus
+
+
+def test_publish_and_dump_roundtrip():
+    bus = EventBus(capacity=16)
+    bus.publish("preempted", rid=7, reason="slots", generated=3)
+    bus.publish("kv_spill", rid=7, pages=2, kind="victim")
+    bus.publish("shed", rid=9, priority="interactive")
+    evs = bus.dump()
+    assert [e["type"] for e in evs] == ["preempted", "kv_spill", "shed"]
+    assert [e["seq"] for e in evs] == [1, 2, 3]   # ascending cursor
+    assert evs[0]["rid"] == 7 and evs[0]["reason"] == "slots"
+    assert all("ts" in e for e in evs)
+    assert bus.cursor == 3
+
+
+def test_unknown_type_raises():
+    bus = EventBus()
+    with pytest.raises(ValueError, match="unknown event type"):
+        bus.publish("preemptedd", rid=1)
+
+
+def test_none_fields_dropped():
+    bus = EventBus()
+    bus.publish("kv_restore", rid=None, pages=4, pid=None)
+    (ev,) = bus.dump()
+    assert "rid" not in ev and "pid" not in ev and ev["pages"] == 4
+
+
+def test_filters_compose():
+    bus = EventBus()
+    for i in range(4):
+        bus.publish("preempted", rid=i % 2, reason="slots")
+    bus.publish("recovered", rid=0)
+    assert len(bus.dump(rid=0)) == 3
+    assert len(bus.dump(type="preempted")) == 4
+    assert len(bus.dump(rid=0, type="preempted")) == 2
+    # since= is a strictly-greater seq cursor: polling with the last
+    # response's cursor reads only what is new
+    assert [e["seq"] for e in bus.dump(since=3)] == [4, 5]
+    assert bus.dump(since=bus.cursor) == []
+    assert len(bus.dump(limit=2)) == 2
+
+
+def test_since_limit_pages_forward_without_loss():
+    """limit keeps the FIRST n after since, and the snapshot cursor
+    always points at the last covered seq — a limited cursor poll
+    walks every event exactly once, skipping none."""
+    bus = EventBus()
+    for i in range(10):
+        bus.publish("recompile", fn=f"f{i}")
+    seen, cur = [], 0
+    while True:
+        page, cur2 = bus.snapshot(since=cur, limit=4)
+        if not page:
+            break
+        seen += [e["seq"] for e in page]
+        cur = cur2
+    assert seen == list(range(1, 11))
+    assert cur == bus.cursor
+    # a truncated page's cursor is the last RETURNED seq, not the
+    # ring's newest (the older remainder must not be skipped)
+    page, cur = bus.snapshot(since=0, limit=4)
+    assert [e["seq"] for e in page] == [1, 2, 3, 4] and cur == 4
+    # limit=0 makes no progress (and no IndexError)
+    page, cur = bus.snapshot(since=2, limit=0)
+    assert page == [] and cur == 2
+
+
+def test_ring_bounds_and_drop_counter():
+    bus = EventBus(capacity=4)
+    for i in range(10):
+        bus.publish("recompile", fn=f"f{i}")
+    evs = bus.dump()
+    assert len(evs) == 4
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]  # oldest evicted
+
+
+def test_jsonl_sink(tmp_path):
+    from cake_tpu.obs.jsonl import read_jsonl
+    path = tmp_path / "events.jsonl"
+    bus = EventBus(capacity=2, log_path=str(path))
+    for i in range(5):
+        bus.publish("fault_injected", site="engine.decode", call=i + 1)
+    bus.close()
+    lines = read_jsonl(str(path))
+    # the sink is lossless even though the ring evicted: 5 lines
+    assert len(lines) == 5
+    assert [ln["seq"] for ln in lines] == [1, 2, 3, 4, 5]
+    assert lines[0]["type"] == "fault_injected"
+
+
+def test_vocabulary_is_the_documented_ten():
+    assert set(EVENT_TYPES) == {
+        "preempted", "kv_spill", "kv_restore", "prefix_hit",
+        "recovered", "poisoned", "reconfigured", "shed",
+        "fault_injected", "recompile"}
+
+
+# -- publishers outside the engine -------------------------------------------
+
+
+def test_injector_publishes_fault_injected():
+    from cake_tpu.faults import build_injector
+    inj = build_injector("seed=1;engine.decode:nth=2:transient")
+    bus = EventBus()
+    inj.events = bus
+    inj.check("engine.decode", step=1)          # no fire
+    with pytest.raises(Exception):
+        inj.check("engine.decode", step=2)      # fires
+    (ev,) = bus.dump(type="fault_injected")
+    assert ev["site"] == "engine.decode" and ev["kind"] == "transient"
+    assert ev["call"] == 2
+
+
+def test_jit_accountant_publishes_recompile():
+    from cake_tpu.obs.steps import JitAccountant, StepTelemetry
+    bus = EventBus()
+    st = StepTelemetry(impl="dense", accountant=JitAccountant(),
+                       events=bus)
+    st.jit_step("decode", (1, 2), lambda: None)
+    st.jit_step("decode", (1, 2), lambda: None)   # cached: no event
+    st.jit_step("decode", (1, 3), lambda: None)   # new signature
+    evs = bus.dump(type="recompile")
+    assert len(evs) == 2
+    assert all(e["fn"] == "decode" for e in evs)
+
+
+def test_host_tier_publishes_spill_and_restore(tiny_config):
+    import jax.numpy as jnp
+
+    from cake_tpu.kv.host_tier import HostTier, SpilledPages
+    from cake_tpu.models.llama.paged import PagedKVCache
+    bus = EventBus()
+    tier = HostTier(8, events=bus)
+    cache = PagedKVCache.create(tiny_config, slots=1, n_pages=4,
+                                page_size=8, max_seq_len=32,
+                                dtype=jnp.float32)
+    arrays = HostTier.fetch_pages(cache, [0, 1])
+    tier.put(("victim", 42), SpilledPages(n_pages=2, arrays=arrays,
+                                          kind="victim"))
+    ev = bus.dump(type="kv_spill")[-1]
+    assert ev["rid"] == 42 and ev["pages"] == 2
+    tier.pop(("victim", 42))
+    ev = bus.dump(type="kv_restore")[-1]
+    assert ev["rid"] == 42 and ev["pages"] == 2
+    # prefix entries carry the pid as a field (no rid exists)
+    tier.put(("prefix", 3), SpilledPages(n_pages=1, arrays=arrays,
+                                         kind="prefix"))
+    ev = bus.dump(type="kv_spill")[-1]
+    assert "rid" not in ev and ev["pid"] == 3
+    # a plain discard (drop) is NOT a restore event
+    tier.drop(("prefix", 3))
+    assert len(bus.dump(type="kv_restore")) == 1
+
+
+# -- the disabled plane: one attribute test per site --------------------------
+
+
+def test_disabled_plane_publish_sites_are_attribute_guarded():
+    """Pin the --event-ring 0 contract structurally (the --fault-plan
+    injector pattern): every event-bus publish site sits behind an
+    `is not None` attribute test, so a disabled bus costs exactly one
+    attribute read per site — no Event object, no lock, no ring."""
+    import cake_tpu.faults.injector as injector
+    import cake_tpu.kv.host_tier as host_tier
+    import cake_tpu.obs.steps as steps
+    import cake_tpu.serve.engine as engine
+    # host_tier routes its two sites through the _publish() helper
+    # (key->rid decoding lives there); the helper itself dereferences
+    # the bus, so the guarded SITES are the helper's callers
+    for mod, attr, call in (
+            (engine, "self.events", "self.events.publish("),
+            (host_tier, "self._events", "self._publish("),
+            (steps, "self._events", "self._events.publish("),
+            (injector, "self.events", "self.events.publish(")):
+        src = open(mod.__file__).readlines()
+        needles = [i for i, ln in enumerate(src)
+                   if call in ln and "def " not in ln]
+        assert needles, f"no publish sites found in {mod.__name__}"
+        for i in needles:
+            window = "".join(src[max(0, i - 6):i + 1])
+            assert f"{attr} is not None" in window, (
+                f"{mod.__name__}:{i + 1} publishes without an "
+                "`is not None` guard — the disabled bus must stay a "
+                "single attribute test per site")
+
+
+def test_engine_event_ring_zero_disables_bus(tiny_config, tiny_params):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.serve.engine import InferenceEngine
+    eng = InferenceEngine(tiny_config, tiny_params,
+                          ByteTokenizer(tiny_config.vocab_size),
+                          max_slots=1, max_seq_len=32, event_ring=0)
+    assert eng.events is None
+    # and the default-on bus exists
+    eng2 = InferenceEngine(tiny_config, tiny_params,
+                           ByteTokenizer(tiny_config.vocab_size),
+                           max_slots=1, max_seq_len=32)
+    assert eng2.events is not None

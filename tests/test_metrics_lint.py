@@ -168,3 +168,72 @@ def test_registry_render_always_lints_clean():
     g = m.Gauge("edge_gauge", "e", registry=reg)
     g.set(-3.25)
     assert lm.lint(reg.render()) == []
+
+
+def test_rid_valued_labels_banned():
+    """Per-request identity on a metric series is banned outright:
+    rids belong on the event bus / request traces."""
+    lm = _load()
+    errs = lm.lint('# TYPE a_total counter\na_total{rid="7"} 1\n')
+    assert any("banned label 'rid'" in e for e in errs)
+    # even under a huge cap — the ban is unconditional
+    errs = lm.lint('# TYPE a_total counter\na_total{rid="7"} 1\n',
+                   series_cap=10_000)
+    assert any("banned label" in e for e in errs)
+
+
+def test_series_cardinality_cap():
+    lm = _load()
+    lines = ["# TYPE fat_total counter"]
+    lines += [f'fat_total{{shard="{i}"}} 1' for i in range(70)]
+    text = "\n".join(lines) + "\n"
+    errs = lm.lint(text)                       # default cap 64
+    assert any("70 live series" in e and "cardinality" in e
+               for e in errs)
+    assert lm.lint(text, series_cap=128) == []  # cap is configurable
+    assert lm.lint(text, series_cap=0) == []    # 0 disables
+
+
+def test_series_cap_counts_label_sets_not_buckets():
+    """A histogram's le buckets are one series per label set — 3
+    children x 20 buckets must count as 3, not 60."""
+    lm = _load()
+    lines = ["# TYPE h_seconds histogram"]
+    for mode in ("a", "b", "c"):
+        for le in [str(x) for x in range(20)] + ["+Inf"]:
+            n = 21 if le == "+Inf" else int(le) + 1
+            lines.append(f'h_seconds_bucket{{mode="{mode}",le="{le}"}}'
+                         f" {n}")
+        lines.append(f'h_seconds_sum{{mode="{mode}"}} 1')
+        lines.append(f'h_seconds_count{{mode="{mode}"}} 21')
+    assert lm.lint("\n".join(lines) + "\n", series_cap=4) == []
+
+
+def test_series_cap_cli_flag(tmp_path):
+    lm = _load()
+    lines = ["# TYPE fat_total counter"]
+    lines += [f'fat_total{{shard="{i}"}} 1' for i in range(70)]
+    p = tmp_path / "m.prom"
+    p.write_text("\n".join(lines) + "\n")
+    assert lm.main([str(p)]) == 1
+    assert lm.main([str(p), "--series-cap", "100"]) == 0
+    assert lm.main([str(p), "--series-cap", "abc"]) == 2
+
+
+def test_goodput_event_families_live_linted():
+    """The tier-1 hook covers the new families: cake_slo_* /
+    cake_goodput_* / cake_events_* are registered (module import),
+    carry real help text and have README rows."""
+    lm = _load()
+    import cake_tpu.obs.events  # noqa: F401 — cake_events_*
+    import cake_tpu.obs.slo  # noqa: F401 — cake_slo_*/cake_goodput_*
+    from cake_tpu.obs import metrics as m
+    text = m.REGISTRY.render()
+    for fam in ("cake_events_total", "cake_events_dropped_total",
+                "cake_slo_attainment", "cake_slo_requests_total",
+                "cake_slo_misses_total", "cake_goodput_tokens_total"):
+        assert any(line.startswith(f"# TYPE {fam}")
+                   for line in text.splitlines()), fam
+    readme = (TOOLS.parent / "README.md").read_text()
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs

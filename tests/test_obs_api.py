@@ -35,6 +35,14 @@ def _load_lint():
     return mod
 
 
+# the module server's engine, reachable for tests that need to drive
+# it directly (e.g. publishing a deterministic event-bus event — the
+# organic "recompile" events dedupe through the PROCESS-GLOBAL jit
+# accountant, so in full-suite order an earlier module may have
+# compiled every signature already)
+_SERVER = {}
+
+
 @pytest.fixture(scope="module")
 def server_url():
     cfg = LlamaConfig.tiny(num_hidden_layers=2)
@@ -44,10 +52,14 @@ def server_url():
                          sampling=SamplingConfig(temperature=0.0),
                          cache_dtype=jnp.float32)
     master = Master(Args(sample_len=4), text_generator=gen)
-    httpd = start(master, address="127.0.0.1:0", block=False)
+    engine = master.make_engine()
+    _SERVER["engine"] = engine
+    httpd = start(master, address="127.0.0.1:0", block=False,
+                  engine=engine)
     host, port = httpd.server_address[:2]
     yield f"http://{host}:{port}"
     httpd.shutdown()
+    _SERVER.clear()
 
 
 def _chat(url, **extra):
@@ -140,3 +152,125 @@ def test_exposition_names_are_prometheus_clean(server_url):
             continue
         name = re.split(r"[{ ]", line, 1)[0]
         assert name_re.match(name), line
+
+
+# -- goodput-first observability surface (events / filters / timeline) -------
+
+
+def _get(url, path):
+    """(status, body) — error statuses read the body instead of
+    raising (the contract under test IS the status code)."""
+    try:
+        r = urllib.request.urlopen(url + path, timeout=10)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_requests_filter_contract(server_url):
+    _chat(server_url)
+    code, obj = _get(server_url, "/api/v1/requests")
+    assert code == 200 and obj["requests"]
+    cursor = obj["cursor"]
+    assert cursor == max(r["rid"] for r in obj["requests"])
+    rid = obj["requests"][0]["rid"]
+    # ?rid= exact
+    code, one = _get(server_url, f"/api/v1/requests?rid={rid}")
+    assert code == 200
+    assert [r["rid"] for r in one["requests"]] == [rid]
+    # ?class= filters by priority (unmarked chats are standard)
+    code, std = _get(server_url, "/api/v1/requests?class=standard")
+    assert code == 200 and std["requests"]
+    assert all(r["priority"] == "standard" for r in std["requests"])
+    code, it = _get(server_url, "/api/v1/requests?class=interactive")
+    assert code == 200 and it["requests"] == []
+    # ?since= is a rid cursor: nothing newer than the newest
+    code, newer = _get(server_url,
+                       f"/api/v1/requests?since={cursor}")
+    assert code == 200 and newer["requests"] == []
+    _chat(server_url)
+    code, newer = _get(server_url,
+                       f"/api/v1/requests?since={cursor}")
+    assert code == 200
+    assert newer["requests"] and all(
+        r["rid"] > cursor for r in newer["requests"])
+    # since-pages run OLDEST-first (cursor pagination pages forward)
+    rids = [r["rid"] for r in newer["requests"]]
+    assert rids == sorted(rids)
+    assert newer["cursor"] == rids[-1]
+    # an empty page keeps the cursor where it was (no skipping)
+    code, empty = _get(server_url,
+                       f"/api/v1/requests?since={newer['cursor']}")
+    assert code == 200 and empty["requests"] == []
+    assert empty["cursor"] == newer["cursor"]
+    # malformed filters are 400s, not silent full dumps
+    assert _get(server_url, "/api/v1/requests?rid=abc")[0] == 400
+    assert _get(server_url, "/api/v1/requests?class=vip")[0] == 400
+    assert _get(server_url, "/api/v1/requests?since=x")[0] == 400
+    assert _get(server_url, "/api/v1/steps?limit=abc")[0] == 400
+
+
+def test_events_endpoint_contract(server_url):
+    _chat(server_url)
+    # publish deterministic events through the live engine's bus: the
+    # organic recompile events dedupe via the process-global jit
+    # accountant, so full-suite order may produce none here
+    bus = _SERVER["engine"].events
+    bus.publish("prefix_hit", rid=123456, pid=1, tokens_saved=16)
+    bus.publish("shed", rid=123457, priority="interactive")
+    code, obj = _get(server_url, "/api/v1/events")
+    assert code == 200
+    assert obj["events"], obj
+    assert obj["cursor"] >= len(obj["events"])
+    seqs = [e["seq"] for e in obj["events"]]
+    assert seqs == sorted(seqs)
+    code, hits = _get(server_url, "/api/v1/events?type=prefix_hit")
+    assert code == 200 and hits["events"]
+    assert all(e["type"] == "prefix_hit" for e in hits["events"])
+    code, one = _get(server_url,
+                     "/api/v1/events?rid=123456&type=prefix_hit")
+    assert code == 200 and len(one["events"]) == 1
+    assert one["events"][0]["tokens_saved"] == 16
+    # cursor polling: nothing newer than the cursor
+    code, newer = _get(server_url,
+                       f"/api/v1/events?since={obj['cursor']}")
+    assert code == 200 and newer["events"] == []
+    assert _get(server_url, "/api/v1/events?type=bogus")[0] == 400
+    assert _get(server_url, "/api/v1/events?rid=abc")[0] == 400
+
+
+def test_timeline_endpoint_contract(server_url):
+    _chat(server_url)
+    _, obj = _get(server_url, "/api/v1/requests?limit=1")
+    rid = obj["requests"][0]["rid"]
+    code, tl = _get(server_url, f"/api/v1/requests/{rid}/timeline")
+    assert code == 200
+    assert tl["rid"] == rid
+    assert {"summary", "timeline"} <= set(tl)
+    ts = [e["t"] for e in tl["timeline"]]
+    assert ts == sorted(ts)
+    assert any(e["source"] == "trace" for e in tl["timeline"])
+    # step records carry rids now: the request's steps are stitched in
+    assert any(e["source"] == "steps" for e in tl["timeline"])
+    assert _get(server_url,
+                "/api/v1/requests/999999/timeline")[0] == 404
+    # the route counter uses the TEMPLATE, never a rid-valued label
+    s = _series(_scrape(server_url))
+    key = ('cake_http_requests_total{route='
+           '"/api/v1/requests/{rid}/timeline",status="200"}')
+    assert s[key] >= 1
+
+
+def test_health_and_metrics_carry_slo_block(server_url):
+    _chat(server_url)
+    code, health = _get(server_url, "/api/v1/health")
+    assert code == 200 and "slo" in health
+    slo = health["slo"]
+    assert slo["requests"].get("standard", 0) >= 1
+    assert set(slo["targets"]) == {"interactive", "standard", "batch"}
+    att = slo["attainment_10m"]
+    assert all(0.0 <= v <= 1.0 for v in att.values())
+    text = _scrape(server_url)
+    assert "# TYPE cake_slo_attainment gauge" in text
+    assert "# TYPE cake_goodput_tokens_total counter" in text
+    assert "# TYPE cake_events_total counter" in text

@@ -1,0 +1,355 @@
+"""SLO attainment + goodput accounting (obs/slo.py): the --slo-targets
+parse matrix, rolling-window attainment, burn-rate/goodput counters,
+the tracer finish seam, and the quality-aware autotune machinery
+(policy v2 guards + controller TTFT-keyed decisions + attainment
+rollback)."""
+
+import pytest
+
+from cake_tpu.obs.slo import (
+    DEFAULT_TARGETS, SLOAccountant, SLOTarget, parse_slo_targets,
+)
+
+
+# -- --slo-targets parsing ----------------------------------------------------
+
+
+def test_parse_empty_keeps_defaults():
+    assert parse_slo_targets(None) == DEFAULT_TARGETS
+    assert parse_slo_targets("") == DEFAULT_TARGETS
+
+
+def test_parse_spec_overrides_named_classes_only():
+    t = parse_slo_targets("interactive=ttft:0.1,e2e:2")
+    assert t["interactive"] == SLOTarget(ttft_s=0.1, e2e_s=2.0)
+    assert t["standard"] == DEFAULT_TARGETS["standard"]
+    assert t["batch"] == DEFAULT_TARGETS["batch"]
+
+
+def test_parse_named_class_replaces_wholesale():
+    # naming only ttft means "no e2e target", not "default e2e"
+    t = parse_slo_targets("standard=ttft:3")
+    assert t["standard"] == SLOTarget(ttft_s=3.0, e2e_s=None)
+
+
+def test_parse_multi_class():
+    t = parse_slo_targets(
+        "interactive=ttft:0.1,e2e:2;batch=ttft:60,e2e:600")
+    assert t["interactive"].ttft_s == 0.1
+    assert t["batch"].e2e_s == 600.0
+
+
+@pytest.mark.parametrize("bad,frag", [
+    ("vip=ttft:1", "unknown class"),
+    ("interactive", "class=metric:seconds"),
+    ("interactive=latency:1", "unknown target"),
+    ("interactive=ttft:fast", "not a number"),
+    ("interactive=ttft:0", "must be > 0"),
+    ("interactive=ttft:-2", "must be > 0"),
+    ("interactive=ttft:1,ttft:2", "duplicate"),
+    ("interactive=ttft", "metric:seconds"),
+])
+def test_parse_rejects_malformed(bad, frag):
+    with pytest.raises(ValueError, match=frag):
+        parse_slo_targets(bad)
+
+
+def test_args_validate_parses_slo_targets():
+    from cake_tpu.args import Args
+    Args(slo_targets="interactive=ttft:0.1,e2e:2").validate()
+    with pytest.raises(ValueError, match="unknown class"):
+        Args(slo_targets="gold=ttft:1").validate()
+    with pytest.raises(ValueError, match="event-ring"):
+        Args(event_ring=-1).validate()
+    Args(event_ring=0).validate()   # 0 = bus disabled, legal
+
+
+# -- accountant ---------------------------------------------------------------
+
+
+def _acct(**targets):
+    clock = [100.0]
+    t = dict(DEFAULT_TARGETS)
+    t.update(targets)
+    a = SLOAccountant(t, clock=lambda: clock[0],
+                      observe_metrics=False)
+    return a, clock
+
+
+def test_attainment_and_goodput_accounting():
+    a, clock = _acct(
+        interactive=SLOTarget(ttft_s=0.5, e2e_s=10.0))
+    assert a.observe("interactive", 0.2, 5.0, tokens=10) is True
+    assert a.observe("interactive", 0.9, 5.0, tokens=7) is False  # ttft
+    assert a.observe("interactive", 0.3, 30.0, tokens=7) is False  # e2e
+    att = a.attainment_by_class("1m")
+    assert att["interactive"] == pytest.approx(1 / 3)
+    assert "standard" not in att          # no data: absent, not 0/1
+    assert a.goodput_tokens["interactive"] == 10   # met-SLO tokens only
+    assert a.requests["interactive"] == 3
+    assert a.misses["interactive"] == 2
+
+
+def test_failed_request_is_unconditional_miss():
+    a, _ = _acct()
+    assert a.observe("standard", None, None, tokens=4,
+                     failed=True) is False
+    assert a.goodput_tokens["standard"] == 0
+    assert a.attainment_by_class("1m")["standard"] == 0.0
+
+
+def test_unmeasured_latency_passes():
+    # a zero-token retirement has no first-token span: judge what was
+    # measured, never guess
+    a, _ = _acct(standard=SLOTarget(ttft_s=1.0, e2e_s=10.0))
+    assert a.observe("standard", None, 2.0, tokens=0) is True
+
+
+def test_windows_roll():
+    a, clock = _acct(standard=SLOTarget(ttft_s=1.0, e2e_s=None))
+    a.observe("standard", 5.0, None, tokens=1)       # miss at t=100
+    clock[0] += 90                                   # outside 1m
+    a.observe("standard", 0.1, None, tokens=1)       # met at t=190
+    assert a.attainment_by_class("1m")["standard"] == 1.0
+    assert a.attainment_by_class("10m")["standard"] == 0.5
+    clock[0] += 700                                  # everything aged out
+    assert a.attainment_by_class("10m") == {}
+
+
+def test_ttft_p99_by_class():
+    a, _ = _acct()
+    for ms in (10, 20, 500):
+        a.observe("interactive", ms / 1000, 1.0, tokens=1)
+    p99 = a.ttft_p99_by_class("1m")
+    assert p99["interactive"] == pytest.approx(0.5)
+
+
+def test_metric_families_registered_and_linted():
+    """The cake_slo_*/cake_goodput_* families render through the lint
+    (help text present; no rid labels; cardinality bounded)."""
+    import importlib.util
+    import pathlib
+
+    from cake_tpu.obs import metrics as m
+    from cake_tpu.obs.slo import SLOAccountant  # noqa: F401 (registers)
+    acct = SLOAccountant()
+    acct.observe("interactive", 0.1, 1.0, tokens=3)
+    text = m.REGISTRY.render()
+    assert "# TYPE cake_slo_attainment gauge" in text
+    assert "# TYPE cake_slo_requests_total counter" in text
+    assert "# TYPE cake_slo_misses_total counter" in text
+    assert "# TYPE cake_goodput_tokens_total counter" in text
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools" / "lint_metrics.py")
+    lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lm)
+    assert lm.lint(text) == []
+
+
+def test_tracer_finish_feeds_accountant():
+    """RequestTracer.finish is THE retire seam: retired requests are
+    judged with the record's own latencies, cancelled ones are skipped,
+    errors are unconditional misses."""
+    from cake_tpu.obs.tracing import RequestTracer
+    a, _ = _acct(standard=SLOTarget(ttft_s=60.0, e2e_s=600.0))
+    tr = RequestTracer(capacity=8, observe_metrics=False, slo=a)
+    tr.admit(1, 4, 8)
+    tr.prefill_start(1)
+    tr.first_token(1)
+    tr.finish(1, "retired")
+    assert a.requests["standard"] == 1
+    assert a.goodput_tokens["standard"] == 1
+    tr.admit(2, 4, 8)
+    tr.finish(2, "cancelled")
+    assert a.requests["standard"] == 1    # cancelled: not judged
+    tr.admit(3, 4, 8)
+    tr.finish(3, "error", error="boom")
+    assert a.requests["standard"] == 2
+    assert a.misses["standard"] == 1
+
+
+# -- quality-aware policy lookup (autotune v2) --------------------------------
+
+
+def _policy(regimes):
+    from cake_tpu.autotune import PolicyTable
+    return PolicyTable(regimes=regimes).validate()
+
+
+LO = {"slots": 2}
+HI = {"slots": 8}
+
+
+def test_policy_v2_roundtrip_and_v1_readable(tmp_path):
+    from cake_tpu.autotune import PolicyTable
+    p = _policy([
+        {"max_offered_rps": 2.0, "config": LO,
+         "max_ttft_p99_s": {"interactive": 0.2},
+         "min_attainment": 0.9},
+        {"max_offered_rps": None, "config": HI}])
+    path = tmp_path / "p.json"
+    p.save(str(path))
+    import json
+    d = json.loads(path.read_text())
+    assert d["version"] == 2
+    p2 = PolicyTable.load(str(path))
+    assert p2.regimes[0]["max_ttft_p99_s"] == {"interactive": 0.2}
+    # version-1 files (no guards) still load
+    d["version"] = 1
+    for r in d["regimes"]:
+        r.pop("max_ttft_p99_s", None)
+        r.pop("min_attainment", None)
+    path.write_text(json.dumps(d))
+    PolicyTable.load(str(path))
+    d["version"] = 3
+    path.write_text(json.dumps(d))
+    with pytest.raises(ValueError, match="version"):
+        PolicyTable.load(str(path))
+
+
+def test_policy_guard_validation():
+    with pytest.raises(ValueError, match="max_ttft_p99_s"):
+        _policy([{"max_offered_rps": None, "config": LO,
+                  "max_ttft_p99_s": "fast"}])
+    with pytest.raises(ValueError, match="min_attainment"):
+        _policy([{"max_offered_rps": None, "config": LO,
+                  "min_attainment": {"interactive": -1}}])
+
+
+def test_lookup_escalates_on_ttft_guard():
+    p = _policy([
+        {"max_offered_rps": 5.0, "config": LO,
+         "max_ttft_p99_s": {"interactive": 0.2}},
+        {"max_offered_rps": None, "config": HI}])
+    # under the boundary, quality fine (or unknown): the small config
+    assert p.lookup(1.0).to_dict()["slots"] == 2
+    assert p.lookup(1.0, ttft_p99_by_class={}).to_dict()["slots"] == 2
+    assert p.lookup(
+        1.0, ttft_p99_by_class={"interactive": 0.1}
+    ).to_dict()["slots"] == 2
+    # same offered load, interactive TTFT blown: escalate to the
+    # catch-all even though rps alone says the small config suffices
+    assert p.lookup(
+        1.0, ttft_p99_by_class={"interactive": 0.4}
+    ).to_dict()["slots"] == 8
+    # a class the guard does not bound cannot trip it
+    assert p.lookup(
+        1.0, ttft_p99_by_class={"batch": 9.9}).to_dict()["slots"] == 2
+
+
+def test_lookup_escalates_on_attainment_guard():
+    p = _policy([
+        {"max_offered_rps": 5.0, "config": LO, "min_attainment": 0.9},
+        {"max_offered_rps": None, "config": HI}])
+    assert p.lookup(1.0, attainment={"interactive": 0.95}
+                    ).to_dict()["slots"] == 2
+    assert p.lookup(1.0, attainment={"interactive": 0.5}
+                    ).to_dict()["slots"] == 8
+    # the catch-all is returned unconditionally (lookup stays total)
+    p2 = _policy([
+        {"max_offered_rps": None, "config": HI, "min_attainment": 0.9}])
+    assert p2.lookup(0.0, attainment={"batch": 0.0}
+                     ).to_dict()["slots"] == 8
+
+
+# -- controller: decisions keyed off quality, not offered rps ----------------
+
+
+def _controller(policy, **cfg_kw):
+    from cake_tpu.autotune import (
+        AutotuneController, ControllerConfig, EngineConfig,
+    )
+    clock = [0.0]
+    cfg = ControllerConfig(interval_s=1.0, window=4, hold=2,
+                           cooldown_s=0.0, rollback_window=2,
+                           rollback_frac=0.7, **cfg_kw)
+    c = AutotuneController(policy, EngineConfig.from_dict(dict(LO)),
+                           config=cfg, now_fn=lambda: clock[0])
+    return c, clock
+
+
+def _sig(t, rps=1.0, tps=100.0, ttft=None, attain=None):
+    from cake_tpu.autotune import AutotuneSignals
+    return AutotuneSignals(
+        t=t, offered_rps=rps, service_tps=tps,
+        ttft_p99_by_class=ttft or {}, attainment=attain or {})
+
+
+def test_controller_keys_decision_off_ttft_signal():
+    """THE quality-lookup acceptance pin: offered rps stays BELOW the
+    regime boundary the whole time — only the interactive TTFT p99
+    signal degrades — and the controller still proposes the big
+    config."""
+    p = _policy([
+        {"max_offered_rps": 5.0, "config": LO,
+         "max_ttft_p99_s": {"interactive": 0.2}},
+        {"max_offered_rps": None, "config": HI}])
+    c, _ = _controller(p)
+    # healthy TTFT: no move, streak stays empty
+    assert c.decide(_sig(0.0, ttft={"interactive": 0.05})) is None
+    assert c.decide(_sig(1.0, ttft={"interactive": 0.05})) is None
+    # TTFT degrades at constant offered load: hysteresis (hold=2) then
+    # an "auto" switch to the catch-all config
+    assert c.decide(_sig(2.0, ttft={"interactive": 0.5})) is None
+    got = c.decide(_sig(3.0, ttft={"interactive": 0.5}))
+    assert got is not None
+    target, reason = got
+    assert reason == "auto" and target.to_dict()["slots"] == 8
+
+
+def test_controller_window_quality_uses_worst_sample():
+    p = _policy([{"max_offered_rps": None, "config": LO}])
+    c, _ = _controller(p)
+    c.decide(_sig(0.0, ttft={"interactive": 0.05},
+                  attain={"interactive": 1.0}))
+    c.decide(_sig(1.0, ttft={"interactive": 0.7},
+                  attain={"interactive": 0.4}))
+    ttft, attain = c.window_quality()
+    assert ttft["interactive"] == pytest.approx(0.7)     # max
+    assert attain["interactive"] == pytest.approx(0.4)   # min
+
+
+def test_rollback_guard_reverts_on_attainment_collapse():
+    """A switch that KEPT tok/s but collapsed SLO attainment reverts
+    (and pins) exactly like a throughput regression."""
+    from cake_tpu.autotune import EngineConfig, config_key
+    p = _policy([
+        {"max_offered_rps": 1.0, "config": LO},
+        {"max_offered_rps": None, "config": HI}])
+    c, _ = _controller(p, )
+    lo = EngineConfig.from_dict(dict(LO))
+    hi = EngineConfig.from_dict(dict(HI))
+    # pre-switch window: healthy attainment
+    c.decide(_sig(0.0, tps=100.0, attain={"interactive": 1.0}))
+    c.decide(_sig(1.0, tps=100.0, attain={"interactive": 1.0}))
+    c._current = hi
+    c.on_switched(hi, lo, pre_rate=100.0, reason="auto")
+    # post-switch: service rate HELD, attainment collapsed
+    assert c.decide(_sig(2.0, tps=100.0,
+                         attain={"interactive": 0.2})) is None
+    got = c.decide(_sig(3.0, tps=100.0, attain={"interactive": 0.2}))
+    assert got is not None
+    target, reason = got
+    assert reason == "rollback"
+    assert config_key(target) == config_key(lo)
+    assert config_key(hi) in c._pinned
+    entry = c.decision_log()[-1]
+    assert entry["action"] == "rollback" and entry["cause"] == "attainment"
+
+
+def test_rollback_guard_accepts_when_quality_holds():
+    from cake_tpu.autotune import EngineConfig
+    p = _policy([{"max_offered_rps": None, "config": HI}])
+    c, _ = _controller(p)
+    lo = EngineConfig.from_dict(dict(LO))
+    hi = EngineConfig.from_dict(dict(HI))
+    c.decide(_sig(0.0, tps=100.0, attain={"interactive": 0.9}))
+    c._current = hi
+    c.on_switched(hi, lo, pre_rate=100.0, reason="auto")
+    c.decide(_sig(1.0, tps=110.0, attain={"interactive": 0.92}))
+    assert c.decide(_sig(2.0, tps=110.0,
+                         attain={"interactive": 0.95})) is None
+    assert c.decision_log()[-1]["action"] == "accepted"
+    assert not c._pinned

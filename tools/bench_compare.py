@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Diff two BENCH round JSONs per tier — the regression gate.
+
+The perf trajectory lives in BENCH_*.json round files, but reading two
+of them side by side is manual and error-prone — worst of all when the
+TPU tunnel was down and a round's numbers read 0.0 (the ROADMAP "check
+the builder files before calling a regression" footgun). This tool
+makes the comparison machine-checkable:
+
+  * tier records are found by walking ANY JSON shape (driver round
+    files, builder-captured files, raw `bench.py` line dumps): every
+    dict carrying a string ``metric`` and a numeric ``value`` is one
+    tier, keyed by its metric name (the last occurrence wins — later
+    entries in a file are reruns);
+  * tiers marked ``"degraded": true`` (the bench emits this whenever a
+    probe fell back off-TPU) are SKIPPED, never compared — a degraded
+    0.0 is a tunnel outage, not a regression;
+  * within each common tier, throughput-like fields (``*tok_s*``,
+    higher is better), TTFT p99 fields (``*ttft_p99*_ms``, lower is
+    better) and utilization fields (``mfu`` / ``hbm_util``, higher is
+    better) are compared under a relative tolerance (--tol, default
+    0.1 = 10%).
+
+Exit status (the rc contract, mirroring tools/autotune_fit.py):
+    0  compared cleanly, no regression (skipped-degraded tiers noted)
+    1  at least one field regressed beyond tolerance
+    2  unusable input (missing/unparseable file, no tier records)
+
+Usage:
+    python tools/bench_compare.py OLD.json NEW.json [--tol 0.1] [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_TOL = 0.1
+
+
+def extract_tiers(obj, out: Optional[Dict[str, dict]] = None
+                  ) -> Dict[str, dict]:
+    """Walk any JSON structure and collect tier records: dicts with a
+    string ``metric`` plus a numeric ``value``. Later occurrences of
+    the same metric replace earlier ones (rerun-wins, matching how the
+    builder files append tier reruns after the round start)."""
+    if out is None:
+        out = {}
+    if isinstance(obj, dict):
+        m, v = obj.get("metric"), obj.get("value")
+        if isinstance(m, str) and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            out[m] = obj
+        for val in obj.values():
+            extract_tiers(val, out)
+    elif isinstance(obj, (list, tuple)):
+        for val in obj:
+            extract_tiers(val, out)
+    return out
+
+
+def _field_direction(key: str) -> Optional[bool]:
+    """True = higher is better, False = lower is better, None = not a
+    compared field. The three families the tier contract names:
+    throughput (tok/s), TTFT p99, and MFU/HBM utilization."""
+    k = key.lower()
+    if "tok_s" in k or "tokens_per_s" in k:
+        return True
+    if "ttft_p99" in k and k.endswith("_ms"):
+        return False
+    if k == "mfu" or k.endswith("_mfu") or k == "hbm_util" \
+            or k.endswith("_hbm_util") or k == "roofline_frac":
+        return True
+    return None
+
+
+def compare_tier(name: str, old: dict, new: dict,
+                 tol: float) -> Tuple[List[dict], List[dict]]:
+    """(regressions, improvements) across the comparable numeric
+    fields both records carry. A zero/absent old value is skipped — a
+    ratio against 0.0 is noise, and honest zeros come from degraded
+    rounds this tool already excludes."""
+    regs: List[dict] = []
+    wins: List[dict] = []
+    for key in sorted(set(old) & set(new)):
+        direction = _field_direction(key)
+        if direction is None:
+            continue
+        ov, nv = old[key], new[key]
+        if not all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                   for x in (ov, nv)):
+            continue
+        if ov <= 0:
+            continue
+        delta = (nv - ov) / ov
+        entry = {"tier": name, "field": key, "old": ov, "new": nv,
+                 "delta": round(delta, 4)}
+        worse = (delta < -tol) if direction else (delta > tol)
+        better = (delta > tol) if direction else (delta < -tol)
+        if worse:
+            regs.append(entry)
+        elif better:
+            wins.append(entry)
+    return regs, wins
+
+
+def compare(old_tiers: Dict[str, dict], new_tiers: Dict[str, dict],
+            tol: float = DEFAULT_TOL) -> dict:
+    """Full comparison summary over the common tier set."""
+    common = sorted(set(old_tiers) & set(new_tiers))
+    skipped = [t for t in common
+               if old_tiers[t].get("degraded") or
+               new_tiers[t].get("degraded")]
+    regressions: List[dict] = []
+    improvements: List[dict] = []
+    compared: List[str] = []
+    for t in common:
+        if t in skipped:
+            continue
+        regs, wins = compare_tier(t, old_tiers[t], new_tiers[t], tol)
+        compared.append(t)
+        regressions.extend(regs)
+        improvements.extend(wins)
+    return {
+        "tol": tol,
+        "compared": compared,
+        "only_old": sorted(set(old_tiers) - set(new_tiers)),
+        "only_new": sorted(set(new_tiers) - set(old_tiers)),
+        "skipped_degraded": skipped,
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    tol = DEFAULT_TOL
+    if "--tol" in argv:
+        i = argv.index("--tol")
+        if i + 1 >= len(argv):
+            print("--tol needs a number", file=sys.stderr)
+            return 2
+        try:
+            tol = float(argv[i + 1])
+        except ValueError:
+            print(f"--tol: {argv[i + 1]!r} is not a number",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if len(argv) != 2:
+        print("usage: bench_compare.py OLD.json NEW.json "
+              "[--tol FRAC] [--json]", file=sys.stderr)
+        return 2
+    tiers = []
+    for path in argv:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            return 2
+        t = extract_tiers(doc)
+        if not t:
+            print(f"{path}: no tier records (no dict with a string "
+                  "'metric' and numeric 'value' anywhere)",
+                  file=sys.stderr)
+            return 2
+        tiers.append(t)
+    summary = compare(tiers[0], tiers[1], tol)
+    if as_json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        for t in summary["skipped_degraded"]:
+            print(f"skip {t}: degraded round (off-TPU fallback) — "
+                  "not comparable")
+        for e in summary["improvements"]:
+            print(f"ok   {e['tier']}.{e['field']}: {e['old']} -> "
+                  f"{e['new']} ({e['delta']:+.1%})")
+        for e in summary["regressions"]:
+            print(f"REGR {e['tier']}.{e['field']}: {e['old']} -> "
+                  f"{e['new']} ({e['delta']:+.1%}, tol {tol:.0%})")
+        if not summary["compared"]:
+            print("no common non-degraded tiers to compare")
+        elif not summary["regressions"]:
+            print(f"ok: {len(summary['compared'])} tier(s) compared, "
+                  "no regression beyond "
+                  f"{tol:.0%}")
+    return 1 if summary["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

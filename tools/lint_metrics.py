@@ -14,7 +14,15 @@ never ship):
     non-decreasing cumulative counts in increasing ``le`` order, ending
     at ``le="+Inf"``, plus ``_sum`` and ``_count`` with
     ``_count == +Inf bucket``;
-  * counter samples are finite and non-negative.
+  * counter samples are finite and non-negative;
+  * label cardinality is bounded: no family may expose more than
+    ``--series-cap`` live series (default 64; histograms count one
+    series per distinct label set, not per bucket) — an unbounded
+    label (a rid, a raw URL, a user id) grows the scrape without limit
+    and this catches it before production does;
+  * ``rid``-valued labels are banned outright, whatever the count:
+    request identity belongs on the event bus / request traces
+    (obs/events.py, obs/tracing.py), never on a metric series.
 
 Additionally, telemetry metric families (``cake_step_*``,
 ``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``, the paged
@@ -28,6 +36,7 @@ fails the fast lane).
 Usage:
     python tools/lint_metrics.py FILE          # or '-' for stdin
     python tools/lint_metrics.py FILE --readme README.md
+    python tools/lint_metrics.py FILE --series-cap 128
     python tools/lint_metrics.py --url http://HOST:PORT/api/v1/metrics
 
 Exit status 0 = clean, 1 = violations (printed one per line).
@@ -66,7 +75,20 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_engine_recoveries_",
                        "cake_engine_recovery_", "cake_poison_",
                        "cake_requests_", "cake_heartbeat_",
-                       "cake_autotune_")
+                       "cake_autotune_",
+                       # goodput-first observability (obs/events.py +
+                       # obs/slo.py): the event bus + SLO attainment /
+                       # goodput families
+                       "cake_slo_", "cake_goodput_", "cake_events_")
+
+# label names that may NEVER appear on a metric series, whatever the
+# live count: per-request identity makes cardinality proportional to
+# traffic — it belongs on the event bus / request traces instead
+BANNED_LABELS = ("rid",)
+
+# default live-series cap per family (histograms count one series per
+# distinct label set, not per le bucket)
+DEFAULT_SERIES_CAP = 64
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
@@ -117,7 +139,8 @@ def _family_of(name: str) -> str:
     return name
 
 
-def lint(text: str) -> List[str]:
+def lint(text: str,
+         series_cap: int = DEFAULT_SERIES_CAP) -> List[str]:
     """Return a list of human-readable violations (empty = clean)."""
     errors: List[str] = []
     types: Dict[str, str] = {}
@@ -127,6 +150,9 @@ def lint(text: str) -> List[str]:
     sums: Dict[str, Dict[Tuple, float]] = {}
     counts: Dict[str, Dict[Tuple, float]] = {}
     seen_families: List[str] = []
+    # family -> distinct label sets (minus le) — the live-series count
+    # behind the cardinality cap
+    live_series: Dict[str, set] = {}
 
     for ln, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -177,6 +203,11 @@ def lint(text: str) -> List[str]:
         for k, _v in pairs:
             if not LABEL_RE.match(k) or k.startswith("__"):
                 errors.append(f"line {ln}: invalid label name {k!r}")
+            elif k in BANNED_LABELS:
+                errors.append(
+                    f"line {ln}: banned label {k!r} on {name!r} — "
+                    "per-request identity belongs on the event bus / "
+                    "request traces, never a metric series")
         try:
             value = _parse_value(m.group("value"))
         except ValueError:
@@ -191,6 +222,8 @@ def lint(text: str) -> List[str]:
             continue
         if fam not in seen_families:
             seen_families.append(fam)
+        live_series.setdefault(fam, set()).add(
+            tuple(sorted((k, v) for k, v in pairs if k != "le")))
 
         if typ == "counter":
             if not (value >= 0):
@@ -244,6 +277,15 @@ def lint(text: str) -> List[str]:
             # a family with zero samples is legal (no children yet);
             # _sum/_count without buckets is not
             errors.append(f"{fam}: histogram with no _bucket samples")
+
+    if series_cap and series_cap > 0:
+        for fam, sets in sorted(live_series.items()):
+            if len(sets) > series_cap:
+                errors.append(
+                    f"{fam}: {len(sets)} live series exceeds the "
+                    f"label-cardinality cap {series_cap} — an "
+                    "unbounded label value set; aggregate it or move "
+                    "the identity to the event bus")
     return errors
 
 
@@ -290,6 +332,19 @@ def main(argv: List[str]) -> int:
         print(__doc__)
         return 0 if argv else 1
     readme_path = None
+    series_cap = DEFAULT_SERIES_CAP
+    if "--series-cap" in argv:
+        i = argv.index("--series-cap")
+        if i + 1 >= len(argv):
+            print("--series-cap needs a number", file=sys.stderr)
+            return 2
+        try:
+            series_cap = int(argv[i + 1])
+        except ValueError:
+            print(f"--series-cap: {argv[i + 1]!r} is not an integer",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
     if "--readme" in argv:
         i = argv.index("--readme")
         if i + 1 >= len(argv):
@@ -297,10 +352,10 @@ def main(argv: List[str]) -> int:
             return 2
         readme_path = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
-        if not argv:
-            print("--readme needs an exposition input too "
-                  "(FILE, '-', or --url URL)", file=sys.stderr)
-            return 2
+    if not argv:
+        print("--readme/--series-cap need an exposition input too "
+              "(FILE, '-', or --url URL)", file=sys.stderr)
+        return 2
     if argv[0] == "--url":
         import urllib.request
         text = urllib.request.urlopen(argv[1], timeout=10).read().decode()
@@ -309,7 +364,7 @@ def main(argv: List[str]) -> int:
     else:
         with open(argv[0]) as f:
             text = f.read()
-    errors = lint(text)
+    errors = lint(text, series_cap=series_cap)
     if readme_path is not None:
         with open(readme_path) as f:
             errors += lint_readme_coverage(text, f.read())
