@@ -242,9 +242,25 @@ AUTOTUNE_TIERS = {
                              interval_s=0.5, cooldown_s=120.0),
 }
 
+# Fleet telemetry federation tiers (bench.py --fleet): the wire cost
+# of fleet observability, no model required — a coordinator-side
+# TelemetryCollector + one threaded TelemetryExporter posing as a
+# follower host, alongside a token-gated control channel exchanging
+# seq-stamped ops over localhost. Reports export batches shipped,
+# collector ingest lag p50/p99, and control-channel bytes per op. The
+# numbers this tier exists for: batches > 0 with finite ingest lag
+# (the federation plane works end to end) and a per-op wire cost small
+# enough to ignore next to a device step.
+FLEET_TIERS = {
+    "fleet_wire": dict(ops=400, frames=12, interval_s=0.05,
+                       events_per_frame=4, payload_ints=64),
+}
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
+    "fleet_tiny": dict(ops=120, frames=6, interval_s=0.05,
+                       events_per_frame=3, payload_ints=16),
     # f32 cache so the autotuned phase's greedy streams must come back
     # token-identical to the pinned phase (the hot-switch contract,
     # not bf16 tie-breaks); the 0.01s burst crosses the 5 req/s
@@ -1531,10 +1547,139 @@ def run_spec_tier(name: str, target: str, draft: str, max_seq: int,
     }
 
 
+def run_fleet_tier(name: str, ops: int, frames: int, interval_s: float,
+                   events_per_frame: int, payload_ints: int) -> dict:
+    """Fleet telemetry federation wire smoke (obs/federation.py +
+    serve/control.py): coordinator-side collector + one threaded
+    exporter posing as host proc1 + a token-gated control channel
+    exchanging `ops` seq-stamped ops over localhost. No model — the
+    tier measures the telemetry/control plane itself: export batches
+    shipped, collector ingest lag p50/p99, control bytes per op, and
+    that the drained follower reports zero applied-seq lag."""
+    import threading
+
+    from cake_tpu.obs import metrics as m
+    from cake_tpu.obs.events import EventBus
+    from cake_tpu.obs.federation import (
+        TelemetryCollector, TelemetryExporter,
+    )
+    from cake_tpu.serve.control import ControlClient, ControlServer
+
+    token = "bench-fleet-token"
+    server = ControlServer(1, host="127.0.0.1", token=token)
+    collector = TelemetryCollector(host="127.0.0.1", token=token,
+                                   control=server, local_host="proc0")
+    applied = {"seq": 0}
+
+    def follower():
+        client = ControlClient(f"127.0.0.1:{server.port}", token=token)
+        try:
+            while True:
+                op = client.recv()
+                if op is None:
+                    return
+                if isinstance(op.get("seq"), int):
+                    applied["seq"] = op["seq"]
+                if op.get("op") == "stop":
+                    return
+        finally:
+            client.close()
+
+    t = threading.Thread(target=follower, daemon=True)
+    t.start()
+    server.accept_followers()
+
+    # the "remote host's" telemetry: its own registry + event bus, so
+    # the frame content is what a real follower would ship
+    remote_reg = m.Registry()
+    remote_ops = m.Counter("bench_fleet_remote_ops_total",
+                           "ops the bench follower replayed",
+                           registry=remote_reg)
+    bus = EventBus(capacity=4096, observe_metrics=False)
+    exporter = TelemetryExporter(
+        f"127.0.0.1:{collector.port}", host="proc1", token=token,
+        interval_s=interval_s, registry=remote_reg, events=bus,
+        applied_seq=lambda: applied["seq"], start=False)
+
+    tx0 = m.REGISTRY.get("cake_control_bytes_total") \
+        .labels(dir="tx").value
+    t0 = time.perf_counter()
+    payload = list(range(payload_ints))
+    for _ in range(ops):
+        server.publish({"op": "decode", "rows": payload})
+        remote_ops.inc()
+    publish_wall = time.perf_counter() - t0
+    for f in range(frames):
+        for j in range(events_per_frame):
+            bus.publish("kv_spill", rid=f * events_per_frame + j,
+                        pages=2)
+        exporter.flush()
+        time.sleep(interval_s)
+    server.publish({"op": "stop"})
+    t.join(timeout=10)
+    assert not t.is_alive(), "bench follower never drained"
+    # terminal frame: the drained follower's applied seq reaches the
+    # collector, so the fleet view must read lag 0
+    assert exporter.flush(), "terminal telemetry flush failed"
+    tx_bytes = m.REGISTRY.get("cake_control_bytes_total") \
+        .labels(dir="tx").value - tx0
+
+    # ingest runs on the collector's connection thread: wait for every
+    # sent frame to land before reading the fleet view
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        fleet = collector.fleet()
+        got = fleet["hosts"].get("proc1", {}).get("frames", 0)
+        if got >= exporter.frames_sent:
+            break
+        time.sleep(0.005)
+    fleet = collector.fleet()
+    view = fleet["hosts"]["proc1"]
+    lags = collector.ingest_lags("proc1")
+    remote_events = collector.events_for(host="proc1")
+    exporter.close(flush=False)
+    collector.close()
+    server.close()
+
+    result = {
+        "metric": f"{name}_export_batches",
+        "value": exporter.frames_sent,
+        "unit": "frames",
+        "vs_baseline": 0.0,
+        "fleet_export_batches": exporter.frames_sent,
+        "fleet_ingest_frames": view["frames"],
+        "fleet_events_shipped": len(remote_events),
+        "fleet_control_ops": ops,
+        "fleet_control_bytes_per_op": round(tx_bytes / (ops + 1), 1),
+        "fleet_publish_us_per_op": round(publish_wall / ops * 1e6, 2),
+        "fleet_applied_seq": view["applied_seq"],
+        "fleet_lag_ops": view["lag_ops"],
+        "fleet_host_live": bool(view["live"]),
+        "fleet_clock_offset_ms": round(
+            (view["clock_offset_s"] or 0.0) * 1e3, 3),
+    }
+    if lags:
+        result["fleet_ingest_lag_p50_ms"] = round(
+            _pct(lags, 0.5) * 1e3, 3)
+        result["fleet_ingest_lag_p99_ms"] = round(
+            _pct(lags, 0.99) * 1e3, 3)
+    log(f"fleet: {result['fleet_export_batches']} batches shipped, "
+        f"{result['fleet_events_shipped']} events, ingest lag p50/p99 "
+        f"{result.get('fleet_ingest_lag_p50_ms')}/"
+        f"{result.get('fleet_ingest_lag_p99_ms')}ms, "
+        f"{result['fleet_control_bytes_per_op']} B/op, "
+        f"{result['fleet_publish_us_per_op']}us/op publish, lag "
+        f"{result['fleet_lag_ops']} after drain")
+    return result
+
+
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in AUTOTUNE_TIERS or name.startswith("autotune"):
+    if name in FLEET_TIERS or name.startswith("fleet"):
+        kwargs = {**FLEET_TIERS, **SMOKE_TIERS}[name]
+        result = run_fleet_tier(name, **kwargs)
+    elif name in AUTOTUNE_TIERS or name.startswith("autotune"):
         kwargs = {**AUTOTUNE_TIERS, **SMOKE_TIERS}[name]
         result = run_autotune_tier(name, **kwargs)
     elif name in CHAOS_TIERS or name.startswith("chaos"):
@@ -1790,6 +1935,18 @@ def _slo_main() -> int:
         fail_error="slo scheduling tier failed")
 
 
+def _fleet_main() -> int:
+    """`bench.py --fleet`: the telemetry-federation wire tier — one
+    JSON line with export batches shipped, collector ingest lag
+    p50/p99, control-channel bytes/op and the drained follower's
+    applied-seq lag (must be 0). No model; CPU-fallback rules match
+    main()."""
+    return _single_tier_main(
+        "export_batches", "frames",
+        cpu_tier="fleet_tiny", tpu_tier="fleet_wire",
+        fail_error="fleet telemetry federation tier failed")
+
+
 def _paged_prefix_main() -> int:
     """`bench.py --paged-prefix`: the paged prefix-sharing tier — one
     JSON line with suffix-only vs whole-prompt TTFT and pages_shared
@@ -1904,6 +2061,8 @@ if __name__ == "__main__":
         sys.exit(_slo_main())
     elif "--chaos" in sys.argv:
         sys.exit(_chaos_main())
+    elif "--fleet" in sys.argv:
+        sys.exit(_fleet_main())
     elif "--paged-prefix" in sys.argv:
         sys.exit(_paged_prefix_main())
     elif "--paged-attn" in sys.argv:

@@ -47,7 +47,7 @@ KNOWN_ROUTES = frozenset({
     "/api/v1/health", "/api/v1/cluster", "/v1/models", "/api/v1/models",
     "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
     "/api/v1/profile", "/api/v1/autotune", "/api/v1/events",
-    "/api/v1/requests/{rid}/timeline",
+    "/api/v1/requests/{rid}/timeline", "/api/v1/fleet",
 })
 
 # rid-bearing paths are counted under their TEMPLATE: a per-rid route
@@ -63,13 +63,20 @@ class ApiServer:
     silent RwLock, api/text.rs:67)."""
 
     def __init__(self, master, model_name: str = "cake-tpu", engine=None,
-                 health=None):
+                 health=None, collector=None):
         self.master = master
         self.model_name = model_name
         self.engine = engine
         # parallel.health.ServingHealth: when it flips to failed, chat
         # requests 503 and /api/v1/health reports the reason
         self.health_state = health
+        # obs/federation.TelemetryCollector (multi-host serving): the
+        # fleet endpoint, ?host= event filters and the host-labeled
+        # federated /metrics families all read from it; attaching it to
+        # the engine makes request timelines span hosts
+        self.collector = collector
+        if collector is not None and engine is not None:
+            engine.telemetry = collector
         if engine is not None:
             engine.start()
         self._gen_lock = threading.Lock()
@@ -384,6 +391,55 @@ class ApiServer:
                 log.debug("retry-after estimate failed", exc_info=True)
         return 1.0
 
+    def fleet(self) -> dict:
+        """GET /api/v1/fleet: per-host liveness, last-export age,
+        applied control seq + lag, clock offset, device HBM gauges and
+        health state — the coordinator composes its own entry (it runs
+        the API; it is live by construction unless health failed) with
+        the collector's remote views (obs/federation.py)."""
+        local_name = getattr(self.collector, "local_host", None) \
+            or "coordinator"
+        failed = (self.health_state is not None
+                  and self.health_state.failed)
+        local: dict = {"role": "coordinator", "live": not failed}
+        if failed:
+            local["health"] = {"status": "failed",
+                               "reason": self.health_state.reason}
+        if self.engine is not None:
+            local["active_requests"] = self.engine.active
+            local["queue_depth"] = self.engine.queue_depth
+        try:
+            from cake_tpu.utils.profiling import device_memory_stats
+            # SAME key names as the remote rows (_hbm_from_metrics —
+            # derived from the cake_device_hbm_* gauge families), so a
+            # dashboard reads hosts[*].hbm uniformly across roles
+            keymap = (("bytes_in_use", "bytes_in_use"),
+                      ("peak_bytes_in_use", "peak_bytes"),
+                      ("bytes_limit", "bytes_limit"))
+            local["hbm"] = {
+                str(s["device"]): {out: s[src] for src, out in keymap
+                                   if s.get(src) is not None}
+                for s in device_memory_stats()
+                if s.get("bytes_in_use") is not None}
+        except Exception:  # noqa: BLE001 — fleet view is best-effort
+            log.debug("local hbm stats unavailable", exc_info=True)
+        out = {"local_host": local_name, "hosts": {local_name: local}}
+        if self.collector is None:
+            out["note"] = ("telemetry federation disabled "
+                           "(single-host serving, or "
+                           "--no-telemetry-export)")
+            return out
+        remote = self.collector.fleet()
+        out["published_seq"] = remote.get("published_seq")
+        out["stale_after_s"] = remote.get("stale_after_s")
+        if out["published_seq"] is not None:
+            # the coordinator publishes the op stream: by definition it
+            # has applied everything it published
+            local["applied_seq"] = out["published_seq"]
+            local["lag_ops"] = 0
+        out["hosts"].update(remote.get("hosts", {}))
+        return out
+
     def cluster(self) -> dict:
         import jax
         from cake_tpu.parallel.distributed import cluster_info
@@ -483,7 +539,25 @@ class ApiServer:
                 # between retirements (a quiet minute must roll the 1m
                 # window forward, not freeze the last busy value)
                 slo.refresh_gauges()
-        return m.REGISTRY.render()
+        if self.collector is not None:
+            # per-host liveness/age gauges live in the LOCAL registry:
+            # refresh them before rendering it
+            try:
+                self.collector.refresh_gauges()
+            except Exception:  # noqa: BLE001 — a scrape must not fail
+                log.debug("fleet gauge refresh failed", exc_info=True)
+        text = m.REGISTRY.render()
+        if self.collector is not None:
+            # fleet federation: remote hosts' families appended with a
+            # host label — families the coordinator also owns reuse its
+            # HELP/TYPE block above, remote-only families bring their
+            # own (one TYPE per family, the lint contract)
+            try:
+                text += self.collector.render_federated(
+                    {f.name for f in m.REGISTRY.families()})
+            except Exception:  # noqa: BLE001 — a scrape must not fail
+                log.debug("federated render failed", exc_info=True)
+        return text
 
     def requests(self, limit: Optional[int] = None,
                  rid: Optional[int] = None, cls: Optional[str] = None,
@@ -519,11 +593,36 @@ class ApiServer:
     def events(self, rid: Optional[int] = None,
                type: Optional[str] = None,
                since: Optional[int] = None,
-               limit: Optional[int] = None) -> dict:
+               limit: Optional[int] = None,
+               host: Optional[str] = None) -> dict:
         """Cross-subsystem event dump (GET /api/v1/events): ascending
         seq, ?rid= / ?type= / ?since= filtered (obs/events.py); the
         response `cursor` is the newest seq — pass it back as ?since=
-        to read only what is new."""
+        to read only what is new. ?host= selects a FLEET host's stream:
+        the local host's name (or "local") serves this process's bus
+        exactly as the unfiltered call does; a remote host name serves
+        the collector-held view (timestamps clock-offset-corrected,
+        seqs/cursors are that host's own). Unknown hosts are a 400 via
+        ValueError — the caller named a host, silently dumping
+        everything would be the opposite of the ask."""
+        local_name = getattr(self.collector, "local_host", None)
+        if host is not None and host not in ("local", local_name):
+            if self.collector is None:
+                raise ValueError(
+                    f"?host={host!r}: telemetry federation is "
+                    "disabled (no collector); only local events exist")
+            known = self.collector.hosts()
+            if host not in known:
+                raise ValueError(
+                    f"unknown host {host!r} (local: "
+                    f"{local_name or 'local'}; exporting: "
+                    f"{', '.join(known) or 'none yet'})")
+            # the collector owns the cursor-pagination contract
+            # (events_page mirrors EventBus.snapshot), so local and
+            # remote streams page identically
+            evs, cursor = self.collector.events_page(
+                host, rid=rid, type=type, since=since, limit=limit)
+            return {"events": evs, "host": host, "cursor": cursor}
         bus = getattr(self.engine, "events", None) \
             if self.engine is not None else None
         if bus is None:
@@ -532,7 +631,10 @@ class ApiServer:
                             "engine-less serving"}
         evs, cursor = bus.snapshot(rid=rid, type=type, since=since,
                                    limit=limit)
-        return {"events": evs, "cursor": cursor}
+        out = {"events": evs, "cursor": cursor}
+        if host is not None:
+            out["host"] = local_name or "local"
+        return out
 
     def steps(self, limit: Optional[int] = None) -> dict:
         """Step flight-recorder dump (GET /api/v1/steps): newest step
@@ -704,9 +806,12 @@ def make_handler(api: ApiServer):
                     return self._json(200, api.events(
                         rid=self._int_arg(q, "rid"), type=t,
                         since=self._int_arg(q, "since"),
-                        limit=self._int_arg(q, "limit")))
+                        limit=self._int_arg(q, "limit"),
+                        host=q.get("host")))
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+            if route == "/api/v1/fleet":
+                return self._json(200, api.fleet())
             if route == "/api/v1/steps":
                 try:
                     return self._json(200, api.steps(
@@ -871,7 +976,8 @@ def make_handler(api: ApiServer):
 
 def start(master, address: str = "127.0.0.1:10128",
           model_name: str = "cake-tpu", block: bool = True, engine=None,
-          checkpoint_path: str | None = None, health=None):
+          checkpoint_path: str | None = None, health=None,
+          collector=None):
     """Bind and serve (reference api/mod.rs:23-48). When the master holds a
     text model, a continuous-batching engine is built automatically so
     concurrent chat requests share the decode loop.
@@ -900,7 +1006,8 @@ def start(master, address: str = "127.0.0.1:10128",
         from cake_tpu.parallel.health import ServingHealth
         health = ServingHealth(engine, stall_after_s=getattr(
             master.args, "stall_timeout", 600.0))
-    api = ApiServer(master, model_name, engine=engine, health=health)
+    api = ApiServer(master, model_name, engine=engine, health=health,
+                    collector=collector)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
 

@@ -249,6 +249,21 @@ class Args:
     # auto (JSON: {"version": 1, "regimes": [{"max_offered_rps": ...,
     # "config": {...}}, ...]}; cake_tpu/autotune/search.py)
     autotune_policy: Optional[str] = None
+    # --telemetry-export / --no-telemetry-export: fleet telemetry
+    # federation (obs/federation.py) — every non-coordinator process
+    # ships its metrics / event-bus events / step summaries / applied
+    # control-op seq to a coordinator-side collector, powering
+    # GET /api/v1/fleet, ?host= event filters, host-labeled federated
+    # /metrics families and cross-host request timelines. None = auto
+    # (on for multi-host serving, where followers would otherwise be
+    # observability black holes); True on a single host is a one-shot
+    # warning (there are no followers to federate)
+    telemetry_export: Optional[bool] = None
+    # --telemetry-interval S: exporter frame cadence in seconds (each
+    # frame batches everything new since the last one; the event
+    # cursor advances only on a successful send, so a collector blip
+    # delays events rather than dropping them)
+    telemetry_interval: float = 2.0
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -310,6 +325,10 @@ class Args:
             raise ValueError(
                 f"--event-ring {self.event_ring} must be >= 0 "
                 "(0 disables the event bus)")
+        if not self.telemetry_interval > 0:
+            raise ValueError(
+                f"--telemetry-interval {self.telemetry_interval} must "
+                "be > 0 seconds")
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

@@ -108,9 +108,35 @@ def _serve_multihost(master, args) -> int:
                 bind_host=bind_host,
                 stale_after_s=args.heartbeat_timeout)
             hb_adv = f"{adv}:{hb_addr.rsplit(':', 1)[1]}"
+        # fleet telemetry federation (obs/federation.py): followers
+        # ship their metrics/events/applied-seq frames here; the
+        # collector feeds /api/v1/fleet, ?host= event filters,
+        # host-labeled /metrics families and cross-host timelines.
+        # Token-gated with the SAME control secret — cluster members
+        # only.
+        collector = None
+        tel_adv = ""
+        tel_enabled, tel_interval = master.telemetry_settings()
+        if tel_enabled:
+            from cake_tpu.obs.federation import TelemetryCollector
+            tel_kwargs = dict(
+                token=token, control=control, local_host="proc0",
+                stale_after_s=max(args.heartbeat_timeout,
+                                  3 * tel_interval),
+                max_hosts=max(8, 2 * jax.process_count()))
+            try:
+                collector = TelemetryCollector(host=bind_host,
+                                               **tel_kwargs)
+            except OSError:
+                # same NAT/alias fallback the control bind takes
+                collector = TelemetryCollector(**tel_kwargs)
+            tel_adv = f"{adv}:{collector.port}"
         broadcast_control_address(
-            f"{adv}:{control.port}|{token}|{hb_adv}")
+            f"{adv}:{control.port}|{token}|{hb_adv}|{tel_adv}")
         control.accept_followers()
+        # (the collector reaches engine.telemetry — the cross-host
+        # timeline merge — through ONE wiring site: ApiServer.__init__,
+        # via start(collector=...) below)
         if image_mode:
             master.attach_image_control(control)
         elif replayed:
@@ -145,6 +171,16 @@ def _serve_multihost(master, args) -> int:
                 except Exception:  # noqa: BLE001
                     pass
             control.wait_closed()
+            if collector is not None:
+                # AFTER wait_closed: the stop op triggers each
+                # follower's final exporter flush (terminal applied
+                # seq -> lag drains to 0), and the control-socket EOF
+                # proves that flush has been sent — only then stop
+                # accepting frames
+                try:
+                    collector.close()
+                except Exception:  # noqa: BLE001
+                    pass
             control.close()
             _distributed_shutdown()
 
@@ -161,7 +197,8 @@ def _serve_multihost(master, args) -> int:
             pass  # not the main thread; caller owns signals
         try:
             start(master, address=args.api, engine=engine,
-                  checkpoint_path=args.checkpoint, health=health)
+                  checkpoint_path=args.checkpoint, health=health,
+                  collector=collector)
         finally:
             teardown()
     else:
@@ -169,7 +206,8 @@ def _serve_multihost(master, args) -> int:
 
         payload = broadcast_control_address(None)
         addr, _, rest = payload.partition("|")
-        token, _, hb_addr = rest.partition("|")
+        token, _, rest = rest.partition("|")
+        hb_addr, _, tel_addr = rest.partition("|")
         client = ControlClient(addr, token=token or None)
         if getattr(args, "fault_plan", None):
             # follower-side chaos: control.recv rules fire in this
@@ -177,8 +215,37 @@ def _serve_multihost(master, args) -> int:
             # the experiment stays reproducible)
             from cake_tpu.faults import build_injector
             client.faults = build_injector(args.fault_plan)
-        beat = (HeartbeatSender(hb_addr, f"proc{jax.process_index()}")
+        proc_name = f"proc{jax.process_index()}"
+        beat = (HeartbeatSender(hb_addr, proc_name)
                 if hb_addr else None)
+        # fleet telemetry exporter (obs/federation.py): the loop below
+        # used to be an observability black hole — now this process's
+        # metrics registry, event-bus events, step summaries, applied
+        # control-op seq and a health snapshot ship to the
+        # coordinator's collector every --telemetry-interval seconds
+        exporter = None
+        tel_enabled, tel_interval = master.telemetry_settings()
+        if tel_enabled and tel_addr:
+            from cake_tpu.obs.federation import TelemetryExporter
+
+            def _health_snapshot(beat=beat):
+                out = {}
+                if beat is not None:
+                    out["heartbeat_ok"] = beat.alive_within(
+                        beat.worst_case_gap_s)
+                return out
+
+            exporter = TelemetryExporter(
+                tel_addr, host=proc_name, token=token or None,
+                interval_s=tel_interval,
+                events=getattr(engine, "events", None)
+                if engine is not None else None,
+                flight=getattr(engine, "flight", None)
+                if engine is not None else None,
+                applied_seq=(
+                    (lambda: engine.applied_op_seq)
+                    if engine is not None else None),
+                health_snapshot=_health_snapshot)
         try:
             if image_mode:
                 _run_image_follower(master, client)
@@ -205,6 +272,12 @@ def _serve_multihost(master, args) -> int:
                         (lambda: beat.alive_within(hb_window))
                         if beat is not None else None))
         finally:
+            if exporter is not None:
+                # flush the terminal frame (final applied seq -> the
+                # coordinator's fleet lag drains to 0) BEFORE the
+                # control-socket EOF below: the coordinator keeps its
+                # collector open until that EOF arrives
+                exporter.close(flush=True)
             if beat is not None:
                 beat.close()
             # socket EOF first, THEN jax.distributed.shutdown() — this
@@ -320,6 +393,15 @@ def main(argv=None) -> int:
         from cake_tpu.api import start
         if jax.process_count() > 1:
             return _serve_multihost(master, args)
+        if getattr(args, "telemetry_export", None):
+            # one-shot warning mirroring --step-log: the federation
+            # plane ships FOLLOWER telemetry to the coordinator; a
+            # single-process deployment has no followers to federate
+            logging.getLogger(__name__).warning(
+                "--telemetry-export has no effect on single-host "
+                "serving: there are no follower processes to "
+                "federate (obs/federation.py); /api/v1/fleet will "
+                "report only this host")
         start(master, address=args.api, checkpoint_path=args.checkpoint)
         return 0
 
@@ -368,6 +450,14 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--autotune applies to engine serving (--api); one-shot "
             "generation has no live engine to reconfigure")
+    if getattr(args, "telemetry_export", None):
+        # the exporter/collector pair lives in multi-host API serving;
+        # a one-shot generation federates nothing — be loud instead of
+        # the flag silently vanishing
+        logging.getLogger(__name__).warning(
+            "--telemetry-export applies to multi-host API serving "
+            "(--api across processes); one-shot generation runs one "
+            "process with nothing to federate")
     if getattr(args, "fault_plan", None) \
             or getattr(args, "recovery", None) is not None:
         # the fault plane's sites and the recovery loop live in the

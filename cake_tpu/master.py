@@ -281,6 +281,21 @@ class Master:
             shed=getattr(self.args, "shed", False),
         )
 
+    def telemetry_settings(self) -> tuple:
+        """(enabled, interval_s) for fleet telemetry federation
+        (--telemetry-export / --telemetry-interval, obs/federation.py).
+        Resolves the auto default here — ONE place — so the
+        coordinator's collector and every follower's exporter agree on
+        whether the plane is armed: None = on exactly when serving
+        spans processes (followers are otherwise observability black
+        holes), an explicit True/False is honored as given."""
+        enabled = getattr(self.args, "telemetry_export", None)
+        if enabled is None:
+            import jax
+            enabled = jax.process_count() > 1
+        return (bool(enabled),
+                float(getattr(self.args, "telemetry_interval", 2.0)))
+
     def _fault_kwargs(self) -> dict:
         """Fault-injection + crash-recovery knobs (--fault-plan /
         --recovery), plumbed to every engine flavor; the engine warns

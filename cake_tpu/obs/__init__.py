@@ -33,9 +33,19 @@ real measurement substrate, dependency-free:
     the autotune controller's quality signals.
   * `obs.jsonl` — the shared append-only JSONL writer (fsync on close)
     and corrupt-tail-tolerant reader all three event logs use.
+  * `obs.federation` — fleet-scope telemetry federation: each
+    non-coordinator process runs a `TelemetryExporter` shipping its
+    metrics/events/step summaries/applied control seq to the
+    coordinator's `TelemetryCollector` (token-gated length-prefixed
+    JSON frames with a clock sample), powering `GET /api/v1/fleet`,
+    `?host=` event filters, host-labeled federated `/metrics`
+    families, and cross-host request timelines.
 """
 
 from cake_tpu.obs.events import EVENT_TYPES, Event, EventBus  # noqa: F401
+from cake_tpu.obs.federation import (  # noqa: F401
+    TelemetryCollector, TelemetryExporter,
+)
 from cake_tpu.obs.jsonl import JsonlAppender, read_jsonl  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,

@@ -222,6 +222,21 @@ class MetricFamily:
                 ".labels(...) first")
         return self._children[()]
 
+    def export(self) -> Dict:
+        """Structured snapshot of this family — the telemetry
+        federation wire shape (obs/federation.py): name/type/help/
+        labelnames plus per-child samples. Counters and gauges ship
+        `samples: [[labelvalues], value]`; histograms override this to
+        ship cumulative buckets + sum + count, so a remote collector
+        can re-render the family (with a host label) exactly as the
+        local renderer would."""
+        return {
+            "name": self.name, "type": self.typ, "help": self.help,
+            "labels": list(self.labelnames),
+            "samples": [[list(lv), v]
+                        for lv, v in self.samples().items()],
+        }
+
     def collect(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.typ}"]
@@ -297,6 +312,35 @@ class Histogram(MetricFamily):
     def sum(self) -> float:
         return self._single().sum
 
+    def child_samples(self) -> Dict[Tuple[str, ...], Dict]:
+        """{labelvalues: {"buckets": [(le, cumulative), ..., (inf, n)],
+        "sum": s, "count": n}} — the histogram half of export():
+        cumulative counts in increasing le order ending at +Inf, the
+        exact series the text renderer emits."""
+        with self._lock:
+            children = list(self._children.items())
+        out: Dict[Tuple[str, ...], Dict] = {}
+        for lv, child in children:
+            with self._lock:
+                counts, s = list(child._counts), child._sum
+            cum = 0
+            buckets = []
+            for ub, c in zip(self.buckets, counts):
+                cum += c
+                buckets.append((ub, cum))
+            cum += counts[-1]
+            buckets.append((math.inf, cum))
+            out[lv] = {"buckets": buckets, "sum": s, "count": cum}
+        return out
+
+    def export(self) -> Dict:
+        return {
+            "name": self.name, "type": self.typ, "help": self.help,
+            "labels": list(self.labelnames),
+            "hist": [{"values": list(lv), **hs}
+                     for lv, hs in self.child_samples().items()],
+        }
+
     def _render_child(self, labelvalues, child) -> List[str]:
         lines = []
         with self._lock:
@@ -343,6 +387,14 @@ class Registry:
     def unregister(self, name: str) -> None:
         with self._lock:
             self._metrics.pop(name, None)
+
+    def families(self) -> List[MetricFamily]:
+        """Registered families in registration order — the public walk
+        for exporters (obs/federation.py ships every family's
+        export()) and for callers that need the local family-name set
+        without parsing the text exposition."""
+        with self._lock:
+            return list(self._metrics.values())
 
     def render(self) -> str:
         with self._lock:

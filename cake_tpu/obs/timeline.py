@@ -34,16 +34,23 @@ CAUSE_TYPES = ("preempted", "kv_spill", "kv_restore", "prefix_hit",
 
 
 def build_timeline(trace: Dict, events: List[Dict],
-                   steps: Optional[List[Dict]] = None) -> Dict:
+                   steps: Optional[List[Dict]] = None,
+                   local_host: Optional[str] = None) -> Dict:
     """Merge one request's trace record (RequestTracer dump entry),
-    its bus events (EventBus.dump(rid=...)) and the step records whose
-    batch contained it (StepTelemetry.records_for(rid)) into one
-    time-ordered view with a cause summary.
+    its bus events (EventBus.dump(rid=...), plus any collector-held
+    REMOTE events — obs/federation.py tags those with their origin
+    ``host`` and corrects their timestamps by the per-host clock
+    offset) and the step records whose batch contained it
+    (StepTelemetry.records_for(rid)) into one time-ordered view with a
+    cause summary.
 
-    All three inputs carry wall-clock timestamps (the tracer's spans
-    are exported anchored to wall time), so a plain sort merges them;
-    ties break trace-first (a span and the event it caused share a
-    timestamp, and the state change reads better first)."""
+    All inputs carry wall-clock timestamps (the tracer's spans are
+    exported anchored to wall time; remote events arrive offset-
+    corrected), so a plain sort merges them — one chronology even when
+    the request's events span hosts; ties break trace-first (a span
+    and the event it caused share a timestamp, and the state change
+    reads better first). local_host names this process in the
+    ``hosts`` summary when remote-origin events are present."""
     entries: List[Dict] = []
     for sp in trace.get("spans", ()):
         entries.append({"t": sp["t"], "source": "trace",
@@ -84,6 +91,16 @@ def build_timeline(trace: Dict, events: List[Dict],
     if compile_steps:
         causes["compiled_steps"] = compile_steps
 
+    # fleet-scope requests: name every host that contributed events —
+    # the local process first, then remote origins in name order
+    remote_hosts = sorted({ev["host"] for ev in events
+                           if ev.get("host")})
+    hosts = None
+    if remote_hosts:
+        hosts = ([local_host] if local_host
+                 and local_host not in remote_hosts else [])
+        hosts += remote_hosts
+
     return {
         "rid": trace.get("rid"),
         "status": trace.get("status"),
@@ -101,6 +118,7 @@ def build_timeline(trace: Dict, events: List[Dict],
             # config switch")
             "causes": causes,
             "ttft_causes": ttft_causes,
+            **({"hosts": hosts} if hosts else {}),
         },
         "timeline": entries,
     }

@@ -50,6 +50,18 @@ _HEARTBEAT_RECONNECTS = obs_metrics.counter(
     "monitor connection, by worker",
     labelnames=("worker",))
 
+# wire latency of the liveness plane itself: the monitor acks each
+# beat with one byte, and the sender times send->ack. A rising RTT is
+# the early signal of a congested/flaky coordinator link — before the
+# staleness gauge trips anything
+_HEARTBEAT_RTT = obs_metrics.histogram(
+    "cake_heartbeat_rtt_seconds",
+    "Heartbeat round-trip time (send 'name\\n' -> monitor ack byte), "
+    "by worker — wire latency of the coordinator liveness channel",
+    labelnames=("worker",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
+
 
 # -- device probe ------------------------------------------------------------
 
@@ -131,6 +143,14 @@ class HeartbeatMonitor:
                     name = line.decode("utf-8", "replace").strip()
                     if name:
                         monitor.beat(name)
+                        try:
+                            # one-byte ack: the sender times send->ack
+                            # into cake_heartbeat_rtt_seconds; a peer
+                            # that never reads it just buffers a byte
+                            self.wfile.write(b"\x06")
+                            self.wfile.flush()
+                        except OSError:
+                            return
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -271,7 +291,25 @@ class HeartbeatSender:
                             worker=self._name).inc()
                     sock = socket.create_connection(
                         self._addr, timeout=self.CONNECT_TIMEOUT_S)
+                t_beat = time.perf_counter()
                 sock.sendall(f"{self._name}\n".encode())
+                try:
+                    # read the monitor's one-byte ack and observe the
+                    # RTT. A timeout (busy monitor, or one predating
+                    # the ack) is NOT a failure — the send succeeded,
+                    # we only lose this sample. A late ack read by the
+                    # NEXT beat shortens that sample; acceptable noise
+                    # for a wire-latency trend signal.
+                    sock.settimeout(min(2.0, self._interval))
+                    ack = sock.recv(64)
+                    if not ack:
+                        raise OSError("heartbeat monitor closed")
+                    _HEARTBEAT_RTT.labels(worker=self._name).observe(
+                        time.perf_counter() - t_beat)
+                except socket.timeout:
+                    pass
+                finally:
+                    sock.settimeout(self.CONNECT_TIMEOUT_S)
                 self._failures = 0
                 self._last_ok = time.monotonic()
                 self._stop.wait(self._interval)

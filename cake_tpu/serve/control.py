@@ -26,12 +26,60 @@ import logging
 import socket
 import struct
 import threading
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
+
+from cake_tpu.obs import metrics as _m
+from cake_tpu.utils import wire as _wire
 
 log = logging.getLogger(__name__)
 
-_LEN = struct.Struct("!I")
+# the shared length-prefix framing (cake_tpu/utils/wire.py — ONE copy
+# for the control and telemetry planes); module aliases kept so the
+# rest of this file (and its tests) read unchanged
+_LEN = _wire.LEN
 MAX_OP_BYTES = 16 << 20  # sanity bound; a real op is < max_seq_len ints
+
+# -- wire metrics ------------------------------------------------------------
+# The control/heartbeat plane carries ALL cross-host coordination, yet
+# until these it emitted nothing — a slow or flapping op stream was
+# invisible. Both sides increment the same family names in their OWN
+# process registry; follower-side samples reach the coordinator's
+# /metrics with a host label via telemetry federation
+# (obs/federation.py).
+_CONTROL_OPS = _m.counter(
+    "cake_control_ops_total",
+    "Control-channel ops by op type (coordinator: published; follower: "
+    "received/replayed — each side counts in its own process registry)",
+    labelnames=("op",))
+_CONTROL_BYTES = _m.counter(
+    "cake_control_bytes_total",
+    "Control-channel wire bytes incl. the length prefix, by direction "
+    "(tx = coordinator publish fan-out across followers, rx = follower "
+    "frame receive)",
+    labelnames=("dir",))
+_CONTROL_PUBLISH = _m.histogram(
+    "cake_control_publish_seconds",
+    "Wall seconds per ControlServer.publish (serialize + fan the op out "
+    "to every follower socket) — the engine thread pays this before "
+    "each replayed device step",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0))
+_FOLLOWER_LAG = _m.gauge(
+    "cake_control_follower_lag_ops",
+    "Published-op seq minus the follower's last-applied seq (reported "
+    "in its telemetry frames) — a growing lag means a follower is "
+    "falling behind the SPMD dispatch stream",
+    labelnames=("follower",))
+
+
+class ControlDesyncError(RuntimeError):
+    """A follower observed a GAP in the published op seq stream: one or
+    more ops were never received, so its mirrored engine state has
+    diverged from the coordinator's. Replaying further ops would
+    silently desync the SPMD dispatch — the only safe move is to fail
+    loudly and disconnect (the coordinator's next publish then raises
+    instead of wedging a collective)."""
 
 
 def broadcast_control_address(addr: Optional[str]) -> str:
@@ -44,8 +92,10 @@ def broadcast_control_address(addr: Optional[str]) -> str:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    # 253-char max DNS name + ":65535|" + 32-hex token fits with room
-    buf = np.zeros(320, np.uint8)
+    # worst case: THREE 253-char DNS-name ":65535" fields (control,
+    # heartbeat, telemetry collector — cli._serve_multihost ships four
+    # |-separated fields) + the 32-hex token fits with room
+    buf = np.zeros(1024, np.uint8)
     if addr:
         raw = addr.encode()
         if len(raw) > buf.size:
@@ -55,8 +105,7 @@ def broadcast_control_address(addr: Optional[str]) -> str:
     return bytes(np.asarray(out)).rstrip(b"\0").decode()
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+_send_msg = _wire.send_msg
 
 
 class ControlServer:
@@ -87,6 +136,15 @@ class ControlServer:
             raise
         self._accept_timeout = accept_timeout
         self._conns: List[socket.socket] = []
+        # parallel to _conns: per-follower wire bookkeeping — peer
+        # address + the last op seq actually written to that socket.
+        # With follower_acks (last-applied seqs reported back through
+        # telemetry frames) a disconnect is diagnosable post-mortem:
+        # the log line says exactly how far the dead follower got.
+        self._peers: List[Dict] = []
+        # follower name (telemetry host id) -> last-acked applied seq
+        self.follower_acks: Dict[str, int] = {}
+        self._seq = 0                # monotonic published-op counter
         self._lock = threading.Lock()
         # deterministic fault injection (cake_tpu/faults): the engine's
         # attach_control points this at its injector so a --fault-plan
@@ -114,34 +172,17 @@ class ControlServer:
             except socket.timeout:
                 continue
             if self.token is not None:
-                # bound BOTH the hello length (a token is tens of bytes —
-                # an attacker-controlled multi-GiB length must not
-                # allocate) and its wall time with an ABSOLUTE deadline
-                # (per-recv timeouts would multiply under byte-trickling
-                # and hold the accept loop hostage)
+                # bounded hello (cake_tpu/utils/wire.py): the length
+                # is size-capped (a token is tens of bytes — an
+                # attacker-controlled multi-GiB length must not
+                # allocate) and the whole read wall-time-capped with
+                # an ABSOLUTE deadline (per-recv timeouts would
+                # multiply under byte-trickling and hold the accept
+                # loop hostage)
+                from cake_tpu.utils.wire import recv_bounded_msg
                 hd = _time.monotonic() + min(
                     10.0, max(deadline - _time.monotonic(), 0.1))
-
-                def recv_bounded(n: int) -> Optional[bytes]:
-                    data = b""
-                    while len(data) < n:
-                        rem = hd - _time.monotonic()
-                        if rem <= 0:
-                            return None
-                        conn.settimeout(rem)
-                        part = conn.recv(n - len(data))
-                        if not part:
-                            return None
-                        data += part
-                    return data
-
-                try:
-                    head = recv_bounded(_LEN.size)
-                    n = _LEN.unpack(head)[0] if head else 0
-                    hello = (recv_bounded(n)
-                             if head and 0 < n <= 256 else None)
-                except OSError:
-                    hello = None
+                hello = recv_bounded_msg(conn, 256, hd)
                 if hello is None or not hmac.compare_digest(
                         hello, self.token.encode()):
                     log.warning("control: rejected peer %s (bad token)",
@@ -151,24 +192,78 @@ class ControlServer:
             conn.settimeout(None)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.append(conn)
+            self._peers.append({"peer": "%s:%s" % peer[:2],
+                                "last_sent_seq": 0})
             log.info("control: follower connected from %s", peer)
 
     def publish(self, op: dict) -> None:
-        """Send one op to every follower. Called from the engine thread
-        immediately before it dispatches the corresponding device step."""
+        """Send one op to every follower, stamped with a monotonically
+        increasing ``seq``. Called from the engine thread immediately
+        before it dispatches the corresponding device step. Followers
+        verify the seq stream is gapless (ControlClient.recv raises
+        ControlDesyncError on a gap) and report their last-applied seq
+        back through telemetry frames (note_ack)."""
         if self.faults is not None:
             self.faults.check("control.publish")
-        payload = json.dumps(op).encode()
+        t0 = time.perf_counter()
+        nbytes = 0
         with self._lock:
-            for conn in self._conns:
+            self._seq += 1
+            seq = self._seq
+            payload = json.dumps({**op, "seq": seq}).encode()
+            for conn, meta in zip(self._conns, self._peers):
                 try:
                     _send_msg(conn, payload)
                 except OSError:
                     # a dead follower cannot be skipped silently — the
                     # SPMD program it was part of will hang; surface it
+                    # WITH the wire state (how far this follower got,
+                    # and what every follower last acked) so the
+                    # desync is diagnosable post-mortem
+                    log.error(
+                        "control: follower %s connection lost at "
+                        "publish seq %d (last_sent_seq=%d, "
+                        "follower_acks=%s)", meta["peer"], seq,
+                        meta["last_sent_seq"], dict(self.follower_acks))
                     raise RuntimeError(
                         "control: follower connection lost; the SPMD "
-                        "mesh is no longer fully driven")
+                        f"mesh is no longer fully driven (follower "
+                        f"{meta['peer']} last_sent_seq="
+                        f"{meta['last_sent_seq']}, publishing seq "
+                        f"{seq}, acks {dict(self.follower_acks)})")
+                meta["last_sent_seq"] = seq
+                nbytes += _LEN.size + len(payload)
+        _CONTROL_OPS.labels(op=str(op.get("op", "?"))).inc()
+        if nbytes:
+            _CONTROL_BYTES.labels(dir="tx").inc(nbytes)
+        _CONTROL_PUBLISH.observe(time.perf_counter() - t0)
+
+    @property
+    def published_seq(self) -> int:
+        """Seq of the newest published op (0 = nothing published) —
+        the minuend of every follower's lag."""
+        with self._lock:
+            return self._seq
+
+    def note_ack(self, follower: str, applied_seq: int) -> None:
+        """Record a follower's last-APPLIED op seq (reported in its
+        telemetry frame, obs/federation.py) and refresh its lag gauge.
+        Keyed by the follower's telemetry host id (proc1, ...)."""
+        with self._lock:
+            self.follower_acks[str(follower)] = int(applied_seq)
+            lag = max(0, self._seq - int(applied_seq))
+        _FOLLOWER_LAG.labels(follower=str(follower)).set(lag)
+
+    def wire_state(self) -> Dict:
+        """Control-plane wire introspection for recovery_state() /
+        post-mortems: the published seq, each connection's last-sent
+        seq, and the last-acked applied seqs by follower name."""
+        with self._lock:
+            return {
+                "published_seq": self._seq,
+                "followers": [dict(meta) for meta in self._peers],
+                "acks": dict(self.follower_acks),
+            }
 
     def wait_closed(self, timeout: float = 30.0) -> None:
         """Block until every follower closes its end (EOF). Called during
@@ -205,7 +300,10 @@ class ControlClient:
                  token: Optional[str] = None):
         host, port = address.rsplit(":", 1)
         deadline = connect_timeout
-        import time
+        # last op seq seen on this channel: recv() enforces a gapless
+        # stream (a GAP = missed ops = diverged mirror state) with a
+        # typed ControlDesyncError instead of silently replaying on
+        self._last_seq = 0
         t0 = time.monotonic()
         last: Optional[Exception] = None
         while time.monotonic() - t0 < deadline:
@@ -268,7 +366,21 @@ class ControlClient:
             self._sock.settimeout(None)
         payload = self._rbuf[_LEN.size:]
         self._rbuf = b""
-        return json.loads(payload)
+        op = json.loads(payload)
+        _CONTROL_BYTES.labels(dir="rx").inc(_LEN.size + len(payload))
+        seq = op.get("seq") if isinstance(op, dict) else None
+        if isinstance(seq, int):
+            if self._last_seq and seq != self._last_seq + 1:
+                raise ControlDesyncError(
+                    f"control op seq gap: expected "
+                    f"{self._last_seq + 1}, got {seq} — this follower "
+                    f"missed {seq - self._last_seq - 1} op(s); its "
+                    "mirrored state has diverged and replaying further "
+                    "ops would silently desync the SPMD dispatch")
+            self._last_seq = seq
+        if isinstance(op, dict):
+            _CONTROL_OPS.labels(op=str(op.get("op", "?"))).inc()
+        return op
 
     def close(self) -> None:
         self._sock.close()

@@ -728,6 +728,14 @@ class InferenceEngine:
         # localizes logits so sampling is process-local + deterministic
         self._control = None
         self._multihost = False
+        # follower side: seq of the last successfully APPLIED control
+        # op — the exporter (obs/federation.py) ships it in telemetry
+        # frames so the coordinator's fleet view can compute lag
+        self.applied_op_seq = 0
+        # coordinator side: an attached obs/federation
+        # TelemetryCollector — request_timeline merges its remote
+        # events so one explain call spans hosts
+        self.telemetry = None
 
         self._next_rid = 1
         self._rid_lock = threading.Lock()
@@ -911,6 +919,10 @@ class InferenceEngine:
                           "within %.0fs; disconnecting", reset_wait_s)
                 return
             if op is None or op.get("op") == "stop":
+                if op is not None and isinstance(op.get("seq"), int):
+                    # count the stop as applied: a drained follower
+                    # must report zero lag, not one phantom op
+                    self.applied_op_seq = op["seq"]
                 log.info("engine follower: coordinator %s",
                          "stopped" if op else "closed the channel")
                 return
@@ -960,6 +972,11 @@ class InferenceEngine:
                 else:
                     log.error("engine follower: unknown op %r", kind)
                 failed = False
+                if isinstance(op.get("seq"), int):
+                    # applied (not merely received): telemetry frames
+                    # report this, and lag vs the published seq is the
+                    # fleet view's behind-ness signal
+                    self.applied_op_seq = op["seq"]
             except Exception:  # noqa: BLE001
                 log.exception("follower op failed (awaiting reset)")
                 failed = True
@@ -1957,6 +1974,12 @@ class InferenceEngine:
         }
         if self._faults is not None:
             out["fault_plan"] = self._faults.describe()
+        if self._control is not None and hasattr(self._control,
+                                                 "wire_state"):
+            # control-plane wire state (published seq, per-follower
+            # last-sent + last-acked seqs): a follower disconnect is
+            # diagnosable from the health endpoint post-mortem
+            out["control"] = self._control.wire_state()
         return out
 
     # -- per-request explain (obs/timeline.py) ---------------------------
@@ -1975,8 +1998,20 @@ class InferenceEngine:
             return None
         events = (self.events.dump(rid=rid)
                   if self.events is not None else [])
+        local_host = None
+        if self.telemetry is not None:
+            # fleet-scope explain: the collector's remote events carry
+            # their origin host and clock-offset-corrected timestamps,
+            # so a request that prefilled on host A and decoded on
+            # host B still reads as ONE ordered chronology
+            local_host = getattr(self.telemetry, "local_host", None)
+            try:
+                events = events + self.telemetry.events_for(rid=rid)
+            except Exception:  # noqa: BLE001 — explain must not fail
+                log.debug("remote event merge failed", exc_info=True)
         return build_timeline(trace, events,
-                              self.flight.records_for(rid))
+                              self.flight.records_for(rid),
+                              local_host=local_host)
 
     # -- live reconfiguration (cake_tpu/autotune) ------------------------
 

@@ -284,6 +284,30 @@ def test_autotune_smoke_tier_switches_without_losing_streams():
                for o in result["autotune_observations"])
 
 
+@pytest.mark.slow  # subprocess tier -> slow lane (tier-1 wall budget)
+def test_fleet_smoke_tier_ships_batches_with_finite_lag():
+    """The --fleet tier's acceptance contract: the federation plane
+    works end to end over real localhost sockets — export batches > 0
+    all ingested, collector ingest lag finite (p99 >= p50 >= 0), the
+    control exchange carries a measurable per-op wire cost, and the
+    drained follower reports applied-seq lag 0."""
+    result = _run_tier("fleet_tiny")
+    assert result["unit"] == "frames" and result["value"] > 0
+    assert result["fleet_export_batches"] > 0
+    assert result["fleet_ingest_frames"] == result[
+        "fleet_export_batches"]
+    assert result["fleet_events_shipped"] > 0
+    import math
+    for key in ("fleet_ingest_lag_p50_ms", "fleet_ingest_lag_p99_ms"):
+        assert math.isfinite(result[key]) and result[key] >= 0
+    assert result["fleet_ingest_lag_p99_ms"] \
+        >= result["fleet_ingest_lag_p50_ms"]
+    assert result["fleet_control_bytes_per_op"] > 0
+    assert result["fleet_publish_us_per_op"] > 0
+    assert result["fleet_lag_ops"] == 0
+    assert result["fleet_host_live"] is True
+
+
 @pytest.mark.slow  # two engine phases under injected chaos -> slow lane
 def test_chaos_smoke_tier_recovers_without_losing_requests():
     """The --chaos tier's acceptance contract: the injected transient

@@ -145,3 +145,101 @@ def test_real_round_files_are_ingestible():
     tiers = bc.extract_tiers(doc)
     assert tiers, "no tier records found in BENCH_r05_builder.json"
     assert all("metric" in t for t in tiers.values())
+
+
+def test_attainment_fields_compared_higher_is_better():
+    """Scalar attainment fields join the comparison: a drop beyond
+    tolerance is a regression even when tok/s held."""
+    bc = _load()
+    regs, wins = bc.compare_tier(
+        "t",
+        _tier("t", tok_s=100.0, slo_attainment=0.95),
+        _tier("t", tok_s=100.0, slo_attainment=0.5),
+        tol=0.1)
+    assert [r["field"] for r in regs] == ["slo_attainment"]
+    regs, wins = bc.compare_tier(
+        "t",
+        _tier("t", slo_attainment=0.5),
+        _tier("t", slo_attainment=0.95), tol=0.1)
+    assert not regs and [w["field"] for w in wins] == ["slo_attainment"]
+
+
+# -- tools/check_bench_round.py: the round-workflow regression hook -----------
+
+
+def _load_round_hook():
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_round", TOOLS / "check_bench_round.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _round(tmp_path, name, tok_s, attainment=None, degraded=False):
+    rec = _tier("slo_tier", goodput_tok_s=tok_s)
+    if attainment is not None:
+        rec["attainment"] = attainment       # per-class dict
+    if degraded:
+        rec["degraded"] = True
+    return _write(tmp_path, name, [rec])
+
+
+def test_round_hook_rc1_on_tok_s_regression(tmp_path):
+    cbr = _load_round_hook()
+    _round(tmp_path, "BENCH_r01.json", tok_s=100.0)
+    _round(tmp_path, "BENCH_r02.json", tok_s=80.0)
+    assert cbr.main([str(tmp_path)]) == 1
+    # within tolerance -> clean
+    assert cbr.main([str(tmp_path), "--tol", "0.5"]) == 0
+
+
+def test_round_hook_rc1_on_attainment_collapse(tmp_path):
+    """A per-class attainment collapse at held tok/s fails the round:
+    nested {class: frac} dicts are flattened before comparison."""
+    cbr = _load_round_hook()
+    _round(tmp_path, "BENCH_r01.json", tok_s=100.0,
+           attainment={"interactive": 0.97, "batch": 0.9})
+    _round(tmp_path, "BENCH_r02.json", tok_s=100.0,
+           attainment={"interactive": 0.4, "batch": 0.9})
+    assert cbr.main([str(tmp_path)]) == 1
+
+
+def test_round_hook_skips_degraded_rounds(tmp_path):
+    """A degraded newest round (dead-tunnel 0.0s) is skipped: the gate
+    compares the newest two NON-degraded rounds instead of calling a
+    tunnel outage a regression."""
+    cbr = _load_round_hook()
+    _round(tmp_path, "BENCH_r01.json", tok_s=100.0)
+    _round(tmp_path, "BENCH_r02.json", tok_s=101.0)
+    _round(tmp_path, "BENCH_r03.json", tok_s=0.0, degraded=True)
+    assert cbr.main([str(tmp_path)]) == 0    # r01 vs r02, not r03
+    # and the regression between the two live rounds still fires
+    _round(tmp_path, "BENCH_r04.json", tok_s=50.0)
+    assert cbr.main([str(tmp_path)]) == 1    # r02 vs r04
+
+
+def test_round_hook_nothing_to_compare_is_not_a_regression(tmp_path):
+    cbr = _load_round_hook()
+    assert cbr.main([str(tmp_path)]) == 0            # zero files
+    _round(tmp_path, "BENCH_r01.json", tok_s=100.0)
+    assert cbr.main([str(tmp_path)]) == 0            # one file
+    bad = tmp_path / "BENCH_r02.json"
+    bad.write_text("{torn")
+    assert cbr.main([str(tmp_path)]) == 0            # torn file skipped
+    assert cbr.main(["/nonexistent-dir"]) == 2
+    assert cbr.main([str(tmp_path), "--tol", "x"]) == 2
+
+
+def test_round_hook_orders_by_round_number(tmp_path):
+    """BENCH_r10 outranks BENCH_r9 (numeric, not lexicographic), and a
+    round's *_builder rerun outranks the round file itself."""
+    cbr = _load_round_hook()
+    assert cbr.round_key("BENCH_r10.json") > cbr.round_key(
+        "BENCH_r9.json")
+    assert cbr.round_key("BENCH_r05_builder.json") > cbr.round_key(
+        "BENCH_r05.json")
+    _round(tmp_path, "BENCH_r9.json", tok_s=100.0)
+    _round(tmp_path, "BENCH_r10.json", tok_s=100.0)
+    _round(tmp_path, "BENCH_r10_builder.json", tok_s=40.0)
+    # newest two = r10 and its builder rerun -> regression fires
+    assert cbr.main([str(tmp_path)]) == 1

@@ -220,6 +220,71 @@ def test_series_cap_cli_flag(tmp_path):
     assert lm.main([str(p), "--series-cap", "abc"]) == 2
 
 
+def test_fleet_wire_families_live_linted():
+    """The fleet-observability tier-1 hook: the control wire metrics
+    (serve/control.py), heartbeat RTT (parallel/health.py) and the
+    telemetry-federation/fleet families (obs/federation.py) are
+    registered on import, carry real help text and have README rows."""
+    lm = _load()
+    import cake_tpu.obs.federation  # noqa: F401 — cake_telemetry_/fleet_
+    import cake_tpu.parallel.health  # noqa: F401 — cake_heartbeat_rtt
+    import cake_tpu.serve.control  # noqa: F401 — cake_control_*
+    from cake_tpu.obs import metrics as m
+    text = m.REGISTRY.render()
+    for fam in ("cake_control_ops_total", "cake_control_bytes_total",
+                "cake_control_publish_seconds",
+                "cake_control_follower_lag_ops",
+                "cake_heartbeat_rtt_seconds",
+                "cake_telemetry_exported_frames_total",
+                "cake_telemetry_export_errors_total",
+                "cake_telemetry_frames_total",
+                "cake_telemetry_bytes_total",
+                "cake_telemetry_ingest_lag_seconds",
+                "cake_fleet_host_up",
+                "cake_fleet_last_export_age_seconds",
+                "cake_fleet_applied_seq",
+                "cake_fleet_clock_offset_seconds"):
+        assert any(line.startswith(f"# TYPE {fam} ")
+                   for line in text.splitlines()), fam
+    readme = (TOOLS.parent / "README.md").read_text()
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs
+
+
+def test_host_label_cardinality_capped_at_topology_size():
+    """Federated families carry one host value per fleet host: more
+    distinct values than --host-cap is a lint error (something is
+    inventing host names), configurable and 0-disableable. The
+    default matches the collector's max_hosts default, so a fleet
+    the collector accepts never false-fails the lint."""
+    lm = _load()
+    assert lm.DEFAULT_HOST_CAP == 64   # = TelemetryCollector max_hosts
+    lines = ["# TYPE fed_total counter"]
+    lines += [f'fed_total{{host="proc{i}"}} 1' for i in range(65)]
+    text = "\n".join(lines) + "\n"
+    # series-cap 0 isolates the host-cap check (65 hosts also exceed
+    # the default 64-series cap)
+    errs = lm.lint(text, series_cap=0)          # default host cap 64
+    assert any("host label values" in e and "topology" in e
+               for e in errs)
+    assert lm.lint(text, series_cap=0, host_cap=128) == []
+    assert lm.lint(text, series_cap=0, host_cap=0) == []
+    # under the cap: clean (the same text minus one host)
+    assert lm.lint("\n".join(lines[:-1]) + "\n", series_cap=0) == []
+
+
+def test_host_cap_cli_flag(tmp_path):
+    lm = _load()
+    lines = ["# TYPE fed_total counter"]
+    lines += [f'fed_total{{host="proc{i}"}} 1' for i in range(65)]
+    p = tmp_path / "m.prom"
+    p.write_text("\n".join(lines) + "\n")
+    assert lm.main([str(p), "--series-cap", "0"]) == 1
+    assert lm.main([str(p), "--series-cap", "0",
+                    "--host-cap", "128"]) == 0
+    assert lm.main([str(p), "--host-cap", "abc"]) == 2
+
+
 def test_goodput_event_families_live_linted():
     """The tier-1 hook covers the new families: cake_slo_* /
     cake_goodput_* / cake_events_* are registered (module import),

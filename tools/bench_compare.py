@@ -62,13 +62,17 @@ def extract_tiers(obj, out: Optional[Dict[str, dict]] = None
 
 def _field_direction(key: str) -> Optional[bool]:
     """True = higher is better, False = lower is better, None = not a
-    compared field. The three families the tier contract names:
-    throughput (tok/s), TTFT p99, and MFU/HBM utilization."""
+    compared field. The families the tier contract names: throughput
+    (tok/s, incl. goodput_tok_s), TTFT p99, MFU/HBM utilization, and
+    scalar SLO attainment fields (per-class dicts are flattened into
+    scalars by tools/check_bench_round.py before comparison)."""
     k = key.lower()
     if "tok_s" in k or "tokens_per_s" in k:
         return True
     if "ttft_p99" in k and k.endswith("_ms"):
         return False
+    if "attainment" in k:
+        return True
     if k == "mfu" or k.endswith("_mfu") or k == "hbm_util" \
             or k.endswith("_hbm_util") or k == "roofline_frac":
         return True

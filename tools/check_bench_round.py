@@ -1,0 +1,186 @@
+#!/usr/bin/env python3
+"""Round-workflow regression gate: diff the newest two BENCH rounds.
+
+tools/bench_compare.py made two round files machine-comparable, but
+someone still had to RUN it — so a silent tok/s or attainment
+regression waited for a human to diff JSONs (the ROADMAP item 5
+leftover). This hook closes the loop: run it after every bench round
+(or in CI) and a regression beyond tolerance exits nonzero.
+
+What it does:
+
+  * globs ``BENCH_*.json`` in DIR (default: this repo's root), ordered
+    by round number (``BENCH_r07`` > ``BENCH_r06``;
+    ``BENCH_r05_builder`` is a rerun of round 5 and outranks
+    ``BENCH_r05``; names without a round number sort oldest so they
+    never displace a real round from the newest-two comparison);
+  * skips files with nothing comparable: unreadable/unparseable files
+    and files whose every tier record is ``"degraded": true`` (the
+    off-TPU-fallback marker — a degraded 0.0 is a tunnel outage, not a
+    regression) are reported and passed over;
+  * diffs the newest two survivors with bench_compare's tier walker
+    under ``--tol`` (default 0.1 = 10%): tok/s down, TTFT p99 up,
+    MFU/HBM-util down, attainment down all count. Per-class attainment
+    dicts (``{"interactive": 0.97, ...}``) are flattened to scalar
+    ``<path>_attainment_<class>`` fields first, so per-class collapses
+    are caught even when the aggregate held.
+
+Exit status:
+    0  no regression (including "fewer than two comparable rounds")
+    1  at least one field regressed beyond tolerance
+    2  unusable input (bad directory / malformed flags)
+
+Usage:
+    python tools/check_bench_round.py [DIR] [--tol 0.1] [--json]
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROUND_RE = re.compile(r"BENCH_r(\d+)")
+
+
+def _load_bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(_HERE, "bench_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def round_key(path: str) -> Tuple[int, str]:
+    """Sort key: round number first (BENCH_r10 > BENCH_r9), then name
+    (BENCH_r05_builder — a rerun — outranks BENCH_r05). Names without
+    a round number sort FIRST (oldest): a stray BENCH_baseline.json
+    must never displace a real round from the newest-two comparison."""
+    name = os.path.basename(path)
+    m = _ROUND_RE.search(name)
+    return (int(m.group(1)) if m else -1, name)
+
+
+def flatten_attainment(rec: Dict) -> Dict:
+    """Record copy with per-class attainment dicts lifted into scalar
+    fields (``low_attainment_interactive``: 0.97), so bench_compare's
+    scalar field comparison sees them. Existing scalar keys win on a
+    (pathological) name collision."""
+    out = dict(rec)
+
+    def walk(obj, path: str) -> None:
+        if not isinstance(obj, dict):
+            return
+        for k, v in obj.items():
+            p = f"{path}_{k}" if path else str(k)
+            if isinstance(v, dict):
+                walk(v, p)
+            elif (isinstance(v, (int, float))
+                  and not isinstance(v, bool)
+                  and "attainment" in p.lower() and p not in rec):
+                out.setdefault(p, v)
+
+    for k, v in rec.items():
+        if isinstance(v, dict):
+            walk(v, str(k))
+    return out
+
+
+def load_round(path: str, bc) -> Optional[Dict[str, dict]]:
+    """Non-degraded tier records of one round file, or None when the
+    file holds nothing comparable (unreadable, unparseable, no tier
+    records, or every tier degraded)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        # stderr: --json consumers must get ONE parseable stdout doc
+        print(f"skip {os.path.basename(path)}: unreadable ({e})",
+              file=sys.stderr)
+        return None
+    tiers = bc.extract_tiers(doc)
+    live = {name: flatten_attainment(rec)
+            for name, rec in tiers.items() if not rec.get("degraded")}
+    if not live:
+        why = ("every tier degraded (off-TPU fallback)" if tiers
+               else "no tier records")
+        print(f"skip {os.path.basename(path)}: {why}",
+              file=sys.stderr)
+        return None
+    return live
+
+
+def main(argv: List[str]) -> int:
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    tol = 0.1
+    if "--tol" in argv:
+        i = argv.index("--tol")
+        if i + 1 >= len(argv):
+            print("--tol needs a number", file=sys.stderr)
+            return 2
+        try:
+            tol = float(argv[i + 1])
+        except ValueError:
+            print(f"--tol: {argv[i + 1]!r} is not a number",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if len(argv) > 1:
+        print("usage: check_bench_round.py [DIR] [--tol FRAC] [--json]",
+              file=sys.stderr)
+        return 2
+    root = argv[0] if argv else os.path.dirname(_HERE)
+    if not os.path.isdir(root):
+        print(f"{root}: not a directory", file=sys.stderr)
+        return 2
+
+    bc = _load_bench_compare()
+    rounds: List[Tuple[str, Dict[str, dict]]] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json")),
+                       key=round_key):
+        live = load_round(path, bc)
+        if live is not None:
+            rounds.append((os.path.basename(path), live))
+    if len(rounds) < 2:
+        note = (f"nothing to compare: {len(rounds)} comparable round "
+                "file(s) (need 2) — not a regression")
+        if as_json:
+            # a --json consumer always gets one parseable document
+            print(json.dumps({"compared": [], "regressions": [],
+                              "improvements": [], "note": note}))
+        else:
+            print(note)
+        return 0
+    (old_name, old_tiers), (new_name, new_tiers) = rounds[-2:]
+    summary = bc.compare(old_tiers, new_tiers, tol)
+    summary["old"] = old_name
+    summary["new"] = new_name
+    if as_json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(f"comparing {old_name} -> {new_name} (tol {tol:.0%})")
+        for e in summary["improvements"]:
+            print(f"ok   {e['tier']}.{e['field']}: {e['old']} -> "
+                  f"{e['new']} ({e['delta']:+.1%})")
+        for e in summary["regressions"]:
+            print(f"REGR {e['tier']}.{e['field']}: {e['old']} -> "
+                  f"{e['new']} ({e['delta']:+.1%})")
+        if not summary["compared"]:
+            print("no common non-degraded tiers between the two rounds")
+        elif not summary["regressions"]:
+            print(f"ok: {len(summary['compared'])} tier(s) compared, "
+                  f"no regression beyond {tol:.0%}")
+    return 1 if summary["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -20,6 +20,11 @@ never ship):
     series per distinct label set, not per bucket) — an unbounded
     label (a rid, a raw URL, a user id) grows the scrape without limit
     and this catches it before production does;
+  * ``host``-labeled (federated, obs/federation.py) families may carry
+    at most ``--host-cap`` distinct host values (default 64, matching
+    the collector's max_hosts default): the host dimension is bounded
+    by TOPOLOGY size, not traffic — more values means something is
+    inventing host names;
   * ``rid``-valued labels are banned outright, whatever the count:
     request identity belongs on the event bus / request traces
     (obs/events.py, obs/tracing.py), never on a metric series.
@@ -37,6 +42,7 @@ Usage:
     python tools/lint_metrics.py FILE          # or '-' for stdin
     python tools/lint_metrics.py FILE --readme README.md
     python tools/lint_metrics.py FILE --series-cap 128
+    python tools/lint_metrics.py FILE --host-cap 32
     python tools/lint_metrics.py --url http://HOST:PORT/api/v1/metrics
 
 Exit status 0 = clean, 1 = violations (printed one per line).
@@ -79,7 +85,12 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # goodput-first observability (obs/events.py +
                        # obs/slo.py): the event bus + SLO attainment /
                        # goodput families
-                       "cake_slo_", "cake_goodput_", "cake_events_")
+                       "cake_slo_", "cake_goodput_", "cake_events_",
+                       # fleet observability (serve/control.py wire
+                       # metrics + obs/federation.py telemetry
+                       # federation + /api/v1/fleet gauges)
+                       "cake_control_", "cake_telemetry_",
+                       "cake_fleet_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to
@@ -89,6 +100,16 @@ BANNED_LABELS = ("rid",)
 # default live-series cap per family (histograms count one series per
 # distinct label set, not per le bucket)
 DEFAULT_SERIES_CAP = 64
+
+# distinct `host` label values per family (telemetry federation adds a
+# host dimension to remote families — obs/federation.py): bounded by
+# TOPOLOGY size, not traffic. The default matches the collector's own
+# max_hosts default (TelemetryCollector max_hosts=64) so a fleet the
+# collector accepts never false-fails the lint; a family whose host
+# values exceed it means something is inventing host names (or the
+# collector's guard was bypassed). Raise --host-cap alongside
+# max_hosts on larger topologies.
+DEFAULT_HOST_CAP = 64
 
 
 def _split_labels(raw: str) -> List[Tuple[str, str]]:
@@ -140,7 +161,8 @@ def _family_of(name: str) -> str:
 
 
 def lint(text: str,
-         series_cap: int = DEFAULT_SERIES_CAP) -> List[str]:
+         series_cap: int = DEFAULT_SERIES_CAP,
+         host_cap: int = DEFAULT_HOST_CAP) -> List[str]:
     """Return a list of human-readable violations (empty = clean)."""
     errors: List[str] = []
     types: Dict[str, str] = {}
@@ -153,6 +175,9 @@ def lint(text: str,
     # family -> distinct label sets (minus le) — the live-series count
     # behind the cardinality cap
     live_series: Dict[str, set] = {}
+    # family -> distinct `host` label values (federated families must
+    # stay topology-sized)
+    host_values: Dict[str, set] = {}
 
     for ln, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -224,6 +249,9 @@ def lint(text: str,
             seen_families.append(fam)
         live_series.setdefault(fam, set()).add(
             tuple(sorted((k, v) for k, v in pairs if k != "le")))
+        for k, v in pairs:
+            if k == "host":
+                host_values.setdefault(fam, set()).add(v)
 
         if typ == "counter":
             if not (value >= 0):
@@ -286,6 +314,14 @@ def lint(text: str,
                     f"label-cardinality cap {series_cap} — an "
                     "unbounded label value set; aggregate it or move "
                     "the identity to the event bus")
+    if host_cap and host_cap > 0:
+        for fam, vals in sorted(host_values.items()):
+            if len(vals) > host_cap:
+                errors.append(
+                    f"{fam}: {len(vals)} distinct host label values "
+                    f"exceeds the topology-size cap {host_cap} — "
+                    "federated families carry one value per fleet "
+                    "host; something is inventing host names")
     return errors
 
 
@@ -333,17 +369,24 @@ def main(argv: List[str]) -> int:
         return 0 if argv else 1
     readme_path = None
     series_cap = DEFAULT_SERIES_CAP
-    if "--series-cap" in argv:
-        i = argv.index("--series-cap")
+    host_cap = DEFAULT_HOST_CAP
+    for flag in ("--series-cap", "--host-cap"):
+        if flag not in argv:
+            continue
+        i = argv.index(flag)
         if i + 1 >= len(argv):
-            print("--series-cap needs a number", file=sys.stderr)
+            print(f"{flag} needs a number", file=sys.stderr)
             return 2
         try:
-            series_cap = int(argv[i + 1])
+            val = int(argv[i + 1])
         except ValueError:
-            print(f"--series-cap: {argv[i + 1]!r} is not an integer",
+            print(f"{flag}: {argv[i + 1]!r} is not an integer",
                   file=sys.stderr)
             return 2
+        if flag == "--series-cap":
+            series_cap = val
+        else:
+            host_cap = val
         argv = argv[:i] + argv[i + 2:]
     if "--readme" in argv:
         i = argv.index("--readme")
@@ -364,7 +407,7 @@ def main(argv: List[str]) -> int:
     else:
         with open(argv[0]) as f:
             text = f.read()
-    errors = lint(text, series_cap=series_cap)
+    errors = lint(text, series_cap=series_cap, host_cap=host_cap)
     if readme_path is not None:
         with open(readme_path) as f:
             errors += lint_readme_coverage(text, f.read())
