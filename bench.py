@@ -221,6 +221,25 @@ CHAOS_TIERS = {
                                       ":match_len=96:times=3")),
 }
 
+# Restart tiers (bench.py --restart): the durable-serving crash drill
+# (serve/journal.py). Phase 1 runs the offered load uninterrupted for
+# the token oracle; phase 2 re-execs this file as a CHILD serving the
+# same load with --journal armed and a fault-plan `abort` staged
+# mid-decode (os._exit — a true kill -9, no flushes beyond what hit
+# the OS); phase 3 replays the child's journal into a fresh engine and
+# measures RTO (recovery wall time: replay + resubmit + finish). The
+# numbers this tier exists for: requests lost MUST be 0, and the
+# recovered greedy streams must be token-identical to the
+# uninterrupted run at f32 KV.
+RESTART_TIERS = {
+    # abort_step lands mid-decode of the wave (the 4-token warmup
+    # consumes ~5 steps; the wave's prefills + early decodes follow)
+    "restart_8b_int8": dict(model="8b", quant="int8", max_seq=512,
+                            slots=4, prompt_len=128, prefill_chunk=128,
+                            gen_tokens=64, wave=6, abort_step=30,
+                            journal_fsync="batch", cache_f32=True),
+}
+
 # Autotune tiers (bench.py --autotune): one mid-run offered-load shift
 # served twice — pinned at the low-load config, then with the online
 # autotuner armed (--autotune auto semantics: a two-regime policy whose
@@ -303,6 +322,14 @@ SMOKE_TIERS = {
                                    ";engine.decode:nth=14:transient"
                                    ";engine.prefill:always:transient"
                                    ":match_len=11:times=3")),
+    # f32 cache so the replayed streams must come back token-identical
+    # to the uninterrupted run (the durability contract, not bf16
+    # tie-breaks); abort_step 10 lands mid-decode of the 3-request
+    # wave on a 2-slot engine (warmup ~5 steps + prefills)
+    "restart_tiny": dict(model="tiny", quant=False, max_seq=128,
+                         slots=2, prompt_len=16, prefill_chunk=16,
+                         gen_tokens=16, wave=3, abort_step=10,
+                         journal_fsync="batch", cache_f32=True),
     "paged_prefix_tiny": dict(model="tiny", quant=False, max_seq=128,
                               slots=2, kv_pages=16, kv_page_size=16,
                               paged_attn="fold", prefix_len=32,
@@ -1246,6 +1273,186 @@ def run_chaos_tier(name: str, model: str, quant, max_seq: int,
     return result
 
 
+RESTART_CHILD_ENV = "CAKE_BENCH_RESTART_CHILD"
+
+
+def _restart_engine(cfg, params, max_seq, slots, prefill_chunk,
+                    cache_f32, journal=None, journal_fsync="batch",
+                    fault_plan=None):
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+    kw = {"cache_dtype": jnp.float32} if cache_f32 else {}
+    return InferenceEngine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        max_slots=slots, max_seq_len=max_seq,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        prefill_chunk=prefill_chunk, journal=journal,
+        journal_fsync=journal_fsync, fault_plan=fault_plan, **kw)
+
+
+def _restart_load(engine, prompt, wave: int, gen_tokens: int,
+                  wait: bool):
+    """The shared offered load: one 4-token warmup (compile + a
+    retired journal record), then the wave. wait=False is the doomed
+    child — it submits and blocks until the staged abort kills it."""
+    warm = engine.submit(prompt(99), max_new_tokens=4)
+    assert warm.wait(timeout=900), "restart warmup timed out"
+    handles = [engine.submit(prompt(i), max_new_tokens=gen_tokens)
+               for i in range(wave)]
+    if wait:
+        assert all(h.wait(timeout=900) for h in handles), \
+            "restart wave timed out"
+    else:
+        for h in handles:
+            h.wait(timeout=900)   # the abort fires first; never returns
+    return handles
+
+
+def restart_child_main() -> None:
+    """Child-process entry (CAKE_BENCH_RESTART_CHILD=<json>): serve
+    the tier's load with --journal armed and a fault-plan `abort`
+    staged at a fixed engine step — the process dies there with
+    ABORT_EXIT_CODE, mid-decode, exactly like a kill -9. rc 3 means
+    the abort never fired (a tier misconfiguration, not a drill)."""
+    from functools import partial
+
+    import jax
+
+    c = json.loads(os.environ[RESTART_CHILD_ENV])
+    cfg = make_config(c["model"])
+    init, _ = _init_fn(c["quant"])
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=c["prompt_len"], vocab=V)
+    engine = _restart_engine(
+        cfg, params, c["max_seq"], c["slots"], c["prefill_chunk"],
+        c["cache_f32"], journal=c["journal"],
+        journal_fsync=c["journal_fsync"],
+        fault_plan=f"engine.step:step={c['abort_step']}:abort")
+    engine.start()
+    _restart_load(engine, prompt, c["wave"], c["gen_tokens"],
+                  wait=False)
+    sys.exit(3)
+
+
+def run_restart_tier(name: str, model: str, quant, max_seq: int,
+                     slots: int, prompt_len: int, prefill_chunk: int,
+                     gen_tokens: int, wave: int, abort_step: int,
+                     journal_fsync: str = "batch",
+                     cache_f32: bool = False) -> dict:
+    """Durable-serving crash drill (serve/journal.py): uninterrupted
+    oracle run, then a journaled child killed mid-decode by a
+    fault-plan `abort` (os._exit — a staged kill -9), then journal
+    replay into a fresh engine. Reports RTO (recovery wall time),
+    requests replayed vs LOST (must be 0), and a token-identity flag
+    vs the oracle. prefill_chunk keeps the folded replay prefills —
+    whose lengths vary with how many tokens each stream had at death —
+    on ONE compiled window program."""
+    import tempfile
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.faults import ABORT_EXIT_CODE
+    from cake_tpu.serve import checkpoint as ckpt
+    from cake_tpu.serve import journal as jr
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+
+    # phase 1: the uninterrupted oracle (also warms this process's jit
+    # cache, so phase-3 RTO measures replay, not compiles)
+    engine = _restart_engine(cfg, params, max_seq, slots, prefill_chunk,
+                             cache_f32)
+    with engine:
+        handles = _restart_load(engine, prompt, wave, gen_tokens,
+                                wait=True)
+        oracle = [list(h._req.out_tokens) for h in handles]
+        oracle_rids = [h._req.rid for h in handles]
+    log(f"restart[oracle]: {wave} streams complete")
+
+    # phase 2: the doomed child — same load, --journal armed, staged
+    # abort at a fixed engine step
+    jpath = os.path.join(tempfile.mkdtemp(prefix="cake_restart_"),
+                         "requests.journal")
+    child_cfg = dict(model=model, quant=quant, max_seq=max_seq,
+                     slots=slots, prompt_len=prompt_len,
+                     prefill_chunk=prefill_chunk,
+                     gen_tokens=gen_tokens, wave=wave,
+                     abort_step=abort_step, journal=jpath,
+                     journal_fsync=journal_fsync, cache_f32=cache_f32)
+    t_child = time.perf_counter()
+    proc, _line = _spawn_self(RESTART_CHILD_ENV, json.dumps(child_cfg),
+                              1500, f"{name}-child")
+    if proc is None or proc.returncode != ABORT_EXIT_CODE:
+        rc = None if proc is None else proc.returncode
+        raise RuntimeError(
+            f"restart child did not die by planned abort (rc={rc}, "
+            f"want {ABORT_EXIT_CODE})")
+    log(f"restart[child]: killed by planned abort in "
+        f"{time.perf_counter() - t_child:.1f}s (rc={proc.returncode})")
+
+    # phase 3: replay the journal into a fresh engine and finish
+    records, bad, torn = jr.read_records(jpath)
+    recs, findings, _hdr = jr.replay_state(records)
+    resumable_rids = sorted(r["rid"] for r in recs
+                            if ckpt.is_resumable(r))
+    finished_at_death = {r["rid"]: list(r["out_tokens"]) for r in recs
+                         if r.get("finished")
+                         and r.get("status") == "retired"}
+    engine2 = _restart_engine(cfg, params, max_seq, slots,
+                              prefill_chunk, cache_f32, journal=jpath,
+                              journal_fsync=journal_fsync)
+    t0 = time.perf_counter()
+    with engine2:
+        handles2, _finished = jr.recover(engine2)
+        assert all(h.wait(timeout=900) for h in handles2), \
+            "restart replay wave timed out"
+        rto = time.perf_counter() - t0
+        by_old_rid = dict(finished_at_death)
+        for old_rid, h in zip(resumable_rids, handles2):
+            by_old_rid[old_rid] = (list(h._req.replayed_tokens)
+                                   + list(h._req.out_tokens))
+        replay_s = (engine2._journal.last_replay or {}).get("seconds")
+    full = [by_old_rid.get(rid) for rid in oracle_rids]
+    lost = sum(1 for t in full if t is None)
+    tokens_match = all(t == o for t, o in zip(full, oracle)
+                       if t is not None)
+    result = {
+        "metric": f"{name}_rto_s",
+        "value": round(rto, 3),
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "restart_abort_step": abort_step,
+        "restart_journal_fsync": journal_fsync,
+        "restart_journal_records": len(records),
+        "restart_journal_corrupt_lines": bad,
+        "restart_journal_torn_tail": torn,
+        "restart_journal_findings": len(findings),
+        "restart_replayed": len(handles2),
+        "restart_finished_before_crash": len(finished_at_death),
+        "restart_lost": lost,
+        "restart_tokens_match": tokens_match,
+        "restart_replay_s": replay_s,
+        "device_kind": dev.device_kind,
+    }
+    log(f"restart: RTO {rto:.3f}s, {len(handles2)} replayed + "
+        f"{len(finished_at_death)} finished pre-crash, {lost} lost, "
+        f"tokens_match={tokens_match} (journal: {len(records)} "
+        f"records, torn_tail={torn})")
+    return result
+
+
 def run_autotune_tier(name: str, model: str, quant, max_seq: int,
                       kv_pages: int, kv_page_size: int, slots_lo: int,
                       slots_hi: int, prompt_len: int,
@@ -1685,6 +1892,9 @@ def tier_main():
     elif name in CHAOS_TIERS or name.startswith("chaos"):
         kwargs = {**CHAOS_TIERS, **SMOKE_TIERS}[name]
         result = run_chaos_tier(name, **kwargs)
+    elif name in RESTART_TIERS or name.startswith("restart"):
+        kwargs = {**RESTART_TIERS, **SMOKE_TIERS}[name]
+        result = run_restart_tier(name, **kwargs)
     elif name in KV_TIER_TIERS or name.startswith("kvtier"):
         kwargs = {**KV_TIER_TIERS, **SMOKE_TIERS}[name]
         result = run_kv_tier(name, **kwargs)
@@ -1900,6 +2110,18 @@ def _kv_tier_main() -> int:
         fail_error="kv tiering tier failed")
 
 
+def _restart_main() -> int:
+    """`bench.py --restart`: the durable-serving crash drill — one
+    JSON line with RTO (recovery wall seconds after a staged kill -9),
+    requests replayed vs lost (must be 0), and a token-identity flag
+    vs an uninterrupted run of the same load through a --journal
+    engine. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "rto_s", "s",
+        cpu_tier="restart_tiny", tpu_tier="restart_8b_int8",
+        fail_error="restart crash-drill tier failed")
+
+
 def _chaos_main() -> int:
     """`bench.py --chaos`: the crash-resilience tier — one JSON line
     with recovered / failed / quarantined request counts, recovery
@@ -2049,6 +2271,11 @@ def main():
 if __name__ == "__main__":
     if os.environ.get(PROBE_ENV):
         probe_main()
+    elif os.environ.get(RESTART_CHILD_ENV):
+        # BEFORE the ORCH_ENV check: the restart tier re-execs this
+        # file from inside its own tier subprocess, so the child
+        # inherits ORCH_ENV and would otherwise loop into tier_main
+        restart_child_main()
     elif os.environ.get(ORCH_ENV):
         tier_main()
     elif "--kv-tier" in sys.argv:
@@ -2061,6 +2288,8 @@ if __name__ == "__main__":
         sys.exit(_slo_main())
     elif "--chaos" in sys.argv:
         sys.exit(_chaos_main())
+    elif "--restart" in sys.argv:
+        sys.exit(_restart_main())
     elif "--fleet" in sys.argv:
         sys.exit(_fleet_main())
     elif "--paged-prefix" in sys.argv:

@@ -48,6 +48,7 @@ KNOWN_ROUTES = frozenset({
     "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
     "/api/v1/profile", "/api/v1/autotune", "/api/v1/events",
     "/api/v1/requests/{rid}/timeline", "/api/v1/fleet",
+    "/api/v1/drain",
 })
 
 # rid-bearing paths are counted under their TEMPLATE: a per-rid route
@@ -82,6 +83,12 @@ class ApiServer:
         self._gen_lock = threading.Lock()
         self._waiting = 0
         self._waiting_lock = threading.Lock()
+        # drain plumbing (POST /api/v1/drain): start() wires _shutdown
+        # to its save-and-exit closure; the drain thread calls it once
+        # in-flight work finishes (or the drain timeout expires)
+        self._shutdown = None
+        self._drain_thread = None
+        self._drain_lock = threading.Lock()
         self.started_at = int(time.time())  # /v1/models "created"
         # POST /api/v1/profile capture target (--profile-dir; None =
         # a fresh temp dir per capture)
@@ -102,15 +109,29 @@ class ApiServer:
 
     # -- text ---------------------------------------------------------------
 
-    def chat(self, body: dict, send_chunk=None,
-             on_start=None) -> Optional[dict]:
+    def chat(self, body: dict, send_chunk=None, on_start=None,
+             idempotency_key=None, last_event_id=None) -> Optional[dict]:
         """Run one chat completion. If send_chunk is set, stream deltas
         through it and return None; else return the full response dict.
         `on_start` fires after admission and before any tokens — the
         streaming handler sends its response headers there, so queue
-        rejections still surface as a clean 503."""
+        rejections still surface as a clean 503.
+
+        idempotency_key (x-cake-idempotency-key): a retried submit with
+        the same key attaches to the live/finished stream instead of
+        double-admitting — safe client retry, across restarts too when
+        --journal is armed. last_event_id (Last-Event-ID): on a
+        streaming reconnect, replay the journaled/held suffix after
+        that absolute token id, then continue live."""
         if self.engine is not None:
-            return self._chat_engine(body, send_chunk, on_start)
+            return self._chat_engine(body, send_chunk, on_start,
+                                     idempotency_key=idempotency_key,
+                                     last_event_id=last_event_id)
+        if idempotency_key is not None or last_event_id is not None:
+            raise ValueError(
+                "idempotency keys / Last-Event-ID resume require the "
+                "batching engine (this deployment serves through the "
+                "legacy locked path)")
         messages, opts = parse_chat_request(body)
         if opts.get("logprobs"):
             raise ValueError(
@@ -157,7 +178,8 @@ class ApiServer:
                 return None
 
     def _chat_engine(self, body: dict, send_chunk=None,
-                     on_start=None) -> Optional[dict]:
+                     on_start=None, idempotency_key=None,
+                     last_event_id=None) -> Optional[dict]:
         """Continuous-batching path: no lock — the engine interleaves this
         request's decode steps with every other in-flight request."""
         from cake_tpu.serve.engine import QueueFullError
@@ -170,6 +192,7 @@ class ApiServer:
             top_p=opts["top_p"],
             want_top_logprobs=n_top > 0,
             priority=opts.get("priority"),
+            idempotency_key=idempotency_key,
         )
 
         def lp_entry(t, lp, top):
@@ -185,6 +208,7 @@ class ApiServer:
             return e
 
         from cake_tpu.sched import ShedError
+        from cake_tpu.serve.errors import DrainingError
 
         if send_chunk is None:
             try:
@@ -192,12 +216,25 @@ class ApiServer:
             except (QueueFullError, ShedError) as e:
                 raise QueueFull(getattr(e, "retry_after", 1.0),
                                 shed=isinstance(e, ShedError))
+            except DrainingError as e:
+                raise QueueFull(e.retry_after, draining=True)
             h.wait()
             lp = None
             if want_lp:
                 lp = [lp_entry(t, l, top) for (t, l), top
                       in zip(h.token_logprobs, h.token_top_logprobs)]
-            return completion_response(h.text(), self.model_name,
+            text = h.text()   # raises the typed error if the engine failed it
+            rep = list(getattr(h._req, "replayed_tokens", ()) or ())
+            if rep:
+                # a journal/checkpoint-resumed stream: the client's
+                # transcript is the WHOLE generation — the tokens
+                # replayed from previous process generations plus this
+                # epoch's (h.text() alone covers only the latter)
+                eos = self.engine.config.eos_token_ids
+                text = self.engine.tokenizer.decode(
+                    [t for t in rep + list(h._req.out_tokens)
+                     if t not in eos])
+            return completion_response(text, self.model_name,
                                        logprobs=lp)
 
         rid = str(uuid.uuid4())
@@ -216,21 +253,47 @@ class ApiServer:
         # UTF-8 tail token's entry ships with the later chunk that
         # contains its text, never ahead of it)
         stream.wants_count = True
+        # back-compat with 1-arg send_chunk callables (embedders,
+        # tests): only a callback that accepts event_id gets the SSE
+        # resume ids; others receive plain chunks
+        import inspect
+        try:
+            _params = inspect.signature(send_chunk).parameters
+            _wants_id = ("event_id" in _params
+                         or any(p.kind == inspect.Parameter.VAR_KEYWORD
+                                for p in _params.values()))
+        except (TypeError, ValueError):
+            _wants_id = False
+        raw_send = send_chunk
+
+        def send_chunk(obj, event_id=None):
+            if _wants_id and event_id is not None:
+                raw_send(obj, event_id=event_id)
+            else:
+                raw_send(obj)
+
         try:
             h = self.engine.chat(messages, stream=stream, **kw)
         except (QueueFullError, ShedError) as e:
             raise QueueFull(getattr(e, "retry_after", 1.0),
                             shed=isinstance(e, ShedError))
+        except DrainingError as e:
+            raise QueueFull(e.retry_after, draining=True)
         if on_start is not None:
             on_start()
         lp_cursor = 0
         eos_ids = self.engine.config.eos_token_ids
+        r = h._req
+        # SSE event ids are ABSOLUTE token positions: tokens replayed
+        # from previous process generations count, so a client's
+        # Last-Event-ID survives any number of restarts
+        id_base = len(getattr(r, "replayed_tokens", ()) or ())
+        sent_id = id_base   # high-water mark of delivered event ids
 
         def chunk_lp(upto):
             nonlocal lp_cursor
             if not want_lp:
                 return None
-            r = h._req
             entries = [
                 lp_entry(r.out_tokens[i], r.out_logprobs[i], r.out_top[i])
                 for i in range(lp_cursor, upto)
@@ -239,6 +302,29 @@ class ApiServer:
             lp_cursor = upto
             return entries
 
+        if getattr(h, "attached", False):
+            # idempotent reconnect: replay the held/journaled suffix
+            # after the client's Last-Event-ID as ONE chunk (its id is
+            # the absolute position it covers up to), then fall into
+            # the live loop — queued deltas at or below the replayed
+            # high-water mark are dropped there, so the client sees
+            # exactly the missing tokens: no duplicates, no gaps.
+            history = (list(getattr(r, "replayed_tokens", ()) or ())
+                       + list(r.out_tokens))
+            start_at = max(0, int(last_event_id or 0))
+            suffix = [t for t in history[start_at:]
+                      if t not in eos_ids]
+            try:
+                if suffix:
+                    send_chunk(chunk_response(
+                        self.engine.tokenizer.decode(suffix),
+                        self.model_name, rid=rid),
+                        event_id=len(history))
+            except OSError:
+                return DISCONNECTED   # reconnect died mid-replay
+            sent_id = max(start_at, len(history))
+            lp_cursor = max(0, sent_id - id_base)
+
         while True:
             try:
                 delta, final, n_done = deltas.get(timeout=0.5)
@@ -246,16 +332,28 @@ class ApiServer:
                 if h._req.done.is_set() and deltas.empty():
                     break  # request ended without a final delta (error path)
                 continue
-            if delta:
+            ev_id = id_base + n_done
+            if delta and ev_id > sent_id:
                 try:
                     send_chunk(chunk_response(delta, self.model_name,
                                               rid=rid,
-                                              logprobs=chunk_lp(n_done)))
+                                              logprobs=chunk_lp(n_done)),
+                               event_id=ev_id)
+                    sent_id = ev_id
                 except OSError:
                     # client disconnected mid-stream: free the slot now
-                    # instead of decoding to max_tokens for nobody
-                    log.info("client disconnected; cancelling request")
-                    self.engine.cancel(h)
+                    # instead of decoding to max_tokens for nobody —
+                    # UNLESS the request is idempotency-keyed: the
+                    # client told us it will reconnect and resume, so
+                    # the stream keeps decoding for its return
+                    if r.idempotency_key is None:
+                        log.info("client disconnected; cancelling "
+                                 "request")
+                        self.engine.cancel(h)
+                    else:
+                        log.info("client disconnected; rid=%d keeps "
+                                 "decoding for an idempotent reconnect",
+                                 r.rid)
                     return DISCONNECTED
             if final:
                 break
@@ -281,7 +379,8 @@ class ApiServer:
             send_chunk(chunk_response("", self.model_name,
                                       finish="stop", rid=rid,
                                       logprobs=chunk_lp(
-                                          len(h._req.out_tokens))))
+                                          len(h._req.out_tokens))),
+                       event_id=id_base + len(h._req.out_tokens))
         except OSError:
             return DISCONNECTED  # request already complete; just stop
         return None
@@ -328,6 +427,19 @@ class ApiServer:
                 # crash-recovery / reset-storm-breaker state (+ the
                 # armed fault plan, when chaos is on)
                 out["recovery"] = self.engine.recovery_state()
+            if getattr(self.engine, "_draining", False):
+                # drain in flight (POST /api/v1/drain / SIGTERM):
+                # admissions 429 while this block counts down the
+                # remaining in-flight work
+                ds = self.engine.drain_state()
+                out["draining"] = True
+                out["drain"] = ds
+            jnl = getattr(self.engine, "_journal", None)
+            if jnl is not None:
+                # write-ahead journal state (--journal): appended
+                # bytes/records, fsync mode, whether the sink failed
+                # open, and the last replay's outcome
+                out["journal"] = jnl.state()
             slo = getattr(self.engine, "slo", None)
             if slo is not None:
                 # per-class targets, rolling attainment and goodput
@@ -375,6 +487,57 @@ class ApiServer:
         return {"switched": bool(switched),
                 "config": self.engine.current_config().to_dict(),
                 "epoch": self.engine.config_epoch}
+
+    def drain(self, body: dict) -> dict:
+        """POST /api/v1/drain {"timeout_s": N?}: graceful shutdown.
+        Closes admissions immediately (new submits get 429 + the
+        computed drain ETA as Retry-After), lets in-flight work finish
+        for up to timeout_s (default 30), then snapshots whatever
+        remains (--checkpoint) or leaves it journaled (--journal),
+        stops the engine and shuts the HTTP server down cleanly.
+        Responds immediately with the drain state; idempotent — a
+        second POST reports progress without rearming."""
+        if self.engine is None:
+            raise ValueError("engine-less serving has no drain "
+                             "(requests serialise on the generation "
+                             "lock; stop the process instead)")
+        timeout_s = body.get("timeout_s", 30.0)
+        if (not isinstance(timeout_s, (int, float))
+                or isinstance(timeout_s, bool) or timeout_s <= 0):
+            raise ValueError("timeout_s must be a positive number")
+        st = self.engine.begin_drain()
+        with self._drain_lock:
+            if self._drain_thread is None:
+                self._drain_thread = threading.Thread(
+                    target=self._drain_then_exit,
+                    args=(float(timeout_s),), daemon=True,
+                    name="cake-drain")
+                self._drain_thread.start()
+        return st
+
+    def _drain_then_exit(self, timeout_s: float) -> None:
+        """Drain-thread body: wait for the queue and the in-flight set
+        to empty (bounded), then run the shared shutdown tail."""
+        eng = self.engine
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = eng.drain_state()
+            if st["pending_requests"] == 0 and st["queue_depth"] == 0:
+                break
+            time.sleep(0.05)
+        else:
+            log.warning("drain: timeout after %.1fs with %d request(s) "
+                        "still in flight (snapshotted/journaled for "
+                        "the next start where armed)", timeout_s,
+                        eng.drain_state()["pending_requests"])
+        shutdown = self._shutdown
+        if shutdown is not None:
+            shutdown()
+        else:
+            # standalone ApiServer (no start() wiring, e.g. tests):
+            # stop the engine; post-drain submits then raise the typed
+            # reset error instead of hanging
+            eng.stop()
 
     def _engine_retry_after(self, priority=None) -> float:
         """Honest Retry-After for a transient engine reset: the shed
@@ -687,15 +850,20 @@ DISCONNECTED = object()
 
 
 class QueueFull(Exception):
-    """Admission rejected: queue full, or load-shed (shed=True).
-    retry_after seconds ride the HTTP 429 Retry-After header — computed
-    from the measured service rate when shedding is on (sched/shed.py),
-    a 1s floor otherwise."""
+    """Admission rejected: queue full, load-shed (shed=True), or the
+    server is draining (draining=True — POST /api/v1/drain or SIGTERM
+    in flight). retry_after seconds ride the HTTP 429 Retry-After
+    header — computed from the measured service rate when shedding is
+    on (sched/shed.py), from the drain ETA when draining, a 1s floor
+    otherwise."""
 
-    def __init__(self, retry_after: float = 1.0, shed: bool = False):
-        super().__init__("request shed" if shed else "queue full")
+    def __init__(self, retry_after: float = 1.0, shed: bool = False,
+                 draining: bool = False):
+        super().__init__("server draining" if draining
+                         else "request shed" if shed else "queue full")
         self.retry_after = retry_after
         self.shed = shed
+        self.draining = draining
 
 
 def make_handler(api: ApiServer):
@@ -859,6 +1027,17 @@ def make_handler(api: ApiServer):
                     log.exception("profile capture failed")
                     return self._json(
                         500, {"error": f"{type(e).__name__}: {e}"})
+            if self.path == "/api/v1/drain":
+                # dispatches before the health gate below: draining a
+                # FAILED server is exactly how an operator evacuates it
+                try:
+                    return self._json(200, api.drain(body))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    log.exception("drain failed")
+                    return self._json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
             # after the body read: responding early would leave unread
             # body bytes desyncing this keep-alive connection
             if api.health_state is not None and api.health_state.failed:
@@ -896,10 +1075,13 @@ def make_handler(api: ApiServer):
                     return  # headers already gone; just drop the connection
                 # 429 + an HONEST Retry-After: computed seconds until
                 # the backlog drains inside the class SLO at the
-                # measured service rate (sched/shed.py), not a
-                # hardcoded constant — for shed AND queue-full alike
+                # measured service rate (sched/shed.py) or the drain
+                # completes (engine.drain_state), not a hardcoded
+                # constant — for shed, queue-full and draining alike
                 self._retry_json(429, e.retry_after, {
-                    "error": ("request shed: server saturated for "
+                    "error": ("server draining: admissions are closed"
+                              if getattr(e, "draining", False)
+                              else "request shed: server saturated for "
                               "this priority class" if e.shed
                               else "queue full"),
                 })
@@ -939,8 +1121,29 @@ def make_handler(api: ApiServer):
             hdr = self.headers.get("x-cake-priority")
             if hdr is not None and body.get("priority") is None:
                 body["priority"] = hdr
+            # durable serving (serve/journal.py): a retried submit
+            # carrying the same x-cake-idempotency-key attaches to the
+            # existing stream instead of double-admitting; on a
+            # streaming reconnect, Last-Event-ID (the standard SSE
+            # resume header — the absolute token id of the last event
+            # the client saw) replays exactly the missing suffix
+            idem_key = self.headers.get("x-cake-idempotency-key")
+            last_id = self.headers.get("Last-Event-ID")
+            if last_id is not None:
+                try:
+                    last_id = int(last_id)
+                except ValueError:
+                    raise ValueError(
+                        f"Last-Event-ID must be an integer event id, "
+                        f"got {last_id!r}")
+                if idem_key is None:
+                    raise ValueError(
+                        "Last-Event-ID resume requires "
+                        "x-cake-idempotency-key (the key names the "
+                        "stream across reconnects and restarts)")
             if not body.get("stream"):
-                return self._json(200, api.chat(body))
+                return self._json(200, api.chat(
+                    body, idempotency_key=idem_key))
             self._stream_started = False
 
             def on_start():
@@ -952,14 +1155,21 @@ def make_handler(api: ApiServer):
                 self.end_headers()
                 self._stream_started = True
 
-            def send_chunk(obj: dict):
-                payload = f"data: {json.dumps(obj)}\n\n".encode()
+            def send_chunk(obj: dict, event_id=None):
+                # the `id:` field makes the stream resumable: it is the
+                # absolute token position this event covers up to, and
+                # a reconnect echoes it back as Last-Event-ID
+                head = (f"id: {int(event_id)}\n"
+                        if event_id is not None else "")
+                payload = f"{head}data: {json.dumps(obj)}\n\n".encode()
                 self.wfile.write(hex(len(payload))[2:].encode() + b"\r\n")
                 self.wfile.write(payload + b"\r\n")
                 self.wfile.flush()
 
             outcome = api.chat(body, send_chunk=send_chunk,
-                               on_start=on_start)
+                               on_start=on_start,
+                               idempotency_key=idem_key,
+                               last_event_id=last_id)
             if outcome is DISCONNECTED:
                 # handled disconnect: the socket is dead, writing the
                 # trailer would only manufacture an error traceback
@@ -1011,7 +1221,9 @@ def start(master, address: str = "127.0.0.1:10128",
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
 
-    if checkpoint_path and engine is not None:
+    journal_armed = (engine is not None
+                     and getattr(engine, "_journal", None) is not None)
+    if engine is not None and (checkpoint_path or journal_armed):
         import os
 
         from cake_tpu.serve import checkpoint as ckpt
@@ -1020,11 +1232,42 @@ def start(master, address: str = "127.0.0.1:10128",
         # engine error) checkpoints in-flight requests BEFORE failing
         # them (engine._fail_all), so a cluster restart resumes them.
         # The weight digest is computed NOW, while the mesh is healthy —
-        # at fail time the device stream may be wedged
-        engine.snapshot_path = checkpoint_path
+        # at fail time the device stream may be wedged (and the
+        # journal's generation header wants it warm for the same
+        # reason)
+        if checkpoint_path:
+            engine.snapshot_path = checkpoint_path
         ckpt.warm_fingerprint(engine)
 
-        if os.path.exists(checkpoint_path):
+        if journal_armed:
+            from cake_tpu.serve import journal as jr
+            try:
+                # cold-restart recovery: checkpoint base + journal
+                # replay, resubmitted through the fold path — every
+                # non-retired stream a kill -9 interrupted completes
+                # (greedy: token-identical at f32 KV)
+                handles, _ = jr.recover(
+                    engine, checkpoint_path=checkpoint_path,
+                    strict=True)
+                if handles:
+                    log.info("journal replay resubmitted %d in-flight "
+                             "request(s)", len(handles))
+            except Exception as e:  # noqa: BLE001
+                # a fingerprint mismatch / unreadable state must not
+                # crash-loop startup; sideline the evidence so the
+                # next save starts clean
+                jpath = engine._journal.path
+                for p in (checkpoint_path, jpath,
+                          jpath + ".replaying"):
+                    if p and os.path.exists(p):
+                        try:
+                            os.replace(p, p + ".invalid")
+                        except OSError:
+                            pass
+                log.warning("journal/checkpoint replay failed (%s); "
+                            "sidelined to *.invalid and starting with "
+                            "an empty engine", e)
+        elif checkpoint_path and os.path.exists(checkpoint_path):
             try:
                 # strict: a fingerprint mismatch (e.g. different weights
                 # with identical shapes) must NOT silently replay tokens —
@@ -1045,26 +1288,45 @@ def start(master, address: str = "127.0.0.1:10128",
                 log.warning("checkpoint restore failed (%s); moved to %s "
                             "and starting with an empty engine", e, bad)
 
+    if engine is not None:
         done = threading.Event()
 
         def save_and_exit(*_sig):
             if done.is_set():
                 return
             done.set()
-            # order matters: stop the engine FIRST (post-stop submits from
-            # handler threads raise instead of racing the snapshot), then
-            # snapshot, then tear down HTTP. shutdown() must run on a
-            # helper thread — called from the serve_forever thread (the
-            # block=True signal path) it deadlocks.
+            # order matters: close admissions FIRST (new submits 429
+            # with the drain ETA instead of racing the stop), then
+            # stop the engine (post-stop submits raise the typed reset
+            # error), then snapshot, then tear down HTTP. shutdown()
+            # must run on a helper thread — called from the
+            # serve_forever thread (the block=True signal path) it
+            # deadlocks.
+            try:
+                engine.begin_drain()
+            except Exception:  # noqa: BLE001
+                pass
             engine.stop()
-            # keep-or-save decision lives in the engine (shutdown_save),
-            # under the same lock as the pre-fail writer: a pre-fail
-            # snapshot written by THIS process is authoritative and
-            # kept; a checkpoint consumed by this process's restore is
-            # overwritten so completed resumes don't replay forever
-            engine.shutdown_save(checkpoint_path)
+            if checkpoint_path:
+                # keep-or-save decision lives in the engine
+                # (shutdown_save), under the same lock as the pre-fail
+                # writer: a pre-fail snapshot written by THIS process
+                # is authoritative and kept; a checkpoint consumed by
+                # this process's restore is overwritten so completed
+                # resumes don't replay forever. (With --journal, the
+                # write also truncates the journal — the handshake
+                # keeping the two restart sources disjoint.)
+                engine.shutdown_save(checkpoint_path)
+            elif not journal_armed:
+                # nothing will resume these after restart: release any
+                # still-open waiters with the typed reset error
+                # instead of letting them hang until process death
+                from cake_tpu.serve.errors import EngineResetError
+                engine._fail_all(EngineResetError(
+                    "server stopped while this request was in flight"))
             threading.Thread(target=httpd.shutdown, daemon=True).start()
 
+        api._shutdown = save_and_exit
         try:
             import signal
 

@@ -249,6 +249,21 @@ class Args:
     # auto (JSON: {"version": 1, "regimes": [{"max_offered_rps": ...,
     # "config": {...}}, ...]}; cake_tpu/autotune/search.py)
     autotune_policy: Optional[str] = None
+    # --journal PATH: write-ahead request journal (serve/journal.py) —
+    # one record per admission, one per emitted-token batch, retire
+    # tombstones. On startup the journal (plus the --checkpoint base
+    # when both are set) replays every non-retired request through the
+    # fold-tokens-into-prompt path, so a hard process death (SIGKILL,
+    # OOM-kill, power) between snapshots loses no stream; greedy
+    # continuations are token-identical at f32 KV. Composes with
+    # idempotency keys + SSE Last-Event-ID resume so clients re-attach
+    # across the restart.
+    journal: Optional[str] = None
+    # --journal-fsync {never,batch,always}: journal durability —
+    # "never" flushes per line (process death loses nothing, machine
+    # death may lose recent records), "batch" (default) fsyncs once
+    # per engine iteration, "always" fsyncs every append
+    journal_fsync: str = "batch"
     # --telemetry-export / --no-telemetry-export: fleet telemetry
     # federation (obs/federation.py) — every non-coordinator process
     # ships its metrics / event-bus events / step summaries / applied
@@ -315,6 +330,10 @@ class Args:
             # silently injects nothing is worse than no chaos run)
             from cake_tpu.faults import FaultPlan
             FaultPlan.parse(self.fault_plan)
+        if self.journal_fsync not in ("never", "batch", "always"):
+            raise ValueError(
+                f"unsupported journal_fsync '{self.journal_fsync}' "
+                "(choose never, batch or always)")
         if self.slo_targets:
             # same discipline as --fault-plan: a malformed SLO spec is
             # a loud startup error, not a serving run silently

@@ -371,6 +371,16 @@ def main(argv=None) -> int:
             "--kv-host-pages has no effect without --kv-pages: the "
             "host tier spills paged KV pool pages (cake_tpu/kv)")
 
+    if (getattr(args, "journal_fsync", "batch") != "batch"
+            and not getattr(args, "journal", None)):
+        # same discipline: the fsync mode tunes the journal's
+        # durability barrier, and without --journal there is no
+        # journal to fsync
+        logging.getLogger(__name__).warning(
+            "--journal-fsync has no effect without --journal: it "
+            "tunes the write-ahead request journal's durability "
+            "barrier (serve/journal.py)")
+
     if args.mode == "worker":
         print(
             "cake-tpu runs the whole topology as one SPMD program over the "
@@ -467,6 +477,13 @@ def main(argv=None) -> int:
             "--fault-plan / --recovery apply to engine serving "
             "(--api); one-shot generation dispatches no engine steps "
             "to inject into or recover")
+    if getattr(args, "journal", None):
+        # the write-ahead request journal records engine admissions
+        # and emitted-token batches; a one-shot generation admits
+        # nothing through the engine — mirror the --step-log warning
+        logging.getLogger(__name__).warning(
+            "--journal applies to engine serving (--api); one-shot "
+            "generation journals nothing and replays nothing")
 
     if args.model_type.value == "image":
         count = [0]

@@ -12,12 +12,12 @@ See plan.py for the spec grammar and injector.py for runtime semantics.
 
 from cake_tpu.faults.injector import FaultInjector, build_injector
 from cake_tpu.faults.plan import (
-    ERRORS, SITES, TRIGGERS, FaultPlan, FaultRule, InjectedFault,
-    InjectedOOM, InjectedTransient, InjectedWedge,
+    ABORT_EXIT_CODE, ERRORS, SITES, TRIGGERS, FaultPlan, FaultRule,
+    InjectedFault, InjectedOOM, InjectedTransient, InjectedWedge,
 )
 
 __all__ = [
-    "ERRORS", "SITES", "TRIGGERS",
+    "ABORT_EXIT_CODE", "ERRORS", "SITES", "TRIGGERS",
     "FaultInjector", "FaultPlan", "FaultRule",
     "InjectedFault", "InjectedOOM", "InjectedTransient", "InjectedWedge",
     "build_injector",

@@ -24,7 +24,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from cake_tpu.faults.plan import (
-    FaultPlan, FaultRule, InjectedOOM, InjectedTransient, InjectedWedge,
+    ABORT_EXIT_CODE, FaultPlan, FaultRule, InjectedOOM,
+    InjectedTransient, InjectedWedge,
 )
 from cake_tpu.obs import metrics as obs_metrics
 
@@ -136,6 +137,18 @@ class FaultInjector:
                                 kind=fire.rule.error, call=call,
                                 step=step)
         kind = fire.rule.error
+        if kind == "abort":
+            # staged kill -9: die NOW, with no atexit/flush courtesy —
+            # only bytes already written to the OS survive, which is
+            # exactly the state a crash drill must recover from. The
+            # event/metric above may be lost with the process; the log
+            # line below is best-effort evidence for the drill driver.
+            import logging
+            import os
+            logging.getLogger(__name__).error(
+                "injected abort at %s (call %d, step %s): os._exit(%d)",
+                site, call, step, ABORT_EXIT_CODE)
+            os._exit(ABORT_EXIT_CODE)
         if kind == "oom":
             raise InjectedOOM(site)
         if kind == "wedge":

@@ -21,6 +21,9 @@ Grammar (rules separated by ``;``, fields inside a rule by ``:``)::
              | 'oom'         a simulated RESOURCE_EXHAUSTED
              | 'wedge'       hold the calling thread for `secs`, then
                              raise (a hung device/tunnel, compressed)
+             | 'abort'       hard process death via os._exit
+                             (ABORT_EXIT_CODE) — a staged kill -9 for
+                             restart/journal-replay crash drills
     option := 'times=N'      total injections this rule may perform (1)
              | 'match_len=N' only calls whose context carries
                              n_tokens == N match (content-keyed faults:
@@ -50,16 +53,27 @@ SITES = frozenset({
     "host_tier.fetch",    # device -> host KV page spill
     "host_tier.install",  # host -> device KV page restore
     "pager.alloc",        # page-pool allocation
+    "journal.append",     # write-ahead journal record append
+    "journal.fsync",      # journal durability barrier (fsync)
+    "journal.replay",     # startup journal replay (serve/journal.py)
 })
 
 TRIGGERS = ("nth", "step", "p", "always")
-ERRORS = ("transient", "oom", "wedge")
+ERRORS = ("transient", "oom", "wedge", "abort")
+
+# `abort` kills the PROCESS (os._exit — no atexit, no flushes beyond
+# what already hit the OS): the in-tree way to stage a kill -9 crash
+# drill. The distinctive exit code lets a drill driver (bench.py
+# --restart, tests) tell a planned abort from an organic death.
+ABORT_EXIT_CODE = 86
 
 # context each call site actually supplies. A rule keyed on context
 # its site never passes would parse cleanly and then never fire — a
 # silently-inert chaos plan, the exact failure mode the loud-parse
 # contract exists to prevent — so parsing rejects the combination.
-NO_STEP_SITES = frozenset({"control.publish", "control.recv"})
+NO_STEP_SITES = frozenset({"control.publish", "control.recv",
+                           "journal.append", "journal.fsync",
+                           "journal.replay"})
 MATCH_LEN_SITES = frozenset({"engine.prefill"})
 
 

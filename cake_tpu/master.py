@@ -297,13 +297,18 @@ class Master:
                 float(getattr(self.args, "telemetry_interval", 2.0)))
 
     def _fault_kwargs(self) -> dict:
-        """Fault-injection + crash-recovery knobs (--fault-plan /
-        --recovery), plumbed to every engine flavor; the engine warns
-        and keeps the legacy fail-all path where the resume fold does
-        not exist (speculative, windowed ctx+tail layouts)."""
+        """Fault-injection + crash-recovery + durability knobs
+        (--fault-plan / --recovery / --journal / --journal-fsync),
+        plumbed to every engine flavor; the engine warns and keeps the
+        legacy fail-all path where the resume fold does not exist
+        (speculative, windowed ctx+tail layouts — the journal still
+        records and replays there, through the same resume path
+        checkpoints use)."""
         return dict(
             fault_plan=getattr(self.args, "fault_plan", None),
             recovery=getattr(self.args, "recovery", None),
+            journal=getattr(self.args, "journal", None),
+            journal_fsync=getattr(self.args, "journal_fsync", "batch"),
         )
 
     # -- text ----------------------------------------------------------------

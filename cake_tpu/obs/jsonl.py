@@ -47,11 +47,14 @@ class JsonlAppender:
     def failed(self) -> bool:
         return self._failed
 
-    def append(self, obj: Dict) -> bool:
-        """Write one record as one line. Returns False when the writer
-        is disabled (a previous failure) or this write failed."""
+    def append(self, obj: Dict) -> int:
+        """Write one record as one line. Returns the bytes written
+        (line + newline — callers accounting journal growth need it
+        without re-serializing), or 0 when the writer is disabled (a
+        previous failure) or this write failed — so boolean tests
+        keep working."""
         if self._failed:
-            return False
+            return 0
         try:
             line = json.dumps(obj)
         except (TypeError, ValueError):
@@ -62,19 +65,39 @@ class JsonlAppender:
                 self._warned_unserializable = True
                 log.warning("jsonl: unserializable record(s) dropped "
                             "(%s); further drops are silent", self.path)
-            return False
+            return 0
         try:
             with self._lock:
                 if self._file is None:
                     self._file = open(self.path, "a")
                 self._file.write(line + "\n")
                 self._file.flush()
-            return True
+            return len(line) + 1
         except OSError:
             # one warning, then disable: a full disk must not turn every
             # record into a logged exception
             self._failed = True
             log.warning("jsonl log disabled: cannot write %s", self.path,
+                        exc_info=True)
+            return 0
+
+    def sync(self) -> bool:
+        """fsync the open file (no-op before the first append, or after
+        a failure). The durability knob behind the request journal's
+        --journal-fsync batch/always modes (serve/journal.py): append()
+        alone flushes to the OS, sync() makes it power-loss durable.
+        Returns False when the writer is disabled or the fsync failed."""
+        if self._failed:
+            return False
+        try:
+            with self._lock:
+                if self._file is None:
+                    return True
+                os.fsync(self._file.fileno())
+            return True
+        except OSError:
+            self._failed = True
+            log.warning("jsonl log disabled: cannot fsync %s", self.path,
                         exc_info=True)
             return False
 

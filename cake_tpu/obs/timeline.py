@@ -6,8 +6,9 @@ streams the repo already keeps into a single chronology:
 
   * the tracer's lifecycle spans (obs/tracing.py: admitted, queued,
     prefill, first_token, decode, preempted, requeued, kv_restored,
-    crash_recovered, reconfigured, retired/error/cancelled) — the
-    request's own state machine;
+    crash_recovered, reconfigured, replayed — a cold-restart
+    journal/checkpoint resume re-seeded this stream's history —
+    retired/error/cancelled) — the request's own state machine;
   * the event bus (obs/events.py: preempted, kv_spill, kv_restore,
     prefix_hit, recovered, poisoned, reconfigured, shed, ...) — what
     the other subsystems DID to it, with their context fields;

@@ -154,6 +154,15 @@ def snapshot_requests(engine) -> List[Dict]:
             "repeat_penalty": req.repeat_penalty,
             "finished": finished,
             "error": str(req.error) if req.error else None,
+            # durable-serving fields (serve/journal.py): the client's
+            # idempotency key survives the restart (a retried submit
+            # attaches instead of double-admitting), and `replayed`
+            # keeps the absolute stream coordinate — tokens generated
+            # in PREVIOUS process generations that are folded into
+            # prompt_ids already — so SSE event ids stay monotonic
+            # across any number of restarts
+            "idempotency_key": getattr(req, "idempotency_key", None),
+            "replayed": list(getattr(req, "replayed_tokens", ()) or ()),
         })
     return requests
 
@@ -187,14 +196,29 @@ def snapshot(engine, requests: Optional[List[Dict]] = None) -> Dict:
 
 
 def write(snap: Dict, path: str) -> None:
-    """Write a snapshot to `path` (atomic replace). The tmp name is
-    thread-unique: a pre-fail snapshot (health-monitor thread) and a
-    shutdown save can overlap in one process."""
+    """Write a snapshot to `path` (atomic: tmp + fsync + rename). The
+    fsync BEFORE the rename is load-bearing: without it a power loss
+    can leave the rename durable but the data not — a zero-length or
+    torn file under the final name, exactly the torn-JSON startup
+    crash this function exists to prevent. A crash at any point leaves
+    either the previous good checkpoint or the complete new one. The
+    tmp name is thread-unique: a pre-fail snapshot (health-monitor
+    thread) and a shutdown save can overlap in one process."""
     import uuid
     tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(snap, f)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        # never leave the tmp litter behind a failed save
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     log.info("checkpoint: %d request(s) -> %s", len(snap["requests"]), path)
 
 
@@ -205,9 +229,26 @@ def save(engine, path: str) -> Dict:
     return snap
 
 
-def load(path: str) -> Dict:
-    with open(path) as f:
-        snap = json.load(f)
+def load(path: str) -> Optional[Dict]:
+    """Load a snapshot. A corrupt or truncated file — the signature of
+    a crash mid-write before write() grew its fsync, or disk rot —
+    degrades to None ("no checkpoint") with a LOUD warning instead of
+    raising: a bad checkpoint must never crash-loop server startup,
+    and the atomic writer means the previous good state was already
+    lost, so starting empty is the only option anyway. A version
+    mismatch still raises (the file is intact — the operator should
+    see an explicit version error, and api.start sidelines it)."""
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("checkpoint %s is unreadable/corrupt (%s); starting "
+                    "with no checkpoint", path, e)
+        return None
+    if not isinstance(snap, dict):
+        log.warning("checkpoint %s is not a snapshot object; starting "
+                    "with no checkpoint", path)
+        return None
     if snap.get("version") != SNAPSHOT_VERSION:
         raise ValueError(
             f"unsupported snapshot version {snap.get('version')!r}")
@@ -274,11 +315,28 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
                 prime_penalty_tokens=rec.get("penalty_context",
                                              rec["out_tokens"]),
                 priority=rec.get("priority"),
+                # durable serving (serve/journal.py): the key
+                # re-registers so a client retry attaches, and the
+                # replay coordinate marks which of `ids` are folded
+                # PRIOR generations — SSE event ids and the journal's
+                # original-stream re-seed both count from it
+                idempotency_key=rec.get("idempotency_key"),
+                replay_tokens=(list(rec.get("replayed") or ())
+                               + list(rec["out_tokens"])),
             )
             tracer = getattr(engine, "tracer", None)
             if tracer is not None:
                 tracer.annotate(h._req.rid, resumed=True,
                                 truncated=truncated)
+                if rec["out_tokens"] or rec.get("replayed"):
+                    # the explain timeline names the resume: this
+                    # stream's earlier history was replayed from a
+                    # snapshot/journal, not generated in this epoch
+                    tracer.span(h._req.rid, "replayed",
+                                journal_rid=rec.get("rid"),
+                                generated=(len(rec["out_tokens"])
+                                           + len(rec.get("replayed")
+                                                 or ())))
             resumed_c.inc()
             handles.append(h)
         except Exception as e:  # noqa: BLE001 — one bad record must not
@@ -294,5 +352,9 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
 
 
 def restore(engine, path: str, strict: bool = True) -> Tuple[List, List[Dict]]:
-    """load + resume in one call."""
-    return resume(engine, load(path), strict=strict)
+    """load + resume in one call; a corrupt/unreadable snapshot (load
+    -> None) restores nothing."""
+    snap = load(path)
+    if snap is None:
+        return [], []
+    return resume(engine, snap, strict=strict)

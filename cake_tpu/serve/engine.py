@@ -28,7 +28,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, List, Optional, Sequence
@@ -174,6 +174,15 @@ class _Request:
     # failed steps this request was dispatched in; reset to 0 by any
     # step that emits for it, quarantined as poison at the budget
     crash_count: int = 0
+    # durable serving (serve/journal.py): the client's idempotency key
+    # (x-cake-idempotency-key — a retried submit with the same key
+    # attaches instead of double-admitting), and the tokens generated
+    # in PREVIOUS process generations that a cold-restart replay folded
+    # into prompt_ids. The request's ABSOLUTE stream position — SSE
+    # event ids, journal emit counts — is len(replayed_tokens) +
+    # len(out_tokens).
+    idempotency_key: Optional[str] = None
+    replayed_tokens: List[int] = field(default_factory=list)
     out_tokens: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
     # per emitted token: [(alt_token_id, alt_logprob), ...] top-N list
@@ -336,6 +345,8 @@ class InferenceEngine:
         fault_plan: Optional[str] = None,
         recovery: Optional[bool] = None,
         recovery_config=None,
+        journal: Optional[str] = None,
+        journal_fsync: str = "batch",
         autotune: Optional[str] = None,
         autotune_policy=None,
         autotune_config=None,
@@ -648,6 +659,30 @@ class InferenceEngine:
         # the recovery path implicates on failure (overwritten by every
         # dispatch; a failure before any dispatch implicates nobody)
         self._implicated: Sequence = ()
+        # durable serving (serve/journal.py): --journal arms a
+        # write-ahead request journal — admissions, emitted-token
+        # batches and retire tombstones, replayed at cold restart so a
+        # kill -9 loses no stream. None without the flag: every call
+        # site below is one attribute test (the --fault-plan injector
+        # discipline, pinned by a source-scan test).
+        self._journal = None
+        if journal:
+            from cake_tpu.serve.journal import RequestJournal
+            self._journal = RequestJournal(journal, fsync=journal_fsync)
+            self._journal.faults = self._faults
+            self._journal.owner = self
+            log.info("request journal armed: %s (fsync=%s)", journal,
+                     journal_fsync)
+        # idempotent-submit registry: key -> live rid, and a bounded
+        # ring of FINISHED keyed requests so a retry that lands after
+        # retirement still attaches to the completed stream instead of
+        # re-running it. Both guarded by _rid_lock.
+        self._idem_live: dict = {}
+        self._idem_done: "OrderedDict" = OrderedDict()
+        self._idem_done_cap = 128
+        # drain mode (POST /api/v1/drain, SIGTERM): admissions refuse
+        # with a typed 429 while in-flight work finishes or snapshots
+        self._draining = False
         self._shed = ShedController(self._sched_cfg) if shed else None
         # rank of a page-starved higher-class admission awaiting a
         # victim; consumed at the TOP of the next engine iteration (a
@@ -835,6 +870,11 @@ class InferenceEngine:
         self.flight.close()
         if self.events is not None:
             self.events.close()
+        if self._journal is not None:
+            # flush buffered emit batches + fsync: a clean stop's
+            # journal is durable (the snapshot handshake may then
+            # truncate it — shutdown_save)
+            self._journal.close()
         if self._control is not None:
             # published only after the engine thread has exited, so no
             # step op can be ordered after the stop on the wire
@@ -1005,6 +1045,8 @@ class InferenceEngine:
         prime_penalty_tokens: Optional[Sequence[int]] = None,
         want_top_logprobs: bool = False,
         priority: Optional[str] = None,
+        idempotency_key: Optional[str] = None,
+        replay_tokens: Optional[Sequence[int]] = None,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; a callback with attribute
@@ -1019,6 +1061,16 @@ class InferenceEngine:
             # restart away from serving this same request)
             from cake_tpu.serve.errors import EngineResetError
             raise EngineResetError("engine stopped")
+        if idempotency_key is not None:
+            # BEFORE validation: the key names an EXISTING stream, so a
+            # retry attaches regardless of what its (possibly
+            # re-rendered, possibly oversized) payload looks like — the
+            # original admission already validated the real work. The
+            # re-check under the switch lock below closes the race of
+            # two concurrent first-submits with one key.
+            prev = self._attach_idempotent(idempotency_key, stream)
+            if prev is not None:
+                return prev
         # validate the class EVERY time (unknown values must 400 at the
         # API); the class only orders admission when the SLO scheduler
         # is on, but it always labels the TTFT histogram
@@ -1060,6 +1112,13 @@ class InferenceEngine:
                 raise ValueError(
                     "logprobs are unavailable in speculative serving "
                     "(accepted drafts are not sampled step-by-step)")
+        replayed = list(replay_tokens or ())
+        if replayed and ids[-len(replayed):] != replayed:
+            # the replay coordinate must be a literal suffix of the
+            # folded prompt (checkpoint/journal resume constructs it
+            # that way); anything else would corrupt SSE event ids
+            raise ValueError(
+                "replay_tokens must be the folded suffix of prompt_ids")
         req = _Request(
             rid=rid, prompt_ids=ids, max_new_tokens=max_new,
             temperature=eff_temp if eff_temp is not None else 0.0,
@@ -1072,6 +1131,8 @@ class InferenceEngine:
             prime_tokens=list(prime_penalty_tokens or ()),
             want_top=want_top_logprobs,
             priority=cls,
+            idempotency_key=idempotency_key,
+            replayed_tokens=replayed,
         )
         # admission critical section: a LIVE config switch
         # (_reconfigure_sync) replaces the pool/pager/scheduler on the
@@ -1080,6 +1141,22 @@ class InferenceEngine:
         # switch (never half-registered across the scheduler swap, and
         # the pool bound below always reads one consistent pool)
         with self._switch_lock:
+            if idempotency_key is not None:
+                # the race-closing RE-check: two concurrent first
+                # submits with one key serialize here — the loser
+                # attaches to the winner's admission instead of
+                # double-admitting
+                prev = self._attach_idempotent(idempotency_key, stream)
+                if prev is not None:
+                    return prev
+            if self._draining and replay_tokens is None:
+                # admissions are closed while the drain finishes or
+                # snapshots in-flight work; replay resubmits (the
+                # recovery path) must still land — they ARE the
+                # in-flight work. Typed so the API maps it to 429 +
+                # the computed seconds until the drain completes.
+                from cake_tpu.serve.errors import DrainingError
+                raise DrainingError(self._drain_eta_s())
             if self.paged and (self._pager.pages_for(len(ids) + max_new)
                                > self.cache.n_pages):
                 # can NEVER be admitted (need exceeds the whole pool) —
@@ -1114,6 +1191,14 @@ class InferenceEngine:
                                         else None))
                     raise ShedError(cls, dec.retry_after_s,
                                     est_wait_s=dec.est_wait_s)
+            if self._journal is not None:
+                # WRITE-AHEAD for real: the admit record must land
+                # before the request becomes visible to the engine
+                # thread (registered below) — otherwise an emit batch
+                # could flush ahead of its admit and replay would drop
+                # the orphaned tokens. A scheduler refusal below
+                # compensates with a tombstone.
+                self._journal.note_admit(req, self.config_epoch)
             # register BEFORE scheduler.submit: the engine thread may
             # plan the rid immediately, and _do_prefill treats an
             # unknown rid as cancelled
@@ -1132,14 +1217,141 @@ class InferenceEngine:
             if not ok:
                 self._requests.pop(rid, None)
                 self.tracer.drop(rid)
+                if self._journal is not None:
+                    # the admit was journaled write-ahead; the refused
+                    # admission must not replay after a restart
+                    self._journal.note_retire(rid, "cancelled")
                 retry = 1.0
                 if self._shed is not None:
                     retry = self._shed.estimate_retry_after(
                         cls, self.scheduler.queue_depth)
                 raise QueueFullError(retry_after=retry)
+            if idempotency_key is not None:
+                with self._rid_lock:
+                    self._idem_live[idempotency_key] = rid
         self._set_queue_gauges()
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
+
+    # -- durable serving: idempotency, drain, journal seams --------------
+
+    def _attach_idempotent(self, key: str,
+                           stream=None) -> Optional[RequestHandle]:
+        """A submit whose idempotency key matches a live or finished
+        request attaches to THAT stream (safe client retry — across
+        reconnects AND restarts, since the journal replay re-registers
+        keys). The new stream callback replaces the dead client's;
+        tokens the swap races are covered by the reconnect replay
+        (api/server.py dedupes by absolute event id). None = no match
+        (admit normally)."""
+        with self._rid_lock:
+            rid = self._idem_live.get(key)
+            req = self._requests.get(rid) if rid is not None else None
+            if req is None:
+                req = self._idem_done.get(key)
+            if req is None:
+                return None
+        if not req.done.is_set() and stream is not None:
+            req.stream = stream
+            req.stream_wants_count = bool(
+                getattr(stream, "wants_count", False))
+        h = RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
+        h.attached = True
+        return h
+
+    def seed_finished_idempotent(self, rec: dict) -> None:
+        """Journal replay (serve/journal.recover): a request that
+        COMPLETED before the crash but whose client may still retry —
+        synthesize its finished state into the idempotency registry so
+        the retry attaches to the transcript instead of re-running it.
+        Errored/cancelled records are not seeded (a fresh retry is the
+        right outcome for those)."""
+        key = rec.get("idempotency_key")
+        if not key or rec.get("error") \
+                or rec.get("status") == "cancelled":
+            return
+        out = list(rec.get("out_tokens") or ())
+        req = _Request(
+            rid=int(rec.get("rid") or 0),
+            prompt_ids=list(rec.get("prompt_ids") or ()),
+            max_new_tokens=int(rec.get("max_new")
+                               or rec.get("remaining") or 0),
+            temperature=rec.get("temperature", 0.0),
+            top_p=rec.get("top_p", 1.0),
+            repeat_penalty=rec.get("repeat_penalty", 1.0),
+            stream=None,
+            priority=rec.get("priority", "standard"),
+            idempotency_key=key,
+            replayed_tokens=list(rec.get("replayed") or ()),
+        )
+        req.out_tokens = out
+        # the journal stores no logprobs; a replayed transcript serves
+        # text/ids only (documented limitation)
+        req.out_logprobs = [0.0] * len(out)
+        req.out_top = [[] for _ in out]
+        req.done.set()
+        with self._rid_lock:
+            self._idem_done[key] = req
+            while len(self._idem_done) > self._idem_done_cap:
+                self._idem_done.popitem(last=False)
+
+    def _journal_retire(self, req: _Request, status: str,
+                        error: Optional[str] = None) -> None:
+        """THE terminal side-channel shared by every retire seam
+        (_emit finish, recovered-finish, force-finish, drop, fail-all,
+        cancel, requeue-exhausted): write the journal tombstone and
+        transition the idempotency registry — a completed keyed
+        request stays attachable in the bounded done-ring, a
+        failed/cancelled one frees its key so a retry re-runs."""
+        if self._journal is not None:
+            self._journal.note_retire(req.rid, status, error=error)
+        key = req.idempotency_key
+        if key is None:
+            return
+        with self._rid_lock:
+            if self._idem_live.get(key) == req.rid:
+                del self._idem_live[key]
+            if status == "retired":
+                self._idem_done[key] = req
+                while len(self._idem_done) > self._idem_done_cap:
+                    self._idem_done.popitem(last=False)
+
+    def begin_drain(self) -> dict:
+        """Close admissions (new submits raise the typed DrainingError
+        the API maps to 429 + computed Retry-After) while in-flight
+        work keeps decoding. POST /api/v1/drain and the SIGTERM paths
+        call this before finishing/snapshotting and exiting clean."""
+        if not self._draining:
+            log.info("drain: admissions closed (%d in flight)",
+                     len(self._requests))
+        self._draining = True
+        self._wake.set()
+        return self.drain_state()
+
+    def _drain_eta_s(self) -> float:
+        """Computed seconds until the drain completes: remaining
+        budgeted tokens over the measured decode rate (capped; a 1s
+        floor matches the API's Retry-After ceil)."""
+        remaining = sum(max(0, r.max_new_tokens - len(r.out_tokens))
+                        for r in list(self._requests.values())
+                        if not r.done.is_set())
+        if remaining == 0:
+            return 1.0
+        rate = self.stats.decode_tokens_per_s
+        if rate > 0:
+            return min(600.0, max(1.0, remaining / rate))
+        return min(600.0, max(1.0, remaining / 8.0))
+
+    def drain_state(self) -> dict:
+        """/api/v1/health `draining` block + the drain response."""
+        pending = sum(1 for r in list(self._requests.values())
+                      if not r.done.is_set())
+        out = {"draining": self._draining,
+               "pending_requests": pending,
+               "queue_depth": self.queue_depth}
+        if self._draining:
+            out["eta_s"] = round(self._drain_eta_s(), 3)
+        return out
 
     def register_prefix(self, prefix_ids: Sequence[int]) -> int:
         """Precompute and cache the KV of a shared prompt head (e.g. the
@@ -1559,6 +1771,7 @@ class InferenceEngine:
                 self._slot_req[req.slot] = None
                 self._release_slot_pages(req.slot)
             req.finish_t = time.perf_counter()
+            self._journal_retire(req, "cancelled")
             self.tracer.finish(rid, "cancelled",
                                output_tokens=len(req.out_tokens))
             req.done.set()
@@ -1653,6 +1866,13 @@ class InferenceEngine:
                 # site, planning/admission code) must implicate nobody
                 # — not this iteration's requests
                 self._implicated = ()
+                if self._journal is not None:
+                    # one emit record per request touched this
+                    # iteration (+ the batch-mode fsync barrier), then
+                    # the size-triggered compaction check — both here,
+                    # between iterations, where the registry is stable
+                    self._journal.flush()
+                    self._journal.maybe_compact(self)
             except Exception as e:  # noqa: BLE001
                 log.exception("engine iteration failed")
                 # capture the request records FIRST (cheap, pure
@@ -1917,6 +2137,7 @@ class InferenceEngine:
         if self._host_tier is not None:
             self._host_tier.drop(("victim", req.rid))
         self._requests.pop(req.rid, None)
+        self._journal_retire(req, "error", error=str(err))
         if poison_reason is not None:
             self.stats.poisoned += 1
             _POISON_REQUESTS.labels(reason=poison_reason).inc()
@@ -1952,6 +2173,7 @@ class InferenceEngine:
             # measured service rate must count it, or post-recovery
             # Retry-After estimates inflate
             self._shed.observe_retire()
+        self._journal_retire(req, "retired")
         self.tracer.finish(req.rid, "retired",
                            output_tokens=len(req.out_tokens))
         req.done.set()
@@ -3142,6 +3364,7 @@ class InferenceEngine:
                 self._page_blocked_rid = None
             if self._host_tier is not None:
                 self._host_tier.drop(("victim", req.rid))
+            self._journal_retire(req, "error", error=str(req.error))
             self.tracer.finish(req.rid, "error", error=str(req.error))
             req.done.set()
         else:
@@ -3901,6 +4124,7 @@ class InferenceEngine:
         if self._shed is not None:
             self._shed.observe_retire()
         self.stats.requests_completed += 1
+        self._journal_retire(req, "retired")
         self.tracer.finish(req.rid, "retired",
                            output_tokens=len(req.out_tokens))
         if req.stream is not None:
@@ -4294,6 +4518,13 @@ class InferenceEngine:
             # a step that emits for this request succeeded: the crash
             # implication is no longer CONSECUTIVE — forgiven
             req.crash_count = 0
+        if self._journal is not None:
+            # buffered; one emit record per (request, iteration) lands
+            # at the run loop's flush. The count is ABSOLUTE (replayed
+            # prior generations included) — the SSE event-id coordinate
+            self._journal.note_emit(
+                req.rid, token_id,
+                len(req.replayed_tokens) + len(req.out_tokens))
         self.stats.tokens_generated += 1
         eos = token_id in self.config.eos_token_ids
         hit_cap = (self._pos[req.slot] + 1 >= self.max_seq_len)
@@ -4319,6 +4550,7 @@ class InferenceEngine:
             self.stats.requests_completed += 1
             if self._shed is not None:
                 self._shed.observe_retire()
+            self._journal_retire(req, "retired")
             self.tracer.finish(req.rid, "retired",
                                output_tokens=len(req.out_tokens))
             req.done.set()
@@ -4357,6 +4589,7 @@ class InferenceEngine:
                     self._slot_req[req.slot] = None
                     self._release_slot_pages(req.slot)
                 self._requests.pop(rid, None)
+                self._journal_retire(req, "error", error=str(err))
                 self.tracer.finish(rid, "error", error=str(err),
                                    output_tokens=len(req.out_tokens))
                 req.done.set()
@@ -4376,6 +4609,11 @@ class InferenceEngine:
                 log.info("keeping pre-fail snapshot at %s", path)
                 return
             checkpoint.write(checkpoint.snapshot(self), path)
+            if self._journal is not None:
+                # compaction handshake: the snapshot now owns every
+                # journaled record — truncating keeps the two restart
+                # sources disjoint (serve/journal.py)
+                self._journal.truncate("checkpoint")
 
     def _snapshot_before_fail(self, requests=None) -> None:
         """Best-effort pre-fail checkpoint (no-op unless api.start armed
@@ -4422,6 +4660,10 @@ class InferenceEngine:
                 return   # nothing worth preserving
             checkpoint.write(snap, path)
             self._prefail_written = True
+            if self._journal is not None:
+                # same handshake as shutdown_save: the pre-fail
+                # snapshot supersedes the journaled history
+                self._journal.truncate("checkpoint")
             log.info("pre-fail snapshot saved to %s", path)
         except Exception:  # noqa: BLE001
             log.exception("pre-fail snapshot failed")

@@ -60,6 +60,19 @@ class SwitchInFlightError(RuntimeError):
     POST /api/v1/autotune. Retry after the current switch lands."""
 
 
+class DrainingError(Exception):
+    """Admission refused because the server is draining (POST
+    /api/v1/drain or a SIGTERM in flight). NOT an EngineRequestError —
+    the request was never admitted; the API maps it to HTTP 429 with
+    the computed seconds until the drain completes as Retry-After (by
+    then this process is gone and a balancer should have moved on,
+    but an honest number beats a constant)."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("server draining: admissions are closed")
+        self.retry_after = retry_after
+
+
 def as_engine_error(err: Exception) -> EngineRequestError:
     """Wrap an arbitrary step failure in the typed, retryable-flagged
     form clients see — idempotent for already-typed errors."""

@@ -308,6 +308,25 @@ def test_fleet_smoke_tier_ships_batches_with_finite_lag():
     assert result["fleet_host_live"] is True
 
 
+@pytest.mark.slow  # oracle + killed child + replay engine -> slow lane
+def test_restart_smoke_tier_loses_nothing_and_matches_tokens():
+    """The --restart tier's acceptance contract: the journaled child
+    died by the PLANNED abort (a staged kill -9, not an organic
+    crash), the replay resubmitted every interrupted stream, ZERO
+    requests were lost, at f32 KV the recovered greedy streams came
+    back token-identical to the uninterrupted oracle, and the tier
+    measured a real RTO."""
+    result = _run_tier("restart_tiny")
+    assert result["unit"] == "s" and result["value"] > 0
+    assert result["restart_journal_records"] > 0
+    assert result["restart_replayed"] > 0
+    assert result["restart_lost"] == 0
+    assert result["restart_tokens_match"] is True
+    assert result["restart_journal_findings"] == 0
+    assert result["restart_replay_s"] is not None
+    assert 0 < result["restart_replay_s"] <= result["value"]
+
+
 @pytest.mark.slow  # two engine phases under injected chaos -> slow lane
 def test_chaos_smoke_tier_recovers_without_losing_requests():
     """The --chaos tier's acceptance contract: the injected transient

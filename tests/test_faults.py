@@ -86,8 +86,10 @@ def test_parse_none_and_empty_mean_no_plan():
     # would parse cleanly and then never fire — rejected loudly
     ("control.publish:step=100:transient", "no engine step counter"),
     ("control.recv:step=5:oom", "no engine step counter"),
+    ("journal.append:step=5:abort", "no engine step counter"),
     ("engine.decode:nth=5:transient:match_len=96", "n_tokens"),
     ("pager.alloc:always:oom:match_len=4", "n_tokens"),
+    ("journal.fsync:always:transient:match_len=4", "n_tokens"),
 ])
 def test_parse_rejects_malformed_rules(spec, frag):
     with pytest.raises(ValueError, match=frag):
@@ -229,7 +231,9 @@ def test_disabled_plane_call_sites_are_attribute_guarded():
     rule scan."""
     import cake_tpu.serve.control as control
     import cake_tpu.serve.engine as engine
-    for mod, attr in ((engine, "_faults"), (control, "faults")):
+    import cake_tpu.serve.journal as journal
+    for mod, attr in ((engine, "_faults"), (control, "faults"),
+                      (journal, "faults")):
         src = open(mod.__file__).readlines()
         needles = [i for i, ln in enumerate(src)
                    if f"{attr}.check(" in ln]
@@ -243,12 +247,13 @@ def test_disabled_plane_call_sites_are_attribute_guarded():
 
 
 def test_sites_frozen_and_documented():
-    # the engine/control/kv call sites reference these names by string;
-    # renaming one without updating SITES must fail loudly here
+    # the engine/control/kv/journal call sites reference these names by
+    # string; renaming one without updating SITES must fail loudly here
     assert {"engine.step", "engine.prefill", "engine.decode",
             "engine.mixed", "control.publish", "control.recv",
-            "host_tier.fetch", "host_tier.install",
-            "pager.alloc"} == set(SITES)
+            "host_tier.fetch", "host_tier.install", "pager.alloc",
+            "journal.append", "journal.fsync",
+            "journal.replay"} == set(SITES)
 
 
 # -- engine acceptance: recovery is transparent ------------------------------

@@ -115,6 +115,7 @@ def test_step_metric_families_documented_in_readme():
     import cake_tpu.obs.steps  # noqa: F401 — registers the families
     import cake_tpu.parallel.health  # noqa: F401 — cake_heartbeat_*
     import cake_tpu.serve.engine  # noqa: F401 — recovery families
+    import cake_tpu.serve.journal  # noqa: F401 — cake_journal_*
     from cake_tpu.obs import metrics as m
     readme = (TOOLS.parent / "README.md").read_text()
     text = m.REGISTRY.render()

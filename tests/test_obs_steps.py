@@ -203,11 +203,14 @@ def test_step_log_jsonl_and_truncated_tail(tmp_path):
 
 def test_jsonl_appender_fail_open(tmp_path):
     ap = JsonlAppender(str(tmp_path))  # a DIRECTORY: open() fails
-    assert ap.append({"a": 1}) is False
+    # falsy on failure (0 — append reports bytes written so the
+    # request journal can account growth without re-serializing)
+    assert not ap.append({"a": 1})
     assert ap.failed
     ap.close()  # no-op, no raise
     good = JsonlAppender(str(tmp_path / "x.jsonl"))
-    assert good.append({"a": 1})
+    n = good.append({"a": 1})
+    assert n == len('{"a": 1}') + 1
     good.close()
     assert read_jsonl(str(tmp_path / "x.jsonl")) == [{"a": 1}]
 

@@ -90,7 +90,11 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # metrics + obs/federation.py telemetry
                        # federation + /api/v1/fleet gauges)
                        "cake_control_", "cake_telemetry_",
-                       "cake_fleet_")
+                       "cake_fleet_",
+                       # durable serving (serve/journal.py): the
+                       # write-ahead request journal's append/fsync/
+                       # replay families
+                       "cake_journal_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to
