@@ -1,0 +1,27 @@
+"""cakelint — AST-level concurrency & dispatch-discipline analyzer.
+
+Static half: tools/cakelint.py drives `analyze()` over cake_tpu/ with
+four checkers (affinity, guards, locks, jit-purity) plus a shared
+suppression/baseline core — see cake_tpu/analysis/core.py for the
+in-source declaration vocabulary. Runtime half: the
+`@engine_thread_only` decorator (annotations.py), armed by
+CAKE_THREAD_ASSERTS, backstops the affinity rule dynamically.
+
+This package import stays cheap (stdlib only) because serving code
+imports the decorator from here.
+"""
+
+from cake_tpu.analysis.annotations import (  # noqa: F401
+    ASSERT_ENV, WrongThreadError, engine_thread_only,
+    thread_asserts_enabled,
+)
+
+__all__ = ["engine_thread_only", "WrongThreadError", "ASSERT_ENV",
+           "thread_asserts_enabled", "analyze"]
+
+
+def analyze(paths, rules=None, baseline=None):
+    """Lazy alias for cake_tpu.analysis.core.analyze (keeps ast/tokenize
+    out of the serving import path)."""
+    from cake_tpu.analysis import core
+    return core.analyze(paths, rules=rules, baseline=baseline)

@@ -1,0 +1,182 @@
+"""cakelint `jit-purity`: side-effect hygiene inside jitted step fns.
+
+A function is "jitted" when it is decorated `@jax.jit` / `@jit` /
+`@partial(jax.jit, ...)` (any partial spelling), or defined locally and
+wrapped as `name = jax.jit(fn)`. Under trace such a function runs ONCE
+per signature — host side effects in its body are retrace hazards the
+flight recorder (obs/steps.py) only catches after the fact:
+
+  * `self.X = ...` / `self.X += ...` — mutating Python state under
+    trace bakes the first trace's value in and silently diverges on
+    cache hits;
+  * `global` declarations (module-state mutation under trace);
+  * `time.*` / `random.*` / `np.random.*` calls — traced once, frozen
+    forever (use jax.random with a threaded key);
+  * `print(...)` — fires at trace time only; `jax.debug.print` is the
+    traced-aware spelling and is allowed.
+
+Nested functions handed to host-callback APIs (`jax.pure_callback`,
+`io_callback`, `jax.debug.callback`) are exempt: they execute on the
+host by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from cake_tpu.analysis.astutil import dotted, func_symbol
+from cake_tpu.analysis.core import Finding, Vocabulary
+
+RULE = "jit-purity"
+
+_PARTIAL_NAMES = {"partial", "_partial"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / _jax.jit / jit / pjit as a bare callable reference."""
+    chain = dotted(node)
+    return chain is not None and chain[-1] in ("jit", "pjit")
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+        fchain = dotted(dec.func)
+        if fchain and fchain[-1] in _PARTIAL_NAMES and dec.args:
+            return _is_jit_expr(dec.args[0])
+        # @jax.jit(...) called-decorator form
+        if _is_jit_expr(dec.func):
+            return True
+    return False
+
+
+def _callback_exempt_ids(fn: ast.AST) -> Set[int]:
+    """Subtrees passed to host-callback APIs."""
+    out: Set[int] = set()
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted(node.func)
+        if not chain or "callback" not in chain[-1]:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                out.add(id(arg))
+            elif isinstance(arg, ast.Name):
+                names.add(arg.id)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            out.add(id(node))
+    return out
+
+
+class _BodyChecker:
+    def __init__(self, path: str, symbol: str,
+                 findings: List[Finding]):
+        self.path = path
+        self.symbol = symbol
+        self.findings = findings
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            RULE, self.path, node.lineno, node.col_offset,
+            f"{msg} inside jitted {self.symbol} (runs at trace time "
+            "only; a cached signature replays the stale value)",
+            symbol=self.symbol))
+
+    def run(self, fn: ast.AST) -> None:
+        exempt = _callback_exempt_ids(fn)
+
+        def visit(node: ast.AST) -> None:
+            if id(node) in exempt:
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                # flatten tuple/list/starred unpacking so
+                # `self.n, out = f(x)` is seen like `self.n = ...`
+                flat = []
+                while targets:
+                    t = targets.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(t.elts)
+                    elif isinstance(t, ast.Starred):
+                        targets.append(t.value)
+                    else:
+                        flat.append(t)
+                for t in flat:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        if isinstance(base, ast.Attribute) \
+                                and isinstance(base.value, ast.Name) \
+                                and base.value.id == "self":
+                            self._flag(t, "mutation of self."
+                                          f"{base.attr}")
+                            break
+                        base = base.value
+            elif isinstance(node, ast.Global):
+                self._flag(node, "`global " + ", ".join(node.names)
+                           + "` mutation")
+            elif isinstance(node, ast.Call):
+                chain = dotted(node.func)
+                if chain:
+                    if chain == ("print",):
+                        self._flag(node, "print() call (use "
+                                         "jax.debug.print)")
+                    elif chain[0] == "time":
+                        self._flag(node, f"{'.'.join(chain)}() call")
+                    elif chain[0] == "random" or (
+                            len(chain) >= 2
+                            and chain[0] in ("np", "numpy")
+                            and chain[1] == "random"):
+                        self._flag(node, f"{'.'.join(chain)}() call "
+                                   "(thread a jax.random key instead)")
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body if not isinstance(fn, ast.Lambda) else [fn.body]:
+            visit(stmt)
+
+
+def _jitted_functions(tree: ast.Module):
+    """Yield (node, name) for every jitted def/lambda in the module."""
+    wrapped_names: Set[str] = set()
+    lambdas: List[Tuple[ast.Lambda, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                wrapped_names.add(arg.id)
+            elif isinstance(arg, ast.Lambda):
+                lambdas.append((arg, f"<lambda:{arg.lineno}>"))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list) \
+                    or node.name in wrapped_names:
+                yield node, node.name
+    for lam, name in lambdas:
+        yield lam, name
+
+
+def check(vocab: Vocabulary, units) -> Tuple[List[Finding], int]:
+    findings: List[Finding] = []
+    sites = 0
+    for unit in units:
+        # map defs to their classes for symbol names
+        cls_of = {}
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef):
+                for fn in node.body:
+                    cls_of[id(fn)] = node.name
+        for fn, name in _jitted_functions(unit.tree):
+            sites += 1
+            symbol = func_symbol(cls_of.get(id(fn)), name)
+            _BodyChecker(unit.path, symbol, findings).run(fn)
+    return findings, sites

@@ -63,6 +63,10 @@ class ApiServer:
     serialise on a generation lock (still an upgrade over the reference's
     silent RwLock, api/text.rs:67)."""
 
+    # cakelint guards discipline: the federation collector is optional
+    # (coordinator-with---telemetry-collect only)
+    OPTIONAL_PLANES = ("collector",)
+
     def __init__(self, master, model_name: str = "cake-tpu", engine=None,
                  health=None, collector=None):
         self.master = master

@@ -145,6 +145,10 @@ class AutotuneController:
     thread; ``state()`` is read by API handler threads — one lock
     covers the mutable window/log."""
 
+    # cakelint guards discipline: the one-shot rollback guard is only
+    # armed across a policy switch — every dotted use is None-guarded
+    OPTIONAL_PLANES = ("_guard",)
+
     def __init__(self, policy: PolicyTable, current: EngineConfig,
                  config: Optional[ControllerConfig] = None,
                  now_fn: Callable[[], float] = time.monotonic):

@@ -70,6 +70,8 @@ class FaultInjector:
     # every firing publishes a fault_injected event so chaos shows up
     # on the same timeline as what it broke
     events: Optional[object] = None
+    # cakelint guards discipline for the optional bus above
+    OPTIONAL_PLANES = ("events",)
 
     def __post_init__(self):
         self._lock = threading.Lock()

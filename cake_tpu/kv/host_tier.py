@@ -96,6 +96,9 @@ class SpilledPages:
 class HostTier:
     """LRU store of spilled KV pages, capacity-bounded in pages."""
 
+    # cakelint guards discipline: the event bus is an optional plane
+    OPTIONAL_PLANES = ("_events",)
+
     def __init__(self, capacity_pages: int, page_bytes: int = 0,
                  events=None):
         if capacity_pages < 1:
@@ -116,6 +119,11 @@ class HostTier:
         self._set_gauges()
 
     def _publish(self, type: str, key, entry: SpilledPages) -> None:
+        if self._events is None:
+            # belt+braces with the callers' own guards: the helper must
+            # hold the disabled-plane contract even for a future caller
+            # that forgets its guard (cakelint `guards` pins this)
+            return
         # ("victim", rid) keys link the event to its request; prefix
         # entries carry the pid as a field instead (no rid exists)
         rid = pid = None

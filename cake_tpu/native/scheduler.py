@@ -30,6 +30,14 @@ from cake_tpu.native import get_library
 class PyScheduler:
     """Pure-Python reference implementation (and toolchain-free fallback)."""
 
+    # cakelint lock discipline: the scheduler/shed/slo `_mu` leaf lock
+    # nests strictly inside the engine's locks (the engine calls
+    # scheduler methods while holding _switch_lock/_rid_lock, never the
+    # reverse), and nothing may block under it — it sits on every
+    # submit AND every engine iteration
+    LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock", "_mu")
+    NO_BLOCKING_UNDER = ("_rid_lock", "_mu")
+
     def __init__(self, max_slots: int, max_queue: int = 1024):
         if max_slots <= 0:
             raise ValueError("max_slots must be positive")

@@ -158,6 +158,10 @@ class TelemetryExporter:
     bus stamps its events with, or the collector's offset correction
     would corrupt remote event ordering instead of fixing it."""
 
+    # cakelint guards discipline: the event bus is optional (an
+    # engine-less follower exports metrics/health only)
+    OPTIONAL_PLANES = ("_events",)
+
     def __init__(self, address: str, host: str,
                  token: Optional[str] = None,
                  interval_s: float = 2.0, *,

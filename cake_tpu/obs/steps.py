@@ -170,10 +170,24 @@ def refresh_page_gauges(engine) -> None:
     if not getattr(engine, "paged", False):
         return
     try:
-        _m.gauge("cake_engine_kv_pages_total",
-                 "KV pages in the pool").set(engine.cache.n_pages)
-        _m.gauge("cake_engine_kv_pages_free",
-                 "KV pages currently free").set(engine._pager.free_pages)
+        # the pager is engine-thread state swapped wholesale by a live
+        # reconfigure; its declared lock (_switch_lock) pins one
+        # consistent pool for this scrape. NON-blocking on purpose: the
+        # watchdog and /metrics run through here, and a switch wedged
+        # on device work must never take the stall detector (or
+        # observability) down with it — on contention the gauges keep
+        # their last values for one scrape.
+        if engine._switch_lock.acquire(blocking=False):
+            try:
+                n_total = engine.cache.n_pages
+                # cakelint: skip[affinity] _switch_lock held via the non-blocking acquire above (the with-form the checker recognizes would block a wedged switch forever)
+                n_free = engine._pager.free_pages
+            finally:
+                engine._switch_lock.release()
+            _m.gauge("cake_engine_kv_pages_total",
+                     "KV pages in the pool").set(n_total)
+            _m.gauge("cake_engine_kv_pages_free",
+                     "KV pages currently free").set(n_free)
         # prefix sharing (serve/engine.py sets this at admission /
         # release; re-set at scrape so a restarted scraper converges
         # without waiting for the next admission)
@@ -415,6 +429,9 @@ class StepTelemetry:
     the accountant keys so engines with different configs cannot alias
     each other's signatures. peak_flops/hbm_bps override the
     device-kind tables (tests pin them for exact MFU math)."""
+
+    # cakelint guards discipline: the event bus is an optional plane
+    OPTIONAL_PLANES = ("_events",)
 
     def __init__(self, *, impl: str = "dense", capacity: int = 512,
                  log_path: Optional[str] = None,

@@ -119,6 +119,10 @@ class ControlServer:
     follower slot or receiving an op — so a rogue peer on the serving
     network can neither exhaust the slots nor observe prompt token ids."""
 
+    # cakelint guards discipline: every dotted use of the injector must
+    # be `is not None`-guarded (disabled plane = one attribute test)
+    OPTIONAL_PLANES = ("faults",)
+
     def __init__(self, n_followers: int, host: str = "",
                  port: int = 0, accept_timeout: float = 120.0,
                  token: Optional[str] = None):
@@ -295,6 +299,9 @@ class ControlClient:
     """Follower side: connect (with retries — the coordinator may still
     be binding), present the shared token, and iterate ops until the
     stream closes."""
+
+    # cakelint guards discipline, same as ControlServer
+    OPTIONAL_PLANES = ("faults",)
 
     def __init__(self, address: str, connect_timeout: float = 120.0,
                  token: Optional[str] = None):

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cake_tpu.analysis import engine_thread_only
 from cake_tpu.models.chat import History, Message
 from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.obs import steps as obs_steps
@@ -299,6 +300,53 @@ class EngineStats:
 
 class InferenceEngine:
     """Slot-based continuous batching over one shared batched KV cache."""
+
+    # -- cakelint vocabulary (tools/cakelint.py, cake_tpu/analysis/) ----
+    # Machine-checked threading discipline; the prose invariants these
+    # encode used to live only in comments here and in two source-scan
+    # tests. ENGINE_THREAD_ATTRS is single-writer engine-thread state:
+    # the mapped lock (if any) is the ONE lock whose holder may touch
+    # the attr from a handler thread; None means only
+    # _run_on_engine_thread reaches it. HANDLER_THREAD_METHODS are the
+    # entry points that run on HTTP handler / scrape / signal / health
+    # threads and are statically checked against that table.
+    ENGINE_THREAD_ATTRS = {
+        # paged pool + page-table row state (the pager swaps wholesale
+        # during a live reconfigure — admission reads its bounds under
+        # the same lock the switch holds)
+        "_pager": "_switch_lock",
+        # slot -> request mapping and the per-slot device mirrors:
+        # written only between device steps by the engine loop
+        "_slot_req": None,
+        "_mixed_pending": None,
+        "_implicated": None,
+        "_last_jit": None,
+        "_page_starved": None,
+        "_pending_page_preempt": None,
+        # handler<->engine mailboxes: strictly lock-guarded
+        "_cancel_q": "_rid_lock",
+        "_cmd_q": "_rid_lock",
+    }
+    HANDLER_THREAD_METHODS = (
+        "submit", "chat", "cancel", "stop", "begin_drain",
+        "drain_state", "_drain_eta_s", "register_prefix",
+        "unregister_prefix", "_auto_register_system",
+        "_attach_idempotent", "seed_finished_idempotent",
+        "reconfigure", "request_timeline", "recovery_state",
+        "autotune_state", "current_config", "_set_queue_gauges",
+        "shutdown_save", "_snapshot_before_fail", "_fail_all",
+    )
+    # optional subsystems (None = disabled plane): every dotted use
+    # must sit under an `is not None` guard so a disabled plane costs
+    # exactly one attribute read per site (the --fault-plan injector
+    # discipline, generalized)
+    OPTIONAL_PLANES = ("_faults", "events", "_journal", "_shed",
+                       "_control", "_host_tier", "_autotuner",
+                       "telemetry")
+    # the only legal nesting order; _rid_lock sits on the submit/emit
+    # hot path, so nothing may block under it
+    LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
+    NO_BLOCKING_UNDER = ("_rid_lock",)
 
     def __init__(
         self,
@@ -865,6 +913,7 @@ class InferenceEngine:
         # catch cancellations enqueued after the engine thread's final
         # drain but before join returned (the cancel() dead-thread check
         # handles calls arriving later than this)
+        # cakelint: skip[affinity] engine thread joined above: inline teardown is single-threaded; the runtime assert checks liveness
         self._drain_cancellations()
         self.tracer.close()
         self.flight.close()
@@ -1399,7 +1448,11 @@ class InferenceEngine:
                 f"prefix length {len(ids)} leaves no room for a suffix "
                 f"(max_seq_len {self.max_seq_len})")
         if self.paged:
-            P = self._pager.page_size
+            with self._switch_lock:
+                # a live reconfigure swaps the pager wholesale; the
+                # switch lock pins one consistent page size for this
+                # validation (same discipline as submit's pool bound)
+                P = self._pager.page_size
             if len(ids) < P:
                 raise ValueError(
                     f"paged prefix sharing is page-granular: the prefix "
@@ -1412,6 +1465,7 @@ class InferenceEngine:
             if self._thread is not None and self._thread.is_alive():
                 return self._run_on_engine_thread(
                     lambda: self._register_prefix_paged(ids))
+            # cakelint: skip[affinity] pre-start direct drive: no engine thread exists to race; the runtime assert enforces this
             return self._register_prefix_paged(ids)
         if self._control is not None:
             return self._run_on_engine_thread(
@@ -1434,6 +1488,7 @@ class InferenceEngine:
         log.info("registered prefix %d: %d tokens", pid, P)
         return pid
 
+    @engine_thread_only
     def _register_prefix_paged(self, ids: List[int]) -> int:
         """Paged registration: round the prefix DOWN to a page boundary
         (remainder ids join every request's suffix — no copy-on-write of
@@ -1526,6 +1581,7 @@ class InferenceEngine:
             raise box["error"]
         return box["result"]
 
+    @engine_thread_only
     def _drain_commands(self) -> None:
         with self._rid_lock:
             cmds, self._cmd_q = self._cmd_q, []
@@ -1579,11 +1635,13 @@ class InferenceEngine:
                 lambda: self._unregister_paged_sync(prefix_id))
             return
         if self.paged:
+            # cakelint: skip[affinity] reached only with the engine thread not running (checked above); runtime assert backstops
             self._unregister_paged_sync(prefix_id)
             return
         with self._rid_lock:
             self._prefixes.pop(prefix_id, None)
 
+    @engine_thread_only
     def _unregister_paged_sync(self, prefix_id: int) -> None:
         with self._rid_lock:
             entry = self._prefixes.pop(prefix_id, None)
@@ -1671,7 +1729,8 @@ class InferenceEngine:
             if self.paged:
                 # page-granular sharing: a head shorter than one page
                 # has nothing to share (register_prefix would refuse)
-                min_len = max(min_len, self._pager.page_size)
+                with self._switch_lock:
+                    min_len = max(min_len, self._pager.page_size)
             if len(ids) < min_len or len(ids) >= self.max_seq_len - 1:
                 # unqualifying head: keep a negative sentinel so the
                 # membership check short-circuits every later request
@@ -1703,6 +1762,7 @@ class InferenceEngine:
         self._wake.set()
         if self._stop.is_set() and (self._thread is None
                                     or not self._thread.is_alive()):
+            # cakelint: skip[affinity] shutdown window: the engine thread has exited (checked above); runtime assert backstops
             self._drain_cancellations()
 
     def _host_attention_pending(self) -> bool:
@@ -1755,6 +1815,7 @@ class InferenceEngine:
         with self._rid_lock:
             return bool(self._cmd_q)
 
+    @engine_thread_only
     def _drain_cancellations(self) -> None:
         with self._rid_lock:
             rids, self._cancel_q = self._cancel_q, []
@@ -2328,7 +2389,7 @@ class InferenceEngine:
         if kv_host_pages is not None:
             from cake_tpu.kv import HostTier
             from cake_tpu.kv.quantized_pool import page_bytes
-            self._host_tier = HostTier(
+            tier = HostTier(
                 kv_host_pages,
                 page_bytes=page_bytes(
                     self.config, kv_page_size,
@@ -2336,9 +2397,10 @@ class InferenceEngine:
                 # spill/restore publish on the engine's event bus
                 # (present on first setup AND on a reconfigure rebuild)
                 events=getattr(self, "events", None))
+            self._host_tier = tier
             log.info("kv host tier: %d pages (%.1f MiB capacity)",
                      kv_host_pages,
-                     kv_host_pages * self._host_tier.page_bytes / 2**20)
+                     kv_host_pages * tier.page_bytes / 2**20)
 
     def _capture_cache_identity(self) -> None:
         """Record the cache's placement/dtype so post-error and
@@ -2397,6 +2459,7 @@ class InferenceEngine:
             slots=self.max_slots,
             decode_scan=self._decode_scan,
             kv_pages=self.cache.n_pages if self.paged else None,
+            # cakelint: skip[affinity] taking _switch_lock here would invert the declared order: checkpoint.snapshot calls this under _ckpt_lock (shutdown_save/_snapshot_before_fail); the unlocked read tolerates a torn value mid-switch (informational health/snapshot metadata only)
             kv_page_size=(self._pager.page_size if self.paged else 128),
             kv_dtype=kv_dtype,
             mixed_batch="on" if self._mixed else "off",
@@ -2434,8 +2497,10 @@ class InferenceEngine:
             finally:
                 with self._switch_lock:
                     self._switch_inflight = False
+        # cakelint: skip[affinity] engine thread not running, or this IS the engine thread (autotune tick); runtime assert distinguishes
         return self._reconfigure_sync(cfg, reason)
 
+    @engine_thread_only
     def _reconfigure_sync(self, new, reason: str) -> bool:
         """Engine-thread body of reconfigure() — between iterations
         only (no device work in flight, exactly the preemption
@@ -2800,6 +2865,7 @@ class InferenceEngine:
             queue_pressure=pressure() if pressure is not None else 0.0,
         )
 
+    @engine_thread_only
     def _autotune_tick(self) -> None:
         """Auto-mode controller drive, called from the engine loop
         between iterations: sample signals every interval, apply the
@@ -2989,6 +3055,7 @@ class InferenceEngine:
         for c, d in depths().items():
             _QUEUE_DEPTH.labels(c).set(d)
 
+    @engine_thread_only
     def _maybe_preempt(self) -> None:
         """Reclaim at most one decoding slot per iteration for a
         starved higher class: first for a page-starved admission noted
@@ -3187,14 +3254,14 @@ class InferenceEngine:
         # recompute): validated against the CURRENT admission shape —
         # a prefix evicted/re-registered between spill and resume
         # changes the row layout, and the stale entry must not restore
-        ent = (self._host_tier.peek(("victim", req.rid))
-               if self._host_tier is not None else None)
-        if ent is not None:
-            if (ent.n_prefix_tokens != n_prefix
-                    or ent.n_pages != len(pages)):
+        ent = None
+        if self._host_tier is not None:
+            ent = self._host_tier.peek(("victim", req.rid))
+            if ent is not None and (ent.n_prefix_tokens != n_prefix
+                                    or ent.n_pages != len(pages)):
                 self._host_tier.drop(("victim", req.rid))
                 ent = None
-            else:
+            elif ent is not None:
                 # counted as a restore; _restore_victim installs it
                 ent = self._host_tier.pop(("victim", req.rid))
         if prefix_pages:
@@ -3484,6 +3551,7 @@ class InferenceEngine:
     # token after ~4 prefills instead of after the whole wave (p50 TTFT)
     PREFILL_FLUSH = 4
 
+    @engine_thread_only
     def _do_prefill_batch(self, prefill_plan) -> None:
         """Admit a wave of requests with one first-token fetch per
         PREFILL_FLUSH admissions: each group's prefills + first-token
@@ -3563,6 +3631,7 @@ class InferenceEngine:
             self._ring = self._ring.at[slot].set(jnp.asarray(row))
             self._steps[slot] = len(prime)
 
+    @engine_thread_only
     def _do_mixed(self, prefill_plan, decode_plan) -> None:
         """One engine iteration of token-level continuous batching:
         admissions map their pages and join the VERY NEXT device step
@@ -3987,6 +4056,7 @@ class InferenceEngine:
             self._last_jit = js
         return logits
 
+    @engine_thread_only
     def _do_decode_spec(self, decode_plan) -> None:
         """One propose-verify-accept round for ALL planned slots in ONE
         compiled program (speculative.spec_round_batched): batched
@@ -4138,6 +4208,7 @@ class InferenceEngine:
                 log.exception("stream callback failed rid=%d", req.rid)
         req.done.set()
 
+    @engine_thread_only
     def _do_decode(self, decode_plan) -> None:
         t0 = time.perf_counter()
         self._implicated = decode_plan
@@ -4580,19 +4651,30 @@ class InferenceEngine:
         with self._ckpt_lock:
             if snapshot:
                 self._snapshot_before_fail()
-            for rid, req in list(self._requests.items()):
-                req.error = err
-                self.scheduler.cancel(rid)
-                if self._host_tier is not None:
-                    self._host_tier.drop(("victim", rid))
-                if req.slot >= 0:
-                    self._slot_req[req.slot] = None
-                    self._release_slot_pages(req.slot)
-                self._requests.pop(rid, None)
-                self._journal_retire(req, "error", error=str(err))
-                self.tracer.finish(rid, "error", error=str(err),
-                                   output_tokens=len(req.out_tokens))
-                req.done.set()
+            # claim the registry under the lock (two racing _fail_all
+            # callers — health monitor + signal handler — each fail a
+            # disjoint set), but run the per-request teardown OUTSIDE
+            # it: _journal_retire takes _rid_lock, and the declared
+            # lock order (_rid_lock before _ckpt_lock) forbids
+            # acquiring it while _ckpt_lock is held
+            doomed = []
+            for rid in list(self._requests):
+                req = self._requests.pop(rid, None)
+                if req is not None:
+                    doomed.append((rid, req))
+        for rid, req in doomed:
+            req.error = err
+            self.scheduler.cancel(rid)
+            if self._host_tier is not None:
+                self._host_tier.drop(("victim", rid))
+            if req.slot >= 0:
+                # cakelint: skip[affinity] fatal path: the engine thread is wedged or has exited; cross-thread teardown is deliberate
+                self._slot_req[req.slot] = None
+                self._release_slot_pages(req.slot)
+            self._journal_retire(req, "error", error=str(err))
+            self.tracer.finish(rid, "error", error=str(err),
+                               output_tokens=len(req.out_tokens))
+            req.done.set()
 
     def shutdown_save(self, path: str) -> None:
         """Clean-shutdown checkpoint: save the live registry — UNLESS

@@ -123,6 +123,11 @@ class RequestJournal:
     through instead: chaos exercises the failure path deliberately.
     """
 
+    # cakelint guards discipline: the chaos plane is optional
+    # (attached by the engine after construction; None without a
+    # --fault-plan) — every dotted use needs `is not None`
+    OPTIONAL_PLANES = ("faults",)
+
     def __init__(self, path: str, fsync: str = "batch",
                  compact_bytes: int = DEFAULT_COMPACT_BYTES):
         if fsync not in FSYNC_MODES:

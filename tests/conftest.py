@@ -15,6 +15,13 @@ import os
 _ON_TPU = os.environ.get("CAKE_TESTS_TPU") == "1"
 if not _ON_TPU:
     os.environ["JAX_PLATFORMS"] = "cpu"
+# Arm the cakelint thread-affinity runtime asserts for the whole suite
+# (cake_tpu/analysis/annotations.py): @engine_thread_only methods raise
+# WrongThreadError on a cross-thread call while the engine thread is
+# alive. MUST be set before any cake_tpu import — the decorator reads
+# the flag once, at decoration time, so production (flag unset) pays
+# zero wrapper cost.
+os.environ.setdefault("CAKE_THREAD_ASSERTS", "1")
 # hermetic: never attempt HF-hub downloads from tests (zero-egress CI
 # would stall through network retries); cache hits still resolve
 os.environ.setdefault("HF_HUB_OFFLINE", "1")

@@ -1,0 +1,518 @@
+"""cakelint (cake_tpu/analysis + tools/cakelint.py) as a tier-1 gate.
+
+Four layers, mirroring tests/test_metrics_lint.py's linter-is-itself-
+tested pattern:
+
+  * fixture matrix per checker — a clean snippet passes, a seeded
+    violation fails, an inline suppression is honored;
+  * shared-core contracts — suppression grammar (reason required),
+    baseline round-trip, --json schema, exit codes;
+  * THE tree gate — `cakelint cake_tpu/` must be clean with every
+    checker provably live (nonzero checked sites), which is what keeps
+    the thread-affinity / optional-plane / lock-order / jit-purity
+    conventions machine-checked from here on;
+  * runtime backstop + regression tests for the violations the first
+    analyzer run surfaced on the real tree (the _fail_all lock-order
+    nest, the scrape-path pager touch, the host-tier publish helper).
+"""
+
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _analyze(paths, rules=None, baseline=None):
+    from cake_tpu.analysis import core
+    return core.analyze([str(p) for p in paths], rules=rules,
+                        baseline=baseline)
+
+
+def _cli():
+    spec = importlib.util.spec_from_file_location(
+        "cakelint_cli", ROOT / "tools" / "cakelint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- checker fixture matrix --------------------------------------------------
+
+AFFINITY_CLEAN = '''
+class Eng:
+    ENGINE_THREAD_ATTRS = {"_slot_req": None, "_pager": "_switch_lock"}
+    HANDLER_THREAD_METHODS = ("submit",)
+
+    @engine_thread_only
+    def _step(self):
+        return self._slot_req
+
+    def submit(self):
+        with self._switch_lock:
+            n = self._pager.free_pages
+        def job():
+            return self._step()
+        return self._run_on_engine_thread(job), n
+'''
+
+AFFINITY_BAD = '''
+class Eng:
+    ENGINE_THREAD_ATTRS = {"_slot_req": None, "_pager": "_switch_lock"}
+    HANDLER_THREAD_METHODS = ("submit",)
+
+    @engine_thread_only
+    def _step(self):
+        return 1
+
+    def submit(self):
+        self._step()
+        n = self._pager.free_pages
+        return self._slot_req
+'''
+
+AFFINITY_FOREIGN = '''
+def scrape(eng):
+    return eng._slot_req
+
+def scrape_locked(eng):
+    with eng._switch_lock:
+        return eng._pager.free_pages
+'''
+
+GUARDS_CLEAN = '''
+class Srv:
+    OPTIONAL_PLANES = ("_bus",)
+
+    def ok(self):
+        if self._bus is not None:
+            self._bus.publish("x")
+        y = self._bus.dump() if self._bus is not None else []
+        if self._bus is None:
+            return y
+        self._bus.close()
+        return self._bus is not None and self._bus.alive()
+'''
+
+GUARDS_BAD = '''
+class Srv:
+    OPTIONAL_PLANES = ("_bus",)
+
+    def bad(self):
+        self._bus.publish("x")
+'''
+
+LOCKS_DECL = '''
+class Eng:
+    LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
+    NO_BLOCKING_UNDER = ("_rid_lock",)
+'''
+
+LOCKS_CLEAN = LOCKS_DECL + '''
+    def ok(self):
+        with self._switch_lock:
+            with self._rid_lock:
+                pass
+        with self._rid_lock:
+            with self._ckpt_lock:
+                pass
+'''
+
+LOCKS_BAD = LOCKS_DECL + '''
+    def bad_order(self):
+        with self._rid_lock:
+            with self._switch_lock:
+                pass
+
+    def bad_block(self):
+        with self._rid_lock:
+            time.sleep(1)
+
+    def helper(self):
+        with self._rid_lock:
+            pass
+
+    def bad_call(self):
+        with self._rid_lock:
+            self.helper()
+'''
+
+PURITY_CLEAN = '''
+import jax
+from functools import partial
+
+@jax.jit
+def ok(x):
+    jax.debug.print("x {}", x)
+    return x + 1
+'''
+
+PURITY_BAD = '''
+import jax, time
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def bad(x, n):
+    print(x)
+    t = time.time()
+    return x
+
+class M:
+    @jax.jit
+    def step(self, x):
+        self.n = 1
+        return x
+'''
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    return p
+
+
+@pytest.mark.parametrize("rule,clean,bad,n_bad", [
+    ("affinity", AFFINITY_CLEAN, AFFINITY_BAD, 3),
+    ("guards", GUARDS_CLEAN, GUARDS_BAD, 1),
+    ("locks", LOCKS_CLEAN, LOCKS_BAD, 3),
+    ("jit-purity", PURITY_CLEAN, PURITY_BAD, 3),
+])
+def test_checker_matrix(tmp_path, rule, clean, bad, n_bad):
+    p = _write(tmp_path, "clean.py", clean)
+    rep = _analyze([p], rules=[rule])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"][rule] > 0, "clean fixture saw no sites"
+
+    p = _write(tmp_path, "bad.py", bad)
+    rep = _analyze([p], rules=[rule])
+    assert len(rep["findings"]) == n_bad, \
+        [f"{f.line}: {f.message}" for f in rep["findings"]]
+    assert all(f.rule == rule for f in rep["findings"])
+
+    # inline suppression (with a reason) silences each finding
+    lines = bad.splitlines()
+    for f in sorted(rep["findings"], key=lambda f: -f.line):
+        lines[f.line - 1] += f"  # cakelint: skip[{rule}] test reason"
+    p = _write(tmp_path, "suppressed.py", "\n".join(lines))
+    rep = _analyze([p], rules=[rule])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["suppressed"] == n_bad
+
+
+def test_affinity_closure_does_not_inherit_lock(tmp_path):
+    """A closure defined under a lock may run later on any thread: the
+    definition site's held locks must not leak into its body (the
+    false-negative a review pass caught on the first implementation)."""
+    src = '''
+class Eng:
+    ENGINE_THREAD_ATTRS = {"_pager": "_switch_lock"}
+    HANDLER_THREAD_METHODS = ("submit",)
+
+    def submit(self):
+        with self._switch_lock:
+            cb = lambda: self._pager.free_pages
+        def later():
+            return self._pager.free_pages
+        with self._switch_lock:
+            return cb, later
+'''
+    p = _write(tmp_path, "closure.py", src)
+    rep = _analyze([p], rules=["affinity"])
+    assert len(rep["findings"]) == 2, \
+        [f"{f.line}: {f.message}" for f in rep["findings"]]
+
+
+def test_purity_tuple_unpack_mutation_flagged(tmp_path):
+    """`self.n, out = f(x)` under trace is the same state-baking hazard
+    as `self.n = f(x)` — the unpacking spelling must not slip through."""
+    src = '''
+import jax
+
+class M:
+    @jax.jit
+    def step(self, x):
+        self.count, out = x, x + 1
+        return out
+'''
+    p = _write(tmp_path, "unpack.py", src)
+    rep = _analyze([p], rules=["jit-purity"])
+    assert len(rep["findings"]) == 1, \
+        [f.message for f in rep["findings"]]
+    assert "self.count" in rep["findings"][0].message
+
+
+def test_baseline_survives_path_spelling(tmp_path, monkeypatch):
+    """Fingerprints are content-addressed: a baseline written from one
+    path spelling must match a scan invoked with another."""
+    from cake_tpu.analysis import core
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "bad.py").write_text(GUARDS_BAD)
+    monkeypatch.chdir(tmp_path)
+    rep = _analyze(["pkg"], rules=["guards"])
+    assert len(rep["findings"]) == 1
+    core.write_baseline("b.json", rep["fingerprints"])
+    for spelling in ("./pkg", str(d), "pkg/bad.py"):
+        rep2 = _analyze([spelling], rules=["guards"],
+                        baseline=core.load_baseline("b.json"))
+        assert rep2["findings"] == [], spelling
+        assert rep2["baselined"] == 1, spelling
+
+
+def test_affinity_foreign_access(tmp_path):
+    """Cross-module accesses to declared engine-thread attrs are
+    flagged unless under the attr's declared lock on the same object."""
+    _write(tmp_path, "eng.py", AFFINITY_CLEAN)
+    _write(tmp_path, "scrape.py", AFFINITY_FOREIGN)
+    rep = _analyze([tmp_path], rules=["affinity"])
+    msgs = [f"{f.path}:{f.line}: {f.message}" for f in rep["findings"]]
+    assert len(rep["findings"]) == 1, msgs
+    assert "_slot_req" in rep["findings"][0].message
+
+
+def test_suppression_requires_reason(tmp_path):
+    p = _write(tmp_path, "s.py",
+               GUARDS_BAD.replace(
+                   'self._bus.publish("x")',
+                   'self._bus.publish("x")  # cakelint: skip[guards]'))
+    rep = _analyze([p])
+    assert any(f.rule == "bad-suppression" and "reason" in f.message
+               for f in rep["findings"])
+    # and the naked skip does NOT silence the underlying finding
+    assert any(f.rule == "guards" for f in rep["findings"])
+
+
+def test_suppression_unknown_rule_flagged(tmp_path):
+    p = _write(tmp_path, "s.py",
+               "x = 1  # cakelint: skip[bogus-rule] because\n")
+    rep = _analyze([p])
+    assert any(f.rule == "bad-suppression" and "bogus-rule" in f.message
+               for f in rep["findings"])
+
+
+def test_suppression_previous_line_form(tmp_path):
+    src = GUARDS_BAD.replace(
+        '        self._bus.publish("x")',
+        '        # cakelint: skip[guards] long reason on its own line\n'
+        '        self._bus.publish("x")')
+    p = _write(tmp_path, "s.py", src)
+    rep = _analyze([p], rules=["guards"])
+    assert rep["findings"] == []
+    assert rep["suppressed"] == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    from cake_tpu.analysis import core
+    p = _write(tmp_path, "bad.py", GUARDS_BAD)
+    rep = _analyze([p], rules=["guards"])
+    assert len(rep["findings"]) == 1
+    base = tmp_path / "baseline.json"
+    core.write_baseline(str(base), rep["fingerprints"])
+    rep2 = _analyze([p], rules=["guards"],
+                    baseline=core.load_baseline(str(base)))
+    assert rep2["findings"] == []
+    assert rep2["baselined"] == 1
+    # a NEW finding is not masked by the old baseline
+    p.write_text(GUARDS_BAD + "\n    def bad2(self):\n"
+                 "        self._bus.close()\n")
+    rep3 = _analyze([p], rules=["guards"],
+                    baseline=core.load_baseline(str(base)))
+    assert len(rep3["findings"]) == 1
+    assert rep3["findings"][0].symbol.endswith("bad2")
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = _write(tmp_path, "broken.py", "def f(:\n")
+    rep = _analyze([p])
+    assert any(f.rule == "parse" for f in rep["findings"])
+
+
+def test_cli_json_schema_and_exit_codes(tmp_path, capsys):
+    cli = _cli()
+    bad = _write(tmp_path, "bad.py", GUARDS_BAD)
+    assert cli.main([str(bad), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == 1
+    assert out["rc"] == 1
+    assert out["counts"] == {"guards": 1}
+    assert out["files"] == 1
+    assert set(out["sites"]) == {"affinity", "guards", "locks",
+                                 "jit-purity"}
+    f = out["findings"][0]
+    assert {"rule", "path", "line", "col", "message", "symbol",
+            "fingerprint"} <= set(f)
+
+    clean = _write(tmp_path, "clean.py", GUARDS_CLEAN)
+    assert cli.main([str(clean)]) == 0
+    capsys.readouterr()
+    assert cli.main([str(clean), "--rules", "nonsense"]) == 2
+    assert cli.main([str(tmp_path / "missing.py")]) == 2
+
+    # baseline flags round-trip through the CLI too
+    base = tmp_path / "b.json"
+    assert cli.main([str(bad), "--write-baseline", str(base)]) == 0
+    assert cli.main([str(bad), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+
+
+# -- THE tier-1 gate ---------------------------------------------------------
+
+def test_cakelint_tree_gate(capsys):
+    """`python tools/cakelint.py cake_tpu/ --json` exits 0: zero
+    unbaselined findings on the shipped tree, with every checker live
+    (nonzero sites — a checker that silently stopped seeing its
+    declarations would otherwise pass vacuously). The --json report is
+    printed so driver rounds can diff finding/site counts."""
+    cli = _cli()
+    rc = cli.main([str(ROOT / "cake_tpu"), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    # re-emit for the driver log, mirroring tools/check_t1_budget.py
+    print(json.dumps({"cakelint": {"files": out["files"],
+                                   "sites": out["sites"],
+                                   "counts": out["counts"],
+                                   "suppressed": out["suppressed"]}}))
+    assert rc == 0, out["findings"]
+    for rule, n in out["sites"].items():
+        assert n > 0, f"checker {rule} saw zero sites on cake_tpu/"
+    # every suppression in the tree carries a reason (a reasonless one
+    # is a bad-suppression finding, so rc==0 already implies this);
+    # keep the count visible as a drift tripwire
+    assert out["suppressed"] >= 5
+
+
+# -- runtime assertion backstop ----------------------------------------------
+
+def _engine(tiny_config, tiny_params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 32)
+    return InferenceEngine(
+        tiny_config, tiny_params,
+        ByteTokenizer(tiny_config.vocab_size),
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        cache_dtype=jnp.float32, **kw)
+
+
+def test_cross_thread_touch_raises(tiny_config, tiny_params):
+    """The dynamic backstop: with CAKE_THREAD_ASSERTS armed (tier-1
+    conftest), a deliberate cross-thread call into an
+    @engine_thread_only method raises while the engine thread is
+    alive, passes when routed through _run_on_engine_thread, and
+    passes again once the engine thread is gone (the inline-teardown
+    paths stop()/cancel() rely on)."""
+    from cake_tpu.analysis import WrongThreadError, thread_asserts_enabled
+    assert thread_asserts_enabled(), \
+        "tier-1 must run with CAKE_THREAD_ASSERTS armed (conftest)"
+    eng = _engine(tiny_config, tiny_params)
+    eng.start()
+    try:
+        with pytest.raises(WrongThreadError):
+            eng._drain_commands()
+        # the sanctioned route executes the same method engine-side
+        assert eng._run_on_engine_thread(
+            lambda: (eng._drain_commands(), "ran")[1]) == "ran"
+    finally:
+        eng.stop()
+    # post-join: single-threaded teardown is allowed
+    eng._drain_commands()
+
+
+# -- regression tests for the violations cakelint surfaced -------------------
+
+def test_fail_all_journals_outside_ckpt_lock(tiny_config, tiny_params,
+                                             tmp_path):
+    """The genuine lock-order nest the first cakelint run found:
+    _fail_all held _ckpt_lock across the per-request teardown, whose
+    _journal_retire acquires _rid_lock — backwards against the
+    declared _rid_lock -> _ckpt_lock order. Pin the fix: the journal
+    tombstone seam must run with _ckpt_lock free."""
+    eng = _engine(tiny_config, tiny_params,
+                  journal=str(tmp_path / "j.jsonl"))
+    h = eng.submit([5, 6, 7], max_new_tokens=4)
+    seen = []
+    orig = eng._journal.note_retire
+
+    def spying_retire(rid, status, error=None):
+        free = eng._ckpt_lock.acquire(blocking=False)
+        if free:
+            eng._ckpt_lock.release()
+        seen.append((rid, status, free))
+        return orig(rid, status, error=error)
+
+    eng._journal.note_retire = spying_retire
+    eng._fail_all(RuntimeError("boom"))
+    assert h.wait(1.0)
+    assert seen, "no journal tombstone written by _fail_all"
+    assert all(free for _rid, _st, free in seen), \
+        "_journal_retire ran while _fail_all still held _ckpt_lock"
+
+
+def test_scrape_page_gauges_respect_switch_lock_nonblocking(
+        tiny_config, tiny_params):
+    """The scrape-path fix: obs/steps.refresh_page_gauges reads the
+    pager under the engine's _switch_lock (its declared lock) so a
+    scrape never observes a half-swapped pool — but via a NON-blocking
+    acquire, so a switch wedged on device work cannot hang the
+    watchdog/metrics threads (they keep last values instead)."""
+    from cake_tpu.obs import metrics as m
+    from cake_tpu.obs import steps as obs_steps
+    eng = _engine(tiny_config, tiny_params, kv_pages=8, kv_page_size=8)
+    free_g = m.gauge("cake_engine_kv_pages_free", "KV pages currently free")
+    obs_steps.refresh_page_gauges(eng)
+    real_free = free_g.value
+    free_g.set(-1)                  # sentinel: did the refresh write?
+    with eng._switch_lock:          # simulate a wedged switch
+        done = threading.Event()
+        t = threading.Thread(
+            target=lambda: (obs_steps.refresh_page_gauges(eng),
+                            done.set()),
+            daemon=True)
+        t.start()
+        assert done.wait(5.0), \
+            "refresh_page_gauges hung on a held _switch_lock"
+        assert free_g.value == -1, \
+            "refresh read the pager during a switch"
+    obs_steps.refresh_page_gauges(eng)   # lock free again: converges
+    assert free_g.value == real_free
+
+
+def test_register_prefix_validates_page_size_under_switch_lock(
+        tiny_config, tiny_params):
+    """The admission-side fix: register_prefix (and the auto-prefix
+    path) read the pager's page size under _switch_lock, so prefix
+    validation can't race a live reconfigure's wholesale pager swap."""
+    eng = _engine(tiny_config, tiny_params, kv_pages=8, kv_page_size=8)
+    done = threading.Event()
+    out = {}
+
+    def register():
+        out["pid"] = eng.register_prefix(list(range(1, 17)))
+        done.set()
+
+    t = threading.Thread(target=register, daemon=True)
+    with eng._switch_lock:          # simulate a switch in progress
+        t.start()
+        time.sleep(0.15)
+        assert not done.is_set(), \
+            "register_prefix read the pager during a switch"
+    assert done.wait(5.0), "registration never completed"
+    assert out["pid"] >= 1
+
+
+def test_host_tier_publish_without_bus_is_noop():
+    """The host-tier guard fix: the _publish helper itself now holds
+    the disabled-plane contract (early return on a None bus), so a
+    future caller without its own guard cannot crash a spill."""
+    from cake_tpu.kv.host_tier import HostTier, SpilledPages
+    tier = HostTier(4, events=None)
+    ent = SpilledPages(n_pages=1, arrays=(np.zeros(2, np.int8),))
+    tier._publish("kv_spill", ("victim", 1), ent)   # must not raise
+    assert tier.put(("victim", 1), ent)
