@@ -275,11 +275,33 @@ FLEET_TIERS = {
                        events_per_frame=4, payload_ints=64),
 }
 
+ROUTER_TIERS = {
+    # 520-char system prompts (~590 rendered-head tokens = 4 x 128-token
+    # pages aligned) x 4 tenants x 6 requests over 2 replicas: affinity
+    # registers each tenant's prefix ONCE fleet-wide, round-robin once
+    # PER replica and still whole-prefills each tenant's first visit to
+    # the other replica
+    "router_8b_int8": dict(model="8b", quant="int8", max_seq=2048,
+                           slots=8, kv_pages=96, kv_page_size=128,
+                           n_tenants=4, reqs_per_tenant=6,
+                           system_chars=520, user_chars=32,
+                           gen_tokens=16, watermark=64),
+}
+
 # CPU-runnable smoke tiers (tests/test_bench.py exercises each via
 # CAKE_BENCH_TIER=<name>); never part of the real fallback chain.
 SMOKE_TIERS = {
     "fleet_tiny": dict(ops=120, frames=6, interval_s=0.05,
                        events_per_frame=3, payload_ints=16),
+    # 90-char system prompts render to 149-token heads (ByteTokenizer)
+    # = 9 aligned 16-token pages; whole prompts are ~260 tokens, so 384
+    # max_seq leaves decode room. The watermark stays high so the
+    # phases measure AFFINITY, not spill
+    "router_tiny": dict(model="tiny", quant=False, max_seq=384,
+                        slots=2, kv_pages=80, kv_page_size=16,
+                        n_tenants=2, reqs_per_tenant=4,
+                        system_chars=90, user_chars=8, gen_tokens=4,
+                        watermark=64),
     # f32 cache so the autotuned phase's greedy streams must come back
     # token-identical to the pinned phase (the hot-switch contract,
     # not bf16 tie-breaks); the 0.01s burst crosses the 5 req/s
@@ -1880,10 +1902,230 @@ def run_fleet_tier(name: str, ops: int, frames: int, interval_s: float,
     return result
 
 
+def run_router_tier(name: str, model: str, quant, max_seq: int,
+                    slots: int, kv_pages: int, kv_page_size: int,
+                    n_tenants: int, reqs_per_tenant: int,
+                    system_chars: int, user_chars: int,
+                    gen_tokens: int, watermark: int) -> dict:
+    """Aggregate-goodput A/B over 2 in-process engine replicas behind
+    the REAL router front door (cake_tpu/router), same offered load
+    with repeated shared system prompts per tenant: phase 1 routes
+    round-robin (the strawman — every tenant's prefix registers and
+    warms on EVERY replica), phase 2 prefix-affinity (each tenant's
+    conversations land on the replica already holding its pages).
+    Reports aggregate goodput tok/s, fleet prefix-hit rate, TTFT
+    p50/p99 per policy and router failovers (must be 0)."""
+    import http.client
+    import threading
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.api.server import ApiServer, make_handler
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.obs import metrics as obs_m
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.router import start_router
+    from cake_tpu.serve.engine import InferenceEngine
+    from http.server import ThreadingHTTPServer
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    def tenant_messages(tenant: int, i: int) -> list:
+        # one long shared system prompt per tenant + a distinct user
+        # turn per request — the population prefix affinity exists for
+        sys_txt = f"You are tenant {tenant}'s assistant. " \
+            + "policy " * ((system_chars - 40) // 7)
+        return [
+            {"role": "system", "content": sys_txt[:system_chars]},
+            {"role": "user", "content": f"q{i} " + "w" * user_chars},
+        ]
+
+    def phase(policy: str) -> dict:
+        engines, httpds = [], []
+        for _ in range(2):
+            eng = InferenceEngine(
+                cfg, params, tok, max_slots=slots,
+                max_seq_len=max_seq,
+                sampling=SamplingConfig(temperature=0.0,
+                                        repeat_penalty=1.0),
+                kv_pages=kv_pages, kv_page_size=kv_page_size,
+                paged_attn="fold", auto_prefix_system=True)
+            master = Master(Args(sample_len=gen_tokens),
+                            text_generator=None)
+            master.llm = object()
+            api = ApiServer(master, engine=eng,
+                            replica_id=f"bench-{len(engines)}")
+            httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                        make_handler(api))
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            api.replica_id = f"127.0.0.1:{httpd.server_address[1]}"
+            engines.append(eng)
+            httpds.append(httpd)
+        replicas = [f"127.0.0.1:{h.server_address[1]}" for h in httpds]
+        rhttpd, router = start_router(
+            replicas, address="127.0.0.1:0", block=False,
+            tokenizer=tok, poll_interval_s=0.05,
+            load_watermark=watermark, policy_mode=policy)
+        raddr = f"127.0.0.1:{rhttpd.server_address[1]}"
+        router.tracker.poll_once()
+
+        # warm each ENGINE directly with a CHAT-shaped request (same
+        # bucket + decode shapes as the measured load, so each phase
+        # pays its jit compiles here, outside the measured window —
+        # engines rebuild per phase, so compiles repeat per phase and
+        # would otherwise all land in whichever phase runs first)
+        from cake_tpu.models.chat import Message
+        warm_msgs = tenant_messages(99, 0)
+        for eng in engines:
+            h = eng.chat([Message.from_json(m) for m in warm_msgs],
+                         max_new_tokens=gen_tokens)
+            assert h.wait(timeout=900), "warmup timed out"
+        warm_regs = sum(len(e._prefixes) for e in engines)
+        warm_done = [e.stats.requests_completed for e in engines]
+
+        f0 = obs_m.REGISTRY.get("cake_router_failovers_total")
+        fail0 = sum(f0.samples().values()) if f0 is not None else 0
+        ttfts, errors = [], []
+        lock = threading.Lock()
+
+        def one(tenant: int, i: int):
+            body = json.dumps({
+                "messages": tenant_messages(tenant, i),
+                "stream": True, "max_tokens": gen_tokens})
+            conn = http.client.HTTPConnection(raddr, timeout=900)
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/api/v1/chat/completions",
+                             body=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    with lock:
+                        errors.append(resp.status)
+                    resp.read()
+                    return
+                ttft = None
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:") and ttft is None:
+                        ttft = time.perf_counter() - t0
+                    if line.strip() == b"data: [DONE]":
+                        break
+                with lock:
+                    ttfts.append(ttft if ttft is not None else -1.0)
+            except OSError as e:
+                with lock:
+                    errors.append(str(e))
+            finally:
+                conn.close()
+
+        t0 = time.perf_counter()
+        threads = []
+        # tenant-major launch: one tenant's requests arrive back to
+        # back, so the round-robin strawman genuinely alternates each
+        # tenant across BOTH replicas (request-major interleaving would
+        # accidentally pin tenant i to replica i%2)
+        for tenant in range(n_tenants):
+            for i in range(reqs_per_tenant):
+                t = threading.Thread(target=one, args=(tenant, i))
+                t.start()
+                threads.append(t)
+                time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=900)
+        wall = time.perf_counter() - t0
+        n_req = n_tenants * reqs_per_tenant
+        # fleet prefix-hit rate: a request "hits" when its tenant's
+        # prefix was ALREADY registered on its replica — i.e. requests
+        # minus the NEW registrations this load forced. (Per-engine
+        # stats.prefix_hits can't tell: a request that just registered
+        # its own prefix counts a hit there.) Round-robin re-registers
+        # every tenant on every replica; affinity registers each once.
+        new_regs = sum(len(e._prefixes) for e in engines) - warm_regs
+        hits = n_req - new_regs
+        toks = sum(e.stats.tokens_generated for e in engines)
+        fail1 = sum(f0.samples().values()) if f0 is not None else 0
+        per_replica = [e.stats.requests_completed - w
+                       for e, w in zip(engines, warm_done)]
+        rhttpd.shutdown()
+        router.close()
+        for h in httpds:
+            h.shutdown()
+        for e in engines:
+            e.stop(timeout=30)
+        assert not errors, f"router phase {policy} errors: {errors[:4]}"
+        good = sorted(t for t in ttfts if t >= 0)
+        return {
+            "goodput_tok_s": round(
+                n_req * gen_tokens / wall, 2) if wall > 0 else 0.0,
+            "hit_rate": round(hits / n_req, 4),
+            "hits": hits,
+            "new_regs": new_regs,
+            "requests": n_req,
+            "per_replica_completed": per_replica,
+            "ttft_p50_ms": round(_pct(good, 0.5) * 1e3, 1)
+            if good else None,
+            "ttft_p99_ms": round(_pct(good, 0.99) * 1e3, 1)
+            if good else None,
+            "failovers": int(fail1 - fail0),
+            "tokens": int(toks),
+            "wall_s": round(wall, 3),
+        }
+
+    rr = phase("round_robin")
+    log(f"router[round_robin]: {rr['goodput_tok_s']} tok/s goodput, "
+        f"hit rate {rr['hit_rate']}, TTFT p50/p99 "
+        f"{rr['ttft_p50_ms']}/{rr['ttft_p99_ms']}ms, per-replica "
+        f"{rr['per_replica_completed']}")
+    aff = phase("affinity")
+    log(f"router[affinity]: {aff['goodput_tok_s']} tok/s goodput, "
+        f"hit rate {aff['hit_rate']}, TTFT p50/p99 "
+        f"{aff['ttft_p50_ms']}/{aff['ttft_p99_ms']}ms, per-replica "
+        f"{aff['per_replica_completed']}")
+    return {
+        "metric": f"{name}_goodput_tok_s",
+        "value": aff["goodput_tok_s"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "router_replicas": 2,
+        "router_requests": aff["requests"],
+        "router_goodput_tok_s_affinity": aff["goodput_tok_s"],
+        "router_goodput_tok_s_round_robin": rr["goodput_tok_s"],
+        "router_hit_rate_affinity": aff["hit_rate"],
+        "router_hit_rate_round_robin": rr["hit_rate"],
+        "router_new_regs_affinity": aff["new_regs"],
+        "router_new_regs_round_robin": rr["new_regs"],
+        "router_ttft_p50_ms_affinity": aff["ttft_p50_ms"],
+        "router_ttft_p99_ms_affinity": aff["ttft_p99_ms"],
+        "router_ttft_p50_ms_round_robin": rr["ttft_p50_ms"],
+        "router_ttft_p99_ms_round_robin": rr["ttft_p99_ms"],
+        "router_failovers": aff["failovers"] + rr["failovers"],
+        "router_per_replica_affinity": aff["per_replica_completed"],
+        "router_per_replica_round_robin": rr["per_replica_completed"],
+        "device_kind": dev.device_kind,
+    }
+
+
 def tier_main():
     """Child-process entry: run one tier, print its JSON line."""
     name = os.environ[ORCH_ENV]
-    if name in FLEET_TIERS or name.startswith("fleet"):
+    if name in ROUTER_TIERS or name.startswith("router"):
+        kwargs = {**ROUTER_TIERS, **SMOKE_TIERS}[name]
+        result = run_router_tier(name, **kwargs)
+    elif name in FLEET_TIERS or name.startswith("fleet"):
         kwargs = {**FLEET_TIERS, **SMOKE_TIERS}[name]
         result = run_fleet_tier(name, **kwargs)
     elif name in AUTOTUNE_TIERS or name.startswith("autotune"):
@@ -2169,6 +2411,19 @@ def _fleet_main() -> int:
         fail_error="fleet telemetry federation tier failed")
 
 
+def _router_main() -> int:
+    """`bench.py --router`: the prefix-affinity router tier — one JSON
+    line with aggregate goodput tok/s, fleet prefix-hit rate and TTFT
+    p50/p99 for the SAME shared-prefix load routed prefix-affinity vs
+    round-robin over 2 in-process engine replicas behind the real
+    front door, plus the failover count (must be 0 on a healthy
+    fleet). CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "goodput_tok_s", "tokens/s",
+        cpu_tier="router_tiny", tpu_tier="router_8b_int8",
+        fail_error="router aggregate-goodput tier failed")
+
+
 def _paged_prefix_main() -> int:
     """`bench.py --paged-prefix`: the paged prefix-sharing tier — one
     JSON line with suffix-only vs whole-prompt TTFT and pages_shared
@@ -2292,6 +2547,8 @@ if __name__ == "__main__":
         sys.exit(_restart_main())
     elif "--fleet" in sys.argv:
         sys.exit(_fleet_main())
+    elif "--router" in sys.argv:
+        sys.exit(_router_main())
     elif "--paged-prefix" in sys.argv:
         sys.exit(_paged_prefix_main())
     elif "--paged-attn" in sys.argv:

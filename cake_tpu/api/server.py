@@ -68,10 +68,23 @@ class ApiServer:
     OPTIONAL_PLANES = ("collector",)
 
     def __init__(self, master, model_name: str = "cake-tpu", engine=None,
-                 health=None, collector=None):
+                 health=None, collector=None, replica_id=None):
+        import os
+        import socket
         self.master = master
         self.model_name = model_name
         self.engine = engine
+        # stable id for THIS serving process, so a front-door router
+        # (cake_tpu/router) and clients can attribute backpressure to a
+        # specific replica: the x-cake-replica header on 429/503
+        # responses and the `replica` health field both carry it.
+        # start() passes the bind address; CAKE_REPLICA_ID overrides.
+        self.replica_id = (replica_id
+                           or os.environ.get("CAKE_REPLICA_ID")
+                           or socket.gethostname())
+        # last page size read under a successful non-blocking
+        # _switch_lock acquire (see _page_size)
+        self._page_size_cache = None
         # parallel.health.ServingHealth: when it flips to failed, chat
         # requests 503 and /api/v1/health reports the reason
         self.health_state = health
@@ -306,6 +319,15 @@ class ApiServer:
             lp_cursor = upto
             return entries
 
+        # trim_from: set when a FRESH admission arrives with a
+        # Last-Event-ID (the front-door router failing a keyed stream
+        # over to a different replica, which re-runs the whole prompt
+        # deterministically): events at or below the client's high-water
+        # mark are suppressed, and the first batch crossing it re-decodes
+        # only the unseen token suffix — the attach path's exact-suffix
+        # semantics, without a local attach to replay from. Same text
+        # re-decode boundary caveat as the attach replay.
+        trim_from = None
         if getattr(h, "attached", False):
             # idempotent reconnect: replay the held/journaled suffix
             # after the client's Last-Event-ID as ONE chunk (its id is
@@ -328,6 +350,11 @@ class ApiServer:
                 return DISCONNECTED   # reconnect died mid-replay
             sent_id = max(start_at, len(history))
             lp_cursor = max(0, sent_id - id_base)
+        elif last_event_id:
+            # fresh admission, resuming client: suppress what it holds
+            sent_id = max(sent_id, int(last_event_id))
+            lp_cursor = max(0, sent_id - id_base)
+            trim_from = lp_cursor
 
         while True:
             try:
@@ -338,6 +365,21 @@ class ApiServer:
                 continue
             ev_id = id_base + n_done
             if delta and ev_id > sent_id:
+                if trim_from is not None:
+                    # the batch crossing the resumed client's
+                    # Last-Event-ID: ship only the unseen suffix
+                    toks = [t for t in r.out_tokens[trim_from:n_done]
+                            if t not in eos_ids]
+                    delta = (self.engine.tokenizer.decode(toks)
+                             if toks else "")
+                    trim_from = None
+                    if not delta:
+                        # the whole crossing batch was EOS/empty:
+                        # nothing to write, but the position advances
+                        sent_id = ev_id
+                        if final:
+                            break
+                        continue
                 try:
                     send_chunk(chunk_response(delta, self.model_name,
                                               rid=rid,
@@ -403,63 +445,117 @@ class ApiServer:
 
     # -- introspection -------------------------------------------------------
 
-    def health(self) -> dict:
+    def _page_size(self):
+        """The paged engine's kv page size (None for dense) — the
+        router aligns its affinity fingerprints to it (the
+        register_prefix rounding rule)."""
+        eng = self.engine
+        if eng is None or not getattr(eng, "paged", False):
+            return None
+        # the pager swaps wholesale during a live reconfigure; its
+        # declared lock pins one consistent value. NON-blocking on
+        # purpose (the refresh_page_gauges discipline): the health
+        # endpoint — including the router's sub-second lite poll —
+        # must never stall behind a fold-everything switch holding
+        # the lock through jit compiles, or the router would eject a
+        # healthy replica exactly when it is switching. On contention
+        # the last-seen value serves one more poll.
+        if eng._switch_lock.acquire(blocking=False):
+            try:
+                # cakelint: skip[affinity] _switch_lock held via the non-blocking acquire above (the with-form would block the health path behind a wedged switch)
+                self._page_size_cache = eng._pager.page_size
+            finally:
+                eng._switch_lock.release()
+        return self._page_size_cache
+
+    def health(self, lite: bool = False) -> dict:
+        """/api/v1/health. lite (?lite=1): ONLY the fields a front-door
+        router polls every few hundred ms — queue depths, SLO
+        attainment, config epoch, draining, breaker — each a SUBTREE of
+        the full document (pinned by contract test). The full document
+        walks every subsystem (journal state, recovery wire state,
+        lifetime counters): too heavy for a 250ms poll loop."""
         failed = (self.health_state is not None
                   and self.health_state.failed)
         out = {"status": "failed" if failed else "ok",
-               "model": self.model_name,
+               "replica": self.replica_id,
                "queue_depth": self._waiting}
+        if not lite:
+            out["model"] = self.model_name
         if failed:
             out["reason"] = self.health_state.reason
-        if self.engine is not None:
-            st = self.engine.stats
-            out.update(
-                queue_depth=self.engine.queue_depth,
-                active_requests=self.engine.active,
-                decode_slots=self.engine.max_slots,
-                requests_completed=st.requests_completed,
-                tokens_generated=st.tokens_generated,
-                decode_tokens_per_s=round(st.decode_tokens_per_s, 2),
-            )
-            depths = getattr(self.engine.scheduler, "class_depths", None)
-            if depths is not None:
-                # SLO scheduling on: per-class queue + outcome counters
-                out["queue_depth_by_class"] = depths()
-                out["preemptions"] = st.preemptions
-                out["requests_shed"] = st.shed
-            if hasattr(self.engine, "recovery_state"):
-                # crash-recovery / reset-storm-breaker state (+ the
-                # armed fault plan, when chaos is on)
-                out["recovery"] = self.engine.recovery_state()
-            if getattr(self.engine, "_draining", False):
-                # drain in flight (POST /api/v1/drain / SIGTERM):
-                # admissions 429 while this block counts down the
-                # remaining in-flight work
-                ds = self.engine.drain_state()
-                out["draining"] = True
-                out["drain"] = ds
-            jnl = getattr(self.engine, "_journal", None)
-            if jnl is not None:
-                # write-ahead journal state (--journal): appended
-                # bytes/records, fsync mode, whether the sink failed
-                # open, and the last replay's outcome
-                out["journal"] = jnl.state()
-            slo = getattr(self.engine, "slo", None)
-            if slo is not None:
-                # per-class targets, rolling attainment and goodput
-                # tokens (obs/slo.py) — the serving-quality block
+        if self.engine is None:
+            return out
+        eng = self.engine
+        out.update(
+            queue_depth=eng.queue_depth,
+            active_requests=eng.active,
+            decode_slots=eng.max_slots,
+        )
+        depths = getattr(eng.scheduler, "class_depths", None)
+        if depths is not None:
+            # SLO scheduling on: per-class queue depths
+            out["queue_depth_by_class"] = depths()
+        if getattr(eng, "_draining", False):
+            # drain in flight (POST /api/v1/drain / SIGTERM):
+            # admissions 429 while this block counts down the
+            # remaining in-flight work
+            out["draining"] = True
+            out["drain"] = eng.drain_state()
+        ps = self._page_size()
+        if ps is not None:
+            out["page_size"] = ps
+        if hasattr(eng, "current_config"):
+            # the autotune epoch + switch flag: a router redirects
+            # fresh admissions while a fold-everything switch runs
+            out["config_epoch"] = getattr(eng, "config_epoch", 0)
+            out["autotune"] = getattr(eng, "autotune_mode", "off")
+            out["switch_in_flight"] = bool(
+                getattr(eng, "_switch_inflight", False))
+        slo = getattr(eng, "slo", None)
+        if slo is not None:
+            # serving quality (obs/slo.py): the router's weighted pick
+            # reads attainment; the full doc carries the whole snapshot
+            if lite:
+                out["slo"] = {"attainment_1m": {
+                    c: round(v, 4) for c, v in
+                    slo.attainment_by_class("1m").items()}}
+            else:
                 out["slo"] = slo.snapshot()
-            if hasattr(self.engine, "current_config"):
-                # the LIVE effective engine config (slots, decode_scan,
-                # kv_pages, kv_dtype, mixed_batch, attn impl) so
-                # operators can see what the autotuner chose; the epoch
-                # pairs with per-request trace attribution
-                out["engine_config"] = (
-                    self.engine.current_config().to_dict())
-                out["config_epoch"] = getattr(self.engine,
-                                              "config_epoch", 0)
-                out["autotune"] = getattr(self.engine, "autotune_mode",
-                                          "off")
+        if hasattr(eng, "recovery_state"):
+            if lite:
+                # just the breaker bit (a tripped breaker means this
+                # replica is a restart away — stop routing to it); the
+                # full recovery_state walks the fault plan and control
+                # wire state
+                out["recovery"] = {"breaker": {"tripped": bool(
+                    getattr(eng, "_breaker_tripped", False))}}
+            else:
+                out["recovery"] = eng.recovery_state()
+        if lite:
+            return out
+        st = eng.stats
+        out.update(
+            requests_completed=st.requests_completed,
+            tokens_generated=st.tokens_generated,
+            decode_tokens_per_s=round(st.decode_tokens_per_s, 2),
+        )
+        if depths is not None:
+            # per-class outcome counters ride the full doc only
+            out["preemptions"] = st.preemptions
+            out["requests_shed"] = st.shed
+        jnl = getattr(eng, "_journal", None)
+        if jnl is not None:
+            # write-ahead journal state (--journal): appended
+            # bytes/records, fsync mode, whether the sink failed
+            # open, and the last replay's outcome
+            out["journal"] = jnl.state()
+        if hasattr(eng, "current_config"):
+            # the LIVE effective engine config (slots, decode_scan,
+            # kv_pages, kv_dtype, mixed_batch, attn impl) so
+            # operators can see what the autotuner chose; the epoch
+            # pairs with per-request trace attribution
+            out["engine_config"] = eng.current_config().to_dict()
         return out
 
     def autotune(self) -> dict:
@@ -896,6 +992,10 @@ def make_handler(api: ApiServer):
             data = json.dumps({**obj, "retry_after_s": retry}).encode()
             self.send_response(code)
             self.send_header("Retry-After", str(retry))
+            # attribute the backpressure to THIS replica: the router
+            # relays the header verbatim, so clients and router logs
+            # can tell which backend computed the Retry-After
+            self.send_header("x-cake-replica", str(api.replica_id))
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
@@ -937,8 +1037,11 @@ def make_handler(api: ApiServer):
 
         def do_GET(self):
             route = self.path.split("?", 1)[0]
-            if self.path == "/api/v1/health":
-                return self._json(200, api.health())
+            if route == "/api/v1/health":
+                # ?lite=1: the router's cheap poll variant (a subtree
+                # of the full document; any other value means full)
+                lite = self._query().get("lite") == "1"
+                return self._json(200, api.health(lite=lite))
             if self.path == "/api/v1/cluster":
                 return self._json(200, api.cluster())
             if route == "/api/v1/requests":
@@ -1221,7 +1324,7 @@ def start(master, address: str = "127.0.0.1:10128",
         health = ServingHealth(engine, stall_after_s=getattr(
             master.args, "stall_timeout", 600.0))
     api = ApiServer(master, model_name, engine=engine, health=health,
-                    collector=collector)
+                    collector=collector, replica_id=address)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
 

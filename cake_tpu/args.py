@@ -30,6 +30,36 @@ class ModelType(str, Enum):
     IMAGE = "image"
 
 
+def parse_replicas(spec: str) -> list:
+    """Validate + split a --replicas list: comma-separated host:port
+    entries, no duplicates. One source of truth for Args.validate and
+    the router builder (cli._serve_router)."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        host, sep, port = entry.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"--replicas entry {entry!r} must be host:port")
+        try:
+            p = int(port)
+        except ValueError:
+            raise ValueError(
+                f"--replicas entry {entry!r}: port {port!r} is not an "
+                "integer")
+        if not 0 < p < 65536:
+            raise ValueError(
+                f"--replicas entry {entry!r}: port {p} out of range")
+        out.append(entry)
+    if not out:
+        raise ValueError(f"--replicas {spec!r} names no replicas")
+    if len(set(out)) != len(out):
+        raise ValueError(f"--replicas {spec!r} has duplicate entries")
+    return out
+
+
 class SDVersion(str, Enum):
     V1_5 = "v1-5"
     V2_1 = "v2-1"
@@ -279,6 +309,29 @@ class Args:
     # cursor advances only on a successful send, so a collector blip
     # delays events rather than dropping them)
     telemetry_interval: float = 2.0
+    # --router: run THIS process as the front-door router
+    # (cake_tpu/router) over N independent engine replicas instead of
+    # loading a model — prefix-affinity consistent-hash routing, lite
+    # health polling with staleness ejection, drain-aware failover,
+    # verbatim Retry-After propagation. Binds --api (or --address).
+    # With --model pointing at a directory holding tokenizer.json the
+    # affinity keys are page-aligned token fingerprints (the
+    # register_prefix rounding rule); without one they degrade to
+    # system-prompt text fingerprints.
+    router: bool = False
+    # --replicas host:port,host:port,...: the engine replicas the
+    # router fronts (each an independent `--api` serving process)
+    replicas: Optional[str] = None
+    # --router-watermark N: bounded-load spill threshold — the
+    # affinity target takes the request only under this queue+active
+    # load; over it, the request spills to the next ring node
+    router_watermark: int = 8
+    # --router-poll S: lite-health poll cadence per replica
+    # (GET /api/v1/health?lite=1)
+    router_poll: float = 0.25
+    # --router-policy {affinity,round_robin}: round_robin is the
+    # bench strawman (no prefix affinity; per-request rotation)
+    router_policy: str = "affinity"
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -348,6 +401,26 @@ class Args:
             raise ValueError(
                 f"--telemetry-interval {self.telemetry_interval} must "
                 "be > 0 seconds")
+        if self.router_policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"unsupported router_policy '{self.router_policy}' "
+                "(choose affinity or round_robin)")
+        if self.router_watermark < 1:
+            raise ValueError(
+                f"--router-watermark {self.router_watermark} must be "
+                ">= 1")
+        if not self.router_poll > 0:
+            raise ValueError(
+                f"--router-poll {self.router_poll} must be > 0 "
+                "seconds")
+        if self.router:
+            # parse NOW so a malformed replica list is a loud startup
+            # error (the --fault-plan discipline)
+            if not self.replicas:
+                raise ValueError(
+                    "--router requires --replicas host:port,... (the "
+                    "engine replicas the front door routes over)")
+            parse_replicas(self.replicas)
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

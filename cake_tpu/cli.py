@@ -353,6 +353,47 @@ def _advertised_host(args) -> str:
     return socket.gethostname()
 
 
+def _serve_router(args) -> int:
+    """The `cake-router` process role: no model weights, no devices —
+    a thin HTTP front door (cake_tpu/router) over --replicas. With a
+    --model directory holding tokenizer.json the affinity keys are
+    page-aligned token fingerprints (the register_prefix rounding
+    rule); otherwise they degrade to system-prompt text fingerprints
+    (RouterServer logs the one-shot warning)."""
+    from cake_tpu.args import parse_replicas
+    from cake_tpu.router import start_router
+
+    log = logging.getLogger(__name__)
+    replicas = parse_replicas(args.replicas)
+    tokenizer = None
+    if args.model:
+        try:
+            from cake_tpu.models.llama.generator import load_tokenizer
+            tokenizer = load_tokenizer(args.model)
+        except Exception as e:  # noqa: BLE001 — degraded, not fatal
+            log.warning("router: could not load tokenizer from %s "
+                        "(%s); affinity falls back to text "
+                        "fingerprints", args.model, e)
+    address = args.api or args.address
+    log.info("router: fronting %d replica(s) on %s", len(replicas),
+             address)
+    start_router(replicas, address=address, tokenizer=tokenizer,
+                 poll_interval_s=args.router_poll,
+                 load_watermark=args.router_watermark,
+                 policy_mode=args.router_policy)
+    return 0
+
+
+def router_main(argv=None) -> int:
+    """The `cake-router` entry: the front-door role with --router
+    implied (equivalent to `cake-tpu --router --replicas ...`); the
+    hook a console-script or wrapper shim points at."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--router" not in argv:
+        argv = ["--router"] + argv
+    return main(argv)
+
+
 def main(argv=None) -> int:
     from cake_tpu.args import parse_args
     from cake_tpu.master import Master
@@ -362,6 +403,19 @@ def main(argv=None) -> int:
         format="[%(asctime)s] %(levelname)s %(name)s: %(message)s",
     )
     args, sd_args, img_args = parse_args(argv)
+
+    if args.router:
+        # BEFORE Master.from_args/initialize: the router is a
+        # model-less, device-less process role — it must not load
+        # weights or join a mesh
+        return _serve_router(args)
+    if getattr(args, "replicas", None):
+        # one-shot warning mirroring --step-log: the replica list only
+        # feeds the router role
+        logging.getLogger(__name__).warning(
+            "--replicas has no effect without --router: the replica "
+            "list names the backends of the front-door router "
+            "(cake_tpu/router)")
 
     if getattr(args, "kv_host_pages", None) and not args.kv_pages:
         # one-shot warning mirroring --step-log: the host KV tier

@@ -1,0 +1,29 @@
+"""Front-door router: prefix-affinity load balancing over N engine
+replicas (ROADMAP item 2 — a replicated data plane).
+
+One thin process in front of N independent `--api` engine servers:
+
+  * `affinity.py` — consistent-hash ring keyed by the request's
+    page-aligned prefix fingerprint (the same rounding rule as the
+    paged engine's register_prefix), so every conversation sharing a
+    system prompt lands on the replica that already holds its prefix
+    pages — PR 4's per-engine prefix sharing becomes a fleet-level
+    cache.
+  * `replicas.py` — a per-replica poller of `GET /api/v1/health?lite=1`
+    (queue depths, SLO attainment, autotune epoch, draining, breaker)
+    with staleness-based ejection and jittered re-probe backoff.
+  * `policy.py` — weighted pick: sticky idempotency keys, then
+    affinity with a bounded-load spill, then least-loaded healthy.
+  * `proxy.py` — streaming SSE pass-through preserving `id:` fields
+    and Retry-After headers verbatim, with typed mid-stream error
+    mapping.
+  * `server.py` — the HTTP front door (`cake-tpu --router
+    --replicas host:port,...`).
+"""
+
+from cake_tpu.router.affinity import (          # noqa: F401
+    HashRing, prefix_fingerprint, text_fingerprint,
+)
+from cake_tpu.router.policy import NoReplicaError, RoutingPolicy  # noqa: F401
+from cake_tpu.router.replicas import ReplicaState, ReplicaTracker  # noqa: F401
+from cake_tpu.router.server import RouterServer, start_router  # noqa: F401

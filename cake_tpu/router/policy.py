@@ -1,0 +1,200 @@
+"""Routing policy: sticky idempotency keys -> prefix affinity with a
+bounded-load spill -> least-loaded healthy.
+
+Pick order for one request:
+
+1. **Sticky idempotency key.** A retried `x-cake-idempotency-key`
+   routes to the replica that first admitted it, so the PR 12 attach
+   semantics (never double-admit; Last-Event-ID exact-suffix resume)
+   hold across the fleet. Only when that replica is EJECTED does the
+   key fall through to re-admission elsewhere — a draining home still
+   serves attaches (the key names existing work; `engine.submit`
+   checks the key before the drain gate).
+2. **Prefix affinity.** The consistent-hash target for the request's
+   page-aligned prefix fingerprint — unless it is over the load
+   watermark, in which case the request SPILLS to the next ring node
+   (bounded load: a hot tenant saturating its home replica overflows
+   deterministically instead of queueing behind itself) and the miss
+   is recorded.
+3. **Least-loaded** healthy, admitting replica (no fingerprint, or the
+   whole ring is uneligible).
+
+A request no replica can take raises NoReplicaError. Its retry-after,
+when present, is a REPLICA-computed drain ETA — the router never
+invents a Retry-After of its own (the PR 5/12 honest-backpressure
+contract).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Set
+
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.router.affinity import HashRing
+from cake_tpu.router.replicas import ReplicaState, ReplicaTracker
+
+_AFFINITY = obs_metrics.counter(
+    "cake_router_affinity_total",
+    "Routing decisions by affinity outcome: hit (ring target taken), "
+    "spill (target over the load watermark or uneligible), sticky "
+    "(idempotency-key home), none (no shareable prefix)",
+    labelnames=("outcome",))
+_FAILOVERS = obs_metrics.counter(
+    "cake_router_failovers_total",
+    "Requests re-routed away from their first-choice replica",
+    labelnames=("reason",))
+
+
+class NoReplicaError(Exception):
+    """No replica can admit this request. retry_after_s, when not None,
+    is a replica-computed drain ETA (propagated, never invented)."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class Decision:
+    """One routing decision (also the router's JSONL decision-log
+    record via to_json)."""
+
+    __slots__ = ("replica", "outcome", "sticky")
+
+    def __init__(self, replica: str, outcome: str, sticky: bool):
+        self.replica = replica
+        self.outcome = outcome   # hit | spill | sticky | none
+        self.sticky = sticky
+
+    def to_json(self) -> dict:
+        return {"replica": self.replica, "outcome": self.outcome,
+                "sticky": self.sticky}
+
+
+class RoutingPolicy:
+    """Pure pick logic over a ReplicaTracker + HashRing; thread-safe
+    (HTTP handler threads route concurrently)."""
+
+    def __init__(self, tracker: ReplicaTracker,
+                 ring: Optional[HashRing] = None,
+                 load_watermark: int = 8,
+                 mode: str = "affinity",
+                 sticky_cap: int = 4096):
+        if mode not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown router policy mode {mode!r} "
+                             "(choose affinity or round_robin)")
+        if load_watermark < 1:
+            raise ValueError(
+                f"load_watermark {load_watermark} must be >= 1")
+        self.tracker = tracker
+        self.ring = ring if ring is not None else HashRing(
+            tracker.names())
+        self.load_watermark = load_watermark
+        self.mode = mode
+        self._mu = threading.Lock()
+        # bounded key -> home-replica map (LRU): sticky failover state
+        self._sticky: OrderedDict[str, str] = OrderedDict()
+        self._sticky_cap = sticky_cap
+        self._rr = 0   # round_robin cursor (the bench strawman)
+
+    # -- sticky map ------------------------------------------------------
+
+    def note_admitted(self, idem_key: Optional[str],
+                      replica: str) -> None:
+        """Record the replica that admitted a keyed request; retries
+        route back to it (attach) until it is ejected."""
+        if idem_key is None:
+            return
+        with self._mu:
+            self._sticky[idem_key] = replica
+            self._sticky.move_to_end(idem_key)
+            while len(self._sticky) > self._sticky_cap:
+                self._sticky.popitem(last=False)
+
+    def sticky_home(self, idem_key: Optional[str]) -> Optional[str]:
+        if idem_key is None:
+            return None
+        with self._mu:
+            return self._sticky.get(idem_key)
+
+    # -- the pick --------------------------------------------------------
+
+    def _eligible(self, exclude: Set[str]) -> List[ReplicaState]:
+        return [s for s in self.tracker.admitting()
+                if s.name not in exclude]
+
+    def route(self, key: Optional[str] = None,
+              idem_key: Optional[str] = None,
+              exclude: Optional[Set[str]] = None) -> Decision:
+        """Pick a replica. `exclude` holds replicas already tried this
+        request (the proxy's failover loop). Raises NoReplicaError when
+        nothing can admit."""
+        exclude = exclude or set()
+        # 1. sticky home: attaches must land where the work lives,
+        # draining or not — but never on an ejected corpse, and never
+        # on a replica this request already failed against
+        home = self.sticky_home(idem_key)
+        if home is not None:
+            st = self.tracker.get(home)
+            usable = (st is not None and not st.ejected and st.polled
+                      and not st.breaker_tripped
+                      and st.doc.get("status") == "ok")
+            if usable and home not in exclude:
+                _AFFINITY.labels(outcome="sticky").inc()
+                return Decision(home, "sticky", sticky=True)
+            if not usable:
+                # the home is GONE (not merely excluded by this
+                # request's retry loop): re-admission elsewhere
+                _FAILOVERS.labels(reason="home_ejected").inc()
+
+        eligible = self._eligible(exclude)
+        if not eligible:
+            # propagate a replica-computed drain ETA when one exists;
+            # otherwise the 503 carries NO Retry-After (the router
+            # never invents one)
+            etas = [s.drain_eta_s for s in self.tracker.states()
+                    if s.draining and s.drain_eta_s is not None]
+            raise NoReplicaError(
+                "no replica can admit this request "
+                f"(tried: {sorted(exclude) or 'none'}; "
+                f"replicas: {self.tracker.snapshot()})",
+                retry_after_s=min(etas) if etas else None)
+
+        if self.mode == "round_robin":
+            with self._mu:
+                self._rr += 1
+                pick = eligible[self._rr % len(eligible)]
+            return Decision(pick.name, "none", sticky=False)
+
+        # 2. affinity with bounded-load spill
+        if key is not None:
+            first = True
+            for name in self.ring.nodes_for(key):
+                st = next((s for s in eligible if s.name == name), None)
+                if st is None:
+                    first = False   # ring target uneligible -> spill
+                    continue
+                if st.load >= self.load_watermark and not first:
+                    # later ring nodes only take spill when under the
+                    # watermark too; past them we fall to least-loaded
+                    first = False
+                    continue
+                if first and st.load < self.load_watermark:
+                    _AFFINITY.labels(outcome="hit").inc()
+                    return Decision(st.name, "hit", sticky=False)
+                if first:
+                    # the affinity target is saturated: spill
+                    first = False
+                    continue
+                _AFFINITY.labels(outcome="spill").inc()
+                return Decision(st.name, "spill", sticky=False)
+            _AFFINITY.labels(outcome="spill").inc()
+
+        # 3. least-loaded healthy
+        pick = min(eligible, key=lambda s: (s.load, s.name))
+        if key is None:
+            _AFFINITY.labels(outcome="none").inc()
+        return Decision(pick.name, "spill" if key is not None else "none",
+                        sticky=False)

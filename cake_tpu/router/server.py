@@ -1,0 +1,396 @@
+"""The router HTTP front door.
+
+`cake-tpu --router --replicas host:port,host:port,...` runs THIS
+process role — no model, no devices: a ThreadingHTTPServer that routes
+each chat request to one of N engine replicas (policy.py), proxies the
+response through (proxy.py), and serves its own introspection:
+
+  * POST /api/v1/chat/completions (+ /v1 alias) — routed + proxied
+  * GET  /api/v1/router — replica states, policy mode, sticky keys
+  * GET  /api/v1/health — the ROUTER's own health (cheap; replicas'
+    health is what the tracker polls)
+  * GET  /metrics — the cake_router_* families
+
+Failover loop: a connect failure or a roamable refusal (draining 429,
+switch 409, retryable 503) moves the request to the next pick until
+every replica was tried; a shed/queue-full 429 relays VERBATIM with
+the replica's computed Retry-After and x-cake-replica attribution. A
+replica dying mid-stream surfaces as a terminal SSE error event; the
+client's keyed reconnect (Last-Event-ID) re-routes — sticky to the
+home replica while it lives, re-admitted elsewhere once it is ejected
+(the engine-side fresh-admission Last-Event-ID suppression keeps the
+resumed stream exact-suffix).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.router.affinity import (
+    HashRing, prefix_fingerprint, text_fingerprint,
+)
+# _FAILOVERS is single-sourced in policy.py (which increments it for
+# sticky home_ejected re-homes); a second declaration here would have
+# to keep its help string byte-identical forever
+from cake_tpu.router.policy import (
+    _FAILOVERS, NoReplicaError, RoutingPolicy,
+)
+from cake_tpu.router.proxy import ReplicaProxy
+from cake_tpu.router.replicas import ReplicaTracker
+
+log = logging.getLogger(__name__)
+
+_REQUESTS = obs_metrics.counter(
+    "cake_router_requests_total",
+    "Chat requests proxied, by backend replica and priority class",
+    labelnames=("replica", "class"))
+_SHEDS = obs_metrics.counter(
+    "cake_router_sheds_total",
+    "Requests the router could not place (no_replica) or relayed a "
+    "replica refusal for (relay)", labelnames=("reason",))
+
+DEFAULT_PAGE_SIZE = 128
+
+
+class RouterServer:
+    """Routing + proxy state shared by the handler threads."""
+
+    # cakelint guards discipline: the tokenizer (page-aligned affinity
+    # keys) and the decision JSONL log are both optional planes
+    OPTIONAL_PLANES = ("tokenizer", "_log")
+
+    def __init__(self, replicas, tokenizer=None,
+                 poll_interval_s: float = 0.25,
+                 stale_after_s: float = 2.0,
+                 load_watermark: int = 8,
+                 policy_mode: str = "affinity",
+                 fetch=None, decision_log: Optional[str] = None,
+                 vnodes: int = 64):
+        self.tokenizer = tokenizer
+        self.tracker = ReplicaTracker(
+            replicas, poll_interval_s=poll_interval_s,
+            stale_after_s=stale_after_s, fetch=fetch)
+        self.ring = HashRing(self.tracker.names(), vnodes=vnodes)
+        self.policy = RoutingPolicy(
+            self.tracker, ring=self.ring,
+            load_watermark=load_watermark, mode=policy_mode)
+        self.proxy = ReplicaProxy()
+        self._log = None
+        if decision_log:
+            from cake_tpu.obs.jsonl import JsonlAppender
+            self._log = JsonlAppender(decision_log)
+        if tokenizer is None:
+            log.warning(
+                "router: no tokenizer — affinity keys fall back to "
+                "system-prompt TEXT fingerprints (stable, but not "
+                "page-aligned; pass the model's tokenizer for the "
+                "register_prefix rounding rule)")
+
+    # -- affinity keys ---------------------------------------------------
+
+    def _page_size(self) -> int:
+        """The fleet's kv page size, read from any polled replica's
+        lite health (replicas of one deployment share a config);
+        default when nothing has reported one yet."""
+        for st in self.tracker.states():
+            if st.page_size:
+                return int(st.page_size)
+        return DEFAULT_PAGE_SIZE
+
+    def affinity_key(self, body: dict) -> Optional[str]:
+        """The request's shareable-head fingerprint: the rendered
+        system-message head (exactly what the engine's --auto-prefix
+        registers), page-aligned through the tokenizer when one is
+        available."""
+        msgs = body.get("messages") or []
+        if not msgs or not isinstance(msgs[0], dict):
+            return None
+        if str(msgs[0].get("role", "")).lower() != "system":
+            return None
+        from cake_tpu.models.chat import BEGIN_OF_TEXT, History, Message
+        try:
+            head = BEGIN_OF_TEXT + History.encode_message(
+                Message.from_json(msgs[0]))
+        except (ValueError, AttributeError):
+            return None
+        if self.tokenizer is None:
+            return text_fingerprint(head)
+        from cake_tpu.models.llama.generator import encode_text
+        ids = encode_text(self.tokenizer, head)
+        return prefix_fingerprint(ids, self._page_size())
+
+    # -- introspection ---------------------------------------------------
+
+    def state(self) -> dict:
+        return {
+            "role": "router",
+            "policy": self.policy.mode,
+            "load_watermark": self.policy.load_watermark,
+            "replicas": self.tracker.snapshot(),
+            "page_size": self._page_size(),
+            "affinity": ("paged" if self.tokenizer is not None
+                         else "text"),
+        }
+
+    def health(self) -> dict:
+        up = [s.name for s in self.tracker.admitting()]
+        return {"status": "ok" if up else "degraded",
+                "role": "router",
+                "replicas_admitting": up,
+                "replicas_total": len(self.tracker.names())}
+
+    def note_decision(self, rec: dict) -> None:
+        if self._log is not None:
+            self._log.append(rec)
+
+    def metrics(self) -> str:
+        return obs_metrics.REGISTRY.render()
+
+    def close(self) -> None:
+        self.tracker.close()
+        if self._log is not None:
+            self._log.close()
+
+
+def make_router_handler(router: RouterServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("router http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            route = self.path.split("?", 1)[0]
+            if route == "/api/v1/router":
+                return self._json(200, router.state())
+            if route == "/api/v1/health":
+                return self._json(200, router.health())
+            if route in ("/metrics", "/api/v1/metrics"):
+                data = router.metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            self._json(404, {"error": "not found (router process: "
+                                      "chat + router introspection "
+                                      "only)"})
+
+        def do_POST(self):
+            route = self.path.split("?", 1)[0]
+            if route not in ("/api/v1/chat/completions",
+                             "/v1/chat/completions"):
+                return self._json(404, {
+                    "error": "not found (the router fronts chat "
+                             "completions; administrative endpoints "
+                             "live on the replicas)"})
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b"{}"
+            try:
+                body = json.loads(raw)
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as e:
+                return self._json(400, {"error": f"invalid JSON body: "
+                                                 f"{e}"})
+            self._route_chat(route, raw, body)
+
+        # -- routed chat -------------------------------------------------
+
+        def _route_chat(self, route: str, raw: bytes,
+                        body: dict) -> None:
+            cls = body.get("priority") \
+                or self.headers.get("x-cake-priority") or "standard"
+            if not isinstance(cls, str):
+                cls = "standard"
+            idem = self.headers.get("x-cake-idempotency-key")
+            stream = bool(body.get("stream"))
+            try:
+                key = router.affinity_key(body)
+            except Exception:  # noqa: BLE001 — affinity is best-effort
+                log.debug("affinity key failed", exc_info=True)
+                key = None
+
+            self._stream_started = False
+            tried: set = set()
+            last_refusal_ra = None
+            while True:
+                try:
+                    decision = router.policy.route(
+                        key=key, idem_key=idem, exclude=tried)
+                except NoReplicaError as e:
+                    _SHEDS.labels(reason="no_replica").inc()
+                    router.note_decision({
+                        "event": "shed", "class": cls,
+                        "tried": sorted(tried)})
+                    hdrs = {}
+                    # a REPLICA-computed Retry-After only: the drain
+                    # ETA from a lite-health doc, or the one carried
+                    # by the last roamable refusal this very request
+                    # saw — the router never invents its own
+                    ra = (e.retry_after_s if e.retry_after_s is not None
+                          else last_refusal_ra)
+                    if ra is not None:
+                        hdrs["Retry-After"] = str(
+                            max(1, int(-(-ra // 1))))
+                    return self._json(503, {
+                        "error": "no replica available",
+                        "tried": sorted(tried),
+                        "retryable": True}, headers=hdrs)
+
+                name = decision.replica
+
+                def admitted(name=name):
+                    # as soon as the replica 200s: the request holds a
+                    # slot there, so keyed reconnects must find this
+                    # home even while the stream is still running
+                    _REQUESTS.labels(name, cls).inc()
+                    router.policy.note_admitted(idem, name)
+
+                outcome = router.proxy.forward_chat(
+                    name, route, raw, self.headers, stream,
+                    send_status=self._relay_status,
+                    send_line=self._relay_line,
+                    send_terminal_error=self._terminal_error,
+                    on_admitted=admitted)
+                router.note_decision({
+                    "event": "route", "replica": name,
+                    "outcome": decision.outcome, "class": cls,
+                    "proxy": outcome.kind, "status": outcome.status})
+
+                if outcome.kind == "retryable":
+                    tried.add(name)
+                    if outcome.retry_after_s is not None:
+                        last_refusal_ra = outcome.retry_after_s
+                    if outcome.hard:
+                        # connect-level failure: strong evidence —
+                        # eject now, probe later (the poller would
+                        # take a staleness window to notice)
+                        router.tracker.note_failure(name, hard=True)
+                        _FAILOVERS.labels(reason="connect").inc()
+                    else:
+                        # post-connect: either a roamable REFUSAL
+                        # (draining/switch/reset — a protocol answer
+                        # from a live replica, no failure evidence) or
+                        # a genuine break (header timeout, cut body —
+                        # soft evidence: a busy replica is not a
+                        # corpse). Labels stay bounded either way.
+                        reason = (outcome.error if outcome.error in
+                                  ("draining", "switch", "reset")
+                                  else "replica_error")
+                        if reason == "replica_error":
+                            router.tracker.note_failure(name)
+                        _FAILOVERS.labels(reason=reason).inc()
+                    continue
+                if outcome.kind == "midstream":
+                    _FAILOVERS.labels(reason="midstream").inc()
+                    router.tracker.note_failure(name)
+                    return
+                if outcome.kind == "relayed":
+                    _SHEDS.labels(reason="relay").inc()
+                    return
+                # "ok": relay complete (admission was counted by the
+                # on_admitted callback when the 200 arrived)
+                if self._stream_started:
+                    # close OUR chunked response (the relay loop only
+                    # forwards the replica's SSE lines)
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                return
+
+        # -- relay callbacks ---------------------------------------------
+
+        def _relay_status(self, code: int, headers: dict,
+                          data: bytes) -> None:
+            self.send_response(code)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _relay_line(self, line: bytes) -> None:
+            if not self._stream_started:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self._stream_started = True
+            self.wfile.write(hex(len(line))[2:].encode() + b"\r\n")
+            self.wfile.write(line + b"\r\n")
+            self.wfile.flush()
+
+        def _terminal_error(self, message: str) -> None:
+            payload = (b"data: " + json.dumps({"error": {
+                "message": message, "type": "ReplicaDownError",
+                "retryable": True}}).encode() + b"\n\n")
+            try:
+                if not self._stream_started:
+                    # should not happen (midstream implies bytes went
+                    # out), but never write a bare payload without
+                    # headers
+                    self.send_response(502)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.wfile.write(
+                    hex(len(payload))[2:].encode() + b"\r\n")
+                self.wfile.write(payload + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except OSError:
+                pass   # client is gone too; nothing to tell anyone
+
+    return Handler
+
+
+def start_router(replicas, address: str = "127.0.0.1:10127",
+                 block: bool = True, **router_kwargs):
+    """Bind and serve the front door. Returns (httpd, router); with
+    block=False the server runs on a daemon thread (tests, bench)."""
+    host, port = address.rsplit(":", 1)
+    router = RouterServer(replicas, **router_kwargs)
+    router.tracker.start()
+    httpd = ThreadingHTTPServer((host, int(port)),
+                                make_router_handler(router))
+    log.info("router listening on %s over replicas %s", address,
+             ",".join(router.tracker.names()))
+
+    def serve():
+        try:
+            httpd.serve_forever()
+        finally:
+            router.close()
+
+    if block:
+        serve()
+    else:
+        threading.Thread(target=serve, daemon=True).start()
+    return httpd, router

@@ -114,6 +114,7 @@ def test_step_metric_families_documented_in_readme():
     import cake_tpu.kv.host_tier  # noqa: F401 — registers cake_kv_*
     import cake_tpu.obs.steps  # noqa: F401 — registers the families
     import cake_tpu.parallel.health  # noqa: F401 — cake_heartbeat_*
+    import cake_tpu.router.server  # noqa: F401 — cake_router_*
     import cake_tpu.serve.engine  # noqa: F401 — recovery families
     import cake_tpu.serve.journal  # noqa: F401 — cake_journal_*
     from cake_tpu.obs import metrics as m
@@ -129,6 +130,8 @@ def test_step_metric_families_documented_in_readme():
                for line in text.splitlines()), "recovery families"
     assert any(line.startswith("# TYPE cake_autotune_switches_total")
                for line in text.splitlines()), "autotune families"
+    assert any(line.startswith("# TYPE cake_router_requests_total")
+               for line in text.splitlines()), "router families"
     errs = lm.lint_readme_coverage(text, readme)
     assert errs == [], errs
 

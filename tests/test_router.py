@@ -1,0 +1,647 @@
+"""cake_tpu/router units: page-aligned affinity fingerprints, the
+consistent-hash ring's ~1/N stability property (3->4->3 replicas over
+1k synthetic prefixes), bounded-load spill under a saturated target,
+idempotency-sticky failover when the home replica is ejected, replica
+tracking (staleness ejection, jittered re-probe, hard-failure fast
+path), --replicas parsing, and the HTTP front door + SSE proxy against
+FAKE replicas (no model, no engine): verbatim Retry-After relay,
+drain-aware failover, mid-stream death -> typed terminal SSE error."""
+
+import http.client
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from cake_tpu.router.affinity import (
+    HashRing, prefix_fingerprint, text_fingerprint,
+)
+from cake_tpu.router.policy import NoReplicaError, RoutingPolicy
+from cake_tpu.router.replicas import ReplicaTracker
+
+TOOLS = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+
+def _lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", TOOLS / "lint_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- affinity fingerprints ----------------------------------------------------
+
+def test_fingerprint_page_alignment_matches_register_prefix_rule():
+    P = 16
+    head = list(range(100, 100 + 2 * P))
+    # identical through the aligned head, differing in the partial
+    # last page -> SAME key (those requests can share pool pages)
+    a = prefix_fingerprint(head + [1, 2, 3], P)
+    b = prefix_fingerprint(head + [9, 9], P)
+    c = prefix_fingerprint(head, P)
+    assert a == b == c and a is not None
+    # a difference inside the aligned head changes the key
+    other = list(head)
+    other[3] += 1
+    assert prefix_fingerprint(other, P) != a
+    # shorter than one page: nothing shareable (register_prefix refuses)
+    assert prefix_fingerprint([1] * (P - 1), P) is None
+    assert prefix_fingerprint([], P) is None
+    with pytest.raises(ValueError):
+        prefix_fingerprint([1], 0)
+
+
+def test_text_fingerprint_stable_and_none_on_empty():
+    assert text_fingerprint("sys prompt") == text_fingerprint("sys prompt")
+    assert text_fingerprint("a") != text_fingerprint("b")
+    assert text_fingerprint("") is None
+
+
+# -- consistent-hash stability (the satellite property test) ------------------
+
+def _keys(n=1000):
+    return [prefix_fingerprint([i & 0xFF, (i >> 8) & 0xFF]
+                               + list(range(30)), 16)
+            for i in range(n)]
+
+
+def test_ring_moves_about_one_nth_on_add_and_remove():
+    """Adding or removing one replica of N remaps only ~1/N of a 1k
+    synthetic prefix population; removing it again restores the
+    original mapping exactly."""
+    keys = _keys()
+    r3 = HashRing(["r0:1", "r1:1", "r2:1"])
+    m3 = {k: r3.node_for(k) for k in keys}
+    r3.add("r3:1")
+    m4 = {k: r3.node_for(k) for k in keys}
+    moved = sum(1 for k in keys if m3[k] != m4[k])
+    # expectation 1/4 = 250; generous band that still rules out a
+    # naive mod-N rehash (which moves ~3/4)
+    assert 0.10 * len(keys) < moved < 0.45 * len(keys), moved
+    # every moved key landed on the NEW node (consistent hashing's
+    # defining property: old nodes never exchange keys among themselves)
+    assert all(m4[k] == "r3:1" for k in keys if m3[k] != m4[k])
+    r3.remove("r3:1")
+    m3b = {k: r3.node_for(k) for k in keys}
+    assert m3b == m3
+    # the population spreads over every node (vnodes do their job)
+    from collections import Counter
+    counts = Counter(m3.values())
+    assert set(counts) == {"r0:1", "r1:1", "r2:1"}
+    assert min(counts.values()) > 0.15 * len(keys), counts
+
+
+def test_ring_spill_order_deterministic_and_distinct():
+    r = HashRing(["a:1", "b:1", "c:1"])
+    key = "some-prefix-key"
+    order = list(r.nodes_for(key))
+    assert sorted(order) == ["a:1", "b:1", "c:1"]
+    assert order == list(r.nodes_for(key))
+
+
+# -- policy: affinity, bounded load, sticky failover --------------------------
+
+def _tracker(docs):
+    t = ReplicaTracker(list(docs), fetch=lambda n: dict(docs[n]))
+    t.poll_once()
+    return t
+
+
+def _doc(depth=0, active=0, **kw):
+    return {"status": "ok", "queue_depth": depth,
+            "active_requests": active, **kw}
+
+
+def test_affinity_hit_then_bounded_load_spill():
+    docs = {"a:1": _doc(), "b:1": _doc(), "c:1": _doc()}
+    t = _tracker(docs)
+    p = RoutingPolicy(t, load_watermark=4)
+    key = "tenant-key"
+    target = p.ring.node_for(key)
+    d = p.route(key=key)
+    assert d.replica == target and d.outcome == "hit"
+    # saturate the target past the watermark: the SAME key now spills
+    # to the next ring node (deterministic per key), recorded as a miss
+    docs[target]["queue_depth"] = 10
+    t.poll_once()
+    d2 = p.route(key=key)
+    spill_order = list(p.ring.nodes_for(key))
+    assert d2.replica == spill_order[1]
+    assert d2.outcome == "spill"
+    # all over the watermark: falls to least-loaded rather than refusing
+    for n in docs:
+        docs[n]["queue_depth"] = 20
+    docs[spill_order[2]]["queue_depth"] = 19
+    t.poll_once()
+    d3 = p.route(key=key)
+    assert d3.replica == spill_order[2]
+
+
+def test_sticky_key_routes_home_until_ejected():
+    docs = {"a:1": _doc(), "b:1": _doc()}
+    t = _tracker(docs)
+    p = RoutingPolicy(t, load_watermark=4)
+    d = p.route(key="k", idem_key="idem-1")
+    p.note_admitted("idem-1", d.replica)
+    home = d.replica
+    # retries stick to the home even when it is DRAINING (an attach
+    # names existing work; the engine's idempotency check precedes its
+    # drain gate) and even when another replica is emptier
+    docs[home]["draining"] = True
+    docs[home]["queue_depth"] = 3
+    t.poll_once()
+    d2 = p.route(key="k", idem_key="idem-1")
+    assert d2.replica == home and d2.outcome == "sticky"
+    # ejected home: fall back to re-admission elsewhere
+    t.note_failure(home, hard=True)
+    d3 = p.route(key="k", idem_key="idem-1")
+    assert d3.replica != home
+    # the re-admission becomes the new home
+    p.note_admitted("idem-1", d3.replica)
+    t.poll_once()   # home recovers…
+    d4 = p.route(key="k", idem_key="idem-1")
+    assert d4.replica == d3.replica   # …but the key stays re-homed
+
+
+def test_no_replica_propagates_replica_computed_eta_only():
+    docs = {"a:1": _doc(draining=True, drain={"eta_s": 7.5}),
+            "b:1": _doc(draining=True, drain={"eta_s": 3.0})}
+    t = _tracker(docs)
+    p = RoutingPolicy(t)
+    with pytest.raises(NoReplicaError) as ei:
+        p.route(key="k")
+    assert ei.value.retry_after_s == 3.0   # min over replicas, verbatim
+    # no replica reported an ETA -> NO invented Retry-After
+    docs2 = {"a:1": {"status": "failed"}}
+    t2 = _tracker(docs2)
+    with pytest.raises(NoReplicaError) as ei2:
+        RoutingPolicy(t2).route()
+    assert ei2.value.retry_after_s is None
+
+
+def test_breaker_tripped_replica_not_admitting():
+    docs = {"a:1": _doc(recovery={"breaker": {"tripped": True}}),
+            "b:1": _doc()}
+    t = _tracker(docs)
+    p = RoutingPolicy(t)
+    for _ in range(4):
+        assert p.route(key="x").replica == "b:1"
+
+
+def test_round_robin_mode_rotates():
+    docs = {"a:1": _doc(), "b:1": _doc()}
+    t = _tracker(docs)
+    p = RoutingPolicy(t, mode="round_robin")
+    picks = {p.route(key="same-key").replica for _ in range(6)}
+    assert picks == {"a:1", "b:1"}
+
+
+# -- tracker: staleness ejection + jittered re-probe --------------------------
+
+def test_tracker_staleness_ejection_and_reinstate():
+    flaky = {"fail": False}
+
+    def fetch(name):
+        if flaky["fail"]:
+            raise OSError("down")
+        return _doc()
+
+    t = ReplicaTracker(["r:1"], stale_after_s=0.05, fetch=fetch)
+    t.poll_once()
+    assert t.get("r:1").admitting
+    flaky["fail"] = True
+    t.poll_once()
+    # one miss inside the staleness window must NOT bounce the replica
+    assert not t.get("r:1").ejected
+    time.sleep(0.06)
+    t.poll_once()
+    st = t.get("r:1")
+    assert st.ejected and not st.admitting
+    # backoff deadline armed; a due probe that succeeds reinstates
+    assert st.next_probe > time.monotonic() - 1
+    flaky["fail"] = False
+    t.poll_once(now=st.next_probe + 1e-3)
+    assert t.get("r:1").admitting
+
+
+def test_tracker_hard_failure_ejects_immediately():
+    t = ReplicaTracker(["r:1"], fetch=lambda n: _doc())
+    t.poll_once()
+    assert t.get("r:1").admitting
+    t.note_failure("r:1", hard=True)
+    assert t.get("r:1").ejected
+
+
+def test_tracker_backoff_jitter_is_per_replica_deterministic():
+    t1 = ReplicaTracker(["r:1", "q:1"], fetch=lambda n: _doc())
+    t2 = ReplicaTracker(["r:1", "q:1"], fetch=lambda n: _doc())
+    s1, s2 = t1.get("r:1"), t2.get("r:1")
+    s1.failures = s2.failures = 3
+    assert t1._backoff_s(s1) == t2._backoff_s(s2)   # seeded from name
+    q = t1.get("q:1")
+    q.failures = 3
+    assert t1._backoff_s(q) != t2._backoff_s(s2)    # de-correlated
+
+
+def test_tracker_rejects_bad_config():
+    with pytest.raises(ValueError):
+        ReplicaTracker([])
+    with pytest.raises(ValueError):
+        ReplicaTracker(["a:1", "a:1"])
+    with pytest.raises(ValueError):
+        ReplicaTracker(["a:1"], poll_interval_s=0)
+
+
+# -- args plumbing ------------------------------------------------------------
+
+def test_args_router_validation():
+    from cake_tpu.args import Args, parse_replicas
+    Args(router=True, replicas="h:1,g:2").validate()
+    with pytest.raises(ValueError, match="requires --replicas"):
+        Args(router=True).validate()
+    with pytest.raises(ValueError, match="host:port"):
+        parse_replicas("nohost")
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_replicas("h:port")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_replicas("h:1,h:1")
+    with pytest.raises(ValueError, match="router_policy"):
+        Args(router_policy="wat").validate()
+    with pytest.raises(ValueError, match="router-watermark"):
+        Args(router_watermark=0).validate()
+    with pytest.raises(ValueError, match="router-poll"):
+        Args(router_poll=0.0).validate()
+
+
+# -- HTTP front door over FAKE replicas ---------------------------------------
+
+class _FakeReplica:
+    """A scripted stand-in engine server: serves lite health and one
+    scripted chat behavior per instance."""
+
+    def __init__(self, behavior="ok", events=3, health=None):
+        self.behavior = behavior
+        self.events = events
+        self.health_doc = health or {"status": "ok", "queue_depth": 0,
+                                     "active_requests": 0,
+                                     "replica": "fake"}
+        self.chat_calls = 0
+        self.seen_headers = []
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/api/v1/health"):
+                    data = json.dumps(fake.health_doc).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+
+            def do_POST(self):
+                fake.chat_calls += 1
+                fake.seen_headers.append(dict(self.headers))
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                b = fake.behavior
+                if b == "shed429":
+                    data = (b'{"error": "request shed: server '
+                            b'saturated for this priority class"}')
+                    self.send_response(429)
+                    self.send_header("Retry-After", "7")
+                    self.send_header("x-cake-replica", "fake-shed")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if b == "drain429":
+                    data = (b'{"error": "server draining: admissions '
+                            b'are closed"}')
+                    self.send_response(429)
+                    self.send_header("Retry-After", "4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                # SSE: `events` id-carrying chunks, then [DONE] unless
+                # behavior says die mid-stream
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(payload: bytes):
+                    self.wfile.write(
+                        hex(len(payload))[2:].encode() + b"\r\n")
+                    self.wfile.write(payload + b"\r\n")
+                    self.wfile.flush()
+
+                for i in range(fake.events):
+                    chunk(b"id: " + str(i + 1).encode()
+                          + b"\ndata: {\"tok\": " + str(i).encode()
+                          + b"}\n\n")
+                if b == "die_midstream":
+                    # hard close without [DONE] — the router must emit
+                    # the typed terminal error, not a silent close.
+                    # shutdown() forces the FIN out NOW: plain close()
+                    # would leave the fd alive under the rfile/wfile
+                    # makefile refs and the router would never see EOF
+                    import socket as _socket
+                    self.wfile.flush()
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                    self.close_connection = True
+                    return
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")   # chunked terminator (len 0)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _start_router(replicas, **kw):
+    from cake_tpu.router import start_router
+    kw.setdefault("poll_interval_s", 0.05)
+    httpd, router = start_router(
+        replicas, address="127.0.0.1:0", block=False, **kw)
+    router.tracker.poll_once()
+    return httpd, router
+
+
+def _post_chat(addr, body=None, headers=None, stream=False):
+    conn = http.client.HTTPConnection(addr, timeout=30)
+    conn.request("POST", "/api/v1/chat/completions",
+                 body=json.dumps(body or {
+                     "messages": [{"role": "user", "content": "hi"}],
+                     **({"stream": True} if stream else {})}),
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    return conn, conn.getresponse()
+
+
+def test_router_sse_passthrough_preserves_ids():
+    fake = _FakeReplica(behavior="ok", events=3)
+    httpd, router = _start_router([fake.addr])
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr, stream=True)
+        assert resp.status == 200
+        body = resp.read().decode()
+        # id: fields preserved verbatim through the proxy
+        assert "id: 1\n" in body and "id: 3\n" in body
+        assert "data: [DONE]" in body
+        conn.close()
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_relays_shed_429_verbatim():
+    fake = _FakeReplica(behavior="shed429")
+    httpd, router = _start_router([fake.addr])
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr)
+        # the replica's computed backpressure relays untouched: status,
+        # Retry-After AND the x-cake-replica attribution
+        assert resp.status == 429
+        assert resp.getheader("Retry-After") == "7"
+        assert resp.getheader("x-cake-replica") == "fake-shed"
+        assert "shed" in json.loads(resp.read())["error"]
+        conn.close()
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_drain_429_fails_over_to_second_replica():
+    draining = _FakeReplica(behavior="drain429")
+    healthy = _FakeReplica(behavior="ok", events=2)
+    httpd, router = _start_router([draining.addr, healthy.addr],
+                                  policy_mode="round_robin")
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        # whichever replica the rotation hits first, every request must
+        # END on the healthy one (a drain refusal roams, never relays)
+        for _ in range(3):
+            conn, resp = _post_chat(addr, stream=True)
+            assert resp.status == 200
+            assert b"[DONE]" in resp.read()
+            conn.close()
+        assert healthy.chat_calls == 3
+    finally:
+        httpd.shutdown()
+        router.close()
+        draining.close()
+        healthy.close()
+
+
+def test_router_midstream_death_is_typed_terminal_event():
+    fake = _FakeReplica(behavior="die_midstream", events=2)
+    httpd, router = _start_router([fake.addr])
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr, stream=True)
+        assert resp.status == 200
+        body = resp.read().decode()
+        # both relayed events arrived, then the TYPED terminal error —
+        # not a silent close
+        assert "id: 2\n" in body
+        err = [ln for ln in body.splitlines()
+               if ln.startswith('data: {"error"')]
+        assert err, body
+        doc = json.loads(err[0][6:])["error"]
+        assert doc["type"] == "ReplicaDownError"
+        assert doc["retryable"] is True
+        assert "Last-Event-ID" in doc["message"]
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_connect_failure_fails_over_and_ejects():
+    dead_port_holder = ThreadingHTTPServer(("127.0.0.1", 0),
+                                           BaseHTTPRequestHandler)
+    dead_addr = f"127.0.0.1:{dead_port_holder.server_address[1]}"
+    dead_port_holder.server_close()   # nothing listens here now
+    # the live replica reports LOAD, so least-loaded deterministically
+    # tries the (apparently idle) corpse first — the scenario where
+    # only the data path can discover the death
+    live = _FakeReplica(behavior="ok", events=1,
+                        health={"status": "ok", "queue_depth": 5,
+                                "active_requests": 2})
+    httpd, router = _start_router([dead_addr, live.addr])
+    try:
+        # the poller may not have ejected the dead one yet: force the
+        # state where only the data path has seen it
+        st = router.tracker.get(dead_addr)
+        st.ejected = False
+        st.doc = {"status": "ok", "queue_depth": 0}
+        st.last_ok = time.monotonic()
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr, stream=True)
+        assert resp.status == 200
+        assert b"[DONE]" in resp.read()
+        conn.close()
+        # the hard connect failure ejected the corpse for next time
+        assert router.tracker.get(dead_addr).ejected
+        assert live.chat_calls == 1
+    finally:
+        httpd.shutdown()
+        router.close()
+        live.close()
+
+
+def test_router_503_when_no_replica_and_introspection():
+    fake = _FakeReplica(health={"status": "ok", "queue_depth": 0,
+                                "active_requests": 0, "draining": True,
+                                "drain": {"eta_s": 5.0}})
+    httpd, router = _start_router([fake.addr])
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr)
+        assert resp.status == 503
+        # the Retry-After is the REPLICA's drain ETA (ceil'd), not a
+        # router invention
+        assert resp.getheader("Retry-After") == "5"
+        doc = json.loads(resp.read())
+        assert doc["retryable"] is True
+        conn.close()
+        # introspection surfaces
+        conn2 = http.client.HTTPConnection(addr, timeout=10)
+        conn2.request("GET", "/api/v1/router")
+        state = json.loads(conn2.getresponse().read())
+        assert state["role"] == "router"
+        assert fake.addr in state["replicas"]
+        assert state["replicas"][fake.addr]["draining"] is True
+        conn2.request("GET", "/api/v1/health")
+        h = json.loads(conn2.getresponse().read())
+        assert h["role"] == "router"
+        assert h["replicas_admitting"] == []
+        # the router's own /metrics lints clean with the cake_router_*
+        # families live
+        conn2.request("GET", "/metrics")
+        text = conn2.getresponse().read().decode()
+        conn2.close()
+        lm = _lint()
+        assert lm.lint(text) == []
+        assert "# TYPE cake_router_replica_state gauge" in text
+        assert "cake_router_sheds_total" in text
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_forwards_control_headers_and_sticks_keyed_requests():
+    a = _FakeReplica(behavior="ok", events=1)
+    b = _FakeReplica(behavior="ok", events=1)
+    httpd, router = _start_router([a.addr, b.addr],
+                                  policy_mode="round_robin")
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        hdrs = {"x-cake-idempotency-key": "key-9",
+                "x-cake-priority": "interactive",
+                "Last-Event-ID": "0"}
+        conn, resp = _post_chat(addr, headers=hdrs, stream=True)
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+        first_home = a if a.chat_calls else b
+        seen = first_home.seen_headers[-1]
+        assert seen.get("x-cake-idempotency-key") == "key-9"
+        assert seen.get("x-cake-priority") == "interactive"
+        assert seen.get("Last-Event-ID") == "0"
+        # retries with the key stick to the first home despite the
+        # round-robin rotation
+        for _ in range(3):
+            conn, resp = _post_chat(addr, headers=hdrs, stream=True)
+            assert resp.status == 200
+            resp.read()
+            conn.close()
+        assert first_home.chat_calls == 4
+        assert (a if first_home is b else b).chat_calls == 0
+    finally:
+        httpd.shutdown()
+        router.close()
+        a.close()
+        b.close()
+
+
+def test_router_exhausted_fleet_propagates_last_refusal_retry_after():
+    """A replica whose lite health still said 'admitting' refuses with
+    a drain 429 + Retry-After; with nowhere left to roam, the router's
+    503 carries THAT replica-computed Retry-After — the poller being a
+    beat behind must not cost the client the honest backoff."""
+    fake = _FakeReplica(behavior="drain429")   # health says ok
+    httpd, router = _start_router([fake.addr])
+    try:
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        conn, resp = _post_chat(addr)
+        assert resp.status == 503
+        assert resp.getheader("Retry-After") == "4"   # the fake's own
+        assert json.loads(resp.read())["retryable"] is True
+        conn.close()
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_blackhole_replica_bounded_by_header_timeout():
+    """A replica that ACCEPTS connections but never answers (drained
+    shutdown leaving its listen socket open, wedged accept loop) must
+    not blackhole requests for the stream-idle window: the proxy's
+    response-header bound turns it into a roamable failure and the
+    request completes on the live replica."""
+    import socket as _socket
+
+    from cake_tpu.router.proxy import ReplicaProxy
+    hole = _socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(8)   # accepts into the backlog; nobody ever reads
+    hole_addr = f"127.0.0.1:{hole.getsockname()[1]}"
+    live = _FakeReplica(behavior="ok", events=1,
+                        health={"status": "ok", "queue_depth": 5,
+                                "active_requests": 2})
+    httpd, router = _start_router([hole_addr, live.addr])
+    router.proxy = ReplicaProxy(header_timeout_s=0.5)
+    try:
+        st = router.tracker.get(hole_addr)
+        st.ejected = False
+        st.doc = {"status": "ok", "queue_depth": 0}
+        st.last_ok = time.monotonic()
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        t0 = time.monotonic()
+        conn, resp = _post_chat(addr, stream=True)
+        assert resp.status == 200
+        assert b"[DONE]" in resp.read()
+        conn.close()
+        # bounded: header timeout (0.5s) + live relay, nowhere near
+        # the 600s idle window
+        assert time.monotonic() - t0 < 10
+        assert live.chat_calls == 1
+    finally:
+        httpd.shutdown()
+        router.close()
+        live.close()
+        hole.close()

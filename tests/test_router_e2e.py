@@ -1,0 +1,382 @@
+"""End-to-end acceptance: 2 REAL in-process engine replicas behind the
+router over localhost HTTP (ISSUE 14).
+
+  * shared-prefix requests route to ONE replica: its per-engine prefix
+    hits advance (the source feeding cake_prefix_paged_hits_total —
+    asserted per-engine because both in-process replicas share the one
+    process-global metrics registry), the other replica's stay 0;
+  * a drained replica receives ZERO new admissions while its in-flight
+    stream finishes, and the drain 429 carries x-cake-replica;
+  * a killed replica's keyed SSE client reconnects through the router
+    with Last-Event-ID and completes token-identical at f32 KV on the
+    surviving replica (fresh-admission suppression in api/server.py);
+  * the lite health document is a subtree of the full one (the
+    ?lite=1 contract the router polls).
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax.numpy as jnp
+import pytest
+
+T = 256
+PAGE = 8
+GEN = 10
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    import jax
+
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", T)
+    kw.setdefault("kv_pages", 48)
+    kw.setdefault("kv_page_size", PAGE)
+    kw.setdefault("paged_attn", "fold")
+    kw.setdefault("auto_prefix_system", True)
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # f32 KV: token identity must exercise routing/failover, not
+        # bf16 tie-breaks
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+def _replica(tiny_config, params, tag, **kw):
+    """One engine + ApiServer + HTTP server; returns (engine, api,
+    httpd, addr)."""
+    from cake_tpu.api.server import ApiServer, make_handler
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    eng = _engine(tiny_config, params, **kw)
+    master = Master(Args(sample_len=GEN), text_generator=None)
+    master.llm = object()
+    api = ApiServer(master, engine=eng, replica_id=tag)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(api))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    api.replica_id = addr
+    return eng, api, httpd, addr
+
+
+def _router_over(replicas, tiny_config, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.router import start_router
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("stale_after_s", 1.0)
+    httpd, router = start_router(
+        replicas, address="127.0.0.1:0", block=False,
+        tokenizer=ByteTokenizer(tiny_config.vocab_size), **kw)
+    router.tracker.poll_once()
+    return httpd, router, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def _messages(tenant: str, turn: str):
+    return [{"role": "system",
+             "content": f"You are {tenant}, a terse test assistant."},
+            {"role": "user", "content": turn}]
+
+
+def _post(addr, body, headers=None, timeout=600):
+    req = urllib.request.Request(
+        f"http://{addr}/api/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _read_sse(resp, until_done=True, max_events=10_000):
+    """Parse an SSE byte stream into [(id, doc)] pairs; stops at [DONE]
+    or EOF."""
+    events, cur_id = [], None
+    for raw in resp:
+        line = raw.decode()
+        if line.startswith("id: "):
+            cur_id = int(line[4:].strip())
+        elif line.startswith("data: "):
+            payload = line[6:].strip()
+            if payload == "[DONE]":
+                break
+            events.append((cur_id, json.loads(payload)))
+            if len(events) >= max_events:
+                break
+    return events
+
+
+def _text_of(events):
+    return "".join(
+        e.get("choices", [{}])[0].get("delta", {}).get("content") or ""
+        for _, e in events if "choices" in e)
+
+
+# -- affinity: one replica holds the pages ------------------------------------
+
+def test_shared_prefix_requests_route_to_one_replica(tiny_config,
+                                                     params):
+    engA, apiA, httpdA, addrA = _replica(tiny_config, params, "A")
+    engB, apiB, httpdB, addrB = _replica(tiny_config, params, "B")
+    rhttpd, router, raddr = _router_over([addrA, addrB], tiny_config)
+    try:
+        key = router.affinity_key(
+            {"messages": _messages("tenant-x", "q")})
+        assert key is not None   # paged fingerprint, from lite health
+        for i in range(4):
+            out = json.loads(_post(raddr, {
+                "messages": _messages("tenant-x", f"turn {i}"),
+                "max_tokens": 4}).read())
+            assert out["choices"][0]["message"]["content"] is not None
+        done = (engA.stats.requests_completed,
+                engB.stats.requests_completed)
+        assert sorted(done) == [0, 4], done
+        home, cold = (engA, engB) if done[0] else (engB, engA)
+        # the home replica's prefix-hit counter (the per-engine source
+        # of cake_prefix_paged_hits_total) advanced; the cold one's
+        # did not, and it holds no registration either
+        assert home.stats.prefix_hits >= 3
+        assert cold.stats.prefix_hits == 0
+        assert len(cold._prefixes) == 0
+        assert len(home._prefixes) == 1
+        # a different tenant may land elsewhere, but never splits:
+        # both its requests go to ONE replica too
+        beforeA, beforeB = (engA.stats.requests_completed,
+                            engB.stats.requests_completed)
+        for i in range(2):
+            _post(raddr, {"messages": _messages("tenant-y", f"t{i}"),
+                          "max_tokens": 2}).read()
+        deltas = sorted((engA.stats.requests_completed - beforeA,
+                         engB.stats.requests_completed - beforeB))
+        assert deltas == [0, 2], deltas
+    finally:
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            h.shutdown()
+        for e in (engA, engB):
+            e.stop(timeout=10)
+
+
+# -- lite health contract -----------------------------------------------------
+
+def _subtree(lite, full, path=""):
+    assert isinstance(lite, dict) and isinstance(full, dict), path
+    for k, v in lite.items():
+        assert k in full, f"lite key {path}/{k} missing from full health"
+        if isinstance(v, dict):
+            _subtree(v, full[k], f"{path}/{k}")
+
+
+def test_lite_health_is_subtree_of_full(tiny_config, params):
+    engA, apiA, httpdA, addrA = _replica(
+        tiny_config, params, "A", priority_classes=True)
+    try:
+        full = apiA.health()
+        lite = apiA.health(lite=True)
+        _subtree(lite, full)
+        # the poll set the router needs is present
+        for k in ("status", "replica", "queue_depth",
+                  "active_requests", "decode_slots", "page_size",
+                  "config_epoch", "switch_in_flight", "recovery",
+                  "queue_depth_by_class"):
+            assert k in lite, k
+        assert lite["page_size"] == PAGE
+        assert lite["recovery"]["breaker"]["tripped"] is False
+        # the heavy blocks stay OUT of lite
+        for k in ("engine_config", "requests_completed",
+                  "tokens_generated", "model"):
+            assert k not in lite, k
+        # HTTP: ?lite=1 serves the lite doc; bare path the full one
+        via_http = json.loads(urllib.request.urlopen(
+            f"http://{addrA}/api/v1/health?lite=1", timeout=30).read())
+        assert set(via_http) == set(lite)
+        via_full = json.loads(urllib.request.urlopen(
+            f"http://{addrA}/api/v1/health", timeout=30).read())
+        assert "engine_config" in via_full
+        assert via_full["replica"] == addrA
+    finally:
+        httpdA.shutdown()
+        engA.stop(timeout=10)
+
+
+# -- drain: zero new admissions, in-flight finishes ---------------------------
+
+def test_drained_replica_gets_zero_new_admissions(tiny_config, params):
+    engA, apiA, httpdA, addrA = _replica(tiny_config, params, "A")
+    engB, apiB, httpdB, addrB = _replica(tiny_config, params, "B")
+    rhttpd, router, raddr = _router_over([addrA, addrB], tiny_config)
+    try:
+        # place tenant-d's home deterministically by asking the router
+        body = {"messages": _messages("tenant-d", "warm"),
+                "max_tokens": 2}
+        json.loads(_post(raddr, body).read())
+        homeA = engA.stats.requests_completed == 1
+        home_eng, home_api, home_addr = \
+            (engA, apiA, addrA) if homeA else (engB, apiB, addrB)
+        cold_eng = engB if homeA else engA
+
+        # long in-flight stream on the home replica
+        resp = _post(raddr, {
+            "messages": _messages("tenant-d", "long answer please"),
+            "stream": True, "max_tokens": 24}, timeout=600)
+        # wait until it holds a slot
+        deadline = time.monotonic() + 60
+        while home_eng.active == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert home_eng.active >= 1
+
+        # drain the home replica directly (the operator's move)
+        dreq = urllib.request.Request(
+            f"http://{home_addr}/api/v1/drain",
+            data=json.dumps({"timeout_s": 60}).encode(),
+            headers={"Content-Type": "application/json"})
+        st = json.loads(urllib.request.urlopen(dreq, timeout=30).read())
+        assert st["draining"] is True
+
+        # a direct submit to the draining replica 429s WITH the
+        # x-cake-replica attribution header (the satellite bugfix)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(home_addr, {"messages": _messages("t", "x")})
+        assert ei.value.code == 429
+        assert ei.value.headers["x-cake-replica"] == home_addr
+        assert int(ei.value.headers["Retry-After"]) >= 1
+
+        # the router observes the drain on its next poll…
+        router.tracker.poll_once()
+        assert not router.tracker.get(home_addr).admitting
+        base_home = home_eng.stats.requests_completed
+        # …and routes EVERY new admission (any tenant — including the
+        # drained home's own) to the other replica
+        for i in range(3):
+            out = json.loads(_post(raddr, {
+                "messages": _messages("tenant-d", f"post-drain {i}"),
+                "max_tokens": 2}).read())
+            assert out["choices"]
+        assert cold_eng.stats.requests_completed >= 3
+        # the in-flight stream FINISHED on the draining home (drain
+        # lets in-flight work complete; zero new admissions landed)
+        events = _read_sse(resp)
+        assert _text_of(events)
+        assert home_eng.stats.requests_completed == base_home + 1
+    finally:
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            h.shutdown()
+        for e in (engA, engB):
+            e.stop(timeout=10)
+
+
+# -- kill + keyed reconnect through the router --------------------------------
+
+def test_killed_replica_keyed_sse_reconnects_token_identical(
+        tiny_config, params):
+    from cake_tpu.serve.errors import EngineResetError
+    engA, apiA, httpdA, addrA = _replica(tiny_config, params, "A")
+    engB, apiB, httpdB, addrB = _replica(tiny_config, params, "B")
+    rhttpd, router, raddr = _router_over([addrA, addrB], tiny_config)
+    conn = None
+    try:
+        body = {"messages": _messages("tenant-k", "tell me a story"),
+                "stream": True, "max_tokens": 24}
+        hdrs = {"Content-Type": "application/json",
+                "x-cake-idempotency-key": "kill-drill"}
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(), headers=hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # read a few events, tracking the client's high-water mark
+        pre_events, cur_id = [], None
+        while len(pre_events) < 3:
+            line = resp.readline().decode()
+            if line.startswith("id: "):
+                cur_id = int(line[4:].strip())
+            elif line.startswith("data: ") and line.strip() != "data:":
+                doc = json.loads(line[6:])
+                if doc.get("choices", [{}])[0].get("delta", {}) \
+                        .get("content"):
+                    pre_events.append((cur_id, doc))
+        last_seen = max(i for i, _ in pre_events)
+        pre_text = _text_of(pre_events)
+        assert 0 < last_seen < 24
+
+        # identify + KILL the home replica: fail in-flight (the typed
+        # terminal event clients see on a dying box), stop the engine,
+        # and close its listening socket so reconnects are refused
+        home = router.policy.sticky_home("kill-drill")
+        assert home in (addrA, addrB)
+        h_eng, h_httpd = (engA, httpdA) if home == addrA \
+            else (engB, httpdB)
+        s_eng = engB if home == addrA else engA
+        h_eng._fail_all(EngineResetError("replica killed"))
+        h_eng.stop(timeout=10)
+        h_httpd.shutdown()
+        h_httpd.server_close()
+        # drain the rest of the broken stream (terminal error event or
+        # socket close — either way, NOT a silent success)
+        try:
+            tail = resp.read().decode()
+            assert '"error"' in tail or tail == ""
+        except (OSError, http.client.HTTPException):
+            pass
+        conn.close()
+        conn = None
+
+        # keyed reconnect THROUGH the router with Last-Event-ID: the
+        # sticky home is dead -> hard-eject failover -> fresh admission
+        # on the survivor, which re-runs the prompt deterministically
+        # and serves exactly the unseen suffix
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(),
+                     headers={**hdrs, "Last-Event-ID": str(last_seen)})
+        resp2 = conn.getresponse()
+        assert resp2.status == 200
+        post_events = _read_sse(resp2)
+        text_events = [(i, e) for i, e in post_events
+                       if e.get("choices", [{}])[0].get("delta", {})
+                       .get("content")]
+        assert text_events, post_events
+        # no event at or below the client's high-water mark: no dups
+        assert all(i is None or i > last_seen
+                   for i, _ in post_events), post_events
+        post_text = _text_of(post_events)
+        assert router.tracker.get(home).ejected
+
+        # token identity at f32 KV: (pre-kill text from the dead home)
+        # + (resumed suffix from the survivor) == the survivor's WHOLE
+        # transcript, fetched via a non-stream attach on the same key
+        out = json.loads(_post(raddr, {
+            "messages": _messages("tenant-k", "tell me a story"),
+            "max_tokens": 24}, headers={
+                "x-cake-idempotency-key": "kill-drill"}).read())
+        full_text = out["choices"][0]["message"]["content"]
+        assert pre_text + post_text == full_text
+        assert s_eng.stats.requests_completed >= 1
+    finally:
+        if conn is not None:
+            conn.close()
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            try:
+                h.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        for e in (engA, engB):
+            e.stop(timeout=10)

@@ -94,7 +94,11 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # durable serving (serve/journal.py): the
                        # write-ahead request journal's append/fsync/
                        # replay families
-                       "cake_journal_")
+                       "cake_journal_",
+                       # front-door router (cake_tpu/router): routed
+                       # requests, affinity hits/misses, sheds,
+                       # failovers, replica-state gauge, proxy TTFT
+                       "cake_router_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to
