@@ -2060,6 +2060,32 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
         fail1 = sum(f0.samples().values()) if f0 is not None else 0
         per_replica = [e.stats.requests_completed - w
                        for e, w in zip(engines, warm_done)]
+        # trace-sampled hop latencies per replica (ISSUE 15): walk
+        # each hop record's span chain pick -> connect -> first_byte
+        # (intermediate spans — admitted — pass through)
+        hop_pc = {r: [] for r in replicas}
+        hop_fb = {r: [] for r in replicas}
+        for rec in (router.hops.dump() if router.hops is not None
+                    else ()):
+            last = None   # (stage, t, replica)
+            for sp in rec["spans"]:
+                nm, rep = sp["name"], sp.get("replica")
+                if nm == "pick":
+                    last = ("pick", sp["t"], rep)
+                elif nm == "connect" and last is not None \
+                        and last[0] == "pick" and last[2] == rep \
+                        and rep in hop_pc:
+                    hop_pc[rep].append(sp["t"] - last[1])
+                    last = ("connect", sp["t"], rep)
+                elif nm == "first_byte" and last is not None \
+                        and last[0] == "connect" and last[2] == rep \
+                        and rep in hop_fb:
+                    hop_fb[rep].append(sp["t"] - last[1])
+                    last = None
+
+        def _hop_ms(samples, q):
+            return [round(_pct(sorted(samples[r]), q) * 1e3, 3)
+                    if samples[r] else None for r in replicas]
         rhttpd.shutdown()
         router.close()
         for h in httpds:
@@ -2080,6 +2106,10 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
             if good else None,
             "ttft_p99_ms": round(_pct(good, 0.99) * 1e3, 1)
             if good else None,
+            "hop_pick_connect_p50_ms": _hop_ms(hop_pc, 0.5),
+            "hop_pick_connect_p99_ms": _hop_ms(hop_pc, 0.99),
+            "hop_connect_first_byte_p50_ms": _hop_ms(hop_fb, 0.5),
+            "hop_connect_first_byte_p99_ms": _hop_ms(hop_fb, 0.99),
             "failovers": int(fail1 - fail0),
             "tokens": int(toks),
             "wall_s": round(wall, 3),
@@ -2094,7 +2124,17 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
     log(f"router[affinity]: {aff['goodput_tok_s']} tok/s goodput, "
         f"hit rate {aff['hit_rate']}, TTFT p50/p99 "
         f"{aff['ttft_p50_ms']}/{aff['ttft_p99_ms']}ms, per-replica "
-        f"{aff['per_replica_completed']}")
+        f"{aff['per_replica_completed']}, hop pick->connect p50 "
+        f"{aff['hop_pick_connect_p50_ms']}ms, connect->first-byte "
+        f"p50 {aff['hop_connect_first_byte_p50_ms']}ms")
+    sentinel = _router_sentinel_smoke(cfg, params, tok, max_seq,
+                                      gen_tokens)
+    log(f"sentinel smoke: clean anomalies "
+        f"{sentinel['sentinel_clean_anomalies']}, storm fired "
+        f"{sentinel['sentinel_storm_anomaly_kinds']} "
+        f"(recompiles detected "
+        f"{sentinel['sentinel_storm_recompile_anomalies']}, seeded "
+        f"degradations {sentinel['sentinel_degradations_injected']})")
     return {
         "metric": f"{name}_goodput_tok_s",
         "value": aff["goodput_tok_s"],
@@ -2115,7 +2155,118 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
         "router_failovers": aff["failovers"] + rr["failovers"],
         "router_per_replica_affinity": aff["per_replica_completed"],
         "router_per_replica_round_robin": rr["per_replica_completed"],
+        # per-replica trace-sampled hop latencies (router/tracing.py)
+        "router_hop_pick_connect_p50_ms":
+            aff["hop_pick_connect_p50_ms"],
+        "router_hop_pick_connect_p99_ms":
+            aff["hop_pick_connect_p99_ms"],
+        "router_hop_connect_first_byte_p50_ms":
+            aff["hop_connect_first_byte_p50_ms"],
+        "router_hop_connect_first_byte_p99_ms":
+            aff["hop_connect_first_byte_p99_ms"],
+        **sentinel,
         "device_kind": dev.device_kind,
+    }
+
+
+def _router_sentinel_smoke(cfg, params, tok, max_seq: int,
+                           gen_tokens: int) -> dict:
+    """The ISSUE 15 sentinel smoke: a CLEAN engine under
+    identical-shape load must fire ZERO anomalies; a degraded engine —
+    a seeded --fault-plan wedge mid-decode plus prompts walking three
+    FRESH prefill buckets in one window — must fire
+    cake_anomaly_total{kind="recompile_storm"}. Dense engines (the
+    paged mixed step compiles ONE program for every prompt length, so
+    bucketed whole-prompt prefill is where a shape storm lives);
+    detectors tick synchronously so the smoke is deterministic."""
+    from cake_tpu.models.chat import History, Message
+    from cake_tpu.models.llama.generator import (
+        bucket_length, encode_text,
+    )
+    from cake_tpu.obs import metrics as obs_m
+    from cake_tpu.obs.sentinel import attach_engine_sentinel
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    def msgs(n_user):
+        return [Message.from_json({"role": "user",
+                                   "content": "q" + "w" * n_user})]
+
+    def render_len(n_user):
+        hist = History(cfg.chat_template)
+        for m in msgs(n_user):
+            hist.add_message(m)
+        return len(encode_text(tok, hist.render()))
+
+    # one content length per DISTINCT prefill bucket, smallest first:
+    # lengths[0] is the clean/warm shape, the rest are the storm
+    base = render_len(0)
+    lengths, seen = [], set()
+    for n in range(1, max_seq - base - gen_tokens - 2):
+        b = bucket_length(base + n, max_seq)
+        if b not in seen:
+            seen.add(b)
+            lengths.append(n)
+        if len(lengths) == 4:
+            break
+    assert len(lengths) >= 3, (lengths, base, max_seq)
+
+    def build(fault_plan=None):
+        return InferenceEngine(
+            cfg, params, tok, max_slots=2, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0,
+                                    repeat_penalty=1.0),
+            fault_plan=fault_plan).start()
+
+    def drive(eng, ns):
+        for n in ns:
+            h = eng.chat(msgs(n), max_new_tokens=gen_tokens)
+            assert h.wait(timeout=900), "sentinel smoke timed out"
+
+    c = obs_m.REGISTRY.get("cake_anomaly_total")
+
+    def fired(kind):
+        return c.samples().get((kind,), 0) if c is not None else 0
+
+    # clean phase: identical-shape load, zero anomalies
+    clean = build()
+    drive(clean, lengths[:1])          # warmup pays its compiles
+    sen = attach_engine_sentinel(clean, fire_after=1,
+                             attainment_floor=0.05)
+    for _ in range(2):
+        drive(clean, lengths[:1] * 2)
+        sen.tick()
+    clean_fired = sen.fired_total
+    clean.stop(timeout=30)
+
+    # degraded phase: the seeded wedge fires on the (gen+2)th decode
+    # dispatch — i.e. mid-STORM, after the warmup's ~gen dispatches —
+    # while the storm prompts compile three fresh prefill buckets
+    storm = build(fault_plan=f"seed=7;engine.decode:"
+                             f"nth={gen_tokens + 2}:wedge:secs=0.5")
+    drive(storm, lengths[:1])          # aliased warm: no new shapes
+    # >1.5/window: the tiny smoke's prompt walk reaches two fresh
+    # buckets past the warm shape (the 8b tier reaches four) — both
+    # are storms against a steady-state norm of zero
+    sen2 = attach_engine_sentinel(storm, fire_after=1,
+                                  recompile_threshold=1.5,
+                                  attainment_floor=0.05)
+    base_rc = fired("recompile_storm")
+    drive(storm, lengths[1:])
+    trs = sen2.tick()
+    kinds = sorted({t["kind"] for t in trs if t["state"] == "fired"})
+    degradations = len(storm.recovery_seconds)
+    storm.stop(timeout=30)
+    assert clean_fired == 0, sen.state()
+    assert "recompile_storm" in kinds, (kinds, trs)
+    assert fired("recompile_storm") > base_rc
+    assert degradations >= 1, "the seeded fault plan never fired"
+    return {
+        "sentinel_clean_anomalies": int(clean_fired),
+        "sentinel_storm_anomaly_kinds": kinds,
+        "sentinel_storm_recompile_anomalies":
+            int(fired("recompile_storm") - base_rc),
+        "sentinel_degradations_injected": degradations,
     }
 
 

@@ -48,7 +48,7 @@ KNOWN_ROUTES = frozenset({
     "/metrics", "/api/v1/metrics", "/api/v1/requests", "/api/v1/steps",
     "/api/v1/profile", "/api/v1/autotune", "/api/v1/events",
     "/api/v1/requests/{rid}/timeline", "/api/v1/fleet",
-    "/api/v1/drain",
+    "/api/v1/drain", "/api/v1/anomalies",
 })
 
 # rid-bearing paths are counted under their TEMPLATE: a per-rid route
@@ -127,23 +127,29 @@ class ApiServer:
     # -- text ---------------------------------------------------------------
 
     def chat(self, body: dict, send_chunk=None, on_start=None,
-             idempotency_key=None, last_event_id=None) -> Optional[dict]:
+             idempotency_key=None, last_event_id=None,
+             trace_id=None) -> Optional[dict]:
         """Run one chat completion. If send_chunk is set, stream deltas
         through it and return None; else return the full response dict.
         `on_start` fires after admission and before any tokens — the
         streaming handler sends its response headers there, so queue
-        rejections still surface as a clean 503.
+        rejections still surface as a clean 503; a callback accepting
+        `rid=` additionally receives the engine rid (the handler echoes
+        it as x-cake-rid, the front-door router's trace join key).
 
         idempotency_key (x-cake-idempotency-key): a retried submit with
         the same key attaches to the live/finished stream instead of
         double-admitting — safe client retry, across restarts too when
         --journal is armed. last_event_id (Last-Event-ID): on a
         streaming reconnect, replay the journaled/held suffix after
-        that absolute token id, then continue live."""
+        that absolute token id, then continue live. trace_id
+        (x-cake-trace): the originating distributed-trace id, threaded
+        to the engine tracer/event bus at admission."""
         if self.engine is not None:
             return self._chat_engine(body, send_chunk, on_start,
                                      idempotency_key=idempotency_key,
-                                     last_event_id=last_event_id)
+                                     last_event_id=last_event_id,
+                                     trace_id=trace_id)
         if idempotency_key is not None or last_event_id is not None:
             raise ValueError(
                 "idempotency keys / Last-Event-ID resume require the "
@@ -196,7 +202,8 @@ class ApiServer:
 
     def _chat_engine(self, body: dict, send_chunk=None,
                      on_start=None, idempotency_key=None,
-                     last_event_id=None) -> Optional[dict]:
+                     last_event_id=None,
+                     trace_id=None) -> Optional[dict]:
         """Continuous-batching path: no lock — the engine interleaves this
         request's decode steps with every other in-flight request."""
         from cake_tpu.serve.engine import QueueFullError
@@ -210,6 +217,7 @@ class ApiServer:
             want_top_logprobs=n_top > 0,
             priority=opts.get("priority"),
             idempotency_key=idempotency_key,
+            trace_id=trace_id,
         )
 
         def lp_entry(t, lp, top):
@@ -273,14 +281,7 @@ class ApiServer:
         # back-compat with 1-arg send_chunk callables (embedders,
         # tests): only a callback that accepts event_id gets the SSE
         # resume ids; others receive plain chunks
-        import inspect
-        try:
-            _params = inspect.signature(send_chunk).parameters
-            _wants_id = ("event_id" in _params
-                         or any(p.kind == inspect.Parameter.VAR_KEYWORD
-                                for p in _params.values()))
-        except (TypeError, ValueError):
-            _wants_id = False
+        _wants_id = _accepts_kwarg(send_chunk, "event_id")
         raw_send = send_chunk
 
         def send_chunk(obj, event_id=None):
@@ -297,7 +298,15 @@ class ApiServer:
         except DrainingError as e:
             raise QueueFull(e.retry_after, draining=True)
         if on_start is not None:
-            on_start()
+            # a callback accepting rid= gets the engine rid (the
+            # handler echoes it as x-cake-rid before any tokens, so a
+            # front-door router learns the trace join key at
+            # admission); plain zero-arg callbacks (embedders, tests)
+            # keep working
+            if _accepts_kwarg(on_start, "rid"):
+                on_start(rid=h._req.rid)
+            else:
+                on_start()
         lp_cursor = 0
         eos_ids = self.engine.config.eos_token_ids
         r = h._req
@@ -479,6 +488,11 @@ class ApiServer:
                   and self.health_state.failed)
         out = {"status": "failed" if failed else "ok",
                "replica": self.replica_id,
+               # doc build-time wall clock: the router's per-replica
+               # clock-offset estimate (min over polls of receive-wall
+               # minus this) — the federated timeline's correction
+               # input, same rule as obs/federation.py frames
+               "now": round(time.time(), 6),
                "queue_depth": self._waiting}
         if not lite:
             out["model"] = self.model_name
@@ -899,6 +913,19 @@ class ApiServer:
             out["host"] = local_name or "local"
         return out
 
+    def anomalies(self, limit: Optional[int] = None) -> dict:
+        """Online regression-sentinel dump (GET /api/v1/anomalies):
+        active anomalies, the recent-firing ring (?limit=), and every
+        detector's threshold/state (obs/sentinel.py; armed by
+        --sentinel)."""
+        sen = (self.engine.sentinel if self.engine is not None
+               else None)
+        if sen is None:
+            return {"active": [], "anomalies": [],
+                    "note": "sentinel disabled (restart with "
+                            "--sentinel) or engine-less serving"}
+        return sen.state(limit=limit)
+
     def steps(self, limit: Optional[int] = None) -> dict:
         """Step flight-recorder dump (GET /api/v1/steps): newest step
         records first plus the aggregate summary (per-kind counts,
@@ -949,6 +976,22 @@ class ApiServer:
 DISCONNECTED = object()
 
 
+def _accepts_kwarg(fn, name: str) -> bool:
+    """Whether calling fn(..., name=...) is safe: the callback
+    evolution contract for chat()'s send_chunk (event_id=) and
+    on_start (rid=) — older zero/one-arg callables (embedders, tests)
+    keep working, newer ones opt in by naming the kwarg (or taking
+    **kwargs)."""
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return (name in params
+            or any(p.kind == inspect.Parameter.VAR_KEYWORD
+                   for p in params.values()))
+
+
 class QueueFull(Exception):
     """Admission rejected: queue full, load-shed (shed=True), or the
     server is draining (draining=True — POST /api/v1/drain or SIGTERM
@@ -976,6 +1019,12 @@ def make_handler(api: ApiServer):
         def _json(self, code: int, obj: dict):
             data = json.dumps(obj).encode()
             self.send_response(code)
+            if code >= 400 and getattr(self, "_trace", None):
+                # echo the request's trace id on error responses: the
+                # router relays non-200s verbatim, so a refused/failed
+                # request still hands its caller the federated-
+                # timeline key
+                self.send_header("x-cake-trace", self._trace)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
@@ -996,6 +1045,8 @@ def make_handler(api: ApiServer):
             # relays the header verbatim, so clients and router logs
             # can tell which backend computed the Retry-After
             self.send_header("x-cake-replica", str(api.replica_id))
+            if getattr(self, "_trace", None):
+                self.send_header("x-cake-trace", self._trace)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
@@ -1036,6 +1087,10 @@ def make_handler(api: ApiServer):
                 raise ValueError("invalid JSON body")
 
         def do_GET(self):
+            # re-stash per request: on a keep-alive connection a stale
+            # value from an earlier POST would mis-attribute this
+            # request's error responses to that POST's trace
+            self._trace = self.headers.get("x-cake-trace")
             route = self.path.split("?", 1)[0]
             if route == "/api/v1/health":
                 # ?lite=1: the router's cheap poll variant (a subtree
@@ -1093,6 +1148,12 @@ def make_handler(api: ApiServer):
                         self._int_arg(self._query(), "limit")))
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+            if route == "/api/v1/anomalies":
+                try:
+                    return self._json(200, api.anomalies(
+                        self._int_arg(self._query(), "limit")))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
             if self.path == "/api/v1/autotune":
                 return self._json(200, api.autotune())
             if self.path in ("/v1/models", "/api/v1/models"):
@@ -1116,6 +1177,9 @@ def make_handler(api: ApiServer):
             self._json(404, {"error": "not found"})  # api/mod.rs:19-21
 
         def do_POST(self):
+            # stashed for the error-path x-cake-trace echo (_json /
+            # _retry_json): SSE streams echo it via on_start instead
+            self._trace = self.headers.get("x-cake-trace")
             try:
                 body = self._read_body()
             except ValueError as e:
@@ -1236,6 +1300,12 @@ def make_handler(api: ApiServer):
             # the client saw) replays exactly the missing suffix
             idem_key = self.headers.get("x-cake-idempotency-key")
             last_id = self.headers.get("Last-Event-ID")
+            # distributed tracing (x-cake-trace, minted by the
+            # front-door router or a client): threaded to the engine
+            # tracer + event bus at admission, echoed on the SSE
+            # response headers (with the engine rid) and on error
+            # responses — the federated timeline's correlation key
+            trace = self.headers.get("x-cake-trace")
             if last_id is not None:
                 try:
                     last_id = int(last_id)
@@ -1250,15 +1320,24 @@ def make_handler(api: ApiServer):
                         "stream across reconnects and restarts)")
             if not body.get("stream"):
                 return self._json(200, api.chat(
-                    body, idempotency_key=idem_key))
+                    body, idempotency_key=idem_key, trace_id=trace))
             self._stream_started = False
 
-            def on_start():
+            def on_start(rid=None):
                 # only once admission + the generation lock are held
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
+                # attribution before any tokens: which replica serves
+                # this stream, under which trace, as which engine rid
+                # (the router relays these to its client and joins the
+                # trace to this replica's timeline through the rid)
+                self.send_header("x-cake-replica", str(api.replica_id))
+                if trace is not None:
+                    self.send_header("x-cake-trace", trace)
+                if rid is not None:
+                    self.send_header("x-cake-rid", str(rid))
                 self.end_headers()
                 self._stream_started = True
 
@@ -1276,7 +1355,8 @@ def make_handler(api: ApiServer):
             outcome = api.chat(body, send_chunk=send_chunk,
                                on_start=on_start,
                                idempotency_key=idem_key,
-                               last_event_id=last_id)
+                               last_event_id=last_id,
+                               trace_id=trace)
             if outcome is DISCONNECTED:
                 # handled disconnect: the socket is dead, writing the
                 # trailer would only manufacture an error traceback

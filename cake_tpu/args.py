@@ -332,6 +332,19 @@ class Args:
     # --router-policy {affinity,round_robin}: round_robin is the
     # bench strawman (no prefix affinity; per-request rotation)
     router_policy: str = "affinity"
+    # --sentinel: arm the online performance-regression sentinel
+    # (obs/sentinel.py) — rolling-window anomaly detectors over the
+    # LIVE signal stream (per-kind step-time p95 vs a self-calibrated
+    # baseline, jit-recompile rate, kv spill rate, shed rate,
+    # per-class SLO attainment; on the --router role: per-replica
+    # TTFT skew, affinity collapse, router shed storms), emitting
+    # typed `anomaly` events, cake_anomaly_total{kind} /
+    # cake_anomaly_active{kind} metrics and GET /api/v1/anomalies.
+    # Fed entirely from existing seams — zero hot-path work.
+    sentinel: bool = False
+    # --sentinel-interval S: detector tick cadence in seconds (each
+    # tick reads one rolling window per detector)
+    sentinel_interval: float = 2.0
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -413,6 +426,10 @@ class Args:
             raise ValueError(
                 f"--router-poll {self.router_poll} must be > 0 "
                 "seconds")
+        if not self.sentinel_interval > 0:
+            raise ValueError(
+                f"--sentinel-interval {self.sentinel_interval} must "
+                "be > 0 seconds")
         if self.router:
             # parse NOW so a malformed replica list is a loud startup
             # error (the --fault-plan discipline)

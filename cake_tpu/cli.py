@@ -380,7 +380,16 @@ def _serve_router(args) -> int:
     start_router(replicas, address=address, tokenizer=tokenizer,
                  poll_interval_s=args.router_poll,
                  load_watermark=args.router_watermark,
-                 policy_mode=args.router_policy)
+                 policy_mode=args.router_policy,
+                 # distributed tracing + sentinel (ISSUE 15): the
+                 # router reuses the engine's obs flag surface —
+                 # hop-span JSONL, typed event ring/log, --sentinel
+                 trace_ring=args.trace_ring,
+                 trace_events=args.trace_events,
+                 event_ring=args.event_ring,
+                 event_log=args.event_log,
+                 sentinel=args.sentinel,
+                 sentinel_interval_s=args.sentinel_interval)
     return 0
 
 

@@ -267,6 +267,10 @@ class Master:
             event_log=getattr(self.args, "event_log", None),
             event_ring=getattr(self.args, "event_ring", 1024),
             slo_targets=getattr(self.args, "slo_targets", None),
+            # online regression sentinel (--sentinel, obs/sentinel.py)
+            sentinel=getattr(self.args, "sentinel", False),
+            sentinel_interval=getattr(self.args, "sentinel_interval",
+                                      2.0),
         )
 
     def _sched_kwargs(self) -> dict:

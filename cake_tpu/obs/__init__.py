@@ -31,6 +31,12 @@ real measurement substrate, dependency-free:
     rolling per-class attainment gauges, burn-rate counters, and
     goodput (tokens from requests that met their class SLO) feeding
     the autotune controller's quality signals.
+  * `obs.sentinel` — the online performance-regression sentinel
+    (`--sentinel`): rolling-window anomaly detectors with hysteresis
+    over the live signal stream (step-time p95 vs self-calibrated
+    baseline, recompile/spill/shed storms, attainment collapse,
+    router replica skew), emitting typed `anomaly` events,
+    `cake_anomaly_*` metrics and `GET /api/v1/anomalies`.
   * `obs.jsonl` — the shared append-only JSONL writer (fsync on close)
     and corrupt-tail-tolerant reader all three event logs use.
   * `obs.federation` — fleet-scope telemetry federation: each
@@ -51,8 +57,14 @@ from cake_tpu.obs.metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
     histogram,
 )
+from cake_tpu.obs.sentinel import (  # noqa: F401
+    BaselineDetector, Sentinel, ThresholdDetector,
+    attach_engine_sentinel, attach_router_sentinel,
+)
 from cake_tpu.obs.slo import (  # noqa: F401
     DEFAULT_TARGETS, SLOAccountant, SLOTarget, parse_slo_targets,
 )
-from cake_tpu.obs.timeline import build_timeline  # noqa: F401
+from cake_tpu.obs.timeline import (  # noqa: F401
+    build_timeline, merge_router_timeline,
+)
 from cake_tpu.obs.tracing import RequestTracer, TraceRecord  # noqa: F401

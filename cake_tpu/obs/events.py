@@ -52,10 +52,21 @@ from typing import Dict, List, Optional
 from cake_tpu.obs import metrics as _m
 from cake_tpu.obs.jsonl import JsonlAppender
 
-# the typed vocabulary — every publisher names one of these
+# the typed vocabulary — every publisher names one of these. The
+# router-tier types (cake_tpu/router: the front-door process publishes
+# into its OWN bus instance) and the sentinel's "anomaly"
+# (obs/sentinel.py) share the vocabulary so ?type= filters and the
+# timeline cause summary treat every tier identically; router events
+# carry a `trace` field (the x-cake-trace id) instead of a rid — the
+# router never knows the replica-local rid until admission.
 EVENT_TYPES = (
     "preempted", "kv_spill", "kv_restore", "prefix_hit", "recovered",
     "poisoned", "reconfigured", "shed", "fault_injected", "recompile",
+    # router tier (cake_tpu/router/server.py)
+    "affinity_miss", "spill_to_secondary", "failover_resume",
+    "shed_by_router",
+    # regression sentinel (obs/sentinel.py): fired/cleared transitions
+    "anomaly",
 )
 
 EVENTS_TOTAL = _m.counter(
@@ -101,8 +112,9 @@ class EventBus:
     (lazily opened, fsync on close, fail-open on OSError — a broken
     log file degrades to a logged warning, never a failed publish)."""
 
-    # cakelint guards discipline: the JSONL appender is optional
-    OPTIONAL_PLANES = ("_log",)
+    # cakelint guards discipline: the JSONL appender and the trace-id
+    # resolver are both optional attachments
+    OPTIONAL_PLANES = ("_log", "trace_of")
 
     def __init__(self, capacity: int = 1024,
                  log_path: Optional[str] = None,
@@ -112,6 +124,12 @@ class EventBus:
         self._next_seq = 1
         self._log = JsonlAppender(log_path) if log_path else None
         self._observe = observe_metrics
+        # optional rid -> trace-id resolver (RequestTracer.trace_for):
+        # when the serving process sits behind the front-door router,
+        # events published with a rid are annotated with the
+        # originating x-cake-trace id so the router's federated
+        # timeline can select them without knowing replica-local rids
+        self.trace_of = None
 
     def publish(self, type: str, rid: Optional[int] = None,
                 **fields) -> Event:
@@ -126,6 +144,11 @@ class EventBus:
                    rid=int(rid) if rid is not None else None,
                    fields={k: v for k, v in fields.items()
                            if v is not None})
+        if (rid is not None and self.trace_of is not None
+                and "trace" not in ev.fields):
+            t = self.trace_of(int(rid))
+            if t:
+                ev.fields["trace"] = t
         with self._lock:
             ev.seq = self._next_seq
             self._next_seq += 1

@@ -94,6 +94,11 @@ class TraceRecord:
     # include a "reconfigured" event is attributable to both configs —
     # admitted under this epoch, finished under a later one
     config_epoch: int = 0
+    # originating distributed-trace id (x-cake-trace, minted by the
+    # front-door router or supplied by the client): the key the
+    # router's federated timeline correlates this replica-local record
+    # under. None when the request arrived without trace context.
+    trace: Optional[str] = None
     wall_start: float = 0.0
     _last_token_t: float = 0.0
 
@@ -160,6 +165,8 @@ class TraceRecord:
         }
         if self.error:
             out["error"] = self.error
+        if self.trace:
+            out["trace"] = self.trace
         if self.resumed:
             out["resumed"] = True
         if self.truncated:
@@ -209,12 +216,14 @@ class RequestTracer:
 
     def admit(self, rid: int, prompt_tokens: int,
               max_new_tokens: int, priority: str = "standard",
-              config_epoch: int = 0) -> None:
+              config_epoch: int = 0,
+              trace: Optional[str] = None) -> None:
         now = time.perf_counter()
         rec = TraceRecord(rid=rid, prompt_tokens=prompt_tokens,
                           max_new_tokens=max_new_tokens,
                           priority=priority,
                           config_epoch=config_epoch,
+                          trace=trace,
                           wall_start=time.time())
         rec.spans.append(("admitted", now))
         rec.spans.append(("queued", now))
@@ -370,6 +379,18 @@ class RequestTracer:
                            None)
             return rec.to_dict() if rec is not None else None
 
+    def trace_for(self, rid: int) -> Optional[str]:
+        """The distributed-trace id (x-cake-trace) the request was
+        admitted under, or None — the EventBus's per-publish annotation
+        resolver (one dict lookup; events are per-incident, never
+        per-token, so this sits on no hot path)."""
+        with self._lock:
+            rec = self._active.get(rid)
+            if rec is None:
+                rec = next((r for r in self._done if r.rid == rid),
+                           None)
+            return rec.trace if rec is not None else None
+
     def recent_ttfts(self, n: int = 32) -> List[float]:
         """TTFT seconds of the newest <= n finished-and-retired
         requests (the autotune controller's arrival-latency signal —
@@ -398,5 +419,7 @@ class RequestTracer:
             return
         line = {"ts": round(time.time(), 6), "rid": rec.rid,
                 "event": event}
+        if rec.trace:
+            line["trace"] = rec.trace
         line.update({k: v for k, v in fields.items() if v is not None})
         self._events.append(line)

@@ -17,8 +17,15 @@ One thin process in front of N independent `--api` engine servers:
   * `proxy.py` — streaming SSE pass-through preserving `id:` fields
     and Retry-After headers verbatim, with typed mid-stream error
     mapping.
+  * `tracing.py` — router-side distributed tracing: per-request hop
+    records (admit, pick + affinity verdict, connect, first byte,
+    failover resume, retire) keyed by the minted/propagated
+    `x-cake-trace` id; the front-door half of the federated
+    `GET /api/v1/requests/{rid}/timeline`.
   * `server.py` — the HTTP front door (`cake-tpu --router
-    --replicas host:port,...`).
+    --replicas host:port,...`) with the router-tier event ring,
+    federated timeline endpoint and `--sentinel` anomaly detectors
+    (obs/sentinel.py).
 """
 
 from cake_tpu.router.affinity import (          # noqa: F401
@@ -27,3 +34,4 @@ from cake_tpu.router.affinity import (          # noqa: F401
 from cake_tpu.router.policy import NoReplicaError, RoutingPolicy  # noqa: F401
 from cake_tpu.router.replicas import ReplicaState, ReplicaTracker  # noqa: F401
 from cake_tpu.router.server import RouterServer, start_router  # noqa: F401
+from cake_tpu.router.tracing import HopRecord, HopTracer  # noqa: F401

@@ -61,16 +61,27 @@ class Decision:
     """One routing decision (also the router's JSONL decision-log
     record via to_json)."""
 
-    __slots__ = ("replica", "outcome", "sticky")
+    __slots__ = ("replica", "outcome", "sticky", "spill_reason")
 
-    def __init__(self, replica: str, outcome: str, sticky: bool):
+    def __init__(self, replica: str, outcome: str, sticky: bool,
+                 spill_reason: Optional[str] = None):
         self.replica = replica
         self.outcome = outcome   # hit | spill | sticky | none
         self.sticky = sticky
+        # why an affinity key did not land on its ring target:
+        # "saturated" (the target is over the load watermark — the
+        # bounded-load spill) or "uneligible" (ejected / draining /
+        # excluded by this request's retry loop). None on hit/sticky/
+        # keyless picks. Feeds the router event ring's affinity_miss /
+        # spill_to_secondary causes (ISSUE 15).
+        self.spill_reason = spill_reason
 
     def to_json(self) -> dict:
-        return {"replica": self.replica, "outcome": self.outcome,
-                "sticky": self.sticky}
+        out = {"replica": self.replica, "outcome": self.outcome,
+               "sticky": self.sticky}
+        if self.spill_reason is not None:
+            out["spill_reason"] = self.spill_reason
+        return out
 
 
 class RoutingPolicy:
@@ -101,14 +112,20 @@ class RoutingPolicy:
 
     # -- sticky map ------------------------------------------------------
 
-    def note_admitted(self, idem_key: Optional[str],
-                      replica: str) -> None:
-        """Record the replica that admitted a keyed request; retries
-        route back to it (attach) until it is ejected."""
+    def note_admitted(self, idem_key: Optional[str], replica: str,
+                      trace: Optional[str] = None) -> None:
+        """Record the replica that admitted a keyed request (retries
+        route back to it — attach — until it is ejected) and the trace
+        id it ran under, so a keyed reconnect CONTINUES the same
+        distributed trace instead of starting a fresh one (the
+        failover-resumed stream is one story across replicas)."""
         if idem_key is None:
             return
         with self._mu:
-            self._sticky[idem_key] = replica
+            prev = self._sticky.get(idem_key)
+            if trace is None and prev is not None:
+                trace = prev[1]
+            self._sticky[idem_key] = (replica, trace)
             self._sticky.move_to_end(idem_key)
             while len(self._sticky) > self._sticky_cap:
                 self._sticky.popitem(last=False)
@@ -117,7 +134,17 @@ class RoutingPolicy:
         if idem_key is None:
             return None
         with self._mu:
-            return self._sticky.get(idem_key)
+            entry = self._sticky.get(idem_key)
+            return entry[0] if entry is not None else None
+
+    def sticky_trace(self, idem_key: Optional[str]) -> Optional[str]:
+        """The trace id the keyed request first admitted under (None =
+        unknown key, or it was admitted without trace context)."""
+        if idem_key is None:
+            return None
+        with self._mu:
+            entry = self._sticky.get(idem_key)
+            return entry[1] if entry is not None else None
 
     # -- the pick --------------------------------------------------------
 
@@ -168,12 +195,18 @@ class RoutingPolicy:
                 pick = eligible[self._rr % len(eligible)]
             return Decision(pick.name, "none", sticky=False)
 
-        # 2. affinity with bounded-load spill
+        # 2. affinity with bounded-load spill. `reason` remembers WHY
+        # the ring primary was bypassed — "saturated" (bounded-load
+        # spill) vs "uneligible" (ejected/draining/excluded) — for the
+        # spill Decision's cause attribution
+        reason = None
         if key is not None:
             first = True
             for name in self.ring.nodes_for(key):
                 st = next((s for s in eligible if s.name == name), None)
                 if st is None:
+                    if first:
+                        reason = "uneligible"
                     first = False   # ring target uneligible -> spill
                     continue
                 if st.load >= self.load_watermark and not first:
@@ -186,15 +219,19 @@ class RoutingPolicy:
                     return Decision(st.name, "hit", sticky=False)
                 if first:
                     # the affinity target is saturated: spill
+                    reason = "saturated"
                     first = False
                     continue
                 _AFFINITY.labels(outcome="spill").inc()
-                return Decision(st.name, "spill", sticky=False)
+                return Decision(st.name, "spill", sticky=False,
+                                spill_reason=reason)
             _AFFINITY.labels(outcome="spill").inc()
 
         # 3. least-loaded healthy
         pick = min(eligible, key=lambda s: (s.load, s.name))
         if key is None:
             _AFFINITY.labels(outcome="none").inc()
-        return Decision(pick.name, "spill" if key is not None else "none",
-                        sticky=False)
+        return Decision(pick.name,
+                        "spill" if key is not None else "none",
+                        sticky=False,
+                        spill_reason=reason if key is not None else None)

@@ -33,12 +33,18 @@ from cake_tpu.obs import metrics as obs_metrics
 log = logging.getLogger(__name__)
 
 # headers the router forwards to the replica; everything else is
-# hop-local (Content-Length is recomputed, Host rewritten by httplib)
+# hop-local (Content-Length is recomputed, Host rewritten by httplib).
+# Trace context (x-cake-trace / x-cake-hop) is NOT in this list: the
+# router owns it — the server passes the minted/propagated values via
+# extra_headers so a client cannot smuggle a conflicting hop count past
+# the front door.
 FORWARD_HEADERS = ("x-cake-priority", "x-cake-idempotency-key",
                    "Last-Event-ID")
 # response headers relayed verbatim on a non-200 (the honest
-# backpressure surface: the replica computed them, the router must not)
-RELAY_HEADERS = ("Retry-After", "x-cake-replica")
+# backpressure surface: the replica computed them, the router must
+# not; x-cake-trace rides along so a refused request still hands the
+# client its trace id)
+RELAY_HEADERS = ("Retry-After", "x-cake-replica", "x-cake-trace")
 
 _TTFT = obs_metrics.histogram(
     "cake_router_ttft_seconds",
@@ -127,7 +133,9 @@ class ReplicaProxy:
                      send_status: Callable[[int, dict, bytes], None],
                      send_line: Callable[[bytes], None],
                      send_terminal_error: Callable[[str], None],
-                     on_admitted: Optional[Callable[[], None]] = None,
+                     on_admitted: Optional[Callable[..., None]] = None,
+                     extra_headers: Optional[dict] = None,
+                     on_hop: Optional[Callable[..., None]] = None,
                      ) -> ProxyOutcome:
         """Forward one chat request.
 
@@ -135,15 +143,23 @@ class ReplicaProxy:
         non-stream response. send_line(raw) — relay one SSE line
         (already includes the newline). send_terminal_error(msg) —
         write the typed terminal SSE error event (only called after
-        send_line delivered bytes). on_admitted fires as soon as the
-        replica answers 200 — i.e. the request holds a slot THERE —
-        so idempotency-sticky state exists before the stream finishes
-        (a mid-stream reconnect must find its home)."""
+        send_line delivered bytes). on_admitted(rid=...) fires as soon
+        as the replica answers 200 — i.e. the request holds a slot
+        THERE — so idempotency-sticky state exists before the stream
+        finishes (a mid-stream reconnect must find its home); rid is
+        the replica's echoed x-cake-rid (None when absent).
+        extra_headers are router-owned forwards (the trace context)
+        merged OVER the client's. on_hop(name, **fields) records hop
+        spans live ("connect", "first_byte") for the router's tracer —
+        live, because a streaming relay returns only when the stream
+        ends, long after both happened."""
         fwd = {"Content-Type": "application/json"}
         for h in FORWARD_HEADERS:
             v = headers.get(h)
             if v is not None:
                 fwd[h] = v
+        if extra_headers:
+            fwd.update(extra_headers)
         # the SHORT timeout covers only the TCP connect (a dead replica
         # must fail over in milliseconds); the response itself may
         # legitimately take a long generation (non-stream requests
@@ -158,6 +174,8 @@ class ReplicaProxy:
             conn.close()
             return ProxyOutcome("retryable", hard=True,
                                 error=f"connect failed: {e}")
+        if on_hop is not None:
+            on_hop("connect")
         try:
             conn.sock.settimeout(self.header_timeout_s)
             conn.request("POST", path, body=body_bytes, headers=fwd)
@@ -193,7 +211,12 @@ class ReplicaProxy:
                     retry_after_s=float(ra) if ra else None)
 
             if on_admitted is not None:
-                on_admitted()
+                rid_h = resp.getheader("x-cake-rid")
+                try:
+                    rid_v = int(rid_h) if rid_h is not None else None
+                except ValueError:
+                    rid_v = None
+                on_admitted(rid=rid_v)
             ctype = resp.getheader("Content-Type", "")
             if not stream or "text/event-stream" not in ctype:
                 try:
@@ -205,7 +228,14 @@ class ReplicaProxy:
                     # transcript re-serves via the idempotent attach)
                     return ProxyOutcome(
                         "retryable", error=f"response body cut: {e}")
-                send_status(200, {}, data)
+                if on_hop is not None:
+                    # non-stream: the whole body IS the first byte the
+                    # client sees (generation answers only when done)
+                    on_hop("first_byte",
+                           ttft_s=round(time.perf_counter() - t0, 6))
+                relay = {h: resp.getheader(h) for h in RELAY_HEADERS
+                         if resp.getheader(h) is not None}
+                send_status(200, relay, data)
                 return ProxyOutcome("ok", status=200)
 
             # SSE pass-through. The replica sent its headers only after
@@ -253,7 +283,10 @@ class ReplicaProxy:
                             "midstream", error="eof without terminal")
                     return ProxyOutcome("ok", status=200)
                 if first and line.startswith((b"data:", b"id:")):
-                    _TTFT.observe(time.perf_counter() - t0)
+                    ttft = time.perf_counter() - t0
+                    _TTFT.observe(ttft)
+                    if on_hop is not None:
+                        on_hop("first_byte", ttft_s=round(ttft, 6))
                     first = False
                 # terminal markers: the exact [DONE] sentinel line or
                 # the typed error event ({"error": {...}} — a delta

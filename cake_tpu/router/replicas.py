@@ -64,6 +64,14 @@ class ReplicaState:
         self.failures = 0
         self.ejected = False
         self.next_probe = 0.0                  # monotonic deadline
+        # estimated wall-clock offset of THIS router vs the replica
+        # (seconds): min over polls of receive-wall minus the
+        # replica's health-reported wall ("now") — skew plus the
+        # smallest observed transit, the PR 11 federation rule. The
+        # federated timeline corrects replica span timestamps by it.
+        # None until a poll carries a clock sample; reset on
+        # reinstatement (a restarted replica's clock is fresh news).
+        self.clock_offset: Optional[float] = None
 
     # -- derived views (router policy reads these) -----------------------
 
@@ -119,6 +127,9 @@ class ReplicaState:
             "config_epoch": self.config_epoch,
             "age_s": (round(time.monotonic() - self.last_ok, 3)
                       if self.last_ok is not None else None),
+            "clock_offset_s": (round(self.clock_offset, 6)
+                               if self.clock_offset is not None
+                               else None),
             "replica_reported": self.doc.get("replica"),
         }
 
@@ -203,6 +214,15 @@ class ReplicaTracker:
 
     def note_ok(self, name: str, doc: dict) -> None:
         st = self._states[name]
+        # clock sample: the health doc's build-time wall clock ("now",
+        # api/server.py) against our receive wall. min over polls is
+        # the tightest offset bound this channel can observe (the
+        # obs/federation.py discipline); a poll without the field
+        # (older replica, fake test fetches) just contributes nothing.
+        sample = None
+        t_wall = doc.get("now") if isinstance(doc, dict) else None
+        if isinstance(t_wall, (int, float)):
+            sample = time.time() - float(t_wall)
         with self._mu:
             reinstated = st.ejected
             st.doc = doc
@@ -210,6 +230,13 @@ class ReplicaTracker:
             st.failures = 0
             st.ejected = False
             st.next_probe = 0.0
+            if sample is not None:
+                if reinstated or st.clock_offset is None:
+                    # a reinstated replica may be a RESTART — its old
+                    # min-offset is stale evidence
+                    st.clock_offset = sample
+                else:
+                    st.clock_offset = min(st.clock_offset, sample)
         if reinstated:
             log.info("router: replica %s reinstated", name)
         self._set_gauge(st)

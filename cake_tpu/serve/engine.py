@@ -342,7 +342,7 @@ class InferenceEngine:
     # discipline, generalized)
     OPTIONAL_PLANES = ("_faults", "events", "_journal", "_shed",
                        "_control", "_host_tier", "_autotuner",
-                       "telemetry")
+                       "telemetry", "sentinel")
     # the only legal nesting order; _rid_lock sits on the submit/emit
     # hot path, so nothing may block under it
     LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
@@ -398,6 +398,8 @@ class InferenceEngine:
         autotune: Optional[str] = None,
         autotune_policy=None,
         autotune_config=None,
+        sentinel: bool = False,
+        sentinel_interval: float = 2.0,
     ):
         self.config = config
         self.params = params
@@ -774,6 +776,24 @@ class InferenceEngine:
         # latest dispatch's _JitStep (engine-thread-only mailbox between
         # the device-call seam and the step record that follows it)
         self._last_jit = None
+        # distributed-trace annotation: events published with a rid
+        # pick up the request's x-cake-trace id from the tracer, so
+        # the front-door router's federated timeline can select this
+        # replica's events by trace (one dict lookup per INCIDENT —
+        # events are never per-token)
+        if self.events is not None:
+            self.events.trace_of = self.tracer.trace_for
+        # online regression sentinel (--sentinel, obs/sentinel.py):
+        # rolling-window detectors over the flight recorder / event
+        # bus / SLO accountant, ticked from a daemon thread between
+        # start() and stop() — zero hot-path instrumentation. None
+        # without the flag (one attribute test per site, the
+        # --fault-plan discipline).
+        self.sentinel = None
+        if sentinel:
+            from cake_tpu.obs.sentinel import attach_engine_sentinel
+            self.sentinel = attach_engine_sentinel(
+                self, interval_s=sentinel_interval)
 
         B = max_slots
         self._pos = np.zeros(B, np.int64)            # next write position
@@ -902,9 +922,13 @@ class InferenceEngine:
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name="cake-engine")
             self._thread.start()
+            if self.sentinel is not None:
+                self.sentinel.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
+        if self.sentinel is not None:
+            self.sentinel.close()
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
@@ -1096,6 +1120,7 @@ class InferenceEngine:
         priority: Optional[str] = None,
         idempotency_key: Optional[str] = None,
         replay_tokens: Optional[Sequence[int]] = None,
+        trace_id: Optional[str] = None,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; a callback with attribute
@@ -1256,9 +1281,13 @@ class InferenceEngine:
             # unknown rid would silently drop the span). config_epoch
             # attributes the trace to the engine config that admitted
             # it (a hot switch bumps the epoch, so traces spanning one
-            # are distinguishable — cake_tpu/autotune).
+            # are distinguishable — cake_tpu/autotune). trace_id is
+            # the originating x-cake-trace (front-door router /
+            # client): the key the federated timeline correlates this
+            # replica-local record under.
             self.tracer.admit(rid, len(ids), max_new, priority=cls,
-                              config_epoch=self.config_epoch)
+                              config_epoch=self.config_epoch,
+                              trace=trace_id)
             ok = (self.scheduler.submit(rid, len(ids), max_new,
                                         priority=cls)
                   if self._slo else

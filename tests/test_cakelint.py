@@ -557,3 +557,54 @@ def test_cakelint_covers_router_subtree():
     rep = _analyze([ROOT / "cake_tpu" / "router"])
     assert rep["findings"] == [], [f.message for f in rep["findings"]]
     assert rep["sites"]["guards"] > 0, rep["sites"]
+
+
+# -- ISSUE 15: tracer / event-ring / sentinel planes gated from day one ------
+
+SENTINEL_GUARDS_BAD = '''
+class Sentinel:
+    OPTIONAL_PLANES = ("_events",)
+
+    def _transition(self, tr):
+        self._events.publish("anomaly", state=tr)
+
+    def tick_ok(self, tr):
+        if self._events is not None:
+            self._events.publish("anomaly", state=tr)
+'''
+
+
+def test_guards_checker_live_on_sentinel_style_code(tmp_path):
+    """Seeded violation in sentinel-shaped code: the unguarded bus
+    publish is a finding, the guarded one is not — the checker is live
+    on exactly the declaration obs/sentinel.py ships."""
+    p = _write(tmp_path, "sentinel_bad.py", SENTINEL_GUARDS_BAD)
+    rep = _analyze([p], rules=["guards"])
+    msgs = [f.message for f in rep["findings"]]
+    assert len(msgs) == 1, msgs
+    assert "_events" in msgs[0]
+
+
+def test_issue15_optional_planes_declared():
+    """The ISSUE 15 satellite: the router's tracer / event ring /
+    sentinel attributes (and the engine's sentinel, the bus's trace
+    resolver, the tracers' JSONL appenders) are declared
+    OPTIONAL_PLANES on their owning classes, so the `is not None`
+    guard discipline is machine-checked by the tree gate from day
+    one."""
+    from cake_tpu.obs.events import EventBus
+    from cake_tpu.obs.sentinel import Sentinel
+    from cake_tpu.router.server import RouterServer
+    from cake_tpu.router.tracing import HopTracer
+    from cake_tpu.serve.engine import InferenceEngine
+    for attr in ("hops", "events", "sentinel"):
+        assert attr in RouterServer.OPTIONAL_PLANES, attr
+    assert "_events" in HopTracer.OPTIONAL_PLANES
+    assert "_events" in Sentinel.OPTIONAL_PLANES
+    assert "trace_of" in EventBus.OPTIONAL_PLANES
+    assert "sentinel" in InferenceEngine.OPTIONAL_PLANES
+    # and the obs subtree (sentinel + events live there) is clean
+    # under the full rule set, with guards provably exercised
+    rep = _analyze([ROOT / "cake_tpu" / "obs"])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"]["guards"] > 0, rep["sites"]

@@ -98,11 +98,15 @@ def test_jsonl_sink(tmp_path):
     assert lines[0]["type"] == "fault_injected"
 
 
-def test_vocabulary_is_the_documented_ten():
+def test_vocabulary_is_the_documented_set():
+    # the engine's ten + the router tier's four (carried with trace=
+    # instead of rid=) + the sentinel's anomaly transitions (ISSUE 15)
     assert set(EVENT_TYPES) == {
         "preempted", "kv_spill", "kv_restore", "prefix_hit",
         "recovered", "poisoned", "reconfigured", "shed",
-        "fault_injected", "recompile"}
+        "fault_injected", "recompile",
+        "affinity_miss", "spill_to_secondary", "failover_resume",
+        "shed_by_router", "anomaly"}
 
 
 # -- publishers outside the engine -------------------------------------------

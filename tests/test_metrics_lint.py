@@ -186,6 +186,22 @@ def test_rid_valued_labels_banned():
     assert any("banned label" in e for e in errs)
 
 
+def test_trace_valued_labels_banned():
+    """ISSUE 15 satellite: trace ids are one value per request — the
+    identical unbounded-cardinality footgun as rids, banned under both
+    spellings; they ride events and hop/trace records instead."""
+    lm = _load()
+    for label in ("trace", "trace_id"):
+        errs = lm.lint(f'# TYPE a_total counter\n'
+                       f'a_total{{{label}="d41d8c"}} 1\n')
+        assert any(f"banned label '{label}'" in e for e in errs), (
+            label, errs)
+    # the kind-labeled sentinel families are NOT banned (bounded set)
+    errs = lm.lint('# TYPE cake_anomaly_total counter\n'
+                   'cake_anomaly_total{kind="recompile_storm"} 1\n')
+    assert errs == [], errs
+
+
 def test_series_cardinality_cap():
     lm = _load()
     lines = ["# TYPE fat_total counter"]

@@ -283,6 +283,139 @@ def test_drained_replica_gets_zero_new_admissions(tiny_config, params):
 
 # -- kill + keyed reconnect through the router --------------------------------
 
+def test_failover_merged_timeline_spans_both_replicas(tiny_config,
+                                                      params):
+    """ISSUE 15 acceptance: one keyed SSE request; the owning
+    replica's ENGINE dies mid-stream (its HTTP front stays up — the
+    wedged-accelerator shape); the client resumes through the router
+    on the survivor, token-identical; the router-merged
+    GET /api/v1/requests/{rid}/timeline then shows the router hops AND
+    BOTH replicas' spans in one wall-clock order with a
+    failover_resume cause."""
+    from cake_tpu.serve.errors import EngineResetError
+    engA, apiA, httpdA, addrA = _replica(tiny_config, params, "A")
+    engB, apiB, httpdB, addrB = _replica(tiny_config, params, "B")
+    rhttpd, router, raddr = _router_over([addrA, addrB], tiny_config)
+    conn = None
+    try:
+        body = {"messages": _messages("tenant-t", "trace me a story"),
+                "stream": True, "max_tokens": 24}
+        hdrs = {"Content-Type": "application/json",
+                "x-cake-idempotency-key": "trace-drill"}
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(), headers=hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # the router minted a trace and joined it to the home
+        # replica's engine rid before the first token
+        tid = resp.getheader("x-cake-trace")
+        home = resp.getheader("x-cake-replica")
+        rid_home = int(resp.getheader("x-cake-rid"))
+        assert tid and home in (addrA, addrB)
+        pre_events, cur_id = [], None
+        while len(pre_events) < 3:
+            line = resp.readline().decode()
+            if line.startswith("id: "):
+                cur_id = int(line[4:].strip())
+            elif line.startswith("data: ") and line.strip() != "data:":
+                doc = json.loads(line[6:])
+                if doc.get("choices", [{}])[0].get("delta", {}) \
+                        .get("content"):
+                    pre_events.append((cur_id, doc))
+        last_seen = max(i for i, _ in pre_events)
+        pre_text = _text_of(pre_events)
+
+        # kill the home ENGINE only: in-flight stream gets the typed
+        # retryable error event; the HTTP front stays up, so the dead
+        # home can still SERVE ITS TIMELINE (and refuses new work
+        # with a roamable 503)
+        h_eng = engA if home == addrA else engB
+        s_addr = addrB if home == addrA else addrA
+        h_eng._fail_all(EngineResetError("accelerator wedged"))
+        h_eng.stop(timeout=10)
+        tail = resp.read().decode()
+        assert '"error"' in tail
+        conn.close()
+        conn = None
+
+        # keyed reconnect through the router: sticky home refuses
+        # (engine stopped -> retryable 503) -> roams to the survivor,
+        # fresh admission + Last-Event-ID exact-suffix resume — and
+        # the SAME trace id continues (the sticky map remembers it)
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(),
+                     headers={**hdrs, "Last-Event-ID": str(last_seen)})
+        resp2 = conn.getresponse()
+        assert resp2.status == 200
+        assert resp2.getheader("x-cake-trace") == tid
+        assert resp2.getheader("x-cake-replica") == s_addr
+        rid_surv = int(resp2.getheader("x-cake-rid"))
+        post_events = _read_sse(resp2)
+        assert all(i is None or i > last_seen
+                   for i, _ in post_events), post_events
+        post_text = _text_of(post_events)
+        conn.close()
+        conn = None
+
+        # token identity preserved across the resume (f32 KV): the
+        # non-stream attach on the same key returns the survivor's
+        # whole transcript
+        out = json.loads(_post(raddr, {
+            "messages": _messages("tenant-t", "trace me a story"),
+            "max_tokens": 24}, headers={
+                "x-cake-idempotency-key": "trace-drill"}).read())
+        assert pre_text + post_text == \
+            out["choices"][0]["message"]["content"]
+
+        # THE merged timeline, queried by the SURVIVOR's rid through
+        # the router
+        tl = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/requests/{rid_surv}/timeline",
+            timeout=30).read())
+        assert tl["trace"] == tid
+        # both replicas named, with their own rids
+        rows = {r["replica"]: r for r in tl["replicas"]}
+        assert rows[home]["rid"] == rid_home
+        assert rows[s_addr]["rid"] == rid_surv
+        # the failover_resume cause is in the summary
+        assert tl["summary"]["causes"].get("failover_resume", 0) >= 1
+        # BOTH replicas' engine spans present (source=trace entries
+        # tagged with each replica), plus the router's own hops
+        ev = [(e.get("source"), e.get("event"), e.get("replica"))
+              for e in tl["timeline"]]
+        assert ("trace", "admitted", home) in ev
+        assert ("trace", "error", home) in ev
+        assert ("trace", "admitted", s_addr) in ev
+        assert ("trace", "retired", s_addr) in ev
+        assert any(s == "router" and n == "failover_resume"
+                   for s, n, _ in ev)
+        # ... in ONE wall-clock order: the home's story strictly
+        # precedes the resume, which precedes the survivor's admission
+        ts = [e["t"] for e in tl["timeline"]]
+        assert ts == sorted(ts)
+        idx = {k: i for i, k in enumerate(ev)}
+        resume_i = next(i for i, (s, n, _) in enumerate(ev)
+                        if s == "router" and n == "failover_resume")
+        assert idx[("trace", "admitted", home)] < resume_i \
+            < idx[("trace", "admitted", s_addr)]
+        # the home's rid resolves to the same merged story
+        tl2 = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/requests/{rid_home}/timeline",
+            timeout=30).read())
+        assert tl2["trace"] == tid
+    finally:
+        if conn is not None:
+            conn.close()
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            h.shutdown()
+        for e in (engA, engB):
+            e.stop(timeout=10)
+
+
 def test_killed_replica_keyed_sse_reconnects_token_identical(
         tiny_config, params):
     from cake_tpu.serve.errors import EngineResetError

@@ -1,0 +1,511 @@
+"""Router-tier distributed tracing (ISSUE 15): the hop tracer, the
+trace-context header contract over fake replicas, the router event
+ring's causes, and the federated timeline merge.
+
+The E2E over two REAL engine replicas (drain-failover with both
+replicas' spans in one chronology) lives in test_router_e2e.py; here
+everything is unit-scale: scripted replicas, synthetic docs.
+"""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from cake_tpu.obs.timeline import merge_router_timeline
+from cake_tpu.router.tracing import HopTracer
+
+
+# -- HopTracer unit -----------------------------------------------------------
+
+def test_hop_record_lifecycle_and_find_by_rid():
+    h = HopTracer(capacity=8)
+    h.begin("t1", cls="interactive", stream=True, hop=1)
+    h.attempt("t1", "a:1", "hit")
+    h.span("t1", "pick", replica="a:1", outcome="hit", sticky=False)
+    h.span("t1", "connect", replica="a:1")
+    h.admitted("t1", "a:1", 42)
+    h.span("t1", "first_byte", replica="a:1", ttft_s=0.05)
+    h.finish("t1", "retire", replica="a:1")
+    rec = h.find_by_rid(42)
+    assert rec is not None and rec["trace"] == "t1"
+    assert rec["status"] == "retire"
+    assert rec["attempts"] == [{"replica": "a:1", "outcome": "hit",
+                                "rid": 42}]
+    names = [sp["name"] for sp in rec["spans"]]
+    assert names == ["admit", "pick", "connect", "admitted",
+                     "first_byte", "retire"]
+    # spans are wall-clock and non-decreasing
+    ts = [sp["t"] for sp in rec["spans"]]
+    assert ts == sorted(ts)
+    assert h.find_by_rid(43) is None
+    assert h.get("t1")["class"] == "interactive"
+    assert h.active_count == 0
+
+
+def test_hop_reactivation_appends_same_story():
+    """A keyed reconnect's begin() with the SAME trace id pulls the
+    finished record back and appends — the failover resume is one
+    record across two replicas."""
+    h = HopTracer(capacity=8)
+    h.begin("t1")
+    h.attempt("t1", "a:1", "sticky")
+    h.admitted("t1", "a:1", 7)
+    h.finish("t1", "midstream", replica="a:1", error="died")
+    assert h.active_count == 0
+    h.begin("t1")                        # the reconnect leg
+    assert h.active_count == 1
+    h.span("t1", "failover_resume", replica="b:1")
+    h.attempt("t1", "b:1", "none")
+    h.admitted("t1", "b:1", 9)
+    h.finish("t1", "retire", replica="b:1")
+    rec = h.get("t1")
+    assert [a["rid"] for a in rec["attempts"]] == [7, 9]
+    # the SAME record resolves from either replica's rid
+    assert h.find_by_rid(7)["trace"] == "t1"
+    assert h.find_by_rid(9)["trace"] == "t1"
+    names = [sp["name"] for sp in rec["spans"]]
+    assert names.count("admit") == 2
+    assert "failover_resume" in names
+
+
+def test_hop_tracer_ring_bound_and_unknown_ops_noop():
+    h = HopTracer(capacity=2)
+    for i in range(4):
+        h.begin(f"t{i}")
+        h.finish(f"t{i}", "retire")
+    assert len(h.dump()) == 2            # bounded
+    h.span("missing", "pick", replica="x")      # no crash
+    h.admitted("missing", "x", 1)
+    h.finish("missing", "retire")
+    h.begin("t9")
+    with pytest.raises(ValueError):
+        h.finish("t9", "not-a-status")
+
+
+def test_hop_tracer_sentinel_samples_windowed():
+    now = [100.0]
+    h = HopTracer(capacity=8, mono=lambda: now[0])
+    h.begin("t1")
+    h.span("t1", "pick", replica="a:1", outcome="hit")
+    h.span("t1", "first_byte", replica="a:1", ttft_s=0.2)
+    now[0] = 150.0
+    h.begin("t2")
+    h.span("t2", "pick", replica="b:1", outcome="spill")
+    h.span("t2", "first_byte", replica="b:1", ttft_s=0.4)
+    # 30s window at t=150 sees only the second request's samples
+    assert h.ttft_by_replica(30.0) == {"b:1": [0.4]}
+    assert h.outcome_counts(30.0) == {"spill": 1}
+    # a 100s window sees both
+    assert h.ttft_by_replica(100.0) == {"a:1": [0.2], "b:1": [0.4]}
+    assert h.outcome_counts(100.0) == {"hit": 1, "spill": 1}
+
+
+def test_hop_tracer_jsonl_sink(tmp_path):
+    from cake_tpu.obs.jsonl import read_jsonl
+    path = tmp_path / "hops.jsonl"
+    h = HopTracer(capacity=4, events_path=str(path))
+    h.begin("t1", cls="standard")
+    h.span("t1", "pick", replica="a:1", outcome="hit")
+    h.finish("t1", "retire", replica="a:1")
+    h.close()
+    lines = read_jsonl(str(path))
+    assert [ln["event"] for ln in lines] == ["admit", "pick", "retire"]
+    assert all(ln["trace"] == "t1" for ln in lines)
+
+
+# -- merge_router_timeline ----------------------------------------------------
+
+def _hop_doc():
+    return {
+        "trace": "tr-1", "class": "standard", "hop": 1,
+        "status": "retire", "stream": True,
+        "attempts": [{"replica": "a:1", "outcome": "sticky", "rid": 5},
+                     {"replica": "b:1", "outcome": "none", "rid": 9}],
+        "spans": [
+            {"name": "admit", "t": 100.0},
+            {"name": "pick", "t": 100.001, "replica": "a:1"},
+            {"name": "first_byte", "t": 100.2, "replica": "a:1"},
+            {"name": "failover_resume", "t": 101.0, "replica": "b:1"},
+            {"name": "pick", "t": 101.001, "replica": "b:1"},
+            {"name": "retire", "t": 102.0, "replica": "b:1"},
+        ],
+    }
+
+
+def _replica_doc(base, causes):
+    return {
+        "rid": 5, "status": "retired",
+        "summary": {"causes": causes},
+        "timeline": [
+            {"t": base + 0.01, "source": "trace", "event": "admitted"},
+            {"t": base + 0.15, "source": "trace",
+             "event": "first_token"},
+        ],
+    }
+
+
+def test_merge_router_timeline_orders_and_attributes():
+    router_events = [
+        {"seq": 1, "ts": 101.0005, "type": "failover_resume",
+         "trace": "tr-1", "replica": "b:1"},
+    ]
+    # replica a's clock runs 5s BEHIND the router's (offset +5):
+    # uncorrected, its spans would sort before the router's admit
+    replicas = [
+        ("a:1", 5.0, 5, _replica_doc(95.0, {"prefix_hit": 1})),
+        ("b:1", 0.0, 9, _replica_doc(101.1, {"recovered": 1})),
+    ]
+    doc = merge_router_timeline(_hop_doc(), router_events, replicas)
+    assert doc["trace"] == "tr-1"
+    assert doc["summary"]["causes"] == {
+        "prefix_hit": 1, "recovered": 1, "failover_resume": 1}
+    assert doc["summary"]["attempts"] == 2
+    assert [r["replica"] for r in doc["replicas"]] == ["a:1", "b:1"]
+    # one wall-clock chronology: every timestamp non-decreasing AFTER
+    # offset correction
+    ts = [e["t"] for e in doc["timeline"]]
+    assert ts == sorted(ts)
+    # replica a's corrected admitted (95.01 + 5 = 100.01) lands right
+    # after the router's pick of a
+    events = [(e["event"], e.get("replica")) for e in doc["timeline"]]
+    assert events.index(("admitted", "a:1")) \
+        > events.index(("pick", "a:1"))
+    # the failover_resume cause event and hop span both precede b's
+    # admitted span
+    assert events.index(("admitted", "b:1")) \
+        > events.index(("failover_resume", "b:1"))
+
+
+def test_merge_router_timeline_unreachable_replica_still_named():
+    replicas = [("a:1", 0.0, 5, None),
+                ("b:1", 0.0, 9, _replica_doc(101.1, {}))]
+    doc = merge_router_timeline(_hop_doc(), [], replicas)
+    rows = {r["replica"]: r for r in doc["replicas"]}
+    assert rows["a:1"]["unreachable"] is True
+    assert "unreachable" not in rows["b:1"]
+    # the dead home's attempt still reads from the ROUTER hops
+    assert any(e["source"] == "router" and e.get("replica") == "a:1"
+               for e in doc["timeline"])
+
+
+# -- HTTP-level: trace context over fake replicas -----------------------------
+
+class _EchoReplica:
+    """Fake engine server that records the headers it saw and echoes
+    x-cake-trace / x-cake-rid like api/server.py does (SSE + errors)."""
+
+    def __init__(self, rid=42, behavior="ok"):
+        self.rid = rid
+        self.behavior = behavior
+        self.seen = []
+        self.timeline_calls = []
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/api/v1/health"):
+                    doc = {"status": "ok", "queue_depth": 0,
+                           "active_requests": 0, "replica": "fake",
+                           "now": time.time()}
+                    data = json.dumps(doc).encode()
+                elif "/timeline" in self.path:
+                    fake.timeline_calls.append(self.path)
+                    data = json.dumps({
+                        "rid": fake.rid, "status": "retired",
+                        "summary": {"causes": {"prefix_hit": 1}},
+                        "timeline": [{"t": time.time(),
+                                      "source": "trace",
+                                      "event": "admitted"}],
+                    }).encode()
+                else:
+                    data = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                fake.seen.append(dict(self.headers))
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                trace = self.headers.get("x-cake-trace")
+                if fake.behavior == "busy503":
+                    data = json.dumps({"error": "reset",
+                                       "retryable": True}).encode()
+                    self.send_response(503)
+                    if trace:
+                        self.send_header("x-cake-trace", trace)
+                    self.send_header("x-cake-replica", "fake-busy")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                if trace:
+                    self.send_header("x-cake-trace", trace)
+                self.send_header("x-cake-rid", str(fake.rid))
+                self.end_headers()
+
+                def chunk(payload):
+                    self.wfile.write(
+                        hex(len(payload))[2:].encode() + b"\r\n")
+                    self.wfile.write(payload + b"\r\n")
+                    self.wfile.flush()
+                chunk(b'id: 1\ndata: {"tok": 1}\n\n')
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _start_router(replicas, **kw):
+    from cake_tpu.router import start_router
+    kw.setdefault("poll_interval_s", 0.05)
+    httpd, router = start_router(
+        replicas, address="127.0.0.1:0", block=False, **kw)
+    router.tracker.poll_once()
+    return httpd, router, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post_chat(addr, headers=None, stream=True):
+    conn = http.client.HTTPConnection(addr, timeout=30)
+    conn.request("POST", "/api/v1/chat/completions",
+                 body=json.dumps({
+                     "messages": [{"role": "user", "content": "hi"}],
+                     **({"stream": True} if stream else {})}),
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    return conn, conn.getresponse()
+
+
+def test_router_mints_forwards_and_echoes_trace_context():
+    fake = _EchoReplica(rid=42)
+    httpd, router, addr = _start_router([fake.addr])
+    try:
+        conn, resp = _post_chat(addr)
+        body = resp.read().decode()
+        assert "data: [DONE]" in body
+        # minted trace id handed back on the SSE headers with the
+        # serving replica + its engine rid
+        tid = resp.getheader("x-cake-trace")
+        assert tid
+        assert resp.getheader("x-cake-replica") == fake.addr
+        assert resp.getheader("x-cake-rid") == "42"
+        conn.close()
+        # forwarded to the replica with the hop count
+        assert fake.seen[-1]["x-cake-trace"] == tid
+        assert fake.seen[-1]["x-cake-hop"] == "1"
+        # hop record: pick -> connect -> admitted -> first_byte,
+        # finished, rid bound
+        rec = router.hops.get(tid)
+        assert rec["status"] == "retire"
+        assert rec["attempts"][0]["rid"] == 42
+        names = [sp["name"] for sp in rec["spans"]]
+        for expect in ("admit", "pick", "connect", "admitted",
+                       "first_byte", "retire"):
+            assert expect in names, names
+        assert router.hops.find_by_rid(42)["trace"] == tid
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_propagates_client_trace_and_increments_hop():
+    fake = _EchoReplica(rid=7)
+    httpd, router, addr = _start_router([fake.addr])
+    try:
+        conn, resp = _post_chat(addr, headers={
+            "x-cake-trace": "client-tid", "x-cake-hop": "2"})
+        resp.read()
+        assert resp.getheader("x-cake-trace") == "client-tid"
+        conn.close()
+        assert fake.seen[-1]["x-cake-trace"] == "client-tid"
+        assert fake.seen[-1]["x-cake-hop"] == "3"
+        assert router.hops.get("client-tid")["hop"] == 3
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_federated_timeline_endpoint():
+    fake = _EchoReplica(rid=42)
+    httpd, router, addr = _start_router([fake.addr])
+    try:
+        conn, resp = _post_chat(addr)
+        resp.read()
+        tid = resp.getheader("x-cake-trace")
+        conn.close()
+        # the router fetches the owning replica's timeline over HTTP
+        # and merges it under the hop spans
+        tl = json.loads(__import__("urllib.request", fromlist=["r"])
+                        .urlopen(f"http://{addr}/api/v1/requests/42/"
+                                 "timeline", timeout=10).read())
+        assert tl["trace"] == tid
+        assert fake.timeline_calls, "replica timeline was not fetched"
+        assert tl["replicas"][0]["replica"] == fake.addr
+        assert tl["summary"]["causes"].get("prefix_hit") == 1
+        srcs = {e["source"] for e in tl["timeline"]}
+        assert "router" in srcs and "trace" in srcs
+        ts = [e["t"] for e in tl["timeline"]]
+        assert ts == sorted(ts)
+        # unknown rid 404s
+        conn2 = http.client.HTTPConnection(addr, timeout=10)
+        conn2.request("GET", "/api/v1/requests/999/timeline")
+        assert conn2.getresponse().status == 404
+        conn2.close()
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_router_shed_publishes_event_and_returns_trace():
+    fake = _EchoReplica()
+    httpd, router, addr = _start_router([fake.addr])
+    try:
+        fake.close()                      # the whole fleet is gone
+        router.tracker.note_failure(fake.addr, hard=True)
+        conn, resp = _post_chat(addr, stream=False)
+        assert resp.status == 503
+        tid = resp.getheader("x-cake-trace")
+        assert tid
+        doc = json.loads(resp.read())
+        assert doc["trace"] == tid
+        conn.close()
+        evs = router.events_page(type="shed_by_router",
+                                 trace=tid)["events"]
+        assert len(evs) == 1
+        assert router.hops.get(tid)["status"] == "shed"
+        # anomalies endpoint answers (sentinel off -> note)
+        conn3 = http.client.HTTPConnection(addr, timeout=10)
+        conn3.request("GET", "/api/v1/anomalies")
+        r3 = conn3.getresponse()
+        assert r3.status == 200
+        assert "note" in json.loads(r3.read())
+        conn3.close()
+    finally:
+        httpd.shutdown()
+        router.close()
+
+
+def test_router_busy503_roam_records_failover_resume_for_resuming():
+    """A keyed resuming client (Last-Event-ID) whose first pick
+    refuses retryably roams — the hop record and event ring carry the
+    failover_resume cause on the replica that finally served it."""
+    busy = _EchoReplica(behavior="busy503")
+    ok = _EchoReplica(rid=9)
+    httpd, router, addr = _start_router([busy.addr, ok.addr])
+    try:
+        # seed stickiness: the busy replica is the recorded home
+        router.policy.note_admitted("key-1", busy.addr, trace="tr-x")
+        conn, resp = _post_chat(addr, headers={
+            "x-cake-idempotency-key": "key-1",
+            "Last-Event-ID": "1"})
+        body = resp.read().decode()
+        assert resp.status == 200 and "data: [DONE]" in body
+        # the reconnect CONTINUED the recorded trace
+        assert resp.getheader("x-cake-trace") == "tr-x"
+        assert resp.getheader("x-cake-replica") == ok.addr
+        conn.close()
+        rec = router.hops.get("tr-x")
+        names = [sp["name"] for sp in rec["spans"]]
+        assert "failover_resume" in names
+        assert rec["status"] == "retire"
+        evs = router.events_page(type="failover_resume",
+                                 trace="tr-x")["events"]
+        assert evs and evs[0]["replica"] == ok.addr
+    finally:
+        httpd.shutdown()
+        router.close()
+        busy.close()
+        ok.close()
+
+
+def test_router_midstream_error_payload_names_replica():
+    """Satellite bugfix: the router's terminal SSE error event carries
+    the dying replica's identity IN THE PAYLOAD (headers are long
+    gone mid-stream) plus the trace id."""
+    # reuse test_router.py's scripted mid-stream death (tests/ is on
+    # sys.path via pytest's rootdir insertion — no package prefix)
+    from test_router import _FakeReplica
+    fake = _FakeReplica(behavior="die_midstream", events=2)
+    httpd, router, addr = _start_router([fake.addr])
+    try:
+        conn, resp = _post_chat(addr)
+        body = resp.read().decode()
+        err_lines = [ln for ln in body.splitlines()
+                     if ln.startswith('data: {"error"')]
+        assert err_lines, body
+        err = json.loads(err_lines[-1][6:])["error"]
+        assert err["type"] == "ReplicaDownError"
+        assert err["retryable"] is True
+        assert err["replica"] == fake.addr
+        assert err["trace"] == resp.getheader("x-cake-trace")
+        conn.close()
+        rec = router.hops.get(err["trace"])
+        assert rec["status"] == "midstream"
+    finally:
+        httpd.shutdown()
+        router.close()
+        fake.close()
+
+
+def test_tracker_clock_offset_from_health_now():
+    from cake_tpu.router.replicas import ReplicaTracker
+    docs = {"r:1": {"status": "ok", "now": time.time() - 5.0}}
+    tr = ReplicaTracker(["r:1"], fetch=lambda name: docs[name])
+    tr.poll_once()
+    st = tr.get("r:1")
+    # the replica's clock reads 5s behind: offset ~ +5
+    assert st.clock_offset == pytest.approx(5.0, abs=0.5)
+    # min-over-polls keeps the tightest bound
+    docs["r:1"] = {"status": "ok", "now": time.time() - 4.0}
+    tr.poll_once()
+    assert st.clock_offset == pytest.approx(4.0, abs=0.5)
+    docs["r:1"] = {"status": "ok", "now": time.time() - 6.0}
+    tr.poll_once()
+    assert st.clock_offset == pytest.approx(4.0, abs=0.5)
+    assert tr.snapshot()["r:1"]["clock_offset_s"] is not None
+
+
+def test_router_events_page_filters_trace_before_limit():
+    """?trace= must select BEFORE ?limit= truncates: a trace whose
+    events sit deep in the ring still pages them out, and a truncated
+    page's cursor resumes exactly after the last returned event."""
+    from cake_tpu.router.server import RouterServer
+    r = RouterServer(["r:1"], fetch=lambda name: {"status": "ok"})
+    try:
+        for i in range(30):
+            r.events.publish("affinity_miss", trace="other", i=i)
+        for i in range(3):
+            r.events.publish("failover_resume", trace="mine", i=i)
+        page = r.events_page(trace="mine", limit=2)
+        assert [e["i"] for e in page["events"]] == [0, 1]
+        assert page["events"][0]["seq"] == 31
+        # the truncated cursor resumes after the last RETURNED event
+        assert page["cursor"] == page["events"][-1]["seq"]
+        page2 = r.events_page(trace="mine", since=page["cursor"])
+        assert [e["i"] for e in page2["events"]] == [2]
+    finally:
+        r.close()

@@ -27,7 +27,10 @@ never ship):
     inventing host names;
   * ``rid``-valued labels are banned outright, whatever the count:
     request identity belongs on the event bus / request traces
-    (obs/events.py, obs/tracing.py), never on a metric series.
+    (obs/events.py, obs/tracing.py), never on a metric series — and
+    so are ``trace``/``trace_id`` labels (x-cake-trace ids are one
+    value per request: the identical unbounded-cardinality footgun;
+    they ride events and hop records instead).
 
 Additionally, telemetry metric families (``cake_step_*``,
 ``cake_steps_*``, ``cake_jit_*``, ``cake_device_*``, the paged
@@ -97,13 +100,20 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_journal_",
                        # front-door router (cake_tpu/router): routed
                        # requests, affinity hits/misses, sheds,
-                       # failovers, replica-state gauge, proxy TTFT
-                       "cake_router_")
+                       # failovers, replica-state gauge, proxy TTFT,
+                       # traced hop latency
+                       "cake_router_",
+                       # online regression sentinel (obs/sentinel.py):
+                       # per-kind anomaly firings + active gauge
+                       "cake_anomaly_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to
-# traffic — it belongs on the event bus / request traces instead
-BANNED_LABELS = ("rid",)
+# traffic — it belongs on the event bus / request traces instead.
+# Trace ids (x-cake-trace, ISSUE 15) are the same footgun with a
+# different spelling: one value per request, unbounded cardinality —
+# they ride events and hop/trace records, never a label.
+BANNED_LABELS = ("rid", "trace", "trace_id")
 
 # default live-series cap per family (histograms count one series per
 # distinct label set, not per le bucket)
