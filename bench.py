@@ -1593,9 +1593,78 @@ def run_autotune_tier(name: str, model: str, quant, max_seq: int,
             f"slots {out['final_slots']}")
         return out
 
+    def closed_loop_smoke() -> dict:
+        """The ISSUE 16 closed-loop phase: with --sentinel-act armed, a
+        clean window records ZERO actions; a seeded recompile storm
+        right after the autonomous switch triggers exactly ONE
+        anomaly-pinned rollback through the existing reconfigure seam;
+        serving recovers on the reverted config. Deterministic: the
+        sentinel daemon is parked (interval 3600s) and the smoke drives
+        tick() by hand; rollback_window=10_000 keeps the rate verdict
+        out of reach so only the anomaly can rule the guard."""
+        eng = InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots_lo, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0,
+                                    repeat_penalty=1.0),
+            prefill_chunk=prefill_chunk, kv_pages=kv_pages,
+            kv_page_size=kv_page_size, paged_attn="fold",
+            autotune="auto",
+            autotune_policy={"version": 1, "regimes": [
+                {"max_offered_rps": None, "config": hi}]},
+            autotune_config=ControllerConfig(
+                interval_s=0.05, hold=1, cooldown_s=3600.0,
+                rollback_window=10_000),
+            sentinel=True, sentinel_interval=3600.0,
+            sentinel_act=True)
+
+        def wait(cond, timeout=120.0):
+            t0 = time.perf_counter()
+            while not cond() and time.perf_counter() - t0 < timeout:
+                time.sleep(0.01)
+            assert cond(), "closed-loop smoke: condition never held"
+
+        with eng:
+            h = eng.submit(prompt(4001), max_new_tokens=4)
+            assert h.wait(timeout=900), "closed-loop warmup timed out"
+            wait(lambda: eng.config_epoch == 1)
+            wait(lambda: eng._autotuner.guard_armed)
+            clean_actions = eng._actions.total
+            assert clean_actions == 0, eng._actions.history()
+            # two over-threshold recompile windows (fire_after=2)
+            for _ in range(2):
+                for _ in range(4):
+                    eng.flight.record("decode", rows=1, tokens=1,
+                                      wall_s=0.01, compiled=True)
+                eng.sentinel.tick()
+            wait(lambda: eng.stats.config_rollbacks == 1)
+            assert eng.max_slots == slots_lo, eng.max_slots
+            # goodput recovers: a fresh stream completes on the
+            # reverted config, and nothing switches again (pin +
+            # anomaly hold + cooldown)
+            h2 = eng.submit(prompt(4002), max_new_tokens=4)
+            assert h2.wait(timeout=900) and h2._req.error is None
+            assert eng.config_epoch == 2, eng.config_epoch
+            acts = eng._actions.history()
+            return {
+                "closed_loop_anomaly_clean_actions": int(clean_actions),
+                "closed_loop_anomaly_rollbacks":
+                    int(eng.stats.config_rollbacks),
+                "closed_loop_anomaly_actions_total":
+                    int(eng._actions.total),
+                "closed_loop_anomaly_last_action":
+                    acts[0]["action"] if acts else None,
+            }
+
     pinned = run(False)
     auto = run(True)
+    closed = closed_loop_smoke()
+    log(f"closed-loop smoke: clean actions "
+        f"{closed['closed_loop_anomaly_clean_actions']}, anomaly "
+        f"rollbacks {closed['closed_loop_anomaly_rollbacks']} "
+        f"(last action {closed['closed_loop_anomaly_last_action']})")
     result = {
+        **closed,
         "metric": f"{name}_switches",
         "value": auto["switches"],
         "unit": "switches", "vs_baseline": 0.0,
@@ -2135,7 +2204,14 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
         f"(recompiles detected "
         f"{sentinel['sentinel_storm_recompile_anomalies']}, seeded "
         f"degradations {sentinel['sentinel_degradations_injected']})")
+    closed = _router_closed_loop_smoke()
+    log(f"closed-loop smoke: clean actions "
+        f"{closed['router_anomaly_clean_actions']}, de-weights "
+        f"{closed['router_anomaly_deweights']}, re-weights "
+        f"{closed['router_anomaly_reweights']} (recovered in "
+        f"{closed['router_anomaly_recovery_ticks']} tick(s))")
     return {
+        **closed,
         "metric": f"{name}_goodput_tok_s",
         "value": aff["goodput_tok_s"],
         "unit": "tokens/s",
@@ -2167,6 +2243,67 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
         **sentinel,
         "device_kind": dev.device_kind,
     }
+
+
+def _router_closed_loop_smoke() -> dict:
+    """The ISSUE 16 closed loop at the router tier, deterministic and
+    engine-free: synthetic hop spans drive the REAL RouterServer +
+    sentinel + RouterAnomalyActuator (--router-anomaly-weighting). A
+    clean balanced fleet records ZERO actions; a 20x per-replica TTFT
+    skew de-weights the offender (placement shifts toward the healthy
+    replica — the goodput mechanism — while the offender stays
+    eligible); balanced windows clear the detector and auto re-weight
+    it. Both transitions land in the action history the router serves
+    at GET /api/v1/anomalies."""
+    from cake_tpu.router.server import RouterServer
+
+    def fetch(addr, timeout=None):
+        return {"status": "ok", "queue_depth": 0, "active_requests": 0}
+
+    def drive(hops, tag, n, slow_ttft):
+        for i in range(n):
+            t = f"cl-{tag}-{i}"
+            hops.begin(t)
+            hops.attempt(t, "a:1", "hit")
+            hops.span(t, "first_byte", replica="a:1", ttft_s=0.05)
+            hops.attempt(t, "b:1", "hit")
+            hops.span(t, "first_byte", replica="b:1", ttft_s=slow_ttft)
+
+    r = RouterServer(["a:1", "b:1"], poll_interval_s=3600, fetch=fetch,
+                     sentinel=True, sentinel_interval_s=3600,
+                     anomaly_weighting=True)
+    try:
+        r.tracker.poll_once()
+        # clean phase: balanced fleet, zero anomalies, zero actions
+        drive(r.hops, "clean", 6, 0.05)
+        assert r.sentinel.tick() == []
+        clean_actions = r.actions.total
+        assert clean_actions == 0, r.actions.history()
+        # replica b degrades 20x for two windows (fire_after=2)
+        for i in range(2):
+            drive(r.hops, f"storm{i}", 6, 1.0)
+            r.sentinel.tick()
+        assert r.policy.weights().get("b:1") == 0.25, r.policy.weights()
+        # recovery: balanced windows dilute the 30s TTFT window, then
+        # clear_after consecutive clean ticks re-weight the replica
+        ticks = 0
+        while r.policy.weights() and ticks < 12:
+            drive(r.hops, f"rec{ticks}", 6, 0.05)
+            r.sentinel.tick()
+            ticks += 1
+        assert r.policy.weights() == {}, r.policy.weights()
+        acts = r.anomalies()["actions"]
+        applied = [a["action"] for a in acts
+                   if a["outcome"] == "applied"]
+        assert "deweight" in applied and "reweight" in applied, acts
+        return {
+            "router_anomaly_clean_actions": int(clean_actions),
+            "router_anomaly_deweights": applied.count("deweight"),
+            "router_anomaly_reweights": applied.count("reweight"),
+            "router_anomaly_recovery_ticks": ticks,
+        }
+    finally:
+        r.close()
 
 
 def _router_sentinel_smoke(cfg, params, tok, max_seq: int,

@@ -915,16 +915,22 @@ class ApiServer:
 
     def anomalies(self, limit: Optional[int] = None) -> dict:
         """Online regression-sentinel dump (GET /api/v1/anomalies):
-        active anomalies, the recent-firing ring (?limit=), and every
+        active anomalies, the recent-firing ring (?limit=), every
         detector's threshold/state (obs/sentinel.py; armed by
-        --sentinel)."""
+        --sentinel), and — with --sentinel-act — the closed-loop
+        action history (obs/actions.py)."""
         sen = (self.engine.sentinel if self.engine is not None
                else None)
         if sen is None:
             return {"active": [], "anomalies": [],
                     "note": "sentinel disabled (restart with "
                             "--sentinel) or engine-less serving"}
-        return sen.state(limit=limit)
+        out = sen.state(limit=limit)
+        plane = getattr(self.engine, "_actions", None)
+        if plane is not None:
+            out["actions"] = plane.history(limit)
+            out["action_rate_per_min"] = plane.max_per_min
+        return out
 
     def steps(self, limit: Optional[int] = None) -> dict:
         """Step flight-recorder dump (GET /api/v1/steps): newest step
@@ -1494,6 +1500,11 @@ def start(master, address: str = "127.0.0.1:10128",
             except Exception:  # noqa: BLE001
                 pass
             engine.stop()
+            pm = getattr(engine, "_postmortem", None)
+            if pm is not None:
+                # black-box bundle on the termination path too: the
+                # engine thread is stopped, so every ring is final
+                pm.dump("sigterm", engine=engine, force=True)
             if checkpoint_path:
                 # keep-or-save decision lives in the engine
                 # (shutdown_save), under the same lock as the pre-fail

@@ -345,6 +345,25 @@ class Args:
     # --sentinel-interval S: detector tick cadence in seconds (each
     # tick reads one rolling window per detector)
     sentinel_interval: float = 2.0
+    # --sentinel-act: CLOSE the loop on the engine replica
+    # (obs/actions.py): recompile-storm / step-time anomalies become
+    # first-class autotune signals — hold new policy switches while
+    # active, pin the post-switch rollback verdict from anomaly
+    # evidence — every action typed on the bus, rate-bounded, counted
+    # in cake_anomaly_actions_total and listed by GET
+    # /api/v1/anomalies. Off = PR 15 report-only, byte-identical.
+    sentinel_act: bool = False
+    # --router-anomaly-weighting: the router-role closed loop — TTFT
+    # skew / shed storm / affinity collapse de-weight the offending
+    # replica's placement (never ejecting it), re-weighting on
+    # recovery with a per-replica cooldown
+    router_anomaly_weighting: bool = False
+    # --postmortem-dir DIR: black-box forensics — breaker stops,
+    # poison quarantines, failed recoveries and SIGTERM each dump one
+    # JSON bundle (step records, event ring, traces, anomaly + action
+    # history, metrics snapshot, journal tail) here;
+    # tools/postmortem.py renders a bundle into a wall-clock narrative
+    postmortem_dir: Optional[str] = None
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -430,6 +449,14 @@ class Args:
             raise ValueError(
                 f"--sentinel-interval {self.sentinel_interval} must "
                 "be > 0 seconds")
+        if self.sentinel_act and not self.sentinel:
+            raise ValueError(
+                "--sentinel-act requires --sentinel (nothing to act "
+                "on without the anomaly sentinel)")
+        if self.router_anomaly_weighting and not self.sentinel:
+            raise ValueError(
+                "--router-anomaly-weighting requires --sentinel (the "
+                "router-side detectors drive the de-weighting)")
         if self.router:
             # parse NOW so a malformed replica list is a loud startup
             # error (the --fault-plan discipline)

@@ -167,6 +167,12 @@ class AutotuneController:
         # pre-switch worst-class attainment (None without SLO data),
         # samples seen since the switch)
         self._guard: Optional[tuple] = None
+        # sentinel fusion (--sentinel-act, obs/actions.py): active
+        # config-plane anomalies hold new policy switches; an anomaly
+        # that fires while the guard is armed pins the rollback verdict
+        # immediately ((kind, cause) consumed by the next decide())
+        self._anomaly_active: Dict[str, Dict] = {}
+        self._anomaly_rollback: Optional[tuple] = None
 
     # -- decisions (engine thread) ----------------------------------------
 
@@ -223,6 +229,13 @@ class AutotuneController:
             return None
         if self._guard is not None:
             return None  # verdict pending: no new move until it rules
+        with self._mu:
+            if self._anomaly_active:
+                # anomaly hold (--sentinel-act): a recompile storm or
+                # step-time regression is live — this window's signals
+                # indict the environment, not a regime boundary; no
+                # new policy move until the sentinel clears
+                return None
         ttft_by_cls, attain = self.window_quality()
         target = self.policy.lookup(self.window_offered_rps(),
                                     ttft_p99_by_class=ttft_by_cls,
@@ -242,7 +255,28 @@ class AutotuneController:
     def _check_rollback(self, sig: AutotuneSignals
                         ) -> Optional[EngineConfig]:
         if self._guard is None:
+            with self._mu:
+                # a rollback proposed in the race window after the
+                # guard ruled has nothing left to revert: drop it
+                self._anomaly_rollback = None
             return None
+        with self._mu:
+            pinned_by = self._anomaly_rollback
+            self._anomaly_rollback = None
+        if pinned_by is not None:
+            # anomaly evidence pins the verdict NOW (--sentinel-act):
+            # a recompile storm / step-time regression right after an
+            # autonomous switch indicts the new config — revert without
+            # waiting out the rollback_window timer, and pin it
+            kind, cause = pinned_by
+            prev_cfg, pre_rate, _pre_attain, _seen = self._guard
+            bad = self._current
+            self._guard = None
+            self._pinned.add(config_key(bad))
+            self._note("rollback", frm=bad, to=prev_cfg,
+                       pre_tps=pre_rate, cause=f"anomaly:{kind}",
+                       anomaly=cause)
+            return prev_cfg
         prev_cfg, pre_rate, pre_attain, seen = self._guard
         seen += 1
         self._guard = (prev_cfg, pre_rate, pre_attain, seen)
@@ -309,6 +343,48 @@ class AutotuneController:
         self._pinned.add(config_key(cfg))
         self._note("pinned", to=cfg, reason=why)
 
+    # -- sentinel fusion (any thread; obs/actions.py) ----------------------
+
+    @property
+    def guard_armed(self) -> bool:
+        return self._guard is not None
+
+    def note_anomaly(self, kind: str, state: str, cause: Dict,
+                     *, allow_switch: bool = True) -> Optional[str]:
+        """A sentinel transition as a first-class controller signal
+        (--sentinel-act). Thread-safe: called from the sentinel thread;
+        it only flips host-side intent that decide() consumes on the
+        engine thread.
+
+        Returns the proposal this transition produced: ``"rollback"``
+        (the post-switch guard is armed and this anomaly pins its
+        verdict — the next decide() reverts through the existing
+        reconfigure() seam), ``"hold"`` (no new policy switches while
+        the anomaly is active), ``"resume"`` (the last active anomaly
+        cleared — normal deciding resumes), or None (a clear with other
+        anomalies still active). `allow_switch=False` (the action
+        plane's rate bound) downgrades a would-be rollback to a plain
+        hold."""
+        if state not in ("fired", "cleared"):
+            raise ValueError(f"state {state!r} must be fired or cleared")
+        with self._mu:
+            if state == "fired":
+                self._anomaly_active[kind] = dict(cause)
+                if (self._guard is not None and allow_switch
+                        and self._anomaly_rollback is None):
+                    self._anomaly_rollback = (kind, dict(cause))
+                    proposal = "rollback"
+                else:
+                    proposal = "hold"
+            else:
+                self._anomaly_active.pop(kind, None)
+                proposal = ("resume" if not self._anomaly_active
+                            else None)
+        if proposal is not None:
+            self._note("anomaly", kind=kind, state=state,
+                       proposal=proposal)
+        return proposal
+
     # -- introspection (any thread) ---------------------------------------
 
     def _note(self, action: str, frm: Optional[EngineConfig] = None,
@@ -329,8 +405,10 @@ class AutotuneController:
         with self._mu:
             window = [s.to_dict() for s in self._window]
             log = list(self._log)
+            anomaly_hold = sorted(self._anomaly_active)
         return {
             "current": self._current.to_dict(),
+            "anomaly_hold": anomaly_hold,
             "window": window,
             "offered_rps": round(self.window_offered_rps(), 3),
             "service_tps": round(self.window_service_tps(), 3),

@@ -80,6 +80,9 @@ class Observation:
     offered_rps: float
     tok_s: float
     ttft_p99_s: Optional[float] = None
+    # worst-class SLO attainment over the observation window (0..1],
+    # from obs/slo.py — feeds the auto-fitted min_attainment guard
+    attainment: Optional[float] = None
 
     def to_dict(self) -> dict:
         out = {"config": self.config.to_dict(),
@@ -87,6 +90,8 @@ class Observation:
                "tok_s": round(self.tok_s, 4)}
         if self.ttft_p99_s is not None:
             out["ttft_p99_s"] = round(self.ttft_p99_s, 6)
+        if self.attainment is not None:
+            out["attainment"] = round(self.attainment, 6)
         return out
 
 
@@ -204,19 +209,26 @@ class PolicyTable:
 def extract_observations(obj) -> List[Observation]:
     """Walk any JSON structure and collect observation records: dicts
     carrying a ``config`` mapping plus ``tok_s`` (and optionally
-    ``offered_rps``/``ttft_p99_s``). Records that fail config parsing
-    are skipped with a warning — a BENCH file holds many shapes of
-    line, and one malformed record must not abort a fit."""
+    ``offered_rps``/``ttft_p99_s``/``attainment``). Records that fail
+    config parsing are skipped with a warning — a BENCH file holds many
+    shapes of line, and one malformed record must not abort a fit."""
     out: List[Observation] = []
     if isinstance(obj, dict):
         if isinstance(obj.get("config"), dict) and "tok_s" in obj:
             try:
+                att = obj.get("attainment")
+                if isinstance(att, dict):
+                    # per-class mapping (obs/slo.py shape): the guard
+                    # tracks the worst class
+                    att = min(att.values()) if att else None
                 out.append(Observation(
                     config=EngineConfig.from_dict(dict(obj["config"])),
                     offered_rps=float(obj.get("offered_rps", 0.0)),
                     tok_s=float(obj["tok_s"]),
                     ttft_p99_s=(float(obj["ttft_p99_s"])
                                 if obj.get("ttft_p99_s") is not None
+                                else None),
+                    attainment=(float(att) if att is not None
                                 else None)))
             except (ValueError, TypeError) as e:
                 log.warning("skipping malformed observation: %s", e)
@@ -272,11 +284,24 @@ def observations_from_step_log(path: str, config: EngineConfig,
 
 
 def fit(observations: Sequence[Observation],
-        max_regimes: int = 4) -> PolicyTable:
+        max_regimes: int = 4,
+        emit_guards: bool = True,
+        ttft_headroom: float = 1.5,
+        attainment_margin: float = 0.9) -> PolicyTable:
     """Fit a piecewise policy: bucket the observed offered-load axis
     into up to `max_regimes` quantile bins, pick the config with the
     best mean tok/s inside each bin, and merge adjacent bins that chose
-    the same config. The last regime is always the catch-all."""
+    the same config. The last regime is always the catch-all.
+
+    When `emit_guards` is set (the default), each non-catch-all regime
+    additionally carries auto-fitted quality guards derived from the
+    winning config's own observation windows: `max_ttft_p99_s` is the
+    worst observed TTFT p99 times `ttft_headroom` (live TTFT drifting
+    past what the config ever delivered — plus headroom — escalates the
+    lookup), and `min_attainment` is the worst observed SLO attainment
+    times `attainment_margin`. Regimes whose observations carry no
+    quality signal get no guard, and the catch-all never does (lookup
+    returns it unconditionally — a guard there would be dead)."""
     obs = [o for o in observations if o.tok_s > 0]
     if not obs:
         raise ValueError("no usable observations (tok_s > 0) to fit")
@@ -312,6 +337,7 @@ def fit(observations: Sequence[Observation],
             "expected_tok_s": round(
                 sum(o.tok_s for o in best) / len(best), 2),
             "n_observations": len(members),
+            "_winners": best,  # stripped before return
         })
     # merge adjacent regimes that picked the same config (the boundary
     # between them carries no information)
@@ -321,8 +347,24 @@ def fit(observations: Sequence[Observation],
                        == config_key(r["config"])):
             merged[-1]["max_offered_rps"] = r["max_offered_rps"]
             merged[-1]["n_observations"] += r["n_observations"]
+            merged[-1]["_winners"] = merged[-1]["_winners"] + r["_winners"]
         else:
             merged.append(r)
     if merged:
         merged[-1]["max_offered_rps"] = None  # guarantee a catch-all
+    for r in merged:
+        winners = r.pop("_winners")
+        if not emit_guards or r["max_offered_rps"] is None:
+            continue
+        ttfts = [o.ttft_p99_s for o in winners
+                 if o.ttft_p99_s is not None and o.ttft_p99_s > 0]
+        g = round(float(ttft_headroom) * max(ttfts), 6) if ttfts else 0
+        if g > 0:
+            r["max_ttft_p99_s"] = g
+        attains = [o.attainment for o in winners
+                   if o.attainment is not None and o.attainment > 0]
+        g = (round(min(1.0, float(attainment_margin) * min(attains)), 6)
+             if attains else 0)
+        if g > 0:
+            r["min_attainment"] = g
     return PolicyTable(regimes=merged).validate()

@@ -389,7 +389,11 @@ def _serve_router(args) -> int:
                  event_ring=args.event_ring,
                  event_log=args.event_log,
                  sentinel=args.sentinel,
-                 sentinel_interval_s=args.sentinel_interval)
+                 sentinel_interval_s=args.sentinel_interval,
+                 # closed-loop anomaly weighting (ISSUE 16,
+                 # obs/actions.py): de-weight/re-weight placement from
+                 # router-tier anomalies — opt-in, report-only default
+                 anomaly_weighting=args.router_anomaly_weighting)
     return 0
 
 
@@ -433,6 +437,14 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--kv-host-pages has no effect without --kv-pages: the "
             "host tier spills paged KV pool pages (cake_tpu/kv)")
+
+    if getattr(args, "router_anomaly_weighting", False):
+        # same discipline: the weighting actuator lives in the router
+        # role's process — on an engine replica the flag does nothing
+        logging.getLogger(__name__).warning(
+            "--router-anomaly-weighting has no effect without "
+            "--router: the placement de-weighting actuator runs in "
+            "the front-door process (cake_tpu/router)")
 
     if (getattr(args, "journal_fsync", "batch") != "batch"
             and not getattr(args, "journal", None)):

@@ -271,6 +271,10 @@ class Master:
             sentinel=getattr(self.args, "sentinel", False),
             sentinel_interval=getattr(self.args, "sentinel_interval",
                                       2.0),
+            # closed-loop actuation + black-box forensics (ISSUE 16,
+            # obs/actions.py): --sentinel-act / --postmortem-dir
+            sentinel_act=getattr(self.args, "sentinel_act", False),
+            postmortem_dir=getattr(self.args, "postmortem_dir", None),
         )
 
     def _sched_kwargs(self) -> dict:

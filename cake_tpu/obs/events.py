@@ -30,6 +30,10 @@ filter):
     shed            admission rejected by per-class load shedding
     fault_injected  the --fault-plan chaos plane fired at a site
     recompile       a step fn compiled a new jit signature
+    anomaly_action  the closed-loop action plane (obs/actions.py)
+                    responded to a sentinel anomaly — carries the
+                    detector kind, the action (hold / rollback /
+                    deweight / reweight / resume) and its outcome
 
 Cost discipline (the --fault-plan injector pattern): publishers hold
 ``events = None`` when the bus is disabled (``--event-ring 0``) and
@@ -67,6 +71,9 @@ EVENT_TYPES = (
     "shed_by_router",
     # regression sentinel (obs/sentinel.py): fired/cleared transitions
     "anomaly",
+    # closed-loop action plane (obs/actions.py): one typed audit event
+    # per action taken (or declined) in response to an anomaly
+    "anomaly_action",
 )
 
 EVENTS_TOTAL = _m.counter(

@@ -270,6 +270,17 @@ class Sentinel:
         self._ticks = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # transition listeners (obs/actions.py actuators): called as
+        # fn(kind, state, cause) on the ticking thread AFTER the
+        # anomaly ring / metrics / bus publish — a listener that raises
+        # is logged and skipped (the sentinel never takes serving down)
+        self._listeners: List[Callable] = []
+
+    def add_listener(self, fn: Callable[[str, str, Dict], None]
+                     ) -> "Sentinel":
+        with self._mu:
+            self._listeners.append(fn)
+        return self
 
     def add(self, detector: Detector,
             source: Callable[[], Optional[float]]) -> "Sentinel":
@@ -329,6 +340,14 @@ class Sentinel:
         if self._events is not None:
             self._events.publish("anomaly", state=tr["state"],
                                  **tr["cause"])
+        with self._mu:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(det.kind, tr["state"], dict(tr["cause"]))
+            except Exception:  # noqa: BLE001 — actuators never take us down
+                log.warning("sentinel listener failed on %s %s",
+                            det.kind, tr["state"], exc_info=True)
 
     # -- export (GET /api/v1/anomalies) -----------------------------------
 
@@ -343,6 +362,59 @@ class Sentinel:
         return {"active": active, "anomalies": hist,
                 "detectors": dets, "ticks": ticks,
                 "interval_s": self.interval_s}
+
+    # -- baseline persistence (checkpoint snapshot, ISSUE 16) --------------
+
+    def export_baselines(self) -> Dict[str, Dict]:
+        """Calibrated BaselineDetector state, keyed by kind — the
+        checkpoint snapshot carries this (informationally, outside the
+        fingerprint) so a graceful restart does not spend calibrate_n
+        windows re-learning what normal looks like. Only CALIBRATED
+        detectors export; a mid-calibration sample list is not a
+        baseline."""
+        out: Dict[str, Dict] = {}
+        with self._mu:
+            sources = list(self._sources)
+        for det, _ in sources:
+            if (isinstance(det, BaselineDetector)
+                    and det.baseline is not None):
+                out[det.kind] = {"baseline": round(det.baseline, 9),
+                                 "ratio": det.ratio, "mode": det.mode}
+        return out
+
+    def restore_baselines(self, baselines: Optional[Dict[str, Dict]]
+                          ) -> int:
+        """Adopt previously exported baselines into this sentinel's
+        still-calibrating BaselineDetectors (matched by kind; a
+        detector that already calibrated keeps its own — live evidence
+        beats a snapshot). Mismatched mode or a non-positive value is
+        skipped: a stale snapshot must never plant a baseline an
+        empty-baseline firing would be judged against. Returns the
+        number of detectors restored."""
+        if not baselines:
+            return 0
+        restored = 0
+        with self._mu:
+            sources = list(self._sources)
+        for det, _ in sources:
+            if not isinstance(det, BaselineDetector):
+                continue
+            saved = baselines.get(det.kind)
+            if not isinstance(saved, dict) or det.baseline is not None:
+                continue
+            try:
+                value = float(saved["baseline"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if value <= 0 or saved.get("mode", det.mode) != det.mode:
+                continue
+            det.baseline = max(value, det.min_baseline)
+            det._calib = []
+            restored += 1
+        if restored:
+            log.info("sentinel: restored %d calibrated baseline(s) "
+                     "from snapshot", restored)
+        return restored
 
     @property
     def active_count(self) -> int:

@@ -109,6 +109,13 @@ class RoutingPolicy:
         self._sticky: OrderedDict[str, str] = OrderedDict()
         self._sticky_cap = sticky_cap
         self._rr = 0   # round_robin cursor (the bench strawman)
+        # anomaly de-weighting (obs/actions.py RouterAnomalyActuator):
+        # replica -> weight in (0, 1]. Effective load = load / weight,
+        # so a de-weighted replica reads as saturated (affinity spills
+        # away, least-loaded stops picking it) but stays ELIGIBLE —
+        # never ejected on a stale anomaly window. Empty by default:
+        # report-only behavior is bit-identical to weightless routing.
+        self._weights: dict = {}
 
     # -- sticky map ------------------------------------------------------
 
@@ -145,6 +152,37 @@ class RoutingPolicy:
         with self._mu:
             entry = self._sticky.get(idem_key)
             return entry[1] if entry is not None else None
+
+    # -- anomaly de-weighting (obs/actions.py) ---------------------------
+
+    def set_weight(self, replica: str, weight: float) -> None:
+        """Set a replica's placement weight. 1.0 (or above) clears the
+        entry — the common case stays an empty dict and a single load
+        comparison. Floored at 0.05: a zero weight would be a de-facto
+        ejection, which the de-weighting contract forbids."""
+        with self._mu:
+            if weight >= 1.0:
+                self._weights.pop(replica, None)
+            else:
+                self._weights[replica] = max(0.05, float(weight))
+
+    def weight(self, replica: str) -> float:
+        with self._mu:
+            return self._weights.get(replica, 1.0)
+
+    def weights(self) -> dict:
+        """Current non-1.0 weights (the /api/v1/anomalies and state
+        export)."""
+        with self._mu:
+            return dict(self._weights)
+
+    def _load_of(self, st: ReplicaState) -> float:
+        """Placement load: reported load divided by the replica's
+        weight (a 0.25-weight replica with 1 in flight competes like 4
+        in flight)."""
+        with self._mu:
+            w = self._weights.get(st.name)
+        return st.load if w is None else st.load / w
 
     # -- the pick --------------------------------------------------------
 
@@ -209,12 +247,13 @@ class RoutingPolicy:
                         reason = "uneligible"
                     first = False   # ring target uneligible -> spill
                     continue
-                if st.load >= self.load_watermark and not first:
+                if (self._load_of(st) >= self.load_watermark
+                        and not first):
                     # later ring nodes only take spill when under the
                     # watermark too; past them we fall to least-loaded
                     first = False
                     continue
-                if first and st.load < self.load_watermark:
+                if first and self._load_of(st) < self.load_watermark:
                     _AFFINITY.labels(outcome="hit").inc()
                     return Decision(st.name, "hit", sticky=False)
                 if first:
@@ -227,8 +266,8 @@ class RoutingPolicy:
                                 spill_reason=reason)
             _AFFINITY.labels(outcome="spill").inc()
 
-        # 3. least-loaded healthy
-        pick = min(eligible, key=lambda s: (s.load, s.name))
+        # 3. least-loaded healthy (by weight-adjusted load)
+        pick = min(eligible, key=lambda s: (self._load_of(s), s.name))
         if key is None:
             _AFFINITY.labels(outcome="none").inc()
         return Decision(pick.name,

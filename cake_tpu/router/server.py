@@ -91,7 +91,7 @@ class RouterServer:
     # dereference is `is not None`-guarded, machine-checked from day
     # one (the PR 13/14 precedent)
     OPTIONAL_PLANES = ("tokenizer", "_log", "hops", "events",
-                       "sentinel")
+                       "sentinel", "actions")
 
     def __init__(self, replicas, tokenizer=None,
                  poll_interval_s: float = 0.25,
@@ -106,6 +106,7 @@ class RouterServer:
                  event_log: Optional[str] = None,
                  sentinel: bool = False,
                  sentinel_interval_s: float = 2.0,
+                 anomaly_weighting: bool = False,
                  fetch_timeline=None,
                  timeline_timeout_s: float = 5.0):
         self.tokenizer = tokenizer
@@ -142,6 +143,23 @@ class RouterServer:
             from cake_tpu.obs.sentinel import attach_router_sentinel
             self.sentinel = attach_router_sentinel(
                 self, interval_s=sentinel_interval_s)
+        # closed-loop anomaly weighting (--router-anomaly-weighting,
+        # obs/actions.py): TTFT-skew / shed-storm / affinity-collapse
+        # anomalies de-weight the offending replica's placement (and
+        # re-weight on recovery), every action audited on the plane.
+        # None without the flag — report-only stays byte-identical.
+        self.actions = None
+        if anomaly_weighting:
+            if self.sentinel is None:
+                raise ValueError(
+                    "--router-anomaly-weighting requires --sentinel "
+                    "with the hop tracer enabled (trace_ring > 0)")
+            from cake_tpu.obs.actions import (
+                ActionPlane, RouterAnomalyActuator,
+            )
+            self.actions = ActionPlane(events=self.events)
+            RouterAnomalyActuator(self, self.actions).attach(
+                self.sentinel)
         self._timeline_timeout_s = timeline_timeout_s
         # injectable replica-timeline fetch (tests / bench drive
         # in-process replicas); default is the HTTP GET
@@ -199,6 +217,8 @@ class RouterServer:
                          else "text"),
             "tracing": self.hops is not None,
             "sentinel": self.sentinel is not None,
+            "anomaly_weighting": self.actions is not None,
+            "weights": self.policy.weights(),
         }
 
     def health(self) -> dict:
@@ -303,12 +323,19 @@ class RouterServer:
         return {"events": evs, "cursor": cursor}
 
     def anomalies(self) -> dict:
-        """GET /api/v1/anomalies (router tier)."""
+        """GET /api/v1/anomalies (router tier), with the closed-loop
+        action history and live placement weights when
+        --router-anomaly-weighting is armed."""
         if self.sentinel is None:
             return {"active": [], "anomalies": [],
                     "note": "sentinel disabled (start the router with "
                             "--sentinel)"}
-        return self.sentinel.state()
+        out = self.sentinel.state()
+        if self.actions is not None:
+            out["actions"] = self.actions.history()
+            out["action_rate_per_min"] = self.actions.max_per_min
+            out["weights"] = self.policy.weights()
+        return out
 
     def close(self) -> None:
         if self.sentinel is not None:

@@ -192,6 +192,21 @@ def snapshot(engine, requests: Optional[List[Dict]] = None) -> Dict:
         except Exception:  # noqa: BLE001 — metadata, never the save
             log.debug("snapshot: engine config capture failed",
                       exc_info=True)
+    # informational too: the sentinel's self-calibrated baselines
+    # (obs/sentinel.py BaselineDetectors). A graceful restart adopts
+    # them instead of spending calibrate_n windows re-learning — and
+    # cannot fire a false step-time regression against an empty
+    # baseline meanwhile. Outside the fingerprint for the same reason
+    # as engine_config: telemetry state never gates a resume.
+    sen = getattr(engine, "sentinel", None)
+    if sen is not None:
+        try:
+            baselines = sen.export_baselines()
+            if baselines:
+                snap["sentinel_baselines"] = baselines
+        except Exception:  # noqa: BLE001 — metadata, never the save
+            log.debug("snapshot: sentinel baseline capture failed",
+                      exc_info=True)
     return snap
 
 
@@ -270,6 +285,19 @@ def resume(engine, snap: Dict, strict: bool = True) -> Tuple[List, List[Dict]]:
         if strict:
             raise ValueError(msg)
         log.warning("%s (resuming anyway)", msg)
+
+    # adopt persisted sentinel baselines BEFORE resubmitting load: a
+    # restored detector must never spend its first windows calibrating
+    # on resume-storm traffic (best-effort — telemetry never gates a
+    # resume; restore_baselines itself skips calibrated/mismatched
+    # detectors and non-positive values)
+    sen = getattr(engine, "sentinel", None)
+    if sen is not None:
+        try:
+            sen.restore_baselines(snap.get("sentinel_baselines"))
+        except Exception:  # noqa: BLE001
+            log.debug("resume: sentinel baseline restore failed",
+                      exc_info=True)
 
     resumed_c = obs_metrics.counter(
         "cake_checkpoint_resumed_requests_total",

@@ -342,7 +342,8 @@ class InferenceEngine:
     # discipline, generalized)
     OPTIONAL_PLANES = ("_faults", "events", "_journal", "_shed",
                        "_control", "_host_tier", "_autotuner",
-                       "telemetry", "sentinel")
+                       "telemetry", "sentinel", "_actions",
+                       "_postmortem")
     # the only legal nesting order; _rid_lock sits on the submit/emit
     # hot path, so nothing may block under it
     LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
@@ -400,6 +401,8 @@ class InferenceEngine:
         autotune_config=None,
         sentinel: bool = False,
         sentinel_interval: float = 2.0,
+        sentinel_act: bool = False,
+        postmortem_dir: Optional[str] = None,
     ):
         self.config = config
         self.params = params
@@ -912,6 +915,30 @@ class InferenceEngine:
                      "interval %.1fs",
                      len(policy.regimes),
                      self._autotuner.config.interval_s)
+
+        # closed-loop action plane (--sentinel-act, obs/actions.py):
+        # sentinel anomalies become first-class autotune signals with a
+        # typed, rate-bounded, metric-counted audit trail. None without
+        # the flag — report-only stays byte-identical to PR 15.
+        self._actions = None
+        if sentinel_act:
+            if self.sentinel is None:
+                raise ValueError(
+                    "--sentinel-act requires --sentinel (nothing to "
+                    "act on without the anomaly sentinel)")
+            from cake_tpu.obs.actions import (
+                ActionPlane, EngineAnomalyActuator,
+            )
+            self._actions = ActionPlane(events=self.events)
+            EngineAnomalyActuator(self, self._actions).attach(
+                self.sentinel)
+        # black-box postmortem sink (--postmortem-dir): breaker stops,
+        # poison quarantines, failed recoveries and SIGTERM dump one
+        # forensic bundle each (tools/postmortem.py renders them)
+        self._postmortem = None
+        if postmortem_dir:
+            from cake_tpu.obs.actions import PostmortemSink
+            self._postmortem = PostmortemSink(postmortem_dir)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -2022,7 +2049,8 @@ class InferenceEngine:
             _RECOVERIES.labels(outcome="storm_breaker").inc()
             self.stats.errors += 1
             self.stats.last_error = f"{type(e).__name__}: {e}"
-            return self._stop_with_snapshot(recs, err)
+            return self._stop_with_snapshot(recs, err,
+                                            trigger="breaker_stop")
         # legacy fail-everything: release the waiters FIRST (the reset
         # publish can block for minutes against a network-partitioned
         # follower's full TCP buffer), then prove the mesh is still
@@ -2038,7 +2066,8 @@ class InferenceEngine:
             log.exception("control publish failed; stopping")
             fatal = True
         if fatal:
-            return self._stop_with_snapshot(recs)
+            return self._stop_with_snapshot(recs,
+                                            trigger="control_lost")
         try:
             self._reset_after_error()
         except Exception:  # noqa: BLE001
@@ -2053,19 +2082,23 @@ class InferenceEngine:
             _RESET_FAILURES.inc()
             self.stats.errors += 1
             self.stats.last_error = "reset failed"
-            return self._stop_with_snapshot(recs)
+            return self._stop_with_snapshot(recs,
+                                            trigger="reset_failed")
         self.stats.errors += 1
         self.stats.last_error = f"{type(e).__name__}: {e}"
         return True
 
     def _stop_with_snapshot(self, recs,
-                            err: Optional[Exception] = None) -> bool:
+                            err: Optional[Exception] = None,
+                            trigger: str = "engine_stop") -> bool:
         """The unrecoverable-failure tail shared by every stop branch:
         fail any still-waiting clients FIRST (omitted when the caller
-        already released them), persist the pre-fail capture, stop the
-        engine thread. Always returns False — the
-        _continue_after_failure 'engine must stop' contract — so
-        callers can `return self._stop_with_snapshot(...)`."""
+        already released them), persist the pre-fail capture, dump the
+        black-box postmortem bundle (--postmortem-dir; `trigger` names
+        the terminal cause), stop the engine thread. Always returns
+        False — the _continue_after_failure 'engine must stop'
+        contract — so callers can
+        `return self._stop_with_snapshot(...)`."""
         if err is not None:
             self._fail_all(err)
         # best-effort stop op: a breaker/reset-failed stop leaves this
@@ -2082,6 +2115,13 @@ class InferenceEngine:
                         "exit on channel close)")
         with self._ckpt_lock:
             self._snapshot_before_fail(requests=recs)
+        if self._postmortem is not None:
+            # terminal: always leaves a bundle, even right after an
+            # interval-bounded poison dump
+            self._postmortem.dump(
+                trigger, engine=self,
+                reason=str(err) if err is not None
+                else self.stats.last_error, force=True)
         self._stop.set()
         return False
 
@@ -2105,7 +2145,8 @@ class InferenceEngine:
             self._publish({"op": "reset"})
         except Exception:  # noqa: BLE001
             log.exception("control publish failed; stopping")
-            return self._stop_with_snapshot(recs, as_engine_error(e))
+            return self._stop_with_snapshot(recs, as_engine_error(e),
+                                            trigger="control_lost")
         # exponential backoff between CONSECUTIVE resets (the first is
         # immediate): a persistent fault must not spin the engine
         # thread through rebuild loops at full speed. Interruptible —
@@ -2130,7 +2171,8 @@ class InferenceEngine:
             _RECOVERIES.labels(outcome="reset_failed").inc()
             self.stats.errors += 1
             self.stats.last_error = "reset failed"
-            return self._stop_with_snapshot(recs, as_engine_error(e))
+            return self._stop_with_snapshot(recs, as_engine_error(e),
+                                            trigger="reset_failed")
         n_rec, n_poison = self._resubmit_after_reset(e)
         self.stats.errors += 1
         self.stats.last_error = f"{type(e).__name__}: {e}"
@@ -2237,6 +2279,12 @@ class InferenceEngine:
                                     crashes=req.crash_count)
             log.error("quarantined rid=%d as poison (%s): %s",
                       req.rid, poison_reason, err)
+            if self._postmortem is not None:
+                # interval-bounded (not forced): a multi-request
+                # quarantine cascade leaves ONE bundle, not one per rid
+                self._postmortem.dump(
+                    "poison", engine=self,
+                    reason=f"rid={req.rid} {poison_reason}: {err}")
         self.tracer.finish(req.rid, "error", error=str(err),
                            output_tokens=len(req.out_tokens))
         req.done.set()

@@ -1,0 +1,675 @@
+"""ISSUE 16 closed loop (obs/actions.py): the ActionPlane audit trail
+and rate bound, engine/router anomaly actuators, routing-policy
+de-weighting, postmortem bundles + the tools/postmortem.py renderer,
+sentinel baseline persistence, and the report-only default pin.
+
+The live-engine E2E (seeded recompile storm -> exactly one
+anomaly-pinned rollback, token-identical) lives in
+tests/test_actions_engine.py — this file is pure host-side units with
+fake clocks."""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from cake_tpu.obs.actions import (
+    ActionPlane, EngineAnomalyActuator, PostmortemSink,
+    ROUTER_ACTION_KINDS, RouterAnomalyActuator,
+)
+from cake_tpu.obs.events import EventBus
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _clock(start=0.0):
+    state = {"t": start}
+
+    def tick(dt=0.0):
+        state["t"] += dt
+        return state["t"]
+
+    return state, (lambda: state["t"])
+
+
+# -- ActionPlane -------------------------------------------------------------
+
+def test_action_plane_records_history_metrics_and_event():
+    from cake_tpu.obs import metrics as m
+    bus = EventBus(observe_metrics=False)
+    plane = ActionPlane(events=bus)
+    c = m.REGISTRY.get("cake_anomaly_actions_total")
+    key = ("recompile_storm", "rollback", "applied")
+    before = c.samples().get(key, 0)
+    plane.record("recompile_storm", "rollback", "applied",
+                 cause_value=5.0, evidence={"big": "dict"}, skipme=None)
+    assert c.samples().get(key, 0) == before + 1
+    h = plane.history()
+    assert len(h) == 1 and h[0]["action"] == "rollback"
+    assert h[0]["cause_value"] == 5.0
+    assert "skipme" not in h[0]          # None detail dropped
+    assert h[0]["evidence"] == {"big": "dict"}  # ring keeps rich detail
+    # the bus event carries scalars only — the ring is authoritative
+    ev = bus.dump(type="anomaly_action")[-1]
+    assert ev["cause_value"] == 5.0 and "evidence" not in ev
+    assert plane.total == 1 and plane.applied_total == 1
+
+
+def test_action_plane_history_is_newest_first_and_bounded():
+    plane = ActionPlane(capacity=3, observe_metrics=False)
+    for i in range(5):
+        plane.record("k", "hold", "applied", i=i)
+    h = plane.history()
+    assert [r["i"] for r in h] == [4, 3, 2]
+    assert plane.history(limit=1)[0]["i"] == 4
+    assert plane.total == 5
+
+
+def test_action_plane_rate_budget_is_a_sliding_minute():
+    state, clock = _clock()
+    plane = ActionPlane(max_per_min=2, clock=clock,
+                        observe_metrics=False)
+    assert plane.allow()
+    plane.record("k", "rollback", "applied")
+    plane.record("k", "deweight", "applied")
+    assert not plane.allow()             # budget spent
+    state["t"] += 61.0                   # the minute slides past
+    assert plane.allow()
+
+
+def test_action_plane_only_applied_state_changes_spend_budget():
+    state, clock = _clock()
+    plane = ActionPlane(max_per_min=1, clock=clock,
+                        observe_metrics=False)
+    # holds / resumes / reweights and non-applied outcomes are free
+    plane.record("k", "hold", "applied")
+    plane.record("k", "resume", "applied")
+    plane.record("k", "reweight", "applied")
+    plane.record("k", "rollback", "rate_limited")
+    plane.record("k", "deweight", "noop")
+    assert plane.allow()
+    plane.record("k", "rollback", "applied")
+    assert not plane.allow()
+
+
+def test_action_plane_rejects_bad_budget():
+    with pytest.raises(ValueError, match="max_per_min"):
+        ActionPlane(max_per_min=0)
+
+
+# -- AutotuneController.note_anomaly -----------------------------------------
+
+def _controller(**cfg_kw):
+    from cake_tpu.autotune import (
+        AutotuneController, ControllerConfig, EngineConfig, PolicyTable,
+    )
+    a = EngineConfig(slots=2)
+    b = EngineConfig(slots=4)
+    policy = PolicyTable(regimes=[
+        {"max_offered_rps": None, "config": b}]).validate()
+    cfg_kw.setdefault("hold", 1)
+    cfg_kw.setdefault("cooldown_s", 0.0)
+    cfg_kw.setdefault("rollback_window", 100)
+    at = AutotuneController(policy, a,
+                            config=ControllerConfig(**cfg_kw))
+    return at, a, b
+
+
+def _sig(t):
+    from cake_tpu.autotune import AutotuneSignals
+    return AutotuneSignals(t=t, offered_rps=1.0, service_tps=100.0)
+
+
+def test_note_anomaly_holds_then_resumes_policy_switches():
+    from cake_tpu.autotune import config_key
+    at, _a, b = _controller()
+    assert at.note_anomaly("recompile_storm", "fired",
+                           {"value": 5.0}) == "hold"
+    assert at.decide(_sig(1.0)) is None          # anomaly hold
+    assert at.state()["anomaly_hold"] == ["recompile_storm"]
+    assert at.note_anomaly("recompile_storm", "cleared", {}) == "resume"
+    target, reason = at.decide(_sig(2.0))
+    assert config_key(target) == config_key(b) and reason == "auto"
+
+
+def test_note_anomaly_pins_rollback_when_guard_armed():
+    from cake_tpu.autotune import config_key
+    at, a, b = _controller()
+    target, reason = at.decide(_sig(1.0))
+    assert reason == "auto"
+    at.on_switched(b, a, pre_rate=100.0, reason="auto")
+    assert at.guard_armed
+    assert at.note_anomaly("step_time:decode", "fired",
+                           {"value": 0.5}) == "rollback"
+    back, reason = at.decide(_sig(2.0))
+    assert config_key(back) == config_key(a) and reason == "rollback"
+    assert not at.guard_armed
+    assert config_key(b) in at._pinned           # never re-proposed
+    # the anomaly is still active: no new policy move either
+    at.on_switched(a, b, pre_rate=100.0, reason="rollback")
+    assert at.decide(_sig(3.0)) is None
+    # and the decision log explains the revert with the anomaly cause
+    rb = [e for e in at.decision_log() if e["action"] == "rollback"]
+    assert rb and rb[-1]["cause"] == "anomaly:step_time:decode"
+
+
+def test_note_anomaly_rate_bound_downgrades_rollback_to_hold():
+    at, a, b = _controller()
+    at.decide(_sig(1.0))
+    at.on_switched(b, a, pre_rate=100.0, reason="auto")
+    assert at.note_anomaly("recompile_storm", "fired", {},
+                           allow_switch=False) == "hold"
+    assert at.guard_armed                        # guard NOT consumed
+
+
+def test_note_anomaly_multiple_kinds_resume_only_when_all_clear():
+    at, _a, _b = _controller()
+    at.note_anomaly("recompile_storm", "fired", {})
+    at.note_anomaly("step_time:decode", "fired", {})
+    assert at.note_anomaly("recompile_storm", "cleared", {}) is None
+    assert at.note_anomaly("step_time:decode", "cleared", {}) == "resume"
+
+
+def test_note_anomaly_rejects_bad_state():
+    at, _a, _b = _controller()
+    with pytest.raises(ValueError, match="fired or cleared"):
+        at.note_anomaly("k", "wobbling", {})
+
+
+# -- EngineAnomalyActuator ---------------------------------------------------
+
+class _FakeAutotuner:
+    def __init__(self, armed=False):
+        self.guard_armed = armed
+        self.calls = []
+
+    def note_anomaly(self, kind, state, cause, *, allow_switch=True):
+        self.calls.append((kind, state, allow_switch))
+        if state == "cleared":
+            return "resume"
+        return ("rollback" if self.guard_armed and allow_switch
+                else "hold")
+
+
+class _FakeEng:
+    def __init__(self, autotuner=None):
+        self._autotuner = autotuner
+
+
+def test_engine_actuator_only_acts_on_config_plane_kinds():
+    plane = ActionPlane(observe_metrics=False)
+    act = EngineAnomalyActuator(_FakeEng(_FakeAutotuner()), plane)
+    assert act.actionable("recompile_storm")
+    assert act.actionable("step_time:decode")
+    assert not act.actionable("shed_storm")
+    assert not act.actionable("attainment:interactive")
+    act.on_transition("shed_storm", "fired", {})
+    assert plane.history() == []
+
+
+def test_engine_actuator_records_skip_without_autotuner():
+    plane = ActionPlane(observe_metrics=False)
+    act = EngineAnomalyActuator(_FakeEng(None), plane)
+    act.on_transition("recompile_storm", "fired", {"value": 5.0})
+    h = plane.history()
+    assert h[0]["outcome"] == "skipped"
+    assert h[0]["reason"] == "autotune disabled"
+
+
+def test_engine_actuator_fired_cleared_audit_trail():
+    at = _FakeAutotuner(armed=True)
+    plane = ActionPlane(observe_metrics=False)
+    act = EngineAnomalyActuator(_FakeEng(at), plane)
+    act.on_transition("recompile_storm", "fired",
+                      {"value": 5.0, "threshold": 2.0})
+    act.on_transition("recompile_storm", "cleared", {})
+    h = plane.history()
+    assert [r["action"] for r in h] == ["resume", "rollback"]
+    assert h[1]["outcome"] == "applied"
+    assert h[1]["cause_value"] == 5.0
+    assert at.calls[0] == ("recompile_storm", "fired", True)
+
+
+def test_engine_actuator_rate_limits_the_rollback():
+    state, clock = _clock()
+    at = _FakeAutotuner(armed=True)
+    plane = ActionPlane(max_per_min=1, clock=clock,
+                        observe_metrics=False)
+    plane.record("x", "rollback", "applied")     # budget spent
+    act = EngineAnomalyActuator(_FakeEng(at), plane)
+    act.on_transition("recompile_storm", "fired", {})
+    h = plane.history()
+    assert h[0]["action"] == "hold"              # downgraded
+    assert h[0]["outcome"] == "rate_limited"
+    assert at.calls[-1] == ("recompile_storm", "fired", False)
+
+
+# -- RoutingPolicy weights ---------------------------------------------------
+
+class _St:
+    def __init__(self, name, load):
+        self.name = name
+        self.load = load
+
+
+class _Trk:
+    def __init__(self, states):
+        self._states = states
+
+    def names(self):
+        return [s.name for s in self._states]
+
+    def admitting(self):
+        return list(self._states)
+
+    def states(self):
+        return list(self._states)
+
+    def get(self, name):
+        return next((s for s in self._states if s.name == name), None)
+
+    def snapshot(self):
+        return {}
+
+
+def _policy(states):
+    from cake_tpu.router.affinity import HashRing
+    from cake_tpu.router.policy import RoutingPolicy
+    trk = _Trk(states)
+    return RoutingPolicy(trk, ring=HashRing(trk.names()))
+
+
+def test_policy_weight_floor_and_clear():
+    pol = _policy([_St("a:1", 1)])
+    pol.set_weight("a:1", 0.25)
+    assert pol.weight("a:1") == 0.25
+    assert pol.weights() == {"a:1": 0.25}
+    pol.set_weight("a:1", 0.0)                   # floored, not ejected
+    assert pol.weight("a:1") == 0.05
+    pol.set_weight("a:1", 1.0)                   # restore clears
+    assert pol.weights() == {}
+    assert pol.weight("a:1") == 1.0
+
+
+def test_route_least_loaded_respects_weights():
+    pol = _policy([_St("a:1", 1), _St("b:1", 3)])
+    assert pol.route().replica == "a:1"          # plain least-loaded
+    pol.set_weight("a:1", 0.25)                  # effective load 4 > 3
+    assert pol.route().replica == "b:1"
+    pol.set_weight("a:1", 1.0)                   # recovery re-weight
+    assert pol.route().replica == "a:1"
+
+
+def test_route_affinity_spills_off_deweighted_home():
+    pol = _policy([_St("a:1", 4), _St("b:1", 4)])
+    pol.load_watermark = 8
+    key = "prefix"
+    home = next(iter(pol.ring.nodes_for(key)))
+    other = "b:1" if home == "a:1" else "a:1"
+    assert pol.route(key=key).replica == home    # under the watermark
+    pol.set_weight(home, 0.25)                   # effective 16 >= 8
+    d = pol.route(key=key)
+    assert d.replica == other and d.outcome == "spill"
+    # de-weighted != ejected: with every other replica gone it still
+    # serves
+    pol.tracker._states = [s for s in pol.tracker._states
+                           if s.name == home]
+    assert pol.route(key=key).replica == home
+
+
+# -- RouterAnomalyActuator ---------------------------------------------------
+
+class _Hops:
+    def __init__(self, ttfts):
+        self.ttfts = ttfts
+
+    def ttft_by_replica(self, window_s, now=None):
+        return dict(self.ttfts)
+
+
+class _Rtr:
+    def __init__(self, states, ttfts=None):
+        self.tracker = _Trk(states)
+        from cake_tpu.router.affinity import HashRing
+        from cake_tpu.router.policy import RoutingPolicy
+        self.policy = RoutingPolicy(self.tracker,
+                                    ring=HashRing(self.tracker.names()))
+        self.hops = _Hops(ttfts or {})
+
+
+def test_router_actuator_deweights_slowest_then_reweights():
+    state, clock = _clock()
+    rtr = _Rtr([_St("a:1", 1), _St("b:1", 1)],
+               ttfts={"a:1": [0.1, 0.1, 0.1], "b:1": [1.0, 1.2, 1.1]})
+    plane = ActionPlane(observe_metrics=False)
+    act = RouterAnomalyActuator(rtr, plane, factor=0.25,
+                                cooldown_s=30.0, clock=clock)
+    act.on_transition("replica_ttft_skew", "fired", {"value": 10.0})
+    assert rtr.policy.weights() == {"b:1": 0.25}
+    h = plane.history()
+    assert h[0]["action"] == "deweight" and h[0]["outcome"] == "applied"
+    assert h[0]["replica"] == "b:1"
+    act.on_transition("replica_ttft_skew", "cleared", {})
+    assert rtr.policy.weights() == {}
+    h = plane.history()
+    assert h[0]["action"] == "reweight" and h[0]["outcome"] == "applied"
+    # cooldown: an immediate refire is skipped, not applied
+    act.on_transition("replica_ttft_skew", "fired", {"value": 10.0})
+    assert rtr.policy.weights() == {}
+    assert plane.history()[0]["outcome"] == "skipped"
+    # past the cooldown it may act again
+    state["t"] += 31.0
+    act.on_transition("replica_ttft_skew", "fired", {"value": 10.0})
+    assert rtr.policy.weights() == {"b:1": 0.25}
+
+
+def test_router_actuator_blames_most_loaded_for_replica_free_kinds():
+    rtr = _Rtr([_St("a:1", 1), _St("b:1", 7)])
+    plane = ActionPlane(observe_metrics=False)
+    act = RouterAnomalyActuator(rtr, plane)
+    act.on_transition("router_shed_storm", "fired", {"value": 9.0})
+    assert rtr.policy.weights() == {"b:1": 0.25}
+
+
+def test_router_actuator_never_deweights_a_lone_replica():
+    rtr = _Rtr([_St("a:1", 5)], ttfts={"a:1": [1.0, 1.0, 1.0]})
+    plane = ActionPlane(observe_metrics=False)
+    act = RouterAnomalyActuator(rtr, plane)
+    for kind in ROUTER_ACTION_KINDS:
+        act.on_transition(kind, "fired", {})
+    assert rtr.policy.weights() == {}
+    assert all(r["outcome"] == "noop" for r in plane.history())
+
+
+def test_router_actuator_second_anomaly_holds_the_weight():
+    rtr = _Rtr([_St("a:1", 1), _St("b:1", 7)],
+               ttfts={"a:1": [0.1, 0.1], "b:1": [1.0, 1.0]})
+    plane = ActionPlane(observe_metrics=False)
+    act = RouterAnomalyActuator(rtr, plane)
+    act.on_transition("replica_ttft_skew", "fired", {})
+    act.on_transition("router_shed_storm", "fired", {})
+    assert rtr.policy.weights() == {"b:1": 0.25}
+    # one clears while the other still blames b:1 -> weight held
+    act.on_transition("replica_ttft_skew", "cleared", {})
+    assert rtr.policy.weights() == {"b:1": 0.25}
+    assert plane.history()[0]["outcome"] == "noop"
+    act.on_transition("router_shed_storm", "cleared", {})
+    assert rtr.policy.weights() == {}
+
+
+def test_router_actuator_rate_limit_blocks_the_deweight():
+    state, clock = _clock()
+    rtr = _Rtr([_St("a:1", 1), _St("b:1", 7)])
+    plane = ActionPlane(max_per_min=1, clock=clock,
+                        observe_metrics=False)
+    plane.record("x", "deweight", "applied")
+    act = RouterAnomalyActuator(rtr, plane, clock=clock)
+    act.on_transition("router_shed_storm", "fired", {})
+    assert rtr.policy.weights() == {}
+    assert plane.history()[0]["outcome"] == "rate_limited"
+
+
+def test_router_actuator_rejects_bad_factor():
+    with pytest.raises(ValueError, match="factor"):
+        RouterAnomalyActuator(_Rtr([]), ActionPlane(), factor=1.5)
+
+
+# -- PostmortemSink + tools/postmortem.py ------------------------------------
+
+def _obs_engine():
+    from cake_tpu.obs.sentinel import Sentinel, ThresholdDetector
+    from cake_tpu.obs.steps import StepTelemetry
+
+    class _E:
+        pass
+
+    eng = _E()
+    eng.events = EventBus(observe_metrics=False)
+    eng.flight = StepTelemetry(impl="fake", capacity=32,
+                               key_prefix=("pm-test",))
+    for i in range(4):
+        eng.flight.record("decode", rows=1, tokens=1, wall_s=0.01,
+                          compiled=(i == 2))
+    sen = Sentinel(interval_s=60, events=eng.events)
+    sen.add(ThresholdDetector("recompile_storm", 2.0, fire_after=1,
+                              clear_after=1), lambda: 5.0)
+    sen.tick()
+    eng.sentinel = sen
+    plane = ActionPlane(events=eng.events, observe_metrics=False)
+    plane.record("recompile_storm", "rollback", "applied",
+                 frm="slots=4", to="slots=2")
+    eng._actions = plane
+    return eng
+
+
+def test_postmortem_bundle_contents(tmp_path):
+    eng = _obs_engine()
+    sink = PostmortemSink(str(tmp_path))
+    path = sink.dump("breaker_stop", engine=eng, reason="storm",
+                     force=True)
+    assert path is not None
+    bundle = json.loads(pathlib.Path(path).read_text())
+    assert bundle["trigger"] == "breaker_stop"
+    assert bundle["reason"] == "storm"
+    for key in ("steps", "events", "anomalies", "actions", "metrics",
+                "wall_time"):
+        assert key in bundle, key
+    assert bundle["anomalies"]["active"][0]["kind"] == "recompile_storm"
+    assert bundle["actions"][0]["action"] == "rollback"
+
+
+def test_postmortem_interval_bound_and_force(tmp_path):
+    state, clock = _clock()
+    sink = PostmortemSink(str(tmp_path), min_interval_s=5.0,
+                          clock=clock)
+    assert sink.dump("poison", engine=_obs_engine()) is not None
+    # a poison cascade inside the interval writes nothing more...
+    assert sink.dump("poison", engine=_obs_engine()) is None
+    # ...but a terminal trigger always leaves a bundle
+    assert sink.dump("sigterm", engine=_obs_engine(),
+                     force=True) is not None
+    state["t"] += 6.0
+    assert sink.dump("poison", engine=_obs_engine()) is not None
+
+
+def test_postmortem_write_failure_is_best_effort(tmp_path):
+    bad = tmp_path / "a-file-not-a-dir"
+    bad.write_text("x")
+    sink = PostmortemSink(str(bad))
+    assert sink.dump("engine_stop", engine=_obs_engine(),
+                     force=True) is None          # counted, not raised
+
+
+def _renderer():
+    spec = importlib.util.spec_from_file_location(
+        "postmortem_tool", ROOT / "tools" / "postmortem.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_postmortem_renderer_orders_the_narrative(tmp_path):
+    """The acceptance shape: the rendered narrative shows the firing
+    anomaly, the attempted action and the terminal event in wall-clock
+    order."""
+    eng = _obs_engine()
+    sink = PostmortemSink(str(tmp_path))
+    path = sink.dump("breaker_stop", engine=eng, reason="storm",
+                     force=True)
+    pm = _renderer()
+    bundle = json.loads(pathlib.Path(path).read_text())
+    text = pm.render(bundle)
+    i_fire = text.index("recompile_storm FIRED")
+    i_act = text.index("rollback [applied]")
+    i_trig = text.index("TRIGGER")
+    assert i_fire < i_act < i_trig, text
+    assert "breaker_stop" in text[i_trig:]
+    # the CLI resolves a directory to its newest bundle
+    assert pm._resolve(str(tmp_path)) == path
+    assert pm.main([str(tmp_path)]) == 0
+    assert pm.main([str(tmp_path / "missing-subdir")]) == 2
+
+
+# -- sentinel baseline persistence -------------------------------------------
+
+def _calibrated_sentinel():
+    from cake_tpu.obs.sentinel import BaselineDetector, Sentinel
+    sen = Sentinel(interval_s=60)
+    vals = iter([0.01, 0.011, 0.01, 0.012])
+    sen.add(BaselineDetector("step_time:decode", ratio=3.0,
+                             calibrate_n=4, min_baseline=1e-4),
+            lambda: next(vals, 0.01))
+    for _ in range(4):
+        sen.tick()
+    return sen
+
+
+def test_baseline_export_restore_roundtrip():
+    from cake_tpu.obs.sentinel import BaselineDetector, Sentinel
+    src = _calibrated_sentinel()
+    exported = src.export_baselines()
+    assert "step_time:decode" in exported
+    b = exported["step_time:decode"]
+    assert b["mode"] == "above" and b["baseline"] > 0
+    # a fresh (restarted) sentinel adopts it: calibrated immediately,
+    # and a regression fires WITHOUT re-learning windows
+    dst = Sentinel(interval_s=60)
+    vals = iter([0.2, 0.2])
+    dst.add(BaselineDetector("step_time:decode", ratio=3.0,
+                             calibrate_n=4, min_baseline=1e-4,
+                             fire_after=2), lambda: next(vals, 0.2))
+    assert dst.restore_baselines(exported) == 1
+    dst.tick()
+    trs = dst.tick()
+    assert [t for t in trs if t["state"] == "fired"], trs
+
+
+def test_baseline_restore_skips_mismatch_and_calibrated():
+    from cake_tpu.obs.sentinel import (
+        BaselineDetector, Sentinel, ThresholdDetector,
+    )
+    sen = Sentinel(interval_s=60)
+    sen.add(BaselineDetector("a", ratio=3.0, calibrate_n=4),
+            lambda: 0.01)
+    sen.add(BaselineDetector("b", ratio=0.5, mode="below",
+                             calibrate_n=4), lambda: 0.9)
+    sen.add(ThresholdDetector("c", 2.0), lambda: 0.0)
+    n = sen.restore_baselines({
+        "a": {"baseline": 0.02, "ratio": 3.0, "mode": "above"},
+        "b": {"baseline": 0.8, "ratio": 0.5, "mode": "above"},  # mode!
+        "c": {"baseline": 1.0, "ratio": 1.0, "mode": "above"},  # kind!
+        "a2": {"baseline": -1.0, "ratio": 3.0, "mode": "above"},
+    })
+    assert n == 1
+    # an already-calibrated detector keeps its own learned baseline
+    cal = _calibrated_sentinel()
+    own = cal.export_baselines()["step_time:decode"]["baseline"]
+    assert cal.restore_baselines({
+        "step_time:decode": {"baseline": 99.0, "ratio": 3.0,
+                             "mode": "above"}}) == 0
+    assert cal.export_baselines()["step_time:decode"]["baseline"] == own
+    # and garbage input is a no-op, not a crash
+    assert cal.restore_baselines(None) == 0
+    assert cal.restore_baselines({"step_time:decode": "junk"}) == 0
+
+
+def test_export_baselines_skips_calibrating_detectors():
+    from cake_tpu.obs.sentinel import BaselineDetector, Sentinel
+    sen = Sentinel(interval_s=60)
+    sen.add(BaselineDetector("warming", ratio=3.0, calibrate_n=6),
+            lambda: 0.01)
+    sen.tick()
+    assert sen.export_baselines() == {}
+
+
+# -- report-only default pin --------------------------------------------------
+
+def test_router_report_only_default_has_no_action_plane():
+    """Flags off = PR 15 behavior: no plane constructed, no weights,
+    no action history in the anomalies export."""
+    from cake_tpu.router.server import RouterServer
+    r = RouterServer(["127.0.0.1:1"], poll_interval_s=3600,
+                     sentinel=True, sentinel_interval_s=3600)
+    try:
+        assert r.actions is None
+        assert r.policy.weights() == {}
+        out = r.anomalies()
+        assert "actions" not in out
+        assert r.state()["anomaly_weighting"] is False
+    finally:
+        r.close()
+
+
+def test_router_anomaly_weighting_requires_sentinel():
+    from cake_tpu.router.server import RouterServer
+    with pytest.raises(ValueError, match="--sentinel"):
+        RouterServer(["127.0.0.1:1"], poll_interval_s=3600,
+                     anomaly_weighting=True)
+
+
+def test_args_validate_action_flags_require_sentinel():
+    from cake_tpu.args import Args
+    with pytest.raises(ValueError, match="--sentinel-act"):
+        Args(sentinel_act=True).validate()
+    with pytest.raises(ValueError, match="--router-anomaly-weighting"):
+        Args(router_anomaly_weighting=True).validate()
+    Args(sentinel=True, sentinel_act=True,
+         router_anomaly_weighting=True).validate()
+
+
+# -- router-tier closed loop (RouterServer + sentinel, no sockets) -----------
+
+def _span_skew(hops, n, slow_ttft):
+    for i in range(n):
+        t = f"t{slow_ttft}-{i}"
+        hops.begin(t)
+        hops.attempt(t, "a:1", "hit")
+        hops.span(t, "first_byte", replica="a:1", ttft_s=0.05)
+        hops.attempt(t, "b:1", "hit")
+        hops.span(t, "first_byte", replica="b:1", ttft_s=slow_ttft)
+
+
+def test_router_closed_loop_deweight_then_recover():
+    """The router E2E satellite: a degrading replica is de-weighted on
+    fire and re-weighted on clear, with BOTH transitions visible in
+    the GET /api/v1/anomalies action history."""
+    from cake_tpu.router.server import RouterServer
+
+    def fetch(addr, timeout=None):
+        return {"status": "ok", "queue_depth": 0, "active_requests": 0}
+
+    r = RouterServer(["a:1", "b:1"], poll_interval_s=3600, fetch=fetch,
+                     sentinel=True, sentinel_interval_s=3600,
+                     anomaly_weighting=True)
+    try:
+        r.tracker.poll_once()
+        assert len(r.tracker.admitting()) == 2
+        # clean phase: balanced fleet, zero anomalies, zero actions
+        _span_skew(r.hops, 6, 0.05)
+        assert r.sentinel.tick() == []
+        assert r.actions.total == 0
+        # replica b degrades 20x for two windows -> skew fires
+        _span_skew(r.hops, 6, 1.0)
+        r.sentinel.tick()
+        _span_skew(r.hops, 6, 1.0)
+        r.sentinel.tick()
+        assert r.policy.weights().get("b:1") == 0.25
+        out = r.anomalies()
+        assert out["actions"][0]["action"] == "deweight"
+        assert out["actions"][0]["replica"] == "b:1"
+        assert out["weights"] == {"b:1": 0.25}
+        # recovery: balanced windows clear the detector -> re-weight
+        # (the skewed spans stay inside the 30s TTFT window during a
+        # fast test, so it takes a few rounds to dilute the median and
+        # then clear_after consecutive clean ticks)
+        for _ in range(6):
+            _span_skew(r.hops, 6, 0.05)
+            r.sentinel.tick()
+        assert r.policy.weights() == {}
+        acts = [(a["action"], a["outcome"]) for a in
+                r.anomalies()["actions"]]
+        assert ("reweight", "applied") in acts
+        assert ("deweight", "applied") in acts
+    finally:
+        r.close()

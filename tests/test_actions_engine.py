@@ -1,0 +1,224 @@
+"""ISSUE 16 closed loop on a LIVE engine: a seeded recompile storm
+rules the armed rollback guard and triggers exactly ONE anomaly-pinned
+config revert (token-identical stream, cooldown intact, full audit
+trail), the report-only default fires the same anomaly and acts on
+nothing, sentinel baselines ride the checkpoint snapshot across a
+restart, and a breaker stop under a seeded fault plan leaves a
+renderable postmortem bundle with the terminal event last.
+
+Determinism: the sentinel daemon is parked (--sentinel-interval 3600)
+and the tests drive ``eng.sentinel.tick()`` by hand; the step-time
+BaselineDetectors stay in calibration (6 windows) so only the seeded
+``recompile_storm`` ThresholdDetector can fire; rollback_window=10_000
+keeps the service-rate verdict unreachable so only the anomaly can
+rule the guard; cooldown_s=3600 pins "exactly one switch after the
+rollback would need a cooldown bypass".
+"""
+
+import importlib.util
+import json
+import pathlib
+import time
+
+import pytest
+
+import jax.numpy as jnp
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+T = 64
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    import jax
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 2)
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=T,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # f32 KV to match the f32 params fixture (the identity pins
+        # must exercise the switch fold, not bf16 tie-breaks)
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+PROMPT = [5, 9, 2, 7, 5, 3, 11, 4, 6]
+
+# single catch-all regime: the controller proposes slots=4 on its
+# first interval regardless of load, which arms the rollback guard
+POLICY = {"version": 1, "regimes": [
+    {"max_offered_rps": None, "config": {"slots": 4}}]}
+
+
+def _ctrl():
+    from cake_tpu.autotune import ControllerConfig
+    return ControllerConfig(interval_s=0.05, hold=1,
+                            cooldown_s=3600.0, rollback_window=10_000)
+
+
+def _wait(cond, timeout=60.0):
+    deadline = time.perf_counter() + timeout
+    while not cond() and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    assert cond(), "condition never held"
+
+
+def _storm_window(eng):
+    """Seed one over-threshold recompile window and judge it: four
+    compiled flight records (threshold 2.0), then a manual tick."""
+    for _ in range(4):
+        eng.flight.record("decode", rows=1, tokens=1, wall_s=0.01,
+                          compiled=True)
+    return eng.sentinel.tick()
+
+
+def test_closed_loop_storm_rolls_back_once_token_identical(
+        tiny_config, params):
+    with _engine(tiny_config, params) as eng:
+        h = eng.submit(PROMPT, max_new_tokens=40)
+        assert h.wait(120)
+        baseline = list(h._req.out_tokens)
+
+    with _engine(tiny_config, params, autotune="auto",
+                 autotune_policy=POLICY, autotune_config=_ctrl(),
+                 sentinel=True, sentinel_interval=3600.0,
+                 sentinel_act=True) as eng:
+        h = eng.submit(PROMPT, max_new_tokens=40)
+        # phase 1 (clean): the policy switch lands, guard arms, and
+        # the action plane records NOTHING
+        _wait(lambda: eng.config_epoch == 1)
+        assert eng.max_slots == 4
+        # the epoch bumps inside the switch; on_switched (which arms
+        # the guard) runs just after on the engine thread
+        _wait(lambda: eng._autotuner.guard_armed)
+        assert eng._actions.total == 0
+        # phase 2 (degradation): two seeded over-threshold windows
+        # fire the storm (fire_after=2); the actuator turns it into a
+        # rollback proposal the next autotune tick applies
+        _storm_window(eng)
+        assert eng._actions.total == 0      # hysteresis: not yet
+        _storm_window(eng)
+        _wait(lambda: eng.stats.config_rollbacks == 1)
+        assert eng.config_epoch == 2
+        assert eng.max_slots == 2           # back on the known-good A
+        assert not eng._autotuner.guard_armed
+        # phase 3 (stability): offender pinned + anomaly hold + 3600s
+        # cooldown -> EXACTLY one anomaly-triggered switch, ever
+        time.sleep(0.3)
+        assert eng.config_epoch == 2
+        assert eng.stats.config_rollbacks == 1
+        # the stream that lived through both switches is untouched
+        assert h.wait(120)
+        assert list(h._req.out_tokens) == baseline
+        # audit trail: ring (API export) + typed bus event agree
+        acts = eng._actions.history()
+        assert acts[0]["action"] == "rollback"
+        assert acts[0]["outcome"] == "applied"
+        assert acts[0]["kind"] == "recompile_storm"
+        ev = eng.events.dump(type="anomaly_action")
+        assert any(e["action"] == "rollback" and e["outcome"] ==
+                   "applied" for e in ev)
+        st = eng._autotuner.state()
+        assert st["anomaly_hold"] == ["recompile_storm"]
+
+
+def test_report_only_default_fires_but_never_acts(tiny_config, params):
+    """PR 15 behavior with the flag off: the same seeded storm fires
+    and is fully reported, but no action plane exists, no rollback
+    happens, and the switched config stays put."""
+    with _engine(tiny_config, params, autotune="auto",
+                 autotune_policy=POLICY, autotune_config=_ctrl(),
+                 sentinel=True, sentinel_interval=3600.0) as eng:
+        h = eng.submit(PROMPT, max_new_tokens=24)
+        _wait(lambda: eng.config_epoch == 1)
+        _wait(lambda: eng._autotuner.guard_armed)
+        assert eng._actions is None
+        _storm_window(eng)
+        _storm_window(eng)
+        active = eng.sentinel.state()["active"]
+        assert any(a["kind"] == "recompile_storm" for a in active)
+        assert h.wait(120)
+        time.sleep(0.2)
+        assert eng.stats.config_rollbacks == 0
+        assert eng.config_epoch == 1
+        assert eng.max_slots == 4
+        assert eng._autotuner.guard_armed   # nothing consumed it
+
+
+def test_sentinel_baselines_ride_the_checkpoint(tiny_config, params):
+    """Satellite (a): a calibrated step-time baseline lands in the
+    snapshot and a restarted engine adopts it instead of re-learning
+    (its detector reports calibrated with the same baseline)."""
+    from cake_tpu.serve import checkpoint
+
+    with _engine(tiny_config, params, sentinel=True,
+                 sentinel_interval=3600.0) as eng:
+        # calibrate step_time:decode: six windows of >= 5 samples
+        # (the p95 source returns None below min_samples)
+        for _ in range(6):
+            for _ in range(5):
+                eng.flight.record("decode", rows=1, tokens=1,
+                                  wall_s=0.01)
+            eng.sentinel.tick()
+        exported = eng.sentinel.export_baselines()
+        assert "step_time:decode" in exported
+        snap = checkpoint.snapshot(eng)
+        assert snap["sentinel_baselines"] == exported
+
+    with _engine(tiny_config, params, sentinel=True,
+                 sentinel_interval=3600.0) as eng2:
+        assert eng2.sentinel.export_baselines() == {}  # fresh start
+        checkpoint.resume(eng2, snap)
+        restored = eng2.sentinel.export_baselines()
+        assert (restored["step_time:decode"]["baseline"]
+                == exported["step_time:decode"]["baseline"])
+
+
+def test_breaker_stop_leaves_a_renderable_postmortem(tiny_config,
+                                                     params, tmp_path):
+    """The acceptance E2E: a reset storm under a seeded fault plan
+    trips the breaker into a clean stop, and --postmortem-dir holds a
+    bundle whose rendered narrative ends on the breaker_stop trigger
+    (wall-clock ordered, terminal event last)."""
+    from cake_tpu.serve.errors import EngineResetError, RecoveryConfig
+
+    eng = _engine(
+        tiny_config, params,
+        fault_plan="engine.decode:always:transient:times=10",
+        recovery_config=RecoveryConfig(
+            implication_budget=99, backoff_base_s=0.01,
+            storm_resets=3, storm_window_s=60.0),
+        sentinel=True, sentinel_interval=3600.0,
+        postmortem_dir=str(tmp_path))
+    with eng:
+        h = eng.submit(PROMPT, max_new_tokens=4)
+        assert h.wait(timeout=600)
+        assert isinstance(h._req.error, EngineResetError)
+        _wait(lambda: eng.recovery_state()["breaker"]["tripped"])
+        _wait(lambda: list(tmp_path.glob("postmortem-*.json")))
+
+    bundles = sorted(tmp_path.glob("postmortem-*.json"))
+    bundle = json.loads(bundles[-1].read_text())
+    assert bundle["trigger"] == "breaker_stop"
+    assert bundle["steps"], "step ring missing from the bundle"
+    assert "metrics" in bundle and "events" in bundle
+
+    spec = importlib.util.spec_from_file_location(
+        "postmortem_tool", ROOT / "tools" / "postmortem.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    text = mod.render(bundle)
+    assert "trigger: breaker_stop" in text
+    # the terminal event is the narrative's last line
+    last = text.rstrip().splitlines()[-1]
+    assert "TRIGGER" in last and "breaker_stop" in last

@@ -124,6 +124,72 @@ def test_fit_rejects_empty():
         fit([])
 
 
+def _qobs(slots, rps, tps, ttft=None, attain=None):
+    return Observation(config=EngineConfig(slots=slots, kv_pages=64),
+                       offered_rps=rps, tok_s=tps, ttft_p99_s=ttft,
+                       attainment=attain)
+
+
+def test_fit_auto_emits_quality_guards_from_the_winner(tmp_path):
+    """ISSUE 16: non-catch-all regimes get max_ttft_p99_s (headroom x
+    the WINNING config's worst observed p99) and min_attainment
+    (margin x its worst attainment) — the losing config's numbers
+    must not shape the guards, and the catch-all never carries any
+    (lookup returns it unconditionally: a guard there is dead)."""
+    obs = (
+        [_qobs(8, 1.0, 200, ttft=0.05, attain=0.99),
+         # the loser is WORSE on both axes: leaking it into the guard
+         # would inflate the envelope
+         _qobs(32, 1.0, 120, ttft=0.4, attain=0.5)] * 3
+        + [_qobs(8, 20.0, 300),
+           _qobs(32, 20.0, 1200, ttft=0.3, attain=0.97)] * 3
+    )
+    policy = fit(obs, max_regimes=4)
+    low = policy.regimes[0]
+    assert low["max_offered_rps"] is not None
+    assert low["max_ttft_p99_s"] == pytest.approx(1.5 * 0.05)
+    assert low["min_attainment"] == pytest.approx(0.9 * 0.99)
+    assert "max_ttft_p99_s" not in policy.regimes[-1]
+    assert "min_attainment" not in policy.regimes[-1]
+    # the guards survive a save/load round-trip and still validate
+    p = tmp_path / "policy.json"
+    policy.save(str(p))
+    loaded = PolicyTable.load(str(p))
+    assert loaded.regimes[0]["max_ttft_p99_s"] == \
+        pytest.approx(1.5 * 0.05)
+    # custom headroom/margin knobs flow through
+    wide = fit(obs, ttft_headroom=3.0, attainment_margin=0.5)
+    assert wide.regimes[0]["max_ttft_p99_s"] == pytest.approx(0.15)
+    assert wide.regimes[0]["min_attainment"] == pytest.approx(0.495)
+
+
+def test_fit_guards_optional_and_signal_gated():
+    obs = ([_qobs(8, 1.0, 200, ttft=0.05, attain=0.99)] * 3
+           + [_qobs(32, 20.0, 1200)] * 3)
+    # emit_guards=False: plain PR-era tables
+    off = fit(obs, emit_guards=False)
+    assert all("max_ttft_p99_s" not in r and "min_attainment" not in r
+               for r in off.regimes)
+    # observations without quality signals fit guard-free regimes
+    plain = fit([_qobs(8, 1.0, 200)] * 3 + [_qobs(32, 20.0, 1200)] * 3)
+    assert all("max_ttft_p99_s" not in r and "min_attainment" not in r
+               for r in plain.regimes)
+
+
+def test_extract_observations_reads_attainment_shapes():
+    doc = {"lines": [
+        {"config": {"slots": 8}, "offered_rps": 2.0, "tok_s": 100,
+         # per-class dict (obs/slo.py shape): worst class wins
+         "attainment": {"interactive": 0.9, "batch": 1.0}},
+        {"config": {"slots": 16}, "offered_rps": 2.0, "tok_s": 100,
+         "attainment": 0.7},
+        {"config": {"slots": 32}, "offered_rps": 2.0, "tok_s": 100},
+    ]}
+    obs = sorted(extract_observations(doc),
+                 key=lambda o: o.config.slots)
+    assert [o.attainment for o in obs] == [0.9, 0.7, None]
+
+
 def test_policy_save_load_validate(tmp_path):
     policy = fit([_obs(8, 1.0, 100), _obs(32, 9.0, 900)],
                  max_regimes=2)

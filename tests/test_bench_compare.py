@@ -243,3 +243,63 @@ def test_round_hook_orders_by_round_number(tmp_path):
     _round(tmp_path, "BENCH_r10_builder.json", tok_s=40.0)
     # newest two = r10 and its builder rerun -> regression fires
     assert cbr.main([str(tmp_path)]) == 1
+
+
+def test_split_anomaly_fields_partition():
+    """Anomaly/action counters leave the comparable record: anything
+    matching *anomal* or a standalone action(s) token is informational,
+    while perf fields (even ones containing 'faction'-style substrings
+    that only match mid-word) stay gated."""
+    cbr = _load_round_hook()
+    keep, info = cbr.split_anomaly_fields({
+        "metric": "t", "value": 1.0, "goodput_tok_s": 100.0,
+        "closed_loop_anomaly_rollbacks": 1,
+        "router_anomaly_deweights": 2,
+        "actions_total": 3, "anomaly_actions": 4,
+        "slo_attainment_fraction": 0.9,   # 'action' mid-word: gated
+    })
+    assert set(info) == {"closed_loop_anomaly_rollbacks",
+                         "router_anomaly_deweights", "actions_total",
+                         "anomaly_actions"}
+    assert set(keep) == {"metric", "value", "goodput_tok_s",
+                         "slo_attainment_fraction"}
+
+
+def test_round_hook_anomaly_fields_inform_but_never_gate(tmp_path,
+                                                         capsys):
+    """Satellite: the closed-loop smoke fields (new in the newer round
+    AND changing between rounds) print as info lines and ride the
+    --json summary under anomaly_fields, with rc 0 as long as the perf
+    fields hold."""
+    cbr = _load_round_hook()
+    _write(tmp_path, "BENCH_r01.json",
+           [_tier("slo_tier", goodput_tok_s=100.0,
+                  router_anomaly_deweights=0)])
+    _write(tmp_path, "BENCH_r02.json",
+           [_tier("slo_tier", goodput_tok_s=100.0,
+                  closed_loop_anomaly_rollbacks=1,   # new field
+                  router_anomaly_deweights=2)])      # changed count
+    assert cbr.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "anomaly/action counter — not gated" in out
+    assert "closed_loop_anomaly_rollbacks" in out
+
+    assert cbr.main([str(tmp_path), "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    changed = {e["field"]: (e["old"], e["new"])
+               for e in summary["anomaly_fields"]}
+    assert changed == {"closed_loop_anomaly_rollbacks": (None, 1),
+                       "router_anomaly_deweights": (0, 2)}
+    assert summary["regressions"] == []
+
+
+def test_round_hook_anomaly_fields_dont_mask_a_regression(tmp_path):
+    """A genuine perf regression still gates rc 1 even when anomaly
+    counters changed alongside it."""
+    cbr = _load_round_hook()
+    _write(tmp_path, "BENCH_r01.json",
+           [_tier("slo_tier", goodput_tok_s=100.0)])
+    _write(tmp_path, "BENCH_r02.json",
+           [_tier("slo_tier", goodput_tok_s=60.0,
+                  closed_loop_anomaly_rollbacks=3)])
+    assert cbr.main([str(tmp_path)]) == 1

@@ -608,3 +608,50 @@ def test_issue15_optional_planes_declared():
     rep = _analyze([ROOT / "cake_tpu" / "obs"])
     assert rep["findings"] == [], [f.message for f in rep["findings"]]
     assert rep["sites"]["guards"] > 0, rep["sites"]
+
+
+# -- ISSUE 16: the closed-loop action plane gated from day one ---------------
+
+ACTIONS_GUARDS_BAD = '''
+class ActionPlane:
+    OPTIONAL_PLANES = ("_events",)
+
+    def record_bad(self, kind):
+        self._events.publish("anomaly_action", kind=kind)
+
+    def record_ok(self, kind):
+        if self._events is not None:
+            self._events.publish("anomaly_action", kind=kind)
+'''
+
+
+def test_guards_checker_live_on_action_plane_code(tmp_path):
+    """Seeded violation in action-plane-shaped code: the unguarded bus
+    publish is a finding, the guarded one is not — the checker is live
+    on exactly the declaration obs/actions.py ships."""
+    p = pathlib.Path(tmp_path) / "actions_bad.py"
+    p.write_text(ACTIONS_GUARDS_BAD)
+    rep = _analyze([p], rules=["guards"])
+    msgs = [f.message for f in rep["findings"]]
+    assert len(msgs) == 1, msgs
+    assert "_events" in msgs[0]
+
+
+def test_issue16_optional_planes_declared():
+    """The ISSUE 16 satellite: the engine's action plane + postmortem
+    sink, the router's action plane and the ActionPlane's own optional
+    bus are declared OPTIONAL_PLANES on their owning classes, so every
+    deref of the closed-loop plumbing is machine-checked for the
+    `is not None` guard discipline by the tree gate."""
+    from cake_tpu.obs.actions import ActionPlane
+    from cake_tpu.router.server import RouterServer
+    from cake_tpu.serve.engine import InferenceEngine
+    for attr in ("_actions", "_postmortem"):
+        assert attr in InferenceEngine.OPTIONAL_PLANES, attr
+    assert "actions" in RouterServer.OPTIONAL_PLANES
+    assert "_events" in ActionPlane.OPTIONAL_PLANES
+    # and the module that ships the plane is clean under the full rule
+    # set with guard sites provably exercised
+    rep = _analyze([ROOT / "cake_tpu" / "obs" / "actions.py"])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"]["guards"] > 0, rep["sites"]

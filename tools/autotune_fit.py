@@ -8,6 +8,13 @@ recorder captures, bucket the offered-load axis into regimes, pick the
 best measured config per regime, and write the piecewise policy file
 the live controller consults (--autotune auto --autotune-policy PATH).
 
+Each non-catch-all regime also gets auto-fitted quality guards
+(``max_ttft_p99_s`` / ``min_attainment``) derived from the winning
+config's own observation windows — live quality drifting past what the
+config ever delivered escalates the lookup toward the catch-all.
+Disable with ``--no-guards``; tune with ``--ttft-headroom`` /
+``--attainment-margin``.
+
 Inputs:
 
   * ``--bench FILE [FILE ...]`` — JSON documents scanned recursively
@@ -57,6 +64,18 @@ def main(argv=None) -> int:
                     help="step-log slice width, seconds (default 10)")
     ap.add_argument("--regimes", type=int, default=4,
                     help="max offered-load regimes (default 4)")
+    ap.add_argument("--no-guards", action="store_true",
+                    help="do not auto-fit per-regime quality guards "
+                         "(max_ttft_p99_s / min_attainment) from the "
+                         "observation windows")
+    ap.add_argument("--ttft-headroom", type=float, default=1.5,
+                    help="max_ttft_p99_s guard = headroom x worst "
+                         "observed TTFT p99 of the winning config "
+                         "(default 1.5)")
+    ap.add_argument("--attainment-margin", type=float, default=0.9,
+                    help="min_attainment guard = margin x worst "
+                         "observed attainment of the winning config "
+                         "(default 0.9)")
     ap.add_argument("--out", required=True,
                     help="policy file to write (--autotune-policy)")
     args = ap.parse_args(argv)
@@ -102,18 +121,25 @@ def main(argv=None) -> int:
         obs.extend(found)
 
     try:
-        policy: PolicyTable = fit(obs, max_regimes=args.regimes)
+        policy: PolicyTable = fit(
+            obs, max_regimes=args.regimes,
+            emit_guards=not args.no_guards,
+            ttft_headroom=args.ttft_headroom,
+            attainment_margin=args.attainment_margin)
     except ValueError as e:
         print(f"autotune_fit: fit failed: {e}", file=sys.stderr)
         return 1
     policy.save(args.out)
     for r in policy.regimes:
         bound = r.get("max_offered_rps")
+        guards = "".join(
+            f" [{k} {r[k]}]" for k in ("max_ttft_p99_s",
+                                       "min_attainment") if k in r)
         print(f"autotune_fit: regime <= "
               f"{'inf' if bound is None else bound} req/s -> "
               f"{r['config'].to_dict()} "
               f"(~{r.get('expected_tok_s', '?')} tok/s over "
-              f"{r.get('n_observations', '?')} obs)")
+              f"{r.get('n_observations', '?')} obs)" + guards)
     print(f"autotune_fit: wrote {len(policy.regimes)} regime(s) to "
           f"{args.out}")
     return 0

@@ -23,7 +23,13 @@ What it does:
     MFU/HBM-util down, attainment down all count. Per-class attainment
     dicts (``{"interactive": 0.97, ...}``) are flattened to scalar
     ``<path>_attainment_<class>`` fields first, so per-class collapses
-    are caught even when the aggregate held.
+    are caught even when the aggregate held;
+  * anomaly / action counters (``anomalies_fired``,
+    ``anomaly_actions`` and friends — the closed-loop tiers report
+    them) are SPLIT OUT before the gate: whether the sentinel fired
+    between two clean runs is workload noise, not a perf regression.
+    Their changes print as ``info`` lines (and ride the --json summary
+    under ``anomaly_fields``) but never affect the exit status.
 
 Exit status:
     0  no regression (including "fewer than two comparable rounds")
@@ -89,6 +95,25 @@ def flatten_attainment(rec: Dict) -> Dict:
         if isinstance(v, dict):
             walk(v, str(k))
     return out
+
+
+# anomaly / closed-loop action fields (any nesting depth once
+# flattened): never gate on these — two clean runs legitimately differ
+# in whether a detector fired or an action was taken
+_ANOMALY_FIELD_RE = re.compile(r"anomal|(^|_)actions?($|_)", re.I)
+
+
+def split_anomaly_fields(rec: Dict) -> Tuple[Dict, Dict]:
+    """(comparable, informational) copies of one tier record: anomaly
+    and action counters are diffed informationally, never gated — a
+    count change between clean rounds is detector noise, and a NEW
+    field appearing (an older round predating the closed loop) must
+    not read as a regression either."""
+    keep: Dict = {}
+    info: Dict = {}
+    for k, v in rec.items():
+        (info if _ANOMALY_FIELD_RE.search(str(k)) else keep)[k] = v
+    return keep, info
 
 
 def load_round(path: str, bc) -> Optional[Dict[str, dict]]:
@@ -161,13 +186,33 @@ def main(argv: List[str]) -> int:
             print(note)
         return 0
     (old_name, old_tiers), (new_name, new_tiers) = rounds[-2:]
-    summary = bc.compare(old_tiers, new_tiers, tol)
+    old_cmp, new_cmp = {}, {}
+    old_info, new_info = {}, {}
+    for name, rec in old_tiers.items():
+        old_cmp[name], old_info[name] = split_anomaly_fields(rec)
+    for name, rec in new_tiers.items():
+        new_cmp[name], new_info[name] = split_anomaly_fields(rec)
+    summary = bc.compare(old_cmp, new_cmp, tol)
     summary["old"] = old_name
     summary["new"] = new_name
+    # informational (non-gating) anomaly/action field diffs across the
+    # tiers that were actually compared
+    infos: List[Dict] = []
+    for tier in summary["compared"]:
+        o, n = old_info.get(tier, {}), new_info.get(tier, {})
+        for field in sorted(set(o) | set(n)):
+            if o.get(field) != n.get(field):
+                infos.append({"tier": tier, "field": field,
+                              "old": o.get(field),
+                              "new": n.get(field)})
+    summary["anomaly_fields"] = infos
     if as_json:
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:
         print(f"comparing {old_name} -> {new_name} (tol {tol:.0%})")
+        for e in summary["anomaly_fields"]:
+            print(f"info {e['tier']}.{e['field']}: {e['old']} -> "
+                  f"{e['new']} (anomaly/action counter — not gated)")
         for e in summary["improvements"]:
             print(f"ok   {e['tier']}.{e['field']}: {e['old']} -> "
                   f"{e['new']} ({e['delta']:+.1%})")

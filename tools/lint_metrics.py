@@ -104,8 +104,15 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # traced hop latency
                        "cake_router_",
                        # online regression sentinel (obs/sentinel.py):
-                       # per-kind anomaly firings + active gauge
-                       "cake_anomaly_")
+                       # per-kind anomaly firings + active gauge —
+                       # cake_anomaly_ also covers the closed-loop
+                       # action counter (obs/actions.py,
+                       # cake_anomaly_actions_total)
+                       "cake_anomaly_",
+                       # black-box postmortem bundles (obs/actions.py
+                       # PostmortemSink): bundles written per trigger
+                       # + best-effort write failures
+                       "cake_postmortem_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to

@@ -1,0 +1,252 @@
+#!/usr/bin/env python3
+"""Render a --postmortem-dir black-box bundle into a wall-clock
+narrative.
+
+The engine/router postmortem sink (cake_tpu/obs/actions.py,
+PostmortemSink) dumps one JSON bundle per terminal incident — breaker
+stop, poisoned request, failed recovery, SIGTERM — holding every
+in-memory observability ring: recent step records, the typed event
+ring, request/hop trace spans, anomaly + action history, a stats and
+metrics snapshot, and the journal tail. This tool merges those rings
+onto ONE wall-clock axis so the incident reads as a story: what the
+workload was doing, which anomaly fired, what the control loop tried,
+and what the terminal event was.
+
+Usage:
+    python tools/postmortem.py BUNDLE.json
+    python tools/postmortem.py /path/to/postmortem-dir   # newest bundle
+    python tools/postmortem.py BUNDLE.json --limit 500   # longer tail
+    python tools/postmortem.py BUNDLE.json --metrics     # +metrics text
+
+The narrative is tail-limited (--limit, default 120 lines) because the
+step ring dominates: the interesting lines are at the END, right before
+the trigger. The trigger itself is always the last line.
+
+Exit status: 0 = rendered, 2 = bad arguments / unreadable bundle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# one narrative line: (wall_ts, source_tag, text). Sorted by (ts, tag,
+# text) so rendering is deterministic even for equal timestamps.
+Entry = Tuple[float, str, str]
+
+# scalar event/action fields worth showing inline; everything else
+# stays in the bundle (the narrative is a summary, not a re-dump)
+_SKIP_FIELDS = ("seq", "ts", "type", "rid", "t", "kind", "action",
+                "outcome")
+
+
+def _fmt_ts(ts: float) -> str:
+    try:
+        dt = datetime.datetime.fromtimestamp(ts)
+        return dt.strftime("%H:%M:%S.") + f"{dt.microsecond // 1000:03d}"
+    except (OverflowError, OSError, ValueError):
+        return f"{ts:.3f}"
+
+
+def _kv(d: Dict, skip=_SKIP_FIELDS) -> str:
+    parts = [f"{k}={v}" for k, v in d.items()
+             if k not in skip and isinstance(v, (str, int, float, bool))]
+    return (" " + " ".join(parts)) if parts else ""
+
+
+def _cause_line(cause) -> str:
+    if not isinstance(cause, dict):
+        return ""
+    keys = ("value", "threshold", "baseline", "ratio", "comparison")
+    parts = [f"{k}={cause[k]}" for k in keys if k in cause]
+    return (" (" + ", ".join(parts) + ")") if parts else ""
+
+
+def _step_entries(bundle: Dict) -> List[Entry]:
+    out: List[Entry] = []
+    for s in bundle.get("steps") or []:
+        ts = s.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        txt = (f"step {s.get('step')} {s.get('kind')}"
+               f" rows={s.get('rows')} tokens={s.get('tokens')}"
+               f" wall={s.get('wall_s')}s")
+        if s.get("compiled"):
+            txt += "  COMPILED"
+        out.append((float(ts), "step", txt))
+    return out
+
+
+def _event_entries(bundle: Dict) -> List[Entry]:
+    out: List[Entry] = []
+    for e in bundle.get("events") or []:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if e.get("type") in ("anomaly", "anomaly_action"):
+            # the sentinel and action rings render these with richer
+            # detail — the bus copies would be duplicate lines
+            continue
+        rid = f" rid={e['rid']}" if e.get("rid") is not None else ""
+        out.append((float(ts), "event",
+                    f"{e.get('type')}{rid}{_kv(e)}"))
+    return out
+
+
+def _anomaly_entries(bundle: Dict) -> List[Entry]:
+    an = bundle.get("anomalies") or {}
+    seen = set()
+    out: List[Entry] = []
+    for a in list(an.get("active") or []) + list(an.get("anomalies")
+                                                 or []):
+        key = (a.get("kind"), a.get("fired_at"))
+        if key in seen:
+            continue
+        seen.add(key)
+        fired = a.get("fired_at")
+        if isinstance(fired, (int, float)):
+            out.append((float(fired), "ANOMALY",
+                        f"{a.get('kind')} FIRED"
+                        f"{_cause_line(a.get('cause'))}"))
+        cleared = a.get("cleared_at")
+        if isinstance(cleared, (int, float)):
+            out.append((float(cleared), "ANOMALY",
+                        f"{a.get('kind')} cleared"))
+    return out
+
+
+def _action_entries(bundle: Dict) -> List[Entry]:
+    # the action ring carries richer detail than its bus event (the
+    # event only rides scalars) — prefer the ring, it is authoritative
+    out: List[Entry] = []
+    for a in bundle.get("actions") or []:
+        t = a.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        out.append((float(t), "ACTION",
+                    f"{a.get('action')} [{a.get('outcome')}] "
+                    f"on {a.get('kind')}{_kv(a)}"))
+    return out
+
+
+def _trace_entries(bundle: Dict) -> List[Entry]:
+    out: List[Entry] = []
+    for r in bundle.get("traces") or []:
+        rid = r.get("rid")
+        for sp in r.get("spans") or []:
+            t = sp.get("t")
+            if isinstance(t, (int, float)):
+                out.append((float(t), "req",
+                            f"rid={rid} {sp.get('name')}"))
+    for r in bundle.get("hops") or []:
+        trace = r.get("trace")
+        for sp in r.get("spans") or []:
+            t = sp.get("t")
+            if isinstance(t, (int, float)):
+                out.append((float(t), "hop",
+                            f"{trace} {sp.get('name')}"
+                            f"{_kv(sp, skip=('name', 't'))}"))
+    return out
+
+
+def render(bundle: Dict, limit: int = 120,
+           show_metrics: bool = False) -> str:
+    lines: List[str] = []
+    wall = bundle.get("wall_time")
+    trigger = bundle.get("trigger", "?")
+    lines.append(f"postmortem bundle v{bundle.get('version', '?')} — "
+                 f"trigger: {trigger}")
+    if isinstance(wall, (int, float)):
+        lines.append(f"  at {_fmt_ts(float(wall))} "
+                     f"({datetime.datetime.fromtimestamp(wall)})")
+    if bundle.get("reason"):
+        lines.append(f"  reason: {bundle['reason']}")
+    stats = bundle.get("stats")
+    if isinstance(stats, dict):
+        picks = [f"{k}={stats[k]}" for k in
+                 ("steps", "completed", "errors", "preempted",
+                  "config_switches", "config_rollbacks", "last_error")
+                 if stats.get(k) not in (None, 0, "")]
+        if picks:
+            lines.append("  stats: " + " ".join(picks))
+    an = bundle.get("anomalies") or {}
+    active = an.get("active") or []
+    if active:
+        lines.append("  active anomalies: "
+                     + ", ".join(str(a.get("kind")) for a in active))
+    jt = bundle.get("journal_tail")
+    if jt:
+        lines.append(f"  journal tail: {len(jt)} record(s) in bundle")
+    lines.append("")
+
+    entries = (_step_entries(bundle) + _event_entries(bundle)
+               + _anomaly_entries(bundle) + _action_entries(bundle)
+               + _trace_entries(bundle))
+    if isinstance(wall, (int, float)):
+        reason = f": {bundle['reason']}" if bundle.get("reason") else ""
+        entries.append((float(wall), "TRIGGER",
+                        f"{trigger}{reason}"))
+    entries.sort(key=lambda e: (e[0], e[1], e[2]))
+    shown = entries[-max(1, int(limit)):]
+    if len(entries) > len(shown):
+        lines.append(f"  ... {len(entries) - len(shown)} earlier "
+                     f"line(s) elided (--limit {limit})")
+    width = max((len(tag) for _, tag, _ in shown), default=0)
+    for ts, tag, txt in shown:
+        lines.append(f"{_fmt_ts(ts)}  {tag.ljust(width)}  {txt}")
+
+    if show_metrics and bundle.get("metrics"):
+        lines.append("")
+        lines.append("-- metrics snapshot " + "-" * 40)
+        lines.append(str(bundle["metrics"]).rstrip())
+    return "\n".join(lines) + "\n"
+
+
+def _resolve(path: str) -> Optional[str]:
+    """A file renders itself; a directory renders its newest bundle."""
+    if os.path.isdir(path):
+        cands = sorted(glob.glob(os.path.join(path,
+                                              "postmortem-*.json")))
+        return cands[-1] if cands else None
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle",
+                    help="bundle JSON file, or a --postmortem-dir "
+                         "(renders the newest bundle in it)")
+    ap.add_argument("--limit", type=int, default=120,
+                    help="max narrative lines, tail-kept (default 120)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="append the bundled metrics snapshot")
+    args = ap.parse_args(argv)
+
+    path = _resolve(args.bundle)
+    if path is None:
+        print(f"postmortem: no postmortem-*.json in {args.bundle}",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(path) as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"postmortem: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(bundle, dict):
+        print(f"postmortem: {path} is not a bundle object",
+              file=sys.stderr)
+        return 2
+    print(f"postmortem: {path}")
+    sys.stdout.write(render(bundle, limit=args.limit,
+                            show_metrics=args.metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
