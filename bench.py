@@ -166,17 +166,18 @@ MIXED_TIERS = {
 }
 
 # KV tiering tiers (bench.py --kv-tier): the same offered load at f32
-# vs int8 KV, each phase's page pool sized to the SAME byte budget —
-# int8 pages + per-page scales cost ~1/4 the bytes, so the identical
-# budget holds ~4x the pages and the pool admits more concurrent
-# streams. Each phase also exercises the host tier: a registered
-# prefix goes cold, the oversubscribed wave spills it for admission
-# pages, and a final prefix-matching request restores it.
+# vs int8 vs int4 KV, each phase's page pool sized to the SAME byte
+# budget — int8 pages + per-page scales cost ~1/4 the bytes and
+# nibble-packed int4 pages ~1/8, so the identical budget holds ~4x /
+# ~8x the pages and the pool admits more concurrent streams. Each
+# phase also exercises the host tier: a registered prefix goes cold,
+# the oversubscribed wave spills it for admission pages, and a final
+# prefix-matching request restores it.
 KV_TIER_TIERS = {
     # 16 f32 pages x 128 tokens at 8B is ~512 MiB of pool budget; the
-    # same budget holds ~64 int8 pages. 24 streams of 2 pages each
-    # oversubscribe both phases, so f32 caps at ~7 resident streams
-    # (prefix spilled) while int8 reaches the 16-slot cap.
+    # same budget holds ~64 int8 / ~128 int4 pages. 24 streams of 2
+    # pages each oversubscribe every phase, so f32 caps at ~7 resident
+    # streams (prefix spilled) while int8/int4 reach the 16-slot cap.
     "kvtier_8b": dict(model="8b", quant="int8", max_seq=512, slots=16,
                       pool_bytes=16 * 2 * 32 * 128 * 8 * 128 * 4,
                       kv_page_size=128, paged_attn="pallas",
@@ -316,14 +317,15 @@ SMOKE_TIERS = {
                           hi_gen=24, hi_stagger_s=0.01,
                           boundary_rps=5.0, interval_s=0.1,
                           cooldown_s=120.0, cache_f32=True),
-    # 4 f32 pages of budget -> ~15 int8 pages: streams of 2 pages each
-    # give f32 ~2 resident vs int8 ~7 (the >= 1.8x acceptance bar),
-    # and the 2-page prefix spills/restores in both phases
-    "kvtier_tiny": dict(model="tiny", quant=False, max_seq=128, slots=8,
+    # 4 f32 pages of budget -> ~15 int8 / ~31 int4 pages: streams of 2
+    # pages each give f32 ~2 resident vs int8 ~7 vs int4 ~15 (the
+    # >= 1.8x acceptance bars at BOTH narrowing steps), and the 2-page
+    # prefix spills/restores in every phase
+    "kvtier_tiny": dict(model="tiny", quant=False, max_seq=128, slots=16,
                         pool_bytes=4 * 2 * 4 * 16 * 2 * 16 * 4,
                         kv_page_size=16, paged_attn="fold",
                         prompt_len=24, gen_tokens=8, prefix_tokens=32,
-                        host_pages=6, wave=10),
+                        host_pages=6, wave=18),
     "mixed_tiny": dict(model="tiny", quant=False, max_seq=128, slots=3,
                        kv_pages=24, kv_page_size=16, paged_attn="fold",
                        prompt_len=24, prefill_chunk=8, base_gen=64,
@@ -939,16 +941,19 @@ def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
                 pool_bytes: int, kv_page_size: int, paged_attn: str,
                 prompt_len: int, gen_tokens: int, prefix_tokens: int,
                 host_pages: int, wave: int) -> dict:
-    """KV tiering A/B (cake_tpu/kv): the same offered load served at
-    f32 KV and at int8 KV, each phase's page pool sized to the SAME
-    byte budget (pool_bytes -> pages per dtype via page_bytes, so int8
-    gets ~4x the pages). Reports max RESIDENT streams per phase (peak
+    """KV tiering three-way (cake_tpu/kv): the same offered load
+    served at f32, int8 and nibble-packed int4 KV, each phase's page
+    pool sized to the SAME byte budget (pool_bytes -> pages per dtype
+    via the one page_bytes source, so int8 gets ~4x and int4 ~8x the
+    pages). Reports max RESIDENT streams per phase (peak
     concurrently-admitted requests — the capacity win quantized pages
     exist for), aggregate decode tok/s, and host-tier spill/restore
-    counts: each phase registers a shared prefix, oversubscribes the
-    pool so the cold prefix SPILLS to the host tier under admission
-    pressure, then sends one prefix-matching request so it RESTORES.
-    The headline value is the int8/f32 resident-stream ratio."""
+    counts (decode-resident parks included): each phase registers a
+    shared prefix, oversubscribes the pool so the cold prefix SPILLS
+    to the host tier under admission pressure, then sends one
+    prefix-matching request so it RESTORES. The headline value stays
+    the int8/f32 resident-stream ratio (round-diffable across PRs);
+    the int4 columns carry their own ratio key."""
     from functools import partial
 
     import jax
@@ -970,9 +975,12 @@ def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
     prefix_ids = _synth_prompt(777, prefix_tokens, V)
 
     def phase(kv_dtype: str) -> dict:
+        # ONE page_bytes source for all three dtypes: the byte budget
+        # and the engine's memory_bytes() cannot drift (page_bytes
+        # takes the storage NAME for quantized pools — values + scales)
         per_page = kv_page_bytes(
             cfg, kv_page_size,
-            jnp.int8 if kv_dtype == "int8" else jnp.float32)
+            kv_dtype if kv_dtype in ("int8", "int4") else jnp.float32)
         pages = max(2, pool_bytes // per_page)
         engine = InferenceEngine(
             cfg, params, ByteTokenizer(cfg.vocab_size),
@@ -1023,19 +1031,24 @@ def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
                 "tok_s": tokens / decode_s if decode_s > 0 else 0.0,
                 "spills": engine.stats.kv_spills,
                 "restores": engine.stats.kv_restores,
+                "resident_spills": engine.stats.kv_resident_spills,
             }
         log(f"kv[{kv_dtype}]: {out['streams']} resident streams, "
-            f"{out['tok_s']:.1f} tok/s, {out['spills']} spills / "
+            f"{out['tok_s']:.1f} tok/s, {out['spills']} spills "
+            f"({out['resident_spills']} resident) / "
             f"{out['restores']} restores ({pages} pages, "
             f"{out['pool_bytes'] / 2**20:.1f} MiB pool)")
         return out
 
     f32 = phase("f32")
     q8 = phase("int8")
+    q4 = phase("int4")
     ratio = q8["streams"] / max(1, f32["streams"])
-    log(f"kv tiering: int8 {q8['streams']} vs f32 {f32['streams']} "
-        f"resident streams at ~{pool_bytes / 2**20:.0f} MiB pool "
-        f"budget -> {ratio:.2f}x")
+    ratio4 = q4["streams"] / max(1, f32["streams"])
+    log(f"kv tiering: int4 {q4['streams']} vs int8 {q8['streams']} vs "
+        f"f32 {f32['streams']} resident streams at "
+        f"~{pool_bytes / 2**20:.0f} MiB pool budget -> "
+        f"{ratio4:.2f}x / {ratio:.2f}x")
     return {
         "metric": f"{name}_kv_resident_streams_ratio",
         "value": round(ratio, 2),
@@ -1043,18 +1056,28 @@ def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
         "vs_baseline": 0.0,
         "paged_attn": paged_attn,
         "kv_pool_budget_bytes": pool_bytes,
+        "kv_streams_ratio_int4": round(ratio4, 2),
+        "kv_streams_int4": q4["streams"],
         "kv_streams_int8": q8["streams"],
         "kv_streams_f32": f32["streams"],
+        "kv_pages_int4": q4["pages"],
         "kv_pages_int8": q8["pages"],
         "kv_pages_f32": f32["pages"],
+        "kv_pool_bytes_int4": q4["pool_bytes"],
         "kv_pool_bytes_int8": q8["pool_bytes"],
         "kv_pool_bytes_f32": f32["pool_bytes"],
+        "kv_tok_s_int4": round(q4["tok_s"], 2),
         "kv_tok_s_int8": round(q8["tok_s"], 2),
         "kv_tok_s_f32": round(f32["tok_s"], 2),
+        "kv_spills_int4": q4["spills"],
         "kv_spills_int8": q8["spills"],
         "kv_spills_f32": f32["spills"],
+        "kv_restores_int4": q4["restores"],
         "kv_restores_int8": q8["restores"],
         "kv_restores_f32": f32["restores"],
+        "kv_resident_spills_int4": q4["resident_spills"],
+        "kv_resident_spills_int8": q8["resident_spills"],
+        "kv_resident_spills_f32": f32["resident_spills"],
         "kv_host_pages": host_pages,
         "device_kind": dev.device_kind,
     }

@@ -16,13 +16,17 @@ from dataclasses import dataclass, field, fields, asdict
 from enum import Enum
 from typing import Optional
 
-# one source of truth for the int8-KV-with-speculation config error:
-# Args.validate raises it on the CLI path, master.make_engine raises it
-# for programmatically-built Args that skipped validate()
+# one source of truth for the quantized-KV-with-speculation config
+# error: Args.validate raises it on the CLI path, master.make_engine
+# raises it for programmatically-built Args that skipped validate()
 INT8_KV_SPEC_ERROR = (
-    "--kv-dtype int8 is unavailable with --draft-model:"
+    "--kv-dtype int8/int4 is unavailable with --draft-model:"
     " the speculative engine is gated off the paged "
     "pool, so there are no KV pages to quantize")
+
+# the quantized paged-pool storage names ("int8" = 1 byte/value,
+# "int4" = two nibble-packed values/byte; cake_tpu/kv/quantized_pool)
+QUANTIZED_KV_DTYPES = ("int8", "int4")
 
 
 class ModelType(str, Enum):
@@ -93,13 +97,15 @@ class Args:
     repeat_last_n: int = 128
     dtype: str = "bf16"                 # f16 | bf16 | f32 (TPU default bf16)
     # KV-cache storage dtype; fp8 halves KV HBM traffic/footprint (values
-    # upcast into the attention matmul on read). "int8" selects the
-    # QUANTIZED paged pool (cake_tpu/kv): int8 KV pages + per-page
-    # per-kv-head f32 scales, ~4x the resident decode streams per pool
-    # byte vs f32 — requires --kv-pages (the page is the quantization
-    # unit) and is a loud config error with --draft-model (the spec
-    # engine is gated off the paged pool). None = same as dtype.
-    kv_dtype: Optional[str] = None      # + f8_e4m3 | f8_e5m2 | int8
+    # upcast into the attention matmul on read). "int8"/"int4" select
+    # the QUANTIZED paged pool (cake_tpu/kv): int8 or nibble-packed
+    # int4 KV pages + per-page per-kv-head f32 scales, ~4x / ~8x the
+    # resident decode streams per pool byte vs f32 — both require
+    # --kv-pages (the page is the quantization unit; int4 additionally
+    # needs an even --kv-page-size) and are a loud config error with
+    # --draft-model (the spec engine is gated off the paged pool).
+    # None = same as dtype.
+    kv_dtype: Optional[str] = None      # + f8_e4m3 | f8_e5m2 | int8 | int4
     cpu: bool = False
     device_idx: int = 0
     max_seq_len: int = 4096             # reference hard constant (config.rs:6); tunable here
@@ -378,14 +384,22 @@ class Args:
             raise ValueError(
                 f"unsupported mixed_batch '{self.mixed_batch}' "
                 "(choose auto, on or off)")
-        if self.kv_dtype == "int8":
-            # int8 KV is page-granular (per-page scales live in the
-            # paged pool); without --kv-pages there is nothing to
+        if self.kv_dtype in QUANTIZED_KV_DTYPES:
+            # quantized KV is page-granular (per-page scales live in
+            # the paged pool); without --kv-pages there is nothing to
             # quantize — loud error, not a silent no-op
             if not self.kv_pages:
                 raise ValueError(
-                    "--kv-dtype int8 requires --kv-pages: int8 KV "
-                    "pages live in the paged pool (cake_tpu/kv)")
+                    f"--kv-dtype {self.kv_dtype} requires --kv-pages: "
+                    "quantized KV pages live in the paged pool "
+                    "(cake_tpu/kv)")
+            if self.kv_dtype == "int4" and self.kv_page_size % 2:
+                # two int4 values nibble-pack into one byte along the
+                # page's token axis, so a page must split evenly
+                raise ValueError(
+                    f"--kv-dtype int4 requires an even --kv-page-size "
+                    f"(got {self.kv_page_size}): pages nibble-pack "
+                    "token pairs (cake_tpu/kv/quantized_pool)")
             if self.draft_model is not None:
                 raise ValueError(INT8_KV_SPEC_ERROR)
         elif self.kv_dtype is not None:

@@ -29,7 +29,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 from cake_tpu.autotune.search import PolicyTable
@@ -136,6 +136,11 @@ class ControllerConfig:
     rollback_window: int = 3      # post-switch samples before the verdict
     rollback_frac: float = 0.7    # revert when post < frac * pre rate
     log_size: int = 64            # retained decision-log entries
+    # pool-pressure escalation: window-mean pages_in_use_frac at or
+    # above this proposes narrowing an int8 pool to int4 (the one
+    # switch direction that frees page capacity without shrinking the
+    # pool; the widening direction stays illegal — space.switch_guard)
+    page_pressure_frac: float = 0.95
 
 
 class AutotuneController:
@@ -203,6 +208,13 @@ class AutotuneController:
                 attain[c] = min(attain.get(c, 1.0), v)
         return ttft, attain
 
+    def _window_page_pressure(self) -> float:
+        """Mean page-pool occupancy fraction over the window — the
+        pool-pressure escalation's trigger signal."""
+        with self._mu:
+            xs = [s.pages_in_use_frac for s in self._window]
+        return sum(xs) / len(xs) if xs else 0.0
+
     def _window_min_attainment(self) -> Optional[float]:
         """Mean worst-class attainment over the window's samples that
         carry attainment data (None without any) — the pre/post series
@@ -240,6 +252,18 @@ class AutotuneController:
         target = self.policy.lookup(self.window_offered_rps(),
                                     ttft_p99_by_class=ttft_by_cls,
                                     attainment=attain)
+        # pool-pressure escalation (takes precedence over the fitted
+        # table — a starving pool throttles every config the table
+        # could name): an int8 pool running at >= page_pressure_frac
+        # occupancy over the window proposes the SAME point at int4,
+        # doubling page capacity in place. int4 is terminal: there is
+        # no narrower pool, and widening back is gated by switch_guard,
+        # so the escalation converges. Flows through the normal
+        # hysteresis + pin + rollback-guard machinery.
+        if (self._current.paged and self._current.kv_dtype == "int8"
+                and self._window_page_pressure()
+                >= cfg.page_pressure_frac):
+            target = replace(self._current, kv_dtype="int4")
         tkey = config_key(target)
         if tkey == config_key(self._current) or tkey in self._pinned:
             self._target_key, self._streak = None, 0

@@ -104,9 +104,9 @@ def resolve_paged_attn(paged_attn: Optional[str]) -> str:
 
 def _canon_kv_dtype(name: Optional[str]) -> Optional[str]:
     """Spelling-normalized storage dtype: "f32"/"float32" and friends
-    map to one canonical string; int8 and None (follow the engine's
-    cache dtype) pass through."""
-    if name is None or name == "int8":
+    map to one canonical string; the quantized-pool names (int8/int4)
+    and None (follow the engine's cache dtype) pass through."""
+    if name is None or name in ("int8", "int4"):
         return name
     try:
         import numpy as np
@@ -173,25 +173,36 @@ def validate_config(cfg: EngineConfig,
     return cfg
 
 
+def _dtype_rank(name: Optional[str]) -> int:
+    """Precision rank of a KV storage dtype: int4 < int8 < float. A
+    live switch may only hold precision or NARROW it — widening would
+    re-derive in-flight transcripts at higher-precision KV."""
+    return {"int4": 0, "int8": 1}.get(_canon_kv_dtype(name), 2)
+
+
 def switch_guard(old: EngineConfig, new: EngineConfig) -> Optional[str]:
     """Reason a LIVE old -> new switch is refused, or None when legal.
 
-    The int8-pool -> float-pool direction is gated off: streams already
-    served from the int8 pool emitted tokens sampled under QUANTIZED KV
-    numerics, and the hot-switch resume re-prefills their transcripts
-    at exact KV — the continuation can disagree with the history the
+    Any precision-WIDENING direction (int8 -> float, int4 -> int8,
+    int4 -> float) is gated off: streams already served from the
+    quantized pool emitted tokens sampled under QUANTIZED KV numerics,
+    and the hot-switch resume re-prefills their transcripts at the
+    wider KV — the continuation can disagree with the history the
     client already received, so the greedy token-identity contract
     (tests/test_autotune_engine.py pins it for every allowed switch at
     f32 KV) cannot be honored in this direction. Quantizing FORWARD
-    (float -> int8) is the autotuner's memory-pressure response and
-    stays allowed: no identity claim is made for a quantized target."""
-    if old.kv_dtype == "int8" and new.kv_dtype != "int8":
+    (float -> int8 -> int4) is the autotuner's memory-pressure
+    response and stays allowed: no identity claim is made for a
+    quantized target."""
+    ro, rn = _dtype_rank(old.kv_dtype), _dtype_rank(new.kv_dtype)
+    if ro < rn:
+        names = {0: "int4-pool", 1: "int8-pool", 2: "float-pool"}
         return (
-            "refusing the int8-pool -> float-pool hot switch: in-flight "
-            "streams were decoded against quantized KV, and the "
-            "fold-tokens-into-prompt resume would re-prefill their "
-            "transcripts at exact KV — continuations could diverge "
+            f"refusing the {names[ro]} -> {names[rn]} hot switch: "
+            "in-flight streams were decoded against quantized KV, and "
+            "the fold-tokens-into-prompt resume would re-prefill their "
+            "transcripts at wider KV — continuations could diverge "
             "from the already-streamed history, breaking the greedy "
             "token-identity contract. Drain the engine and restart "
-            "with the float pool instead.")
+            f"with the {names[rn]} instead.")
     return None

@@ -517,7 +517,7 @@ def main(argv=None) -> int:
             "single request with nothing to schedule")
     if args.kv_pages or args.auto_prefix \
             or getattr(args, "kv_host_pages", None) \
-            or getattr(args, "kv_dtype", None) == "int8" \
+            or getattr(args, "kv_dtype", None) in ("int8", "int4") \
             or getattr(args, "mixed_batch", "auto") == "on":
         # all live in the serving engine (paged pool / prefix registry
         # / mixed ragged step / kv tiering); a one-shot generation
@@ -525,9 +525,9 @@ def main(argv=None) -> int:
         # nothing"
         logging.getLogger(__name__).warning(
             "--kv-pages / --auto-prefix / --mixed-batch / --kv-dtype "
-            "int8 / --kv-host-pages apply to engine serving (--api); "
-            "one-shot generation uses the sequential generator's "
-            "dense cache")
+            "int8/int4 / --kv-host-pages apply to engine serving "
+            "(--api); one-shot generation uses the sequential "
+            "generator's dense cache")
     if getattr(args, "autotune", "off") != "off":
         # the autotuner hot-switches a LIVE engine's config between
         # iterations; a one-shot generation has no engine and no load

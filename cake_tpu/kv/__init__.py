@@ -4,23 +4,27 @@ The paged serving pool (models/llama/paged.py) treats the PAGE as its
 unit of allocation; this package makes the page the unit of two more
 things:
 
-  * quantization (`quantized_pool.py`): an int8 page pool with
-    per-page, per-kv-head symmetric scales — pool bytes drop ~4x vs
-    f32 (~2x vs bf16), so the same HBM budget holds proportionally
-    more resident decode streams;
+  * quantization (`quantized_pool.py`): int8 and nibble-packed int4
+    page pools with per-page, per-kv-head symmetric scales — pool
+    bytes drop ~4x (int8) / ~8x (int4) vs f32, so the same HBM budget
+    holds proportionally more resident decode streams;
   * tiering (`host_tier.py`): an LRU host-RAM spill store behind the
-    refcounted PageAllocator — cold shared-prefix pages and preempted
-    victims' pages stream out to pinned host memory and back on
+    refcounted PageAllocator — cold shared-prefix pages, preempted
+    victims' pages, and (under pool pressure) actively-decoding
+    streams' pages stream out to pinned host memory and back on
     demand, instead of being discarded and recomputed.
 """
 
 from cake_tpu.kv.host_tier import HostTier
 from cake_tpu.kv.quantized_pool import (
-    QuantPool, QuantizedPagedKVCache, dequantize_pages,
+    Int4PagedKVCache, Int4Pool, QuantPool, QuantizedPagedKVCache,
+    dequantize_pages,
 )
 
 __all__ = [
     "HostTier",
+    "Int4PagedKVCache",
+    "Int4Pool",
     "QuantPool",
     "QuantizedPagedKVCache",
     "dequantize_pages",

@@ -19,10 +19,12 @@ the tier itself only moves bytes and keeps the LRU map.
 
 Metrics (obs/metrics.py registry; also refreshed at scrape by
 obs/steps.refresh_page_gauges):
-  cake_kv_host_pages{state}   gauge    used | free host pages
-  cake_kv_spill_total{dir}    counter  spill | restore page movements
-  cake_kv_spill_seconds       histogram device<->host copy wall
-  cake_kv_pool_bytes{tier}    gauge    device | host resident bytes
+  cake_kv_host_pages{state,dtype}  gauge    used | free host pages
+  cake_kv_spill_total{dir}         counter  spill | restore page moves
+  cake_kv_resident_spills_total    counter  decode-resident streams
+                                            parked under pool pressure
+  cake_kv_spill_seconds            histogram device<->host copy wall
+  cake_kv_pool_bytes{tier,dtype}   gauge    device | host bytes
 """
 
 from __future__ import annotations
@@ -43,13 +45,20 @@ log = logging.getLogger(__name__)
 _HOST_PAGES = obs_metrics.gauge(
     "cake_kv_host_pages",
     "Host-tier KV pages by state (used = spilled pages resident in "
-    "host RAM, free = remaining --kv-host-pages capacity)",
-    labelnames=("state",))
+    "host RAM, free = remaining --kv-host-pages capacity) and pool "
+    "storage dtype",
+    labelnames=("state", "dtype"))
 _SPILLS = obs_metrics.counter(
     "cake_kv_spill_total",
     "KV pages moved across the HBM/host boundary, by direction "
     "(spill = device->host, restore = host->device)",
     labelnames=("dir",))
+_RESIDENT_SPILLS = obs_metrics.counter(
+    "cake_kv_resident_spills_total",
+    "Actively-decoding streams parked in the host tier because the "
+    "pool could not admit a new request (decode-resident spill; "
+    "preemption victims and cold prefixes count in cake_kv_spill_total "
+    "only)")
 _SPILL_SECONDS = obs_metrics.histogram(
     "cake_kv_spill_seconds",
     "Wall seconds per spill/restore page movement (device_get or "
@@ -57,18 +66,43 @@ _SPILL_SECONDS = obs_metrics.histogram(
 _POOL_BYTES = obs_metrics.gauge(
     "cake_kv_pool_bytes",
     "KV pool bytes resident per tier (device = the paged pool incl. "
-    "int8 scale sidecars, host = spilled pages in RAM)",
-    labelnames=("tier",))
+    "int8/int4 scale sidecars, host = spilled pages in RAM) and pool "
+    "storage dtype",
+    labelnames=("tier", "dtype"))
+
+
+def pool_dtype_name(cache) -> str:
+    """Storage-dtype label value for a paged cache: quantized pools
+    report their logical precision (a packed int4 pool is uint8-backed
+    but stores int4 values), plain pools their array dtype. The ONE
+    source for the {dtype} label on cake_kv_pool_bytes /
+    cake_kv_host_pages."""
+    k = cache.k
+    if hasattr(k, "q"):            # QuantPool / Int4Pool
+        return "int4" if k.q.dtype == np.uint8 else "int8"
+    return np.dtype(k.dtype).name
+
+
+def note_resident_spill() -> None:
+    """Count one decode-resident stream parked in the host tier — the
+    engine's _spill_resident_stream seam; keeps the counter global
+    module-private."""
+    _RESIDENT_SPILLS.inc()
 
 
 def refresh_gauges(cache, tier: Optional["HostTier"]) -> None:
     """Scrape-time refresh of every cake_kv_* gauge — the PUBLIC seam
     for obs/steps.refresh_page_gauges, so the metric globals above stay
     module-private. cache is the engine's paged pool (device tier:
-    memory_bytes sums int8 pools + scale sidecars per dtype); tier is
-    the engine's HostTier or None when --kv-host-pages is unset."""
-    _POOL_BYTES.labels("device").set(cache.memory_bytes())
+    memory_bytes sums quantized pools + scale sidecars per dtype); tier
+    is the engine's HostTier or None when --kv-host-pages is unset.
+    The {dtype} label value is derived here from the live cache — host
+    entries always match the device pool's dtype (a reconfigure drops
+    entries on any storage change), so one name labels both tiers."""
+    name = pool_dtype_name(cache)
+    _POOL_BYTES.labels("device", name).set(cache.memory_bytes())
     if tier is not None:
+        tier.dtype_name = name
         tier._set_gauges()
 
 
@@ -100,12 +134,16 @@ class HostTier:
     OPTIONAL_PLANES = ("_events",)
 
     def __init__(self, capacity_pages: int, page_bytes: int = 0,
-                 events=None):
+                 events=None, dtype_name: str = "float32"):
         if capacity_pages < 1:
             raise ValueError(
                 f"--kv-host-pages {capacity_pages} must be >= 1")
         self.capacity = capacity_pages
         self.page_bytes = page_bytes
+        # {dtype} gauge label: set at construction from the engine's
+        # storage name, re-derived from the live cache at every scrape
+        # (refresh_gauges is the source of truth)
+        self.dtype_name = dtype_name
         self._entries: "OrderedDict[object, SpilledPages]" = OrderedDict()
         self._used = 0
         self.spills = 0
@@ -156,9 +194,11 @@ class HostTier:
 
     def _set_gauges(self) -> None:
         try:
-            _HOST_PAGES.labels("used").set(self._used)
-            _HOST_PAGES.labels("free").set(self.free_pages)
-            _POOL_BYTES.labels("host").set(self.used_bytes)
+            _HOST_PAGES.labels("used", self.dtype_name).set(self._used)
+            _HOST_PAGES.labels("free", self.dtype_name).set(
+                self.free_pages)
+            _POOL_BYTES.labels("host", self.dtype_name).set(
+                self.used_bytes)
         except Exception:  # noqa: BLE001 — telemetry never fails serving
             log.debug("host tier gauge update failed", exc_info=True)
 

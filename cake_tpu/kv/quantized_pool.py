@@ -1,4 +1,4 @@
-"""int8 KV page pool with per-page, per-kv-head scales.
+"""int8/int4 KV page pools with per-page, per-kv-head scales.
 
 Decode is HBM-bandwidth-bound and the KV cache is the growing term
 (BENCH_MEASURED: int8 *weights* already run at 1.6x the bf16 roofline;
@@ -28,9 +28,18 @@ must keep the already-quantized page consistent:
     page's first write always sets its own scale instead of inheriting
     a previous occupant's.
 
-`QuantPool` is a NamedTuple, so a stacked [L, ...] pool rides
-`lax.scan` over the block axis unchanged — each layer's body sees a
-per-layer QuantPool leaf pair, and the writers in
+The INT4 variant (`Int4Pool`) halves the bytes again: a page stores
+nibble-packed values (the `ops/int4_matmul.pack_int4` group-halves
+layout with one group per page — token t rides the LOW nibble of
+packed row t, token t + page/2 the HIGH nibble, bias +8) in a
+[L, N_pages, page//2, KV, hd] uint8 pool, same f32 scale sidecar, same
+monotone-scale RMW discipline at qmax 7. Every writer here is
+polymorphic over the two pool types: int4 pages unpack on gather and
+repack on scatter, so the quantization math is shared line-for-line.
+
+`QuantPool`/`Int4Pool` are NamedTuples, so a stacked [L, ...] pool
+rides `lax.scan` over the block axis unchanged — each layer's body
+sees a per-layer pool leaf pair, and the writers in
 `models/llama/paged.py` dispatch on the leaf type.
 """
 
@@ -43,6 +52,9 @@ import jax.numpy as jnp
 
 # symmetric int8 range and the amax floor (ops/quant.py convention)
 _QMAX = 127.0
+# symmetric int4 range: clip to [-7, 7] so the +8 packing bias keeps
+# every value a strict nibble (ops/int4_matmul convention)
+_QMAX4 = 7.0
 _EPS = 1e-8
 
 
@@ -55,6 +67,75 @@ class QuantPool(NamedTuple):
 
     q: jnp.ndarray
     scale: jnp.ndarray
+
+
+class Int4Pool(NamedTuple):
+    """One int4 page pool half (k or v): nibble-packed values + scales.
+
+    q:     uint8, [(L,) N_pages, page//2, KV, hd] — two tokens per
+           byte: token t in the low nibble of packed row t, token
+           t + page//2 in the high nibble, +8 bias (pack_int4 layout
+           with one group per page)
+    scale: f32,   [(L,) N_pages, KV]
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def pack_page_nibbles(q: jnp.ndarray) -> jnp.ndarray:
+    """[..., P, KV, hd] ints in [-8, 7] -> [..., P//2, KV, hd] uint8.
+
+    The `ops/int4_matmul.pack_int4` group-halves layout with g = P (one
+    group per page): +8 bias, low nibble = token t, high nibble =
+    token t + P//2."""
+    P = q.shape[-3]
+    v = (q.astype(jnp.int32) + 8) & 0xF
+    lo = v[..., : P // 2, :, :]
+    hi = v[..., P // 2:, :, :]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_page_nibbles(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of pack_page_nibbles: [..., P//2, KV, hd] uint8 ->
+    [..., P, KV, hd] int8 in [-8, 7], token order restored."""
+    p32 = packed.astype(jnp.int32)
+    lo = (p32 & 0xF) - 8
+    hi = (p32 >> 4) - 8
+    return jnp.concatenate([lo, hi], axis=-3).astype(jnp.int8)
+
+
+def _pool_qmax(pool) -> float:
+    return _QMAX4 if isinstance(pool, Int4Pool) else _QMAX
+
+
+def _pool_page(pool) -> int:
+    """Tokens per page for a per-layer pool leaf (the packed int4 axis
+    stores two tokens per row)."""
+    return pool.q.shape[1] * (2 if isinstance(pool, Int4Pool) else 1)
+
+
+def _gather_q(pool, idx) -> jnp.ndarray:
+    """Gather pages `idx` as UNPACKED int values [..., P, KV, hd].
+    Out-of-range ids fill with garbage that every caller either masks
+    (amax) or drops on the scatter-back."""
+    q = jnp.take(pool.q, idx, axis=0, mode="fill", fill_value=0)
+    if isinstance(pool, Int4Pool):
+        q = unpack_page_nibbles(q)
+    return q
+
+
+def _scatter_q(pool, idx, qw, new_s):
+    """Scatter whole pages back (packing int4 values first); OOB ids
+    drop. qw: [..., P, KV, hd] ints; new_s: [..., KV] f32."""
+    if isinstance(pool, Int4Pool):
+        qw = pack_page_nibbles(qw)
+    else:
+        qw = qw.astype(jnp.int8)
+    return pool._replace(
+        q=pool.q.at[idx].set(qw, mode="drop"),
+        scale=pool.scale.at[idx].set(new_s, mode="drop"),
+    )
 
 
 class QuantizedPagedKVCache(NamedTuple):
@@ -112,45 +193,108 @@ class QuantizedPagedKVCache(NamedTuple):
             (self.k, self.v)))
 
 
+class Int4PagedKVCache(NamedTuple):
+    """PagedKVCache with nibble-packed int4 pools + scale sidecars.
+    Same property surface as PagedKVCache / QuantizedPagedKVCache so
+    the engine and the jitted step fns stay layout-blind; page_size is
+    REAL tokens per page (2x the packed storage axis)."""
+
+    k: Int4Pool
+    v: Int4Pool
+    table: jnp.ndarray    # [slots, max_pages] int32, -1 = unmapped
+
+    @property
+    def page_size(self) -> int:
+        return self.k.q.shape[2] * 2
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.q.shape[1]
+
+    @property
+    def max_pages(self) -> int:
+        return self.table.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.table.shape[1] * self.k.q.shape[2] * 2
+
+    @classmethod
+    def create(cls, config, slots: int, n_pages: int, page_size: int,
+               max_seq_len: int) -> "Int4PagedKVCache":
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq_len "
+                f"{max_seq_len}")
+        if page_size % 2:
+            raise ValueError(
+                f"int4 KV pages nibble-pack two tokens per byte: "
+                f"page_size {page_size} must be even")
+        L = config.num_hidden_layers
+        KV = config.num_key_value_heads
+        hd = config.head_dim
+        shape = (L, n_pages, page_size // 2, KV, hd)
+        sshape = (L, n_pages, KV)
+        return cls(
+            k=Int4Pool(q=jnp.zeros(shape, jnp.uint8),
+                       scale=jnp.zeros(sshape, jnp.float32)),
+            v=Int4Pool(q=jnp.zeros(shape, jnp.uint8),
+                       scale=jnp.zeros(sshape, jnp.float32)),
+            table=jnp.full((slots, max_seq_len // page_size), -1,
+                           jnp.int32),
+        )
+
+    def memory_bytes(self) -> int:
+        return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            (self.k, self.v)))
+
+
 def page_bytes(config, page_size: int, dtype=jnp.float32) -> int:
-    """Storage bytes ONE pool page costs (k + v, all layers, scales
-    included for int8) — the unit the bench `--kv-tier` byte budget and
-    the host tier's accounting both price pages in."""
+    """Storage bytes ONE pool page costs (k + v, all layers, scale
+    sidecars included for int8/int4) — the ONE source the bench
+    `--kv-tier` byte budget, `memory_bytes`, and the host tier's
+    accounting all price pages in."""
     L = config.num_hidden_layers
     KV = config.num_key_value_heads
     hd = config.head_dim
-    if dtype == jnp.int8 or dtype == "int8":
+    name = dtype if isinstance(dtype, str) else jnp.dtype(dtype).name
+    if name == "int8":
         per = L * page_size * KV * hd * 1 + L * KV * 4
+    elif name == "int4":
+        per = L * (page_size // 2) * KV * hd * 1 + L * KV * 4
     else:
         per = L * page_size * KV * hd * jnp.dtype(dtype).itemsize
     return 2 * per          # k and v
 
 
-def _quantize_windows(vals: jnp.ndarray):
+def _quantize_windows(vals: jnp.ndarray, qmax: float = _QMAX):
     """Quantize whole page windows: vals [..., P, KV, hd] f32-ish ->
-    (q int8 same shape, scale f32 [..., KV]) with amax over (P, hd)."""
+    (q int8 same shape in [-qmax, qmax], scale f32 [..., KV]) with
+    amax over (P, hd)."""
     v32 = vals.astype(jnp.float32)
     amax = jnp.max(jnp.abs(v32), axis=(-3, -1))            # [..., KV]
-    scale = jnp.maximum(amax, _EPS) / _QMAX
+    scale = jnp.maximum(amax, _EPS) / qmax
     q = jnp.clip(jnp.round(v32 / scale[..., None, :, None]),
-                 -_QMAX, _QMAX).astype(jnp.int8)
+                 -qmax, qmax).astype(jnp.int8)
     return q, scale
 
 
-def _requant(q_old: jnp.ndarray, ratio: jnp.ndarray) -> jnp.ndarray:
-    """Re-quantize resident int8 values after a monotone scale growth:
+def _requant(q_old: jnp.ndarray, ratio: jnp.ndarray,
+             qmax: float = _QMAX) -> jnp.ndarray:
+    """Re-quantize resident int values after a monotone scale growth:
     q' = round(q * old/new). ratio broadcasts [..., KV] over
     [..., P, KV, hd]."""
     return jnp.clip(
         jnp.round(q_old.astype(jnp.float32) * ratio[..., None, :, None]),
-        -_QMAX, _QMAX).astype(jnp.int8)
+        -qmax, qmax).astype(jnp.int8)
 
 
-def dequantize_pages(pool: QuantPool, idx: jnp.ndarray,
+def dequantize_pages(pool, idx: jnp.ndarray,
                      fill_zero: bool = False) -> jnp.ndarray:
     """Gather pages `idx` and dequantize to f32:
     [*idx.shape, P, KV, hd]. fill_zero routes out-of-range ids to a
-    zero page (the fold's unmapped-page semantics)."""
+    zero page (the fold's unmapped-page semantics; an int4 fill page
+    unpacks to -8s but its zero scale zeroes the product)."""
     if fill_zero:
         q = jnp.take(pool.q, idx, axis=0, mode="fill", fill_value=0)
         s = jnp.take(pool.scale, idx, axis=0, mode="fill",
@@ -158,11 +302,12 @@ def dequantize_pages(pool: QuantPool, idx: jnp.ndarray,
     else:
         q = jnp.take(pool.q, idx, axis=0)
         s = jnp.take(pool.scale, idx, axis=0)
+    if isinstance(pool, Int4Pool):
+        q = unpack_page_nibbles(q)
     return q.astype(jnp.float32) * s[..., None, :, None]
 
 
-def reset_page_scales(cache: QuantizedPagedKVCache,
-                      pages) -> QuantizedPagedKVCache:
+def reset_page_scales(cache, pages):
     """Zero the scales of freshly-allocated pages (host-computed page
     list; one tiny eager scatter per admission, the table_set_slot
     precedent). A fresh page's first incremental write then sets its
@@ -181,10 +326,10 @@ def reset_page_scales(cache: QuantizedPagedKVCache,
 # -- writers (per-layer pool leaves, models/llama/paged.py contracts) ---------
 
 
-def qwrite_prompt_pages(pool: QuantPool, vals: jnp.ndarray,
-                        table_row: jnp.ndarray,
-                        n_real=None) -> QuantPool:
-    """write_prompt_pages over a quantized pool: page-ALIGNED windows
+def qwrite_prompt_pages(pool, vals: jnp.ndarray,
+                        table_row: jnp.ndarray, n_real=None):
+    """write_prompt_pages over a quantized pool (int8 or int4):
+    page-ALIGNED windows
     fully overwrite their pages, so each window quantizes fresh (scale
     from the window's own amax; zero padding cannot raise it) and both
     q and scale scatter in one parallel write. Unmapped windows route
@@ -197,7 +342,7 @@ def qwrite_prompt_pages(pool: QuantPool, vals: jnp.ndarray,
     this write, so a garbage-inflated amax coarsens the page's real
     tokens for the page's whole life. Padding values are zeroed before
     quantization instead."""
-    N, P = pool.q.shape[0], pool.q.shape[1]
+    N, P = pool.q.shape[0], _pool_page(pool)
     S = vals.shape[1]
     KV, hd = vals.shape[2], vals.shape[3]
     if n_real is not None:
@@ -210,15 +355,12 @@ def qwrite_prompt_pages(pool: QuantPool, vals: jnp.ndarray,
     pages = table_row[:n_win]
     idx = jnp.where(pages >= 0, pages, N)
     w = vals[0].reshape(n_win, P, KV, hd)
-    q, scale = _quantize_windows(w)
-    return QuantPool(
-        q=pool.q.at[idx].set(q, mode="drop"),
-        scale=pool.scale.at[idx].set(scale, mode="drop"),
-    )
+    q, scale = _quantize_windows(w, _pool_qmax(pool))
+    return _scatter_q(pool, idx, q, scale)
 
 
-def qupdate_pool_per_row(pool: QuantPool, vals: jnp.ndarray, pos,
-                         active, table) -> QuantPool:
+def qupdate_pool_per_row(pool, vals: jnp.ndarray, pos,
+                         active, table):
     """update_pool_per_row over a quantized pool: each active row's
     decode token lands in ONE page — gather that page + scale, grow
     the scale to cover the token, re-quantize residents by old/new,
@@ -226,32 +368,29 @@ def qupdate_pool_per_row(pool: QuantPool, vals: jnp.ndarray, pos,
     the B round-trips are disjoint; inactive/unmapped rows route to
     the out-of-bounds index on both the gather (zero/one fill) and the
     scatter (drop)."""
-    N, P = pool.q.shape[0], pool.q.shape[1]
+    N, P = pool.q.shape[0], _pool_page(pool)
+    qmax = _pool_qmax(pool)
     B = vals.shape[0]
     rows = jnp.arange(B)
     pages = table[rows, pos // P]
     offs = pos % P
     valid = jnp.logical_and(active, pages >= 0)
     idx = jnp.where(valid, pages, N)
-    qs = jnp.take(pool.q, idx, axis=0, mode="fill",
-                  fill_value=0)                         # [B,P,KV,hd]
+    qs = _gather_q(pool, idx)                           # [B,P,KV,hd]
     ss = jnp.take(pool.scale, idx, axis=0, mode="fill",
                   fill_value=0.0)                       # [B,KV]
     tok = vals[:, 0].astype(jnp.float32)                # [B,KV,hd]
-    need = jnp.maximum(jnp.max(jnp.abs(tok), axis=-1), _EPS) / _QMAX
+    need = jnp.maximum(jnp.max(jnp.abs(tok), axis=-1), _EPS) / qmax
     new_s = jnp.maximum(ss, need)
-    qr = _requant(qs, ss / new_s)
+    qr = _requant(qs, ss / new_s, qmax)
     qt = jnp.clip(jnp.round(tok / new_s[..., None]),
-                  -_QMAX, _QMAX).astype(jnp.int8)       # [B,KV,hd]
+                  -qmax, qmax).astype(jnp.int8)         # [B,KV,hd]
     mask = (jnp.arange(P)[None, :] == offs[:, None])    # [B,P]
     qw = jnp.where(mask[..., None, None], qt[:, None], qr)
-    return QuantPool(
-        q=pool.q.at[idx].set(qw, mode="drop"),
-        scale=pool.scale.at[idx].set(new_s, mode="drop"),
-    )
+    return _scatter_q(pool, idx, qw, new_s)
 
 
-def _window_pages_rmw(pool: QuantPool, vals, j_idx, off_idx, wmask_src,
+def _window_pages_rmw(pool, vals, j_idx, off_idx, wmask_src,
                       idx, touched):
     """Shared gather -> rescale -> overwrite -> scatter core for the
     window writers. vals: [..., C, KV, hd] f32; j_idx/off_idx: window
@@ -259,11 +398,11 @@ def _window_pages_rmw(pool: QuantPool, vals, j_idx, off_idx, wmask_src,
     validity; idx: [..., W] gather/scatter page ids (OOB = dropped);
     touched: [..., W] pages that receive >= 1 position."""
     W = idx.shape[-1]
-    P = pool.q.shape[1]
+    P = _pool_page(pool)
+    qmax = _pool_qmax(pool)
     KV, hd = vals.shape[-2], vals.shape[-1]
     lead = vals.shape[:-3]
-    qs = jnp.take(pool.q, idx, axis=0, mode="fill",
-                  fill_value=0)                    # [..., W, P, KV, hd]
+    qs = _gather_q(pool, idx)                      # [..., W, P, KV, hd]
     ss = jnp.take(pool.scale, idx, axis=0, mode="fill",
                   fill_value=0.0)                  # [..., W, KV]
     # place the window's values + mask into page coordinates: every
@@ -281,22 +420,19 @@ def _window_pages_rmw(pool: QuantPool, vals, j_idx, off_idx, wmask_src,
     buf, msk = buf[..., :W, :, :, :], msk[..., :W, :]
     amax = jnp.max(jnp.where(msk[..., None, None], jnp.abs(buf), 0.0),
                    axis=(-3, -1))                  # [..., W, KV]
-    need = jnp.maximum(amax, _EPS) / _QMAX
+    need = jnp.maximum(amax, _EPS) / qmax
     new_s = jnp.where(touched[..., None], jnp.maximum(ss, need), ss)
     qr = _requant(qs, jnp.where(new_s > 0, ss / jnp.maximum(new_s, _EPS),
-                                0.0))
+                                0.0), qmax)
     qt = jnp.clip(jnp.round(buf / jnp.maximum(new_s, _EPS)[..., None, :,
                                               None]),
-                  -_QMAX, _QMAX).astype(jnp.int8)
+                  -qmax, qmax).astype(jnp.int8)
     qw = jnp.where(msk[..., None, None], qt, qr)
-    return QuantPool(
-        q=pool.q.at[idx].set(qw, mode="drop"),
-        scale=pool.scale.at[idx].set(new_s, mode="drop"),
-    )
+    return _scatter_q(pool, idx, qw, new_s)
 
 
-def qwrite_window_pages(pool: QuantPool, vals: jnp.ndarray,
-                        table_row, pos0, n_real=None) -> QuantPool:
+def qwrite_window_pages(pool, vals: jnp.ndarray,
+                        table_row, pos0, n_real=None):
     """write_window_pages over a quantized pool: one C-token window at
     absolute position pos0 (any in-page offset). The window touches at
     most ceil(C/P)+1 consecutive pages — those are gathered, rescaled,
@@ -309,7 +445,7 @@ def qwrite_window_pages(pool: QuantPool, vals: jnp.ndarray,
     writer already masks by q_len). Padding positions neither write
     nor contribute to the amax, and pages touched only by padding are
     left alone entirely."""
-    N, P = pool.q.shape[0], pool.q.shape[1]
+    N, P = pool.q.shape[0], _pool_page(pool)
     C = vals.shape[1]
     max_pages = table_row.shape[0]
     if n_real is None:
@@ -333,14 +469,14 @@ def qwrite_window_pages(pool: QuantPool, vals: jnp.ndarray,
                              wvalid, idx, touched)
 
 
-def qwrite_windows_pages(pool: QuantPool, vals: jnp.ndarray, pos,
-                         q_len, active, table) -> QuantPool:
+def qwrite_windows_pages(pool, vals: jnp.ndarray, pos,
+                         q_len, active, table):
     """write_windows_pages over a quantized pool: the batched mixed
     writer — every row's q_len-token window at its own offset, decode
     rows (q_len=1) included. Per row the window spans at most
     ceil(C/P)+1 consecutive pages; rows own disjoint (non-shared)
     pages, so the batched page round-trips never collide."""
-    N, P = pool.q.shape[0], pool.q.shape[1]
+    N, P = pool.q.shape[0], _pool_page(pool)
     B, C = vals.shape[0], vals.shape[1]
     max_pages = table.shape[1]
     W = -(-C // P) + 1

@@ -71,10 +71,11 @@ class Master:
             # draft/verify rounds run BATCHED across slots (spec_round_batched), so
             # concurrent API requests all speculate, stream, and
             # checkpoint like any other engine request
-            if getattr(self.args, "kv_dtype", None) == "int8":
+            if getattr(self.args, "kv_dtype", None) in ("int8", "int4"):
                 # loud config error, not a warning: an operator asking
-                # for int8 KV expects the capacity win, and the spec
-                # engine (gated off the paged pool) cannot deliver it
+                # for quantized KV expects the capacity win, and the
+                # spec engine (gated off the paged pool) cannot
+                # deliver it
                 from cake_tpu.args import INT8_KV_SPEC_ERROR
                 raise ValueError(INT8_KV_SPEC_ERROR)
             if getattr(self.args, "kv_pages", None):
@@ -144,12 +145,12 @@ class Master:
                             "ctx/tail cache is not paged (the ctx "
                             "region is sequence-sharded, not "
                             "slot-paged)")
-            if (getattr(self.args, "kv_dtype", None) == "int8"
+            if (getattr(self.args, "kv_dtype", None) in ("int8", "int4")
                     or getattr(self.args, "kv_host_pages", None)):
-                log.warning("--kv-dtype int8 / --kv-host-pages ignored:"
-                            " KV tiering (cake_tpu/kv) applies to the "
-                            "paged pool, and the sp engine's ctx/tail "
-                            "cache is not paged")
+                log.warning("--kv-dtype int8/int4 / --kv-host-pages "
+                            "ignored: KV tiering (cake_tpu/kv) applies "
+                            "to the paged pool, and the sp engine's "
+                            "ctx/tail cache is not paged")
             if getattr(self.args, "auto_prefix", False):
                 log.warning("--auto-prefix ignored: prefix caching is "
                             "not implemented for the sp engine's "

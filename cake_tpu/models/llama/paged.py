@@ -36,9 +36,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from cake_tpu.kv.quantized_pool import (
-    QuantPool, QuantizedPagedKVCache, dequantize_pages,
-    qupdate_pool_per_row, qwrite_prompt_pages, qwrite_window_pages,
-    qwrite_windows_pages,
+    Int4PagedKVCache, Int4Pool, QuantPool, QuantizedPagedKVCache,
+    dequantize_pages, qupdate_pool_per_row, qwrite_prompt_pages,
+    qwrite_window_pages, qwrite_windows_pages,
 )
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.parallel.context_parallel import (
@@ -220,7 +220,7 @@ def write_prompt_pages(pool_k, pool_v, k, v, table_row, n_real=None):
     real token count) matters ONLY there: bucket-padding garbage is
     dead data in an f32 pool but would inflate the fresh page scales,
     so the quantized writer zeroes positions >= n_real first."""
-    if isinstance(pool_k, QuantPool):
+    if isinstance(pool_k, (QuantPool, Int4Pool)):
         return (qwrite_prompt_pages(pool_k, k, table_row, n_real),
                 qwrite_prompt_pages(pool_v, v, table_row, n_real))
     N, P = pool_k.shape[0], pool_k.shape[1]
@@ -260,7 +260,7 @@ def write_window_pages(pool_k, pool_v, k, v, table_row, pos0,
     write (kv/quantized_pool.qwrite_window_pages); n_real (traced
     scalar) keeps the window's bucket-padding garbage out of the
     monotone page scales there (dead data for an f32 pool)."""
-    if isinstance(pool_k, QuantPool):
+    if isinstance(pool_k, (QuantPool, Int4Pool)):
         return (qwrite_window_pages(pool_k, k, table_row, pos0, n_real),
                 qwrite_window_pages(pool_v, v, table_row, pos0, n_real))
     N, P = pool_k.shape[0], pool_k.shape[1]
@@ -292,7 +292,7 @@ def write_windows_pages(pool_k, pool_v, k, v, pos, q_len, active, table):
 
     A QuantPool quantizes on scatter via per-row touched-page
     read-modify-writes (kv/quantized_pool.qwrite_windows_pages)."""
-    if isinstance(pool_k, QuantPool):
+    if isinstance(pool_k, (QuantPool, Int4Pool)):
         return (qwrite_windows_pages(pool_k, k, pos, q_len, active,
                                      table),
                 qwrite_windows_pages(pool_v, v, pos, q_len, active,
@@ -326,7 +326,7 @@ def update_pool_per_row(pool_k, pool_v, k, v, pos, active, table):
     A QuantPool quantizes on scatter: each row's page is gathered,
     its scale grown to cover the new token, residents re-quantized,
     and the page scattered back (kv/quantized_pool)."""
-    if isinstance(pool_k, QuantPool):
+    if isinstance(pool_k, (QuantPool, Int4Pool)):
         return (qupdate_pool_per_row(pool_k, k, pos, active, table),
                 qupdate_pool_per_row(pool_v, v, pos, active, table))
     N, P = pool_k.shape[0], pool_k.shape[1]
@@ -361,9 +361,12 @@ def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
     Returns [B, 1, H, hd].
     """
     B, _, H, hd = q.shape
-    quant = isinstance(pool_k, QuantPool)
+    quant = isinstance(pool_k, (QuantPool, Int4Pool))
+    packed4 = isinstance(pool_k, Int4Pool)
     pk_arr = pool_k.q if quant else pool_k
     N, P, KV = pk_arr.shape[0], pk_arr.shape[1], pk_arr.shape[2]
+    if packed4:
+        P *= 2      # the packed axis stores two tokens per byte
     max_pages = table.shape[1]
 
     if impl == "pallas":
@@ -371,11 +374,12 @@ def paged_attention(q, pool_k, pool_v, table, pos, *, impl: str = "fold"):
             ragged_paged_attention, ragged_paged_supported,
         )
         if ragged_paged_supported(P, H, KV, hd, quantized=quant,
-                                  n_pages=N):
+                                  n_pages=N, packed4=packed4):
             if quant:
                 return ragged_paged_attention(
                     q, pool_k.q, pool_v.q, table, pos,
-                    scale_k=pool_k.scale, scale_v=pool_v.scale)
+                    scale_k=pool_k.scale, scale_v=pool_v.scale,
+                    packed4=packed4)
             return ragged_paged_attention(q, pool_k, pool_v, table, pos)
     elif impl != "fold":
         raise ValueError(f"unknown paged_attn impl {impl!r}")
@@ -447,9 +451,12 @@ def paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     padding whose output the caller never reads. Returns [B, C, H, hd].
     """
     B, C, H, hd = q.shape
-    quant = isinstance(pool_k, QuantPool)
+    quant = isinstance(pool_k, (QuantPool, Int4Pool))
+    packed4 = isinstance(pool_k, Int4Pool)
     pk_arr = pool_k.q if quant else pool_k
     N, P, KV = pk_arr.shape[0], pk_arr.shape[1], pk_arr.shape[2]
+    if packed4:
+        P *= 2      # the packed axis stores two tokens per byte
     max_pages = table.shape[1]
 
     if impl == "pallas":
@@ -457,11 +464,13 @@ def paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
             ragged_paged_attention_mixed, ragged_paged_mixed_supported,
         )
         if ragged_paged_mixed_supported(P, H, KV, hd, C,
-                                        quantized=quant, n_pages=N):
+                                        quantized=quant, n_pages=N,
+                                        packed4=packed4):
             if quant:
                 return ragged_paged_attention_mixed(
                     q, pool_k.q, pool_v.q, table, pos, q_len,
-                    scale_k=pool_k.scale, scale_v=pool_v.scale)
+                    scale_k=pool_k.scale, scale_v=pool_v.scale,
+                    packed4=packed4)
             return ragged_paged_attention_mixed(q, pool_k, pool_v,
                                                 table, pos, q_len)
     elif impl != "fold":
@@ -752,7 +761,7 @@ def prefill_slot_paged_prefixed(params, tokens, suffix_len, slot,
             # row) into a dense [1, n_prefix, KV, hd] view — read-only,
             # pre-write pool (prefix and suffix pages are disjoint);
             # a quantized pool dequantizes page-by-page on the gather
-            if isinstance(pk, QuantPool):
+            if isinstance(pk, (QuantPool, Int4Pool)):
                 kp = dequantize_pages(pk, prefix_pages).reshape(
                     1, n_prefix, KV, hd).astype(q.dtype)
                 vp = dequantize_pages(pv, prefix_pages).reshape(
@@ -839,7 +848,7 @@ def prefill_slot_paged_chunk(params, tokens, n_real, slot, pos0,
             # post-write gather: the dense view holds every written
             # position (prefix head, earlier windows, this window);
             # a quantized pool dequantizes page-by-page on the gather
-            if isinstance(pk2, QuantPool):
+            if isinstance(pk2, (QuantPool, Int4Pool)):
                 k_full = dequantize_pages(
                     pk2, gather_idx, fill_zero=True).reshape(
                     1, T, KV, hd).astype(q.dtype)

@@ -23,6 +23,10 @@ filter):
     kv_spill        KV pages moved device -> host RAM
     kv_restore      KV pages streamed back host -> device
     prefix_hit      an admission reused a registered prefix's KV
+    resident_spilled  a decode-RESIDENT stream was parked in the host
+                    tier under admission pressure (pool
+                    oversubscription; its page moves also publish
+                    kv_spill)
     recovered       a crashed request was resubmitted via the fold
     poisoned        a request was quarantined as crash-implicated
     reconfigured    a live config switch folded/requeued the request
@@ -66,6 +70,9 @@ from cake_tpu.obs.jsonl import JsonlAppender
 EVENT_TYPES = (
     "preempted", "kv_spill", "kv_restore", "prefix_hit", "recovered",
     "poisoned", "reconfigured", "shed", "fault_injected", "recompile",
+    # decode-resident spill under pool oversubscription
+    # (serve/engine._spill_resident_stream)
+    "resident_spilled",
     # router tier (cake_tpu/router/server.py)
     "affinity_miss", "spill_to_secondary", "failover_resume",
     "shed_by_router",

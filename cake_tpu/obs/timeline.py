@@ -31,9 +31,10 @@ from typing import Dict, List, Optional
 # the whole life for the e2e view)
 ROUTER_CAUSE_TYPES = ("affinity_miss", "spill_to_secondary",
                       "failover_resume", "shed_by_router")
-CAUSE_TYPES = ("preempted", "kv_spill", "kv_restore", "prefix_hit",
-               "recovered", "poisoned", "reconfigured", "shed",
-               "fault_injected", "recompile") + ROUTER_CAUSE_TYPES
+CAUSE_TYPES = ("preempted", "resident_spilled", "kv_spill",
+               "kv_restore", "prefix_hit", "recovered", "poisoned",
+               "reconfigured", "shed", "fault_injected",
+               "recompile") + ROUTER_CAUSE_TYPES
 
 
 def build_timeline(trace: Dict, events: List[Dict],

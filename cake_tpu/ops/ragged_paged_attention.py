@@ -208,9 +208,92 @@ def _rpa_kernel_q8(pos_ref, table_ref, sk_ref, sv_ref, q_ref, k_ref,
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _unpack_nibbles(block, hd_slice):
+    """In-register nibble unpack of one packed int4 page block column
+    slice: block [P//2, hd] uint8 -> [P, hd] f32 in [-8, 7]. The pool's
+    pack_page_nibbles layout puts token t in the low nibble of packed
+    row t and token t + P//2 in the high nibble, so concatenating the
+    two half-planes along the sublane axis restores natural token
+    order."""
+    p32 = block[:, hd_slice].astype(jnp.int32)
+    return jnp.concatenate([(p32 & 0xF) - 8, (p32 >> 4) - 8],
+                           axis=0).astype(jnp.float32)
+
+
+def _rpa_kernel_q4(pos_ref, table_ref, sk_ref, sv_ref, q_ref, k_ref,
+                   v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                   page_size: int, kv_heads: int, group: int,
+                   head_dim: int):
+    """int4 variant of _rpa_kernel_q8: the page blocks stream as
+    nibble-PACKED uint8 — an EIGHTH of the f32 DMA bytes — and unpack
+    in registers per kv head before the dots. Scales prefetch into
+    SMEM and fold into the dot outputs exactly like the int8 kernel;
+    page_size here is REAL tokens (the packed block holds page_size//2
+    sublanes)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    page = table_ref[b, j]
+    live = jnp.logical_and(j * page_size <= pos, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0, 0]                        # [H, hd]
+        P = page_size
+        hd = head_dim
+        pid = jnp.maximum(page, 0)
+        col_valid = (j * P + jax.lax.broadcasted_iota(
+            jnp.int32, (1, P), 1)) <= pos      # [1, P]
+        parts = []
+        for kv in range(kv_heads):
+            kh = _unpack_nibbles(k_ref[0],
+                                 slice(kv * hd, (kv + 1) * hd))  # [P, hd]
+            qh = q[kv * group:(kv + 1) * group].astype(jnp.float32)
+            s_kv = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            parts.append(s_kv * sk_ref[pid, kv])
+        s = jnp.concatenate(parts, axis=0) * scale     # [H, P]
+        s = jnp.where(col_valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                 # [H, P]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        outs = []
+        for kv in range(kv_heads):
+            vh = _unpack_nibbles(v_ref[0],
+                                 slice(kv * hd, (kv + 1) * hd))  # [P, hd]
+            ph = p[kv * group:(kv + 1) * group]        # [G, P]
+            o_kv = jax.lax.dot_general(
+                ph, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            outs.append(o_kv * sv_ref[pid, kv])
+        acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(outs, axis=0)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
 def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
                            scale: float | None = None,
                            scale_k=None, scale_v=None,
+                           packed4: bool = False,
                            interpret: bool | None = None):
     """Ragged decode attention over a paged KV pool, one Pallas kernel.
 
@@ -222,8 +305,11 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
     pos:          [B] int32 — position of the CURRENT token per row
     scale_k/scale_v: optional [N_pages, KV] f32 per-page per-kv-head
                   dequantization scales — present iff the pool is the
-                  int8 KV tier (cake_tpu/kv); pages then stream as
-                  int8 and scales prefetch into SMEM.
+                  int8/int4 KV tier (cake_tpu/kv); pages then stream
+                  quantized and scales prefetch into SMEM.
+    packed4:      the pool is nibble-PACKED int4
+                  ([N_pages, page//2, KV, hd] uint8, kv/quantized_pool
+                  pack_page_nibbles layout); requires scale_k/scale_v.
     Returns [B, 1, H, hd] in q.dtype. Numerically matches
     `models/llama/paged.py:paged_attention` (the fold reference) to f32
     tolerance — tests/test_ragged_paged_attn.py pins the parity.
@@ -231,17 +317,20 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
     B, S, H, hd = q.shape
     if S != 1:
         raise ValueError(f"decode kernel takes one query per row, got S={S}")
-    N, P, KV, _ = pool_k.shape
+    N, Pb, KV, _ = pool_k.shape
+    P = Pb * 2 if packed4 else Pb       # REAL tokens per page
     G = H // KV
     max_pages = table.shape[1]
     quantized = scale_k is not None
+    if packed4 and not quantized:
+        raise ValueError("packed4 pools require scale_k/scale_v")
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    kf = pool_k.reshape(N, P, KV * hd)
-    vf = pool_v.reshape(N, P, KV * hd)
+    kf = pool_k.reshape(N, Pb, KV * hd)
+    vf = pool_v.reshape(N, Pb, KV * hd)
 
     def kv_index(b, j, pos_ref, table_ref, *_scales):
         # clamp dead pages (past the row's live count) to the LAST live
@@ -254,8 +343,9 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
         return (jnp.maximum(page, 0), 0, 0)
 
     if quantized:
+        kern_fn = _rpa_kernel_q4 if packed4 else _rpa_kernel_q8
         kernel = functools.partial(
-            _rpa_kernel_q8, scale=scale, page_size=P, kv_heads=KV,
+            kern_fn, scale=scale, page_size=P, kv_heads=KV,
             group=G, head_dim=hd)
         n_prefetch = 4
         operands = (jnp.asarray(pos, jnp.int32),
@@ -274,8 +364,8 @@ def ragged_paged_attention(q, pool_k, pool_v, table, pos, *,
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((1, P, KV * hd), kv_index),
-            pl.BlockSpec((1, P, KV * hd), kv_index),
+            pl.BlockSpec((1, Pb, KV * hd), kv_index),
+            pl.BlockSpec((1, Pb, KV * hd), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, H, hd),
                                lambda b, j, *_: (b, 0, 0, 0)),
@@ -464,9 +554,88 @@ def _rpa_mixed_kernel_q8(pos_ref, qlen_ref, table_ref, sk_ref, sv_ref,
             o_ref[0, :, kv * G:(kv + 1) * G, :] = o.astype(o_ref.dtype)
 
 
+def _rpa_mixed_kernel_q4(pos_ref, qlen_ref, table_ref, sk_ref, sv_ref,
+                         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                         l_ref, *, scale: float, page_size: int,
+                         kv_heads: int, group: int, head_dim: int,
+                         q_width: int):
+    """int4 variant of _rpa_mixed_kernel_q8: pages stream nibble-PACKED
+    (an eighth of the f32 page bytes) and unpack in registers per kv
+    head; scales prefetch into SMEM and fold into the dot outputs.
+    page_size is REAL tokens — the packed block holds page_size//2
+    sublanes."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    C = q_width
+    G = group
+    P = page_size
+    hd = head_dim
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[b]
+    last = pos + jnp.maximum(qlen_ref[b], 1) - 1
+    page = table_ref[b, j]
+    live = jnp.logical_and(j * P <= last, page >= 0)
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0]                           # [C, H, hd]
+        pid = jnp.maximum(page, 0)
+        qidx = jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 0) // G
+        col = j * P + jax.lax.broadcasted_iota(jnp.int32, (C * G, P), 1)
+        valid = col <= pos + qidx
+        for kv in range(kv_heads):
+            kh = _unpack_nibbles(k_ref[0],
+                                 slice(kv * hd, (kv + 1) * hd))  # [P, hd]
+            vh = _unpack_nibbles(v_ref[0],
+                                 slice(kv * hd, (kv + 1) * hd))  # [P, hd]
+            qh = q[:, kv * G:(kv + 1) * G, :].reshape(
+                C * G, hd).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (
+                    scale * sk_ref[pid, kv])                 # [C*G, P]
+            s = jnp.where(valid, s, NEG_INF)
+            r0 = kv * C * G
+            m_prev = m_ref[r0:r0 + C * G, :1]                # [C*G, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            # all-masked query rows keep l at 0 so _finish emits
+            # zeros — the mixed f32 kernel's guard, unchanged
+            p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+            l_new = (alpha * l_ref[r0:r0 + C * G, :1]
+                     + jnp.sum(p, axis=-1, keepdims=True))
+            out = jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * sv_ref[pid, kv]
+            acc_ref[r0:r0 + C * G] = acc_ref[r0:r0 + C * G] * alpha + out
+            m_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                m_new, (C * G, m_ref.shape[1]))
+            l_ref[r0:r0 + C * G] = jnp.broadcast_to(
+                l_new, (C * G, l_ref.shape[1]))
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        for kv in range(kv_heads):
+            r0 = kv * C * G
+            l = l_ref[r0:r0 + C * G, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o = (acc_ref[r0:r0 + C * G] / l).reshape(C, G, hd)
+            o_ref[0, :, kv * G:(kv + 1) * G, :] = o.astype(o_ref.dtype)
+
+
 def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
                                  scale: float | None = None,
                                  scale_k=None, scale_v=None,
+                                 packed4: bool = False,
                                  interpret: bool | None = None):
     """MIXED ragged attention over a paged KV pool, one Pallas kernel.
 
@@ -493,17 +662,20 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
     to f32 tolerance — tests/test_ragged_paged_attn.py pins the parity.
     """
     B, C, H, hd = q.shape
-    N, P, KV, _ = pool_k.shape
+    N, Pb, KV, _ = pool_k.shape
+    P = Pb * 2 if packed4 else Pb       # REAL tokens per page
     G = H // KV
     max_pages = table.shape[1]
     quantized = scale_k is not None
+    if packed4 and not quantized:
+        raise ValueError("packed4 pools require scale_k/scale_v")
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    kf = pool_k.reshape(N, P, KV * hd)
-    vf = pool_v.reshape(N, P, KV * hd)
+    kf = pool_k.reshape(N, Pb, KV * hd)
+    vf = pool_v.reshape(N, Pb, KV * hd)
 
     def kv_index(b, j, pos_ref, qlen_ref, table_ref, *_scales):
         # clamp dead pages (past the row's live count) to the LAST live
@@ -515,8 +687,9 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
         return (jnp.maximum(page, 0), 0, 0)
 
     if quantized:
+        kern_fn = _rpa_mixed_kernel_q4 if packed4 else _rpa_mixed_kernel_q8
         kernel = functools.partial(
-            _rpa_mixed_kernel_q8, scale=scale, page_size=P, kv_heads=KV,
+            kern_fn, scale=scale, page_size=P, kv_heads=KV,
             group=G, head_dim=hd, q_width=C)
         n_prefetch = 5
         operands = (jnp.asarray(pos, jnp.int32),
@@ -537,8 +710,8 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, C, H, hd), lambda b, j, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((1, P, KV * hd), kv_index),
-            pl.BlockSpec((1, P, KV * hd), kv_index),
+            pl.BlockSpec((1, Pb, KV * hd), kv_index),
+            pl.BlockSpec((1, Pb, KV * hd), kv_index),
         ],
         out_specs=pl.BlockSpec((1, C, H, hd),
                                lambda b, j, *_: (b, 0, 0, 0)),
@@ -561,22 +734,29 @@ def ragged_paged_attention_mixed(q, pool_k, pool_v, table, pos, q_len, *,
 
 def ragged_paged_supported(page_size: int, H: int, KV: int,
                            hd: int, quantized: bool = False,
-                           n_pages: Optional[int] = None) -> bool:
+                           n_pages: Optional[int] = None,
+                           packed4: bool = False) -> bool:
     """Static shape gate for the hardware path (flash_supported
     precedent): Mosaic wants the block's minor dim to fill 128-wide
     lanes and the second-minor (page) dim to tile by 16 — or by 32 for
-    an int8 pool (the int8 sublane tile is twice as deep). Production
-    configs (hd=128, 128-token pages) pass; tiny test configs fall back
-    to the fold on silicon and keep exercising the kernel in interpret
-    mode on CPU. An int8 pool additionally bounds its whole-pool
-    scale_k/scale_v scalar-prefetch operands against SMEM (pass
-    n_pages to enforce) — an oversized pool must degrade to the fold
-    instead of failing Mosaic allocation at the first dispatch."""
+    an int8 pool (the int8 sublane tile is twice as deep). A PACKED
+    int4 pool's uint8 block carries page_size//2 sublanes, so the real
+    page size must be a multiple of 64 for the packed axis to tile by
+    32 on silicon. Production configs (hd=128, 128-token pages) pass;
+    tiny test configs fall back to the fold on silicon and keep
+    exercising the kernel in interpret mode on CPU. A quantized pool
+    additionally bounds its whole-pool scale_k/scale_v scalar-prefetch
+    operands against SMEM (pass n_pages to enforce) — an oversized
+    pool must degrade to the fold instead of failing Mosaic allocation
+    at the first dispatch."""
     if H % KV != 0:
+        return False
+    if packed4 and page_size % 2:
         return False
     if jax.default_backend() != "tpu":
         return True      # interpret mode imposes no tiling constraints
-    page_tile = 32 if quantized else 16
+    quantized = quantized or packed4
+    page_tile = 64 if packed4 else (32 if quantized else 16)
     if not (hd % 128 == 0 and page_size % page_tile == 0):
         return False
     if quantized and n_pages is not None:
@@ -609,7 +789,8 @@ _SCALE_SMEM_BUDGET = 256 * 1024
 def ragged_paged_mixed_supported(page_size: int, H: int, KV: int,
                                  hd: int, q_width: int,
                                  quantized: bool = False,
-                                 n_pages: Optional[int] = None) -> bool:
+                                 n_pages: Optional[int] = None,
+                                 packed4: bool = False) -> bool:
     """Gate for the MIXED hardware kernel: the decode gate's tiling
     rules PLUS a VMEM bound. Unlike the C=1 decode kernel, the mixed
     kernel's scratch scales linearly with the query width C
@@ -617,7 +798,8 @@ def ragged_paged_mixed_supported(page_size: int, H: int, KV: int,
     fold reference instead of failing Mosaic allocation at the first
     mixed dispatch."""
     if not ragged_paged_supported(page_size, H, KV, hd,
-                                  quantized=quantized, n_pages=n_pages):
+                                  quantized=quantized, n_pages=n_pages,
+                                  packed4=packed4):
         return False
     if jax.default_backend() != "tpu":
         return True      # interpret mode allocates host memory

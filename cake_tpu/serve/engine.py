@@ -270,9 +270,12 @@ class EngineStats:
     preemptions: int = 0
     shed: int = 0
     # KV host tier (cake_tpu/kv): spill/restore EVENTS (the
-    # cake_kv_spill_total counters count pages)
+    # cake_kv_spill_total counters count pages); resident spills are
+    # the subset that parked an ACTIVELY-DECODING stream to admit a
+    # new one (pool oversubscription, cake_kv_resident_spills_total)
     kv_spills: int = 0
     kv_restores: int = 0
+    kv_resident_spills: int = 0
     # crash recovery (cake_tpu/faults): successful reset+resubmit
     # cycles, requests carried across them, and requests quarantined
     # as poison so the rest of their batch could recover
@@ -323,6 +326,13 @@ class InferenceEngine:
         "_last_jit": None,
         "_page_starved": None,
         "_pending_page_preempt": None,
+        # decode-resident spill state (_spill_resident_stream): the
+        # admission-order stamp for LRU victim choice, the iteration's
+        # decode-resident candidate set, and the parked flag that
+        # forces the decode dispatch to re-validate its (stale) plan
+        "_admit_seq": None,
+        "_cur_decode": None,
+        "_resident_parked": None,
         # handler<->engine mailboxes: strictly lock-guarded
         "_cancel_q": "_rid_lock",
         "_cmd_q": "_rid_lock",
@@ -551,13 +561,14 @@ class InferenceEngine:
         # (models/llama/paged.py).
         self.paged = kv_pages is not None
         self.paged_attn: Optional[str] = None
-        # --kv-dtype: storage dtype for the PAGED pool. "int8" selects
-        # the quantized page pool (cake_tpu/kv: int8 pages + per-page
-        # per-kv-head f32 scales — ~4x the resident streams per pool
-        # byte vs f32); other names resolve to a plain pool dtype.
-        # int8 without --kv-pages (the spec engine included: spec is
-        # gated off paged) is a loud config error, not a silent no-op.
-        self.kv_quant = kv_dtype == "int8"
+        # --kv-dtype: storage dtype for the PAGED pool. "int8"/"int4"
+        # select the quantized page pools (cake_tpu/kv: int8 pages or
+        # nibble-packed int4 pages + per-page per-kv-head f32 scales —
+        # ~4x / ~8x the resident streams per pool byte vs f32); other
+        # names resolve to a plain pool dtype. Quantized KV without
+        # --kv-pages (the spec engine included: spec is gated off
+        # paged) is a loud config error, not a silent no-op.
+        self.kv_quant = kv_dtype in ("int8", "int4")
         # config identity the live-reconfiguration seam (reconfigure /
         # cake_tpu/autotune) needs verbatim: the configured storage
         # name, the base cache dtype, the host-tier capacity and the
@@ -586,8 +597,8 @@ class InferenceEngine:
             else parse_slo_targets(slo_targets))
         if self.kv_quant and not self.paged:
             raise ValueError(
-                "--kv-dtype int8 requires --kv-pages: int8 KV pages "
-                "live in the paged pool"
+                f"--kv-dtype {kv_dtype} requires --kv-pages: quantized "
+                "KV pages live in the paged pool"
                 + (" (speculative serving is gated off the paged "
                    "engine, so it cannot quantize KV)" if self._spec
                    else ""))
@@ -742,6 +753,14 @@ class InferenceEngine:
         # mid-wave preemption would leave already-planned decode rows
         # writing through a released page-table row)
         self._pending_page_preempt: Optional[int] = None
+        # decode-resident spill (kv oversubscription): admission-order
+        # stamp for LRU victim choice, this iteration's decode-resident
+        # slots (plan()'s decode rows — NOT same-wave admissions, whose
+        # prefill may be mid-flight), and the parked-this-iteration
+        # flag that makes the decode dispatch re-validate its plan
+        self._admit_seq = 0
+        self._cur_decode: dict = {}
+        self._resident_parked = False
         # retained for live reconfiguration: a hot switch that changes
         # max_slots rebuilds/resizes the scheduler at the same queue
         # capacity (reconfigure)
@@ -1929,6 +1948,12 @@ class InferenceEngine:
                 # just-released page-table row
                 self._maybe_preempt()
             prefill_plan, decode_plan = self.scheduler.plan()
+            # decode-resident slots THIS iteration: the candidate set
+            # for _spill_resident_stream — plan()'s decode rows only,
+            # never same-wave admissions (their prefill may be in
+            # flight when an admission later in the wave spills)
+            self._resident_parked = False
+            self._cur_decode = {s: r for r, s in decode_plan}
             if self._slo:
                 self._set_queue_gauges()
             if not prefill_plan and not decode_plan:
@@ -1956,6 +1981,13 @@ class InferenceEngine:
                 else:
                     for rid, slot in prefill_plan:
                         self._do_prefill(rid, slot)
+                if decode_plan and not self._mixed:
+                    if self._resident_parked:
+                        # an admission above parked a decode-resident
+                        # slot: the plan predates the park, and the
+                        # device step must not write through a
+                        # released page-table row
+                        decode_plan = self._live_decode_rows(decode_plan)
                 if decode_plan and not self._mixed:
                     if self._spec:
                         self._do_decode_spec(decode_plan)
@@ -2207,6 +2239,7 @@ class InferenceEngine:
         self._slot_req = [None] * self.max_slots
         self._page_blocked_rid = None
         self._pending_page_preempt = None
+        self._cur_decode = {}
         if not self.paged:
             self._mixed_pending.clear()
         n_rec = n_poison = 0
@@ -2439,8 +2472,10 @@ class InferenceEngine:
             from cake_tpu.utils.devices import resolve_kv_dtype
             pool_dtype = resolve_kv_dtype(self._kv_dtype_name)
         if self.kv_quant:
-            from cake_tpu.kv import QuantizedPagedKVCache
-            self.cache = QuantizedPagedKVCache.create(
+            from cake_tpu.kv import Int4PagedKVCache, QuantizedPagedKVCache
+            qcls = (Int4PagedKVCache if self._kv_dtype_name == "int4"
+                    else QuantizedPagedKVCache)
+            self.cache = qcls.create(
                 self.config, self.max_slots, kv_pages, kv_page_size,
                 self.max_seq_len)
         else:
@@ -2452,7 +2487,8 @@ class InferenceEngine:
                  "%s storage (%.2f GiB pool; dense %d-slot "
                  "equivalent would be %.2f GiB)",
                  kv_pages, kv_page_size, impl,
-                 "int8+scales" if self.kv_quant else str(pool_dtype),
+                 (self._kv_dtype_name + "+scales") if self.kv_quant
+                 else str(pool_dtype),
                  self.cache.memory_bytes() / 2**30, self.max_slots,
                  self.cache.memory_bytes() / 2**30
                  * self.max_slots * self.max_seq_len
@@ -2462,6 +2498,7 @@ class InferenceEngine:
         # suffix pages and cold shared-prefix pages spill to pinned
         # host memory and stream back on demand, instead of being
         # discarded and recomputed.
+        prev_tier = getattr(self, "_host_tier", None)
         self._host_tier = None
         if kv_host_pages is not None:
             from cake_tpu.kv import HostTier
@@ -2470,10 +2507,24 @@ class InferenceEngine:
                 kv_host_pages,
                 page_bytes=page_bytes(
                     self.config, kv_page_size,
-                    jnp.int8 if self.kv_quant else pool_dtype),
+                    self._kv_dtype_name if self.kv_quant
+                    else pool_dtype),
                 # spill/restore publish on the engine's event bus
                 # (present on first setup AND on a reconfigure rebuild)
-                events=getattr(self, "events", None))
+                events=getattr(self, "events", None),
+                dtype_name=(self._kv_dtype_name if self.kv_quant
+                            else jnp.dtype(pool_dtype).name))
+            if (prev_tier is not None
+                    and prev_tier.page_bytes == tier.page_bytes):
+                # reconfigure rebuild: _prepare_fold already decided
+                # which entries the switch invalidates (and dropped or
+                # cleared them) — carry the survivors into the fresh
+                # tier so spilled streams resume from their pages
+                # instead of re-prefilling
+                for key in prev_tier.keys():
+                    ent = prev_tier.pop(key)
+                    if ent is not None:
+                        tier.put(key, ent)
             self._host_tier = tier
             log.info("kv host tier: %d pages (%.1f MiB capacity)",
                      kv_host_pages,
@@ -2524,7 +2575,7 @@ class InferenceEngine:
         kv_dtype = None
         if self.paged:
             if self.kv_quant:
-                kv_dtype = "int8"
+                kv_dtype = self._kv_dtype_name
             elif self._pool_dtype != self._base_cache_dtype:
                 # report the storage name only when it actually
                 # differs from what an UNSET --kv-dtype resolves to —
@@ -2684,6 +2735,35 @@ class InferenceEngine:
         self._wake.set()
         return True
 
+    def _storage_name(self) -> str:
+        """The LIVE pool's storage-dtype name ("int8"/"int4" for the
+        quantized tiers, the numpy dtype name otherwise) — the identity
+        a host-tier entry's raw slices are layout-bound to."""
+        if self.kv_quant:
+            return self._kv_dtype_name
+        return np.dtype(self._pool_dtype).name
+
+    def _target_storage_name(self, new) -> str:
+        """What _setup_paged_exec would resolve `new`'s storage to —
+        mirrors its pool_dtype resolution so the host-tier survival
+        check compares the names the rebuild will actually use."""
+        if new.kv_dtype in ("int8", "int4"):
+            return new.kv_dtype
+        if new.kv_dtype is not None:
+            from cake_tpu.utils.devices import resolve_kv_dtype
+            return np.dtype(resolve_kv_dtype(new.kv_dtype)).name
+        return np.dtype(self._base_cache_dtype).name
+
+    def _host_tier_survives(self, new) -> bool:
+        """Whether spilled host-tier entries stay valid across a switch
+        to `new`: the rebuilt pool must still be paged with the SAME
+        page geometry and storage dtype — entries are raw pool slices,
+        so a matching pool re-installs them verbatim (page COUNT may
+        change freely; entries reference contents, not page ids)."""
+        return (self.paged and new.kv_pages is not None
+                and new.kv_page_size == self._pager.page_size
+                and self._target_storage_name(new) == self._storage_name())
+
     def _prepare_fold(self, new) -> set:
         """Host-side half of the fold: clear every slot's mappings,
         release pages through the OLD allocator (before the rebuild
@@ -2707,11 +2787,28 @@ class InferenceEngine:
         self._page_blocked_rid = None
         self._pending_page_preempt = None
         self._page_starved = False
+        self._cur_decode = {}
         self._implicated = ()
         if self._host_tier is not None:
-            # spilled pages are OLD-pool layout/dtype; a restore into
-            # the rebuilt pool would scatter stale bytes
-            self._host_tier.clear()
+            if self._host_tier_survives(new):
+                # PR 9 gap closed: victim entries are raw per-page pool
+                # slices (dtype-blind install), valid in ANY rebuilt
+                # pool with the same page geometry + storage dtype —
+                # keep them so spilled/preempted streams resume from
+                # their pages instead of re-prefilling. Prefix entries
+                # still die with the registry below (their pids and
+                # refcounts do not survive the fold), and a surviving
+                # victim whose admission shape no longer matches is
+                # dropped by _alloc_slot_pages' entry validation.
+                for key in self._host_tier.keys():
+                    if not (isinstance(key, tuple) and key
+                            and key[0] == "victim"):
+                        self._host_tier.drop(key)
+            else:
+                # geometry or storage dtype changed: spilled pages are
+                # OLD-pool layout/dtype; a restore into the rebuilt
+                # pool would scatter stale bytes
+                self._host_tier.clear()
         if self.paged or new.kv_pages is not None:
             # the paged registry points at pool pages that die with the
             # old pool (and a dense registry's (k, v) entries mean
@@ -2816,7 +2913,7 @@ class InferenceEngine:
         self.max_slots = B
         self._decode_scan = max(1, new.decode_scan)
         self.paged = new.kv_pages is not None
-        self.kv_quant = new.kv_dtype == "int8"
+        self.kv_quant = new.kv_dtype in ("int8", "int4")
         self._kv_dtype_name = new.kv_dtype
         self._mixed = self.paged and (new.mixed_batch or "auto") != "off"
         # free the OLD cache/pool BEFORE building the new one: unlike
@@ -3061,8 +3158,12 @@ class InferenceEngine:
                 # requests / cleared registry — stale shortcuts only
                 self._host_tier.clear()
             if self.kv_quant:
-                from cake_tpu.kv import QuantizedPagedKVCache
-                return QuantizedPagedKVCache.create(
+                from cake_tpu.kv import (Int4PagedKVCache,
+                                         QuantizedPagedKVCache)
+                qcls = (Int4PagedKVCache
+                        if self._kv_dtype_name == "int4"
+                        else QuantizedPagedKVCache)
+                return qcls.create(
                     self.config, self.max_slots, self.cache.n_pages,
                     self.cache.page_size, self.max_seq_len)
             return PagedKVCache.create(
@@ -3325,6 +3426,13 @@ class InferenceEngine:
                        - self._pager.free_pages)
             if self._spill_cold_prefixes(missing, keep_pid=hit_pid):
                 pages = self._pager.alloc(need)
+        if pages is None and self._host_tier is not None:
+            # still short after the cold spills: oversubscribe — park
+            # decode-RESIDENT streams (LRU by admission) in the host
+            # tier until the admission fits or no candidate remains
+            while (pages is None
+                   and self._spill_resident_stream(req.rid)):
+                pages = self._pager.alloc(need)
         if pages is None:
             return self._requeue_for_pages(req, slot, starved=True)
         # preempted victim whose pages were spilled (spill-over-
@@ -3362,6 +3470,13 @@ class InferenceEngine:
             self._restore_victim(req, slot, pages, ent)
         if req.rid == blocked:
             self._page_blocked_rid = None
+        # LRU stamp for _spill_resident_stream's victim choice: a
+        # re-admission (restored or recompute-folded) counts as RECENT
+        # use, so the same stream is not immediately re-parked; the
+        # token watermark starts its anti-thrash residency quantum
+        req._admit_seq = self._admit_seq
+        req._resident_base = len(req.out_tokens)
+        self._admit_seq += 1
         return True
 
     def _restore_victim(self, req: _Request, slot: int,
@@ -3497,9 +3612,15 @@ class InferenceEngine:
                 req.rid, len(req.prompt_ids) + len(req.out_tokens),
                 req.max_new_tokens - len(req.out_tokens))
         else:
+            # folded shape, like the requeue above: a parked
+            # decode-resident stream (_spill_resident_stream) can be
+            # page-starved at RE-admission — resubmitting its original
+            # budget would let the scheduler grant max_new_tokens on
+            # top of what it already generated
             self.scheduler.cancel(req.rid)
-            ok = self.scheduler.submit(req.rid, len(req.prompt_ids),
-                                       req.max_new_tokens)
+            ok = self.scheduler.submit(
+                req.rid, len(req.prompt_ids) + len(req.out_tokens),
+                req.max_new_tokens - len(req.out_tokens))
         if not ok:
             req.error = RuntimeError(
                 "kv page pool exhausted and admission queue full")
@@ -3523,6 +3644,122 @@ class InferenceEngine:
                 self._pending_page_preempt = (r if cur is None
                                               else min(cur, r))
         return False
+
+    def _live_decode_rows(self, decode_plan):
+        """Re-validate a decode plan after a mid-wave resident spill:
+        plan() ran before admissions, so a slot parked by
+        _spill_resident_stream may still carry a planned decode row —
+        pointing at pages already released (and possibly re-allocated
+        to the admission that triggered the park)."""
+        self._resident_parked = False
+        live = []
+        for rid, slot in decode_plan:
+            req = self._slot_req[slot]
+            if req is not None and req.rid == rid:
+                live.append((rid, slot))
+        return live
+
+    @engine_thread_only
+    def _spill_resident_stream(self, exclude_rid: int) -> bool:
+        """Decode-resident spill — oversubscribe the KV pool like
+        virtual memory: when admission would be refused even after
+        cold-prefix spills, park the LEAST-RECENTLY-ADMITTED decoding
+        stream's owned suffix pages in the host tier and requeue it.
+        The victim resumes through the same two paths a preemption
+        victim does (_restore_victim when its pages round-trip, the
+        fold-tokens-into-prompt recompute otherwise), so its token
+        stream is identical to an uninterrupted run. Returns True when
+        a stream was parked (its pages are now free), False when no
+        candidate qualifies — callers retry the allocation per park.
+
+        Candidates come from _cur_decode (this iteration's planned
+        decode rows), NEVER same-wave admissions: a re-admitted
+        preemption victim earlier in this prefill wave has out_tokens
+        but its prefill may still be in flight on device."""
+        if (self._host_tier is None
+                or not getattr(self._sched_cfg, "spill_resident", True)):
+            return False
+        quantum = getattr(self._sched_cfg, "resident_quantum", 8)
+        best = None
+        for slot, rid in self._cur_decode.items():
+            req = (self._slot_req[slot]
+                   if 0 <= slot < self.max_slots else None)
+            if (req is None or req.rid != rid or req.rid == exclude_rid
+                    or req.done.is_set() or slot in self._mixed_pending
+                    or not req.out_tokens
+                    or req.max_new_tokens - len(req.out_tokens) <= 0):
+                continue
+            # anti-thrash: the victim must have USED its residency —
+            # quantum-sized time-slices, not one-token ping-pong
+            if (len(req.out_tokens)
+                    - getattr(req, "_resident_base", 0) < quantum):
+                continue
+            own = (self._slot_pages.get(slot)
+                   or [])[self._slot_prefix_pages.get(slot, 0):]
+            # FREE capacity only — a park must never LRU-evict an
+            # existing entry: a spilled prefix is its only copy (an
+            # eviction unregisters it), and evicting another parked
+            # stream just trades one recompute for another
+            if not own or len(own) > self._host_tier.free_pages:
+                continue
+            seq = getattr(req, "_admit_seq", 0)
+            if best is None or seq < best[0]:
+                best = (seq, rid, slot)
+        if best is None:
+            return False
+        _, rid, slot = best
+        req = self._slot_req[slot]
+        remaining = req.max_new_tokens - len(req.out_tokens)
+        if self._slo:
+            # seniority survives (the _preempt_slot discipline): the
+            # parked stream keeps aging from its original admission
+            if not self.scheduler.requeue(
+                    rid, len(req.prompt_ids) + len(req.out_tokens),
+                    remaining):
+                return False
+        else:
+            # resubmit as it will RE-prefill: generated tokens folded
+            # into the prompt, budget reduced to the remainder — the
+            # scheduler retires on ITS budget count, so the original
+            # max_new here would let the stream over-generate
+            self.scheduler.cancel(rid)
+            if not self.scheduler.submit(
+                    rid, len(req.prompt_ids) + len(req.out_tokens),
+                    remaining):
+                # admission queue full: the victim has nowhere to wait
+                # — it errors exactly like a page-starved admission
+                # with a full queue (_requeue_for_pages), and its
+                # pages still come back to the pool
+                self._slot_req[slot] = None
+                req.slot = -1
+                self._release_slot_pages(slot)
+                self._resident_parked = True
+                req.error = RuntimeError(
+                    "kv page pool exhausted and admission queue full")
+                self._requests.pop(rid, None)
+                self._journal_retire(req, "error", error=str(req.error))
+                self.tracer.finish(rid, "error", error=str(req.error))
+                req.done.set()
+                return True
+        from cake_tpu.kv.host_tier import note_resident_spill
+        self._slot_req[slot] = None
+        req.slot = -1
+        spilled = self._spill_victim_pages(req, slot)
+        self._release_slot_pages(slot)
+        self._resident_parked = True
+        self.stats.kv_resident_spills += 1
+        note_resident_spill()
+        self.tracer.span(rid, "resident_spilled",
+                         generated=len(req.out_tokens), spilled=spilled)
+        if self.events is not None:
+            self.events.publish("resident_spilled", rid=rid,
+                                generated=len(req.out_tokens),
+                                spilled=spilled)
+        log.debug("parked decode-resident rid=%d (%d tokens %s)", rid,
+                  len(req.out_tokens),
+                  "spilled to the host tier" if spilled
+                  else "fold into the prompt")
+        return True
 
     def _do_prefill(self, rid: int, slot: int, defer: bool = False):
         """Prefill one admission. defer=False: dispatch, fetch, emit —
@@ -3728,6 +3965,12 @@ class InferenceEngine:
             # pure decode: the phase path's programs are strictly
             # cheaper here (C=1 step, K-step scan bursts) and no
             # admission is waiting on a step boundary
+            if decode_plan and self._resident_parked:
+                # an admission above parked a decode-resident slot
+                # (_spill_resident_stream): drop its stale row before
+                # the device step (_mixed_dispatch re-validates per
+                # row; these phase-path programs do not)
+                decode_plan = self._live_decode_rows(decode_plan)
             if decode_plan:
                 n = self._scan_steps_for(decode_plan)
                 if n > 1:

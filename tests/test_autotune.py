@@ -96,6 +96,27 @@ def test_switch_guard_gates_int8_to_float_only():
     assert switch_guard(f32, EngineConfig(slots=32)) is None
 
 
+def test_switch_guard_gates_widening_from_int4():
+    """int4 sits below int8 in the precision lattice: every
+    rank-RAISING hot switch is refused (already-streamed tokens were
+    decoded against the narrower pool; a re-prefill at wider KV could
+    diverge from them), every narrowing or same-rank move is legal."""
+    i4 = EngineConfig(kv_pages=16, kv_dtype="int4")
+    i8 = EngineConfig(kv_pages=16, kv_dtype="int8")
+    f32 = EngineConfig(kv_pages=16)
+    r = switch_guard(i4, i8)
+    assert r is not None and "int4-pool -> int8-pool" in r
+    r = switch_guard(i4, f32)
+    assert r is not None and "int4-pool -> float-pool" in r
+    # the int8 -> float text stays pinned (PR 9 contract)
+    assert "int8-pool -> float-pool" in switch_guard(i8, f32)
+    # the narrowing chain and geometry moves stay legal
+    assert switch_guard(f32, i4) is None
+    assert switch_guard(i8, i4) is None
+    assert switch_guard(i4, EngineConfig(kv_pages=32,
+                                         kv_dtype="int4")) is None
+
+
 # -- policy table + fit -----------------------------------------------------
 
 
@@ -401,6 +422,52 @@ def test_manual_switch_does_not_arm_the_guard():
     for t in range(1, 5):
         got = c.decide(_sig(float(t), 50.0, tps=1.0))
         assert got is None or got[1] != "rollback"
+
+
+def test_pool_pressure_escalates_int8_to_int4():
+    """A saturated int8 page pool (window-mean occupancy >= the 0.95
+    trigger) overrides the fitted table and proposes the SAME point at
+    int4 — doubling page capacity in place — through the normal
+    hysteresis; healthy occupancy proposes nothing, and a one-window
+    spike does not move the mean past the trigger."""
+    I8 = EngineConfig(slots=8, kv_pages=64, kv_dtype="int8")
+    policy = PolicyTable(regimes=[
+        {"max_offered_rps": None, "config": I8}]).validate()
+    clock = [0.0]
+    c = AutotuneController(
+        policy, I8,
+        config=ControllerConfig(interval_s=1.0, window=2, hold=2,
+                                cooldown_s=0.0, rollback_window=2,
+                                rollback_frac=0.0),
+        now_fn=lambda: clock[0])
+
+    def sig(t, frac):
+        return AutotuneSignals(t=t, offered_rps=1.0, service_tps=100.0,
+                               pages_in_use_frac=frac)
+
+    # healthy pool: the table names the current config, nothing moves
+    for t in range(3):
+        assert c.decide(sig(float(t), 0.5)) is None
+    # one saturated window: the window-2 mean stays below the trigger
+    assert c.decide(sig(3.0, 1.0)) is None
+    assert c.decide(sig(4.0, 0.2)) is None
+    # sustained saturation: escalation target survives the hold streak
+    assert c.decide(sig(5.0, 0.99)) is None
+    assert c.decide(sig(6.0, 0.99)) is None        # mean crossed: streak 1
+    got = c.decide(sig(7.0, 0.99))                 # streak 2 == hold
+    assert got is not None
+    target, reason = got
+    assert reason == "auto"
+    assert target.kv_dtype == "int4"
+    assert target.slots == 8 and target.kv_pages == 64
+    # the proposed narrowing is LEGAL for the engine to apply...
+    assert switch_guard(I8, target) is None
+    # ...and terminal: at int4 the pressure override no longer applies
+    # (no narrower pool exists; the table's int8 point is a WIDENING
+    # the engine-side switch_guard refuses and pins)
+    c.on_switched(target, I8, pre_rate=100.0, reason="auto")
+    assert c.decide(sig(8.0, 0.99)) is None        # guard verdict window
+    assert c.decide(sig(9.0, 0.99)) is None
 
 
 def test_config_info_gauge_tracks_the_live_config():

@@ -144,6 +144,62 @@ def test_int8_to_float_switch_gated_loudly(tiny_config, params):
                             "paged_attn": "fold"}) is True
 
 
+def test_int4_widening_switches_gated_loudly(tiny_config, params):
+    """The int4 rung of the precision lattice at the engine seam: both
+    widening directions refuse with the lattice reason; the narrowing
+    int8 -> int4 hot switch (the pool-pressure escalation's move) and
+    int4 geometry moves land."""
+    eng = _engine(tiny_config, params, kv_pages=8, kv_page_size=32,
+                  kv_dtype="int4", paged_attn="fold")
+    with pytest.raises(ValueError, match="int4-pool -> int8-pool"):
+        eng.reconfigure({"slots": 2, "kv_pages": 8, "kv_page_size": 32,
+                         "kv_dtype": "int8", "paged_attn": "fold"})
+    with pytest.raises(ValueError, match="int4-pool -> float-pool"):
+        eng.reconfigure({"slots": 2, "kv_pages": 8, "kv_page_size": 32,
+                         "paged_attn": "fold"})
+    assert eng.reconfigure({"slots": 4, "kv_pages": 12,
+                            "kv_page_size": 32, "kv_dtype": "int4",
+                            "paged_attn": "fold"}) is True
+    eng2 = _engine(tiny_config, params, kv_pages=8, kv_page_size=32,
+                   kv_dtype="int8", paged_attn="fold")
+    assert eng2.reconfigure({"slots": 2, "kv_pages": 8,
+                             "kv_page_size": 32, "kv_dtype": "int4",
+                             "paged_attn": "fold"}) is True
+    assert eng2.cache.k.q.dtype == jnp.uint8     # really the packed pool
+
+
+def test_switch_keeps_matching_host_tier_victim_entries(tiny_config,
+                                                        params):
+    """The PR 9 gap, closed: victim entries are raw per-page pool
+    slices, valid in ANY rebuilt pool with the same page geometry +
+    storage dtype — a matching switch must KEEP them (parked and
+    preempted streams resume from their pages instead of re-prefilling)
+    while prefix entries still die with the registry; a switch that
+    changes the storage dtype clears the tier (old-pool bytes would
+    scatter stale into the new pool)."""
+    from cake_tpu.kv.host_tier import HostTier, SpilledPages
+
+    eng = _engine(tiny_config, params, kv_pages=8, kv_page_size=PAGE,
+                  kv_dtype="int8", kv_host_pages=8, paged_attn="fold")
+    arrays = HostTier.fetch_pages(eng.cache, [0, 1])
+    assert eng._host_tier.put(("victim", 7),
+                              SpilledPages(2, arrays, "victim"))
+    assert eng._host_tier.put(("prefix", 3),
+                              SpilledPages(2, arrays, "prefix"))
+    # same geometry + storage dtype, page COUNT and slots move: the
+    # victim entry survives, the prefix entry dies with the registry
+    assert eng.reconfigure({"slots": 4, "kv_pages": 12,
+                            "kv_page_size": PAGE, "kv_dtype": "int8",
+                            "paged_attn": "fold"}) is True
+    assert eng._host_tier.peek(("victim", 7)) is not None
+    assert eng._host_tier.peek(("prefix", 3)) is None
+    # storage narrows int8 -> int4: every entry is old-pool bytes now
+    assert eng.reconfigure({"slots": 4, "kv_pages": 12,
+                            "kv_page_size": PAGE, "kv_dtype": "int4",
+                            "paged_attn": "fold"}) is True
+    assert eng._host_tier.used_pages == 0
+
+
 def test_switch_refused_when_a_stream_cannot_fit(tiny_config, params):
     with _engine(tiny_config, params, kv_pages=16, kv_page_size=PAGE,
                  paged_attn="fold") as eng:

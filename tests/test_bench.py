@@ -229,26 +229,30 @@ def test_spec_smoke_tier_reports_acceptance():
     assert result["spec_gamma"] == 4
 
 
-@pytest.mark.slow  # two engine phases under load -> slow lane
+@pytest.mark.slow  # three engine phases under load -> slow lane
 def test_kv_tier_smoke_reports_capacity_win():
     """The --kv-tier acceptance contract: at the SAME pool byte
-    budget, int8 KV admits >= 1.8x the resident decode streams of f32
-    (and strictly more), and each phase's host tier actually engaged —
-    the cold shared prefix SPILLED under admission pressure and
-    RESTORED for the prefix-matching tail request. A run where the
-    int8 pool silently fell back to f32 sizing (equal pages) or the
-    tier never moved a page benches nothing and fails here."""
+    budget, each KV narrowing step admits >= 1.8x the resident decode
+    streams of the tier above it (int8 vs f32, int4 vs int8), and each
+    phase's host tier actually engaged — the cold shared prefix
+    SPILLED under admission pressure and RESTORED for the
+    prefix-matching tail request. A run where a quantized pool
+    silently fell back to wider sizing (equal pages) or the tier never
+    moved a page benches nothing and fails here."""
     result = _run_tier("kvtier_tiny")
     assert result["unit"] == "x" and result["value"] >= 1.8
     assert result["kv_streams_int8"] > result["kv_streams_f32"]
     assert result["kv_streams_int8"] >= 1.8 * result["kv_streams_f32"]
+    # int4 repeats the win over int8, and transitively dominates f32
+    assert result["kv_streams_int4"] >= 1.8 * result["kv_streams_int8"]
+    assert result["kv_streams_int4"] > result["kv_streams_f32"]
+    assert result["kv_streams_ratio_int4"] > result["value"]
     # the byte budget really bought more pages, not more bytes
     assert result["kv_pages_int8"] > result["kv_pages_f32"]
-    assert (result["kv_pool_bytes_int8"]
-            <= result["kv_pool_budget_bytes"])
-    assert (result["kv_pool_bytes_f32"]
-            <= result["kv_pool_budget_bytes"])
-    for tag in ("int8", "f32"):
+    assert result["kv_pages_int4"] > result["kv_pages_int8"]
+    for tag in ("int4", "int8", "f32"):
+        assert (result[f"kv_pool_bytes_{tag}"]
+                <= result["kv_pool_budget_bytes"])
         assert result[f"kv_tok_s_{tag}"] > 0
         assert result[f"kv_spills_{tag}"] > 0
         assert result[f"kv_restores_{tag}"] > 0

@@ -99,14 +99,15 @@ def test_jsonl_sink(tmp_path):
 
 
 def test_vocabulary_is_the_documented_set():
-    # the engine's ten + the router tier's four (carried with trace=
+    # the engine's eleven (resident_spilled joined in ISSUE 17's pool
+    # oversubscription) + the router tier's four (carried with trace=
     # instead of rid=) + the sentinel's anomaly transitions (ISSUE 15)
     # + the action plane's audit record for what an anomaly CHANGED
     # (ISSUE 16)
     assert set(EVENT_TYPES) == {
         "preempted", "kv_spill", "kv_restore", "prefix_hit",
         "recovered", "poisoned", "reconfigured", "shed",
-        "fault_injected", "recompile",
+        "fault_injected", "recompile", "resident_spilled",
         "affinity_miss", "spill_to_secondary", "failover_resume",
         "shed_by_router", "anomaly", "anomaly_action"}
 

@@ -465,3 +465,150 @@ def test_supported_gate_int8_page_tiling():
     else:
         assert ragged_paged_supported(P, H=4, KV=2, hd=16,
                                       quantized=True)
+
+
+# -- int4 KV parity (cake_tpu/kv nibble-packed pool) --------------------------
+#
+# Same contract as int8 one tier down: the fold over an Int4Pool
+# (unpack + dequantize per page inside the loop) is the bit-exact
+# reference; the int4 kernels stream nibble-PACKED uint8 pages,
+# unpack in-register, and apply the per-(page, kv-head) scales to the
+# dot outputs.
+
+
+def _q4pools(rng, KV, hd):
+    """Two nibble-packed pools (k, v) built through the production
+    writer (qwrite_prompt_pages dispatches on the pool type), so every
+    page carries its own per-head scale from its own amax."""
+    from cake_tpu.kv.quantized_pool import Int4Pool, qwrite_prompt_pages
+
+    def one(seed_vals):
+        pool = Int4Pool(
+            q=jnp.zeros((N_PAGES, P // 2, KV, hd), jnp.uint8),
+            scale=jnp.zeros((N_PAGES, KV), jnp.float32))
+        return qwrite_prompt_pages(
+            pool, seed_vals, jnp.arange(N_PAGES, dtype=jnp.int32))
+
+    pk = one(jnp.asarray(rng.normal(size=(1, N_PAGES * P, KV, hd)),
+                         jnp.float32))
+    pv = one(jnp.asarray(rng.normal(size=(1, N_PAGES * P, KV, hd)),
+                         jnp.float32))
+    return pk, pv
+
+
+def _assert_parity_q4(q, pk, pv, table, pos, atol=2e-5):
+    want = paged_attention(q, pk, pv, table, pos)
+    got = ragged_paged_attention(q, pk.q, pv.q, table, pos,
+                                 scale_k=pk.scale, scale_v=pv.scale,
+                                 packed4=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=atol, rtol=atol)
+
+
+def _assert_mixed_parity_q4(q, pk, pv, table, pos, qlen, atol=2e-5):
+    want = np.asarray(paged_attention_mixed(q, pk, pv, table, pos,
+                                            qlen))
+    got = np.asarray(ragged_paged_attention_mixed(
+        q, pk.q, pv.q, table, pos, qlen, scale_k=pk.scale,
+        scale_v=pv.scale, packed4=True, interpret=True))
+    for b in range(q.shape[0]):
+        n = int(qlen[b])
+        np.testing.assert_allclose(got[b, :n], want[b, :n],
+                                   atol=atol, rtol=atol)
+
+
+def test_kernel_parity_int4_page_boundaries():
+    """int4 decode kernel at page-edge positions: the early exit flips
+    at ceil((pos+1)/P) in REAL tokens (the packed axis holds P//2
+    rows), with scales following the page stream."""
+    rng = np.random.default_rng(30)
+    pk, pv = _q4pools(rng, KV=2, hd=16)
+    q = jnp.asarray(rng.normal(size=(4, 1, 4, 16)), jnp.float32)
+    table = jnp.asarray([[3, 6, 0, 10, 5]] * 4, jnp.int32)
+    pos = jnp.asarray([P - 1, P, 2 * P - 1, 2 * P], jnp.int32)
+    _assert_parity_q4(q, pk, pv, table, pos)
+
+
+@pytest.mark.parametrize("H,KV", [(8, 2), (6, 3), (4, 4)])
+def test_kernel_parity_int4_gqa(H, KV):
+    """int4 decode kernel at GQA group sizes 4, 2 and 1: each query
+    group must read its own kv head's scale through the unpack."""
+    rng = np.random.default_rng(31)
+    pk, pv = _q4pools(rng, KV=KV, hd=16)
+    q = jnp.asarray(rng.normal(size=(2, 1, H, 16)), jnp.float32)
+    table = jnp.asarray([[9, 1, 6, -1, -1], [0, 5, -1, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 3, P + 6], jnp.int32)
+    _assert_parity_q4(q, pk, pv, table, pos)
+
+
+def test_kernel_parity_int4_unmapped_holes():
+    """int4 decode kernel with -1 holes inside the live range and a
+    fully-dead row: holes masked (their clamped page-0 nibbles and
+    scale must not leak), dead row zeros."""
+    rng = np.random.default_rng(32)
+    pk, pv = _q4pools(rng, KV=2, hd=16)
+    q = jnp.asarray(rng.normal(size=(3, 1, 4, 16)), jnp.float32)
+    table = jnp.asarray([[4, -1, 11, 3, -1],
+                         [-1, 2, 7, -1, -1],
+                         [-1, -1, -1, -1, -1]], jnp.int32)
+    pos = jnp.asarray([3 * P + 2, 2 * P + 1, P + 4], jnp.int32)
+    _assert_parity_q4(q, pk, pv, table, pos)
+    dead = ragged_paged_attention(q, pk.q, pv.q, table, pos,
+                                  scale_k=pk.scale, scale_v=pv.scale,
+                                  packed4=True, interpret=True)[2]
+    np.testing.assert_array_equal(np.asarray(dead),
+                                  np.zeros_like(np.asarray(dead)))
+
+
+def test_mixed_kernel_parity_int4_offsets_and_holes():
+    """int4 MIXED kernel: a decode row, a chunk row straddling a page
+    boundary at an arbitrary offset (the straddle crosses the packed
+    low/high nibble halves), a chunk row behind an unmapped hole, and
+    an idle row (q_len=0) in one launch."""
+    rng = np.random.default_rng(33)
+    pk, pv = _q4pools(rng, KV=2, hd=16)
+    C = 6
+    q = jnp.asarray(rng.normal(size=(4, C, 4, 16)), jnp.float32)
+    table = jnp.asarray([[7, 2, 9, -1, -1],
+                         [4, 11, 3, -1, -1],
+                         [-1, 8, 5, -1, -1],
+                         [-1, -1, -1, -1, -1]], jnp.int32)
+    pos = jnp.asarray([2 * P + 5, P + 3, P + 2, 0], jnp.int32)
+    qlen = jnp.asarray([1, 6, 4, 0], jnp.int32)
+    _assert_mixed_parity_q4(q, pk, pv, table, pos, qlen)
+
+
+@pytest.mark.parametrize("H,KV", [(8, 2), (6, 3), (4, 4)])
+def test_mixed_kernel_parity_int4_gqa(H, KV):
+    """int4 mixed kernel at GQA group sizes 4, 2 and 1."""
+    rng = np.random.default_rng(34)
+    pk, pv = _q4pools(rng, KV=KV, hd=16)
+    C = 5
+    q = jnp.asarray(rng.normal(size=(2, C, H, 16)), jnp.float32)
+    table = jnp.asarray([[9, 1, 6, -1, -1], [0, 5, 2, -1, -1]],
+                        jnp.int32)
+    pos = jnp.asarray([2 * P + 3, P + 6], jnp.int32)
+    qlen = jnp.asarray([1, 5], jnp.int32)
+    _assert_mixed_parity_q4(q, pk, pv, table, pos, qlen)
+
+
+def test_supported_gate_int4_page_tiling(monkeypatch):
+    """On silicon a packed int4 pool needs page_size % 64 (the packed
+    uint8 axis carries page//2 sublanes, tiled by 32); odd page sizes
+    can't nibble-pack anywhere, and the scale-SMEM bound rides through
+    from the int8 gate."""
+    # odd pages can't pack two tokens per byte on ANY backend
+    assert not ragged_paged_supported(7, H=4, KV=2, hd=16, packed4=True)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert ragged_paged_supported(128, H=4, KV=2, hd=128, packed4=True)
+    # 32-token pages satisfy the int8 tile but pack to only 16 sublanes
+    assert not ragged_paged_supported(32, H=4, KV=2, hd=128,
+                                      packed4=True)
+    assert ragged_paged_supported(32, H=4, KV=2, hd=128, quantized=True)
+    # whole-pool scale arrays still bound against SMEM
+    assert not ragged_paged_supported(128, H=32, KV=8, hd=128,
+                                      packed4=True, n_pages=100_000)
+    assert not ragged_paged_mixed_supported(128, H=32, KV=8, hd=128,
+                                            q_width=1, packed4=True,
+                                            n_pages=100_000)
