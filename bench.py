@@ -2233,8 +2233,19 @@ def run_router_tier(name: str, model: str, quant, max_seq: int,
         f"{closed['router_anomaly_deweights']}, re-weights "
         f"{closed['router_anomaly_reweights']} (recovered in "
         f"{closed['router_anomaly_recovery_ticks']} tick(s))")
+    disc = _router_discovery_smoke(cfg, params, tok, max_seq, slots,
+                                   kv_pages, kv_page_size, gen_tokens)
+    log(f"discovery smoke: hot-join -> first serve "
+        f"{disc['router_disc_join_to_first_serve_ms']}ms, joiner "
+        f"served {disc['router_disc_joiner_completed']} (placement "
+        f"shift {disc['router_disc_placement_shift']}), hot-switch "
+        f"admissions {disc['router_disc_switch_admissions_routed_around']}"
+        f" (restored {disc['router_disc_switch_restored']}), "
+        f"post-departure admissions "
+        f"{disc['router_disc_post_departure_admissions']}")
     return {
         **closed,
+        **disc,
         "metric": f"{name}_goodput_tok_s",
         "value": aff["goodput_tok_s"],
         "unit": "tokens/s",
@@ -2327,6 +2338,176 @@ def _router_closed_loop_smoke() -> dict:
         }
     finally:
         r.close()
+
+
+def _router_discovery_smoke(cfg, params, tok, max_seq: int, slots: int,
+                            kv_pages: int, kv_page_size: int,
+                            gen_tokens: int) -> dict:
+    """The ISSUE 18 discovery/placement smoke over the REAL announce
+    wire: the router starts with an EMPTY static fleet; replica A
+    self-registers and takes the whole offered load; replica B
+    hot-joins mid-load (the tier reports the latency from B's
+    announcer starting to B's first routed completion); a config
+    hot-switch on B — ``switch_in_flight`` shipped over the announce
+    channel by the replica itself — routes NEW admissions around B
+    and restores it the moment the flag clears; B's explicit departure
+    notice then drains-then-forgets with ZERO post-notice admissions."""
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from cake_tpu.api.server import ApiServer, make_handler
+    from cake_tpu.args import Args
+    from cake_tpu.master import Master
+    from cake_tpu.models.chat import Message
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.router import start_router
+    from cake_tpu.router.discovery import ReplicaAnnouncer
+    from cake_tpu.serve.engine import InferenceEngine
+
+    def replica(tag: str):
+        eng = InferenceEngine(
+            cfg, params, tok, max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0,
+                                    repeat_penalty=1.0),
+            kv_pages=kv_pages, kv_page_size=kv_page_size,
+            paged_attn="fold", auto_prefix_system=True)
+        master = Master(Args(sample_len=gen_tokens),
+                        text_generator=None)
+        master.llm = object()
+        api = ApiServer(master, engine=eng, replica_id=tag)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    make_handler(api))
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        api.replica_id = f"127.0.0.1:{httpd.server_address[1]}"
+        return eng, api, httpd, api.replica_id
+
+    def msgs(tenant: str, i: int) -> list:
+        return [{"role": "system",
+                 "content": f"You are tenant {tenant}'s assistant. "
+                            + "policy " * 8},
+                {"role": "user", "content": f"q{i} wwww"}]
+
+    engA, apiA, httpdA, addrA = replica("disc-a")
+    engB, apiB, httpdB, addrB = replica("disc-b")
+    # pay the jit compiles on BOTH engines before any clock starts, so
+    # the join latency measures discovery + placement, not XLA
+    for eng in (engA, engB):
+        h = eng.chat([Message.from_json(m) for m in msgs("warm", 0)],
+                     max_new_tokens=gen_tokens)
+        assert h.wait(timeout=900), "discovery smoke warmup timed out"
+    warm_b = engB.stats.requests_completed
+
+    rhttpd, router = start_router(
+        [], address="127.0.0.1:0", block=False, tokenizer=tok,
+        poll_interval_s=0.05, stale_after_s=1.0,
+        announce="127.0.0.1:0", announce_interval_s=0.1,
+        forget_grace_s=0.5, policy_mode="affinity")
+    raddr = f"127.0.0.1:{rhttpd.server_address[1]}"
+    aport = router.discovery.port
+
+    def ask(tenant: str, i: int) -> None:
+        req = urllib.request.Request(
+            f"http://{raddr}/api/v1/chat/completions",
+            data=json.dumps({"messages": msgs(tenant, i),
+                             "max_tokens": gen_tokens}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=900) as resp:
+            json.loads(resp.read())
+
+    def until(pred, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while not pred() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pred(), "discovery smoke condition timed out"
+
+    switch = {"flag": False}
+
+    def b_health() -> dict:
+        doc = apiB.health(lite=True)
+        if switch["flag"]:
+            doc["switch_in_flight"] = True
+        return doc
+
+    annA = annB = None
+    try:
+        annA = ReplicaAnnouncer(
+            f"127.0.0.1:{aport}", addrA, interval_s=0.1,
+            health=lambda: apiA.health(lite=True), engine=engA)
+        until(lambda: (st := router.tracker.get(addrA)) is not None
+              and st.admitting)
+        for i in range(4):           # pre-join: A owns the fleet
+            ask("solo", i)
+        assert engA.stats.requests_completed >= 4
+
+        # -- hot-join B mid-fleet; time announce -> first serve --
+        t_join = time.perf_counter()
+        annB = ReplicaAnnouncer(
+            f"127.0.0.1:{aport}", addrB, interval_s=0.1,
+            health=b_health, engine=engB)
+        until(lambda: (st := router.tracker.get(addrB)) is not None
+              and st.admitting)
+        join_ms, sent = None, 0
+        joiners = [f"j{i}" for i in range(24)]
+        for tenant in joiners:       # fresh tenants hash across BOTH
+            ask(tenant, 0)
+            sent += 1
+            if engB.stats.requests_completed > warm_b:
+                join_ms = (time.perf_counter() - t_join) * 1e3
+                if sent >= 8:        # enough samples for the shift
+                    break
+        b_served = engB.stats.requests_completed - warm_b
+        placement_shift = b_served / sent if sent else 0.0
+
+        # -- hot-switch: B flags switch_in_flight over the wire --
+        switch["flag"] = True
+        until(lambda: router.tracker.get(addrB).switch_in_flight)
+        b0 = engB.stats.requests_completed
+        for i in range(4):           # routed AROUND the switching box
+            ask(f"s{i}", 0)
+        routed_around = engB.stats.requests_completed - b0
+        switch["flag"] = False       # epoch landed: restore
+        until(lambda: not router.tracker.get(addrB).switch_in_flight)
+        b1 = engB.stats.requests_completed
+        for tenant in joiners[:sent]:
+            ask(tenant, 1)           # B's tenants come HOME
+            if engB.stats.requests_completed > b1:
+                break
+        restored = engB.stats.requests_completed > b1
+
+        # -- explicit departure: drain-then-forget, 0 admissions --
+        b2 = engB.stats.requests_completed
+        assert annB.depart(timeout_s=5.0) is True
+        until(lambda: (st := router.tracker.get(addrB)) is None
+              or st.departing)
+        for i in range(4):
+            ask(f"d{i}", 0)
+        post_departure = engB.stats.requests_completed - b2
+        until(lambda: router.tracker.get(addrB) is None)
+        return {
+            "router_disc_join_to_first_serve_ms":
+                round(join_ms, 1) if join_ms is not None else None,
+            "router_disc_joiner_completed": int(b_served),
+            "router_disc_placement_shift": round(placement_shift, 4),
+            "router_disc_switch_admissions_routed_around":
+                int(routed_around),
+            "router_disc_switch_restored": bool(restored),
+            "router_disc_post_departure_admissions":
+                int(post_departure),
+            "router_disc_forgotten_after_depart":
+                router.tracker.get(addrB) is None,
+        }
+    finally:
+        for a in (annA, annB):
+            if a is not None:
+                a.close(depart=True)
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            h.shutdown()
+        for e in (engA, engB):
+            e.stop(timeout=30)
 
 
 def _router_sentinel_smoke(cfg, params, tok, max_seq: int,

@@ -1380,14 +1380,23 @@ def make_handler(api: ApiServer):
 def start(master, address: str = "127.0.0.1:10128",
           model_name: str = "cake-tpu", block: bool = True, engine=None,
           checkpoint_path: str | None = None, health=None,
-          collector=None):
+          collector=None, announce: str | None = None,
+          announce_interval_s: float = 2.0,
+          announce_token: str | None = None):
     """Bind and serve (reference api/mod.rs:23-48). When the master holds a
     text model, a continuous-batching engine is built automatically so
     concurrent chat requests share the decode loop.
 
     checkpoint_path: restore any in-flight requests recorded by a previous
     shutdown, and snapshot unfinished requests on SIGTERM/serve_forever
-    exit (serve/checkpoint.py)."""
+    exit (serve/checkpoint.py).
+
+    announce: a front-door router's announce listener ("host:port",
+    --router-announce on the replica role) — this replica self-registers
+    there and ships lite-health-superset telemetry frames every
+    announce_interval_s (router/discovery.ReplicaAnnouncer); shutdown
+    ships an explicit departure notice FIRST so the router
+    drains-then-forgets instead of inferring death from silence."""
     host, port = address.rsplit(":", 1)
     if engine is None and master.llm is not None:
         engine = master.make_engine()
@@ -1413,6 +1422,21 @@ def start(master, address: str = "127.0.0.1:10128",
                     collector=collector, replica_id=address)
     httpd = ThreadingHTTPServer((host, int(port)), make_handler(api))
     log.info("REST API listening on %s", address)
+
+    announcer = None
+    if announce is not None:
+        from cake_tpu.router.discovery import ReplicaAnnouncer
+        # the announced identity doubles as the router's proxy target,
+        # so it must be dialable FROM the router: the bound port (a
+        # port-0 bind resolves here), and a concrete host when we
+        # bound a wildcard
+        ahost = host if host not in ("", "0.0.0.0", "::") else "127.0.0.1"
+        announcer = ReplicaAnnouncer(
+            announce, f"{ahost}:{httpd.server_address[1]}",
+            token=announce_token, interval_s=announce_interval_s,
+            health=lambda: api.health(lite=True), engine=engine)
+        log.info("announcing to router at %s as %s", announce,
+                 announcer.replica)
 
     journal_armed = (engine is not None
                      and getattr(engine, "_journal", None) is not None)
@@ -1488,13 +1512,17 @@ def start(master, address: str = "127.0.0.1:10128",
             if done.is_set():
                 return
             done.set()
-            # order matters: close admissions FIRST (new submits 429
-            # with the drain ETA instead of racing the stop), then
-            # stop the engine (post-stop submits raise the typed reset
-            # error), then snapshot, then tear down HTTP. shutdown()
-            # must run on a helper thread — called from the
-            # serve_forever thread (the block=True signal path) it
-            # deadlocks.
+            # order matters: the router hears the departure notice
+            # FIRST (it stops routing NEW work here while our
+            # in-flight streams finish — drain-then-forget), then
+            # close admissions (new submits 429 with the drain ETA
+            # instead of racing the stop), then stop the engine
+            # (post-stop submits raise the typed reset error), then
+            # snapshot, then tear down HTTP. shutdown() must run on a
+            # helper thread — called from the serve_forever thread
+            # (the block=True signal path) it deadlocks.
+            if announcer is not None:
+                announcer.depart()
             try:
                 engine.begin_drain()
             except Exception:  # noqa: BLE001
@@ -1522,6 +1550,11 @@ def start(master, address: str = "127.0.0.1:10128",
                 from cake_tpu.serve.errors import EngineResetError
                 engine._fail_all(EngineResetError(
                     "server stopped while this request was in flight"))
+            if announcer is not None:
+                # terminal frame: the departure notice again, now with
+                # the drained (zero-load) health doc — the router's
+                # forget condition
+                announcer.close()
             threading.Thread(target=httpd.shutdown, daemon=True).start()
 
         api._shutdown = save_and_exit
@@ -1555,6 +1588,10 @@ def start(master, address: str = "127.0.0.1:10128",
             # not just SIGTERM
             if save_and_exit is not None:
                 save_and_exit()
+            elif announcer is not None:
+                # engine-less serving: no save_and_exit path to ship
+                # the departure notice — do it here
+                announcer.close()
             if health is not None:
                 health.close()
 

@@ -326,8 +326,25 @@ class Args:
     # system-prompt text fingerprints.
     router: bool = False
     # --replicas host:port,host:port,...: the engine replicas the
-    # router fronts (each an independent `--api` serving process)
+    # router fronts (each an independent `--api` serving process).
+    # With --router-announce this becomes an OPTIONAL static seed —
+    # announced replicas join the same fleet.
     replicas: Optional[str] = None
+    # --router-announce host:port — dual-role flag for fleet discovery
+    # (cake_tpu/router/discovery.py):
+    #   * on the --router role: BIND the token-gated announce listener
+    #     there (port 0 = ephemeral); replicas self-register, pushed
+    #     frames supersede polling while fresh, departures
+    #     drain-then-forget, pushed headroom/attainment feed placement
+    #   * on a replica (--api) role: ANNOUNCE to the router's listener
+    #     at that address (lite-health-superset frames + an explicit
+    #     departure notice at shutdown)
+    # The shared token comes from $CAKE_ANNOUNCE_TOKEN on both sides.
+    router_announce: Optional[str] = None
+    # --announce-interval S: replica announce-frame cadence; also the
+    # router side's warm-up Retry-After bound and (x3) its
+    # fallback-to-poll staleness window
+    announce_interval: float = 2.0
     # --router-watermark N: bounded-load spill threshold — the
     # affinity target takes the request only under this queue+active
     # load; over it, the request spills to the next ring node
@@ -471,14 +488,36 @@ class Args:
             raise ValueError(
                 "--router-anomaly-weighting requires --sentinel (the "
                 "router-side detectors drive the de-weighting)")
+        if not self.announce_interval > 0:
+            raise ValueError(
+                f"--announce-interval {self.announce_interval} must "
+                "be > 0 seconds")
+        if self.router_announce is not None:
+            # same shape discipline as a --replicas entry: the value
+            # must be a bindable/dialable host:port
+            host, sep, port = self.router_announce.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    f"--router-announce {self.router_announce!r} must "
+                    "be host:port (port 0 binds an ephemeral announce "
+                    "listener on the router role)")
+            if not 0 <= int(port) <= 65535:
+                raise ValueError(
+                    f"--router-announce port {port} out of range "
+                    "(0-65535)")
         if self.router:
             # parse NOW so a malformed replica list is a loud startup
-            # error (the --fault-plan discipline)
-            if not self.replicas:
+            # error (the --fault-plan discipline). With discovery
+            # armed the static seed may be empty; without it an empty
+            # fleet could never serve — keep the loud error.
+            if not self.replicas and self.router_announce is None:
                 raise ValueError(
                     "--router requires --replicas host:port,... (the "
-                    "engine replicas the front door routes over)")
-            parse_replicas(self.replicas)
+                    "engine replicas the front door routes over) or "
+                    "--router-announce host:port (fleet discovery: "
+                    "replicas self-register)")
+            if self.replicas:
+                parse_replicas(self.replicas)
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

@@ -10,6 +10,7 @@ and explained for compatibility.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 
@@ -198,7 +199,10 @@ def _serve_multihost(master, args) -> int:
         try:
             start(master, address=args.api, engine=engine,
                   checkpoint_path=args.checkpoint, health=health,
-                  collector=collector)
+                  collector=collector,
+                  announce=getattr(args, "router_announce", None),
+                  announce_interval_s=args.announce_interval,
+                  announce_token=os.environ.get("CAKE_ANNOUNCE_TOKEN"))
         finally:
             teardown()
     else:
@@ -360,11 +364,15 @@ def _serve_router(args) -> int:
     page-aligned token fingerprints (the register_prefix rounding
     rule); otherwise they degrade to system-prompt text fingerprints
     (RouterServer logs the one-shot warning)."""
+    import os
+
     from cake_tpu.args import parse_replicas
     from cake_tpu.router import start_router
 
     log = logging.getLogger(__name__)
-    replicas = parse_replicas(args.replicas)
+    # with fleet discovery (--router-announce) the static seed is
+    # optional — the fleet forms from replica announce frames
+    replicas = parse_replicas(args.replicas) if args.replicas else []
     tokenizer = None
     if args.model:
         try:
@@ -393,7 +401,14 @@ def _serve_router(args) -> int:
                  # closed-loop anomaly weighting (ISSUE 16,
                  # obs/actions.py): de-weight/re-weight placement from
                  # router-tier anomalies — opt-in, report-only default
-                 anomaly_weighting=args.router_anomaly_weighting)
+                 anomaly_weighting=args.router_anomaly_weighting,
+                 # fleet discovery (ISSUE 18, router/discovery.py):
+                 # bind the token-gated announce listener; replicas
+                 # self-register, pushed frames supersede polling,
+                 # departures drain-then-forget
+                 announce=args.router_announce,
+                 announce_interval_s=args.announce_interval,
+                 announce_token=os.environ.get("CAKE_ANNOUNCE_TOKEN"))
     return 0
 
 
@@ -437,6 +452,15 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--kv-host-pages has no effect without --kv-pages: the "
             "host tier spills paged KV pool pages (cake_tpu/kv)")
+
+    if getattr(args, "router_announce", None) and not args.api:
+        # same discipline: on a non-router process the flag points the
+        # replica's announcer at a router, and only an --api serving
+        # process has anything to announce
+        logging.getLogger(__name__).warning(
+            "--router-announce has no effect without --api (or "
+            "--router): a replica announces its serving address to "
+            "the front door (cake_tpu/router/discovery.py)")
 
     if getattr(args, "router_anomaly_weighting", False):
         # same discipline: the weighting actuator lives in the router
@@ -487,7 +511,10 @@ def main(argv=None) -> int:
                 "serving: there are no follower processes to "
                 "federate (obs/federation.py); /api/v1/fleet will "
                 "report only this host")
-        start(master, address=args.api, checkpoint_path=args.checkpoint)
+        start(master, address=args.api, checkpoint_path=args.checkpoint,
+              announce=getattr(args, "router_announce", None),
+              announce_interval_s=args.announce_interval,
+              announce_token=os.environ.get("CAKE_ANNOUNCE_TOKEN"))
         return 0
 
     if args.step_log:

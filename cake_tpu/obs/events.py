@@ -81,6 +81,13 @@ EVENT_TYPES = (
     # closed-loop action plane (obs/actions.py): one typed audit event
     # per action taken (or declined) in response to an anomaly
     "anomaly_action",
+    # fleet discovery (cake_tpu/router/discovery.py): replica
+    # membership churn at the front door — a replica's first announce
+    # frame registered it (replica_joined), its departure notice began
+    # the drain-then-forget sequence (replica_departed), or its
+    # announce stream went quiet and placement fell back to the poll
+    # path (replica_stale)
+    "replica_joined", "replica_departed", "replica_stale",
 )
 
 EVENTS_TOTAL = _m.counter(

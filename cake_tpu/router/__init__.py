@@ -22,6 +22,12 @@ One thin process in front of N independent `--api` engine servers:
     failover resume, retire) keyed by the minted/propagated
     `x-cake-trace` id; the front-door half of the federated
     `GET /api/v1/requests/{rid}/timeline`.
+  * `discovery.py` — fleet discovery (`--router-announce`): replicas
+    self-register over a token-gated announce channel (the PR 11
+    telemetry framing), pushed frames supersede polling while fresh,
+    departures drain-then-forget, and pushed headroom/attainment
+    compose into placement weight factors with provenance
+    (`GET /api/v1/fleet`).
   * `server.py` — the HTTP front door (`cake-tpu --router
     --replicas host:port,...`) with the router-tier event ring,
     federated timeline endpoint and `--sentinel` anomaly detectors
@@ -30,6 +36,9 @@ One thin process in front of N independent `--api` engine servers:
 
 from cake_tpu.router.affinity import (          # noqa: F401
     HashRing, prefix_fingerprint, text_fingerprint,
+)
+from cake_tpu.router.discovery import (         # noqa: F401
+    AnnounceListener, FleetDiscovery, ReplicaAnnouncer,
 )
 from cake_tpu.router.policy import NoReplicaError, RoutingPolicy  # noqa: F401
 from cake_tpu.router.replicas import ReplicaState, ReplicaTracker  # noqa: F401

@@ -56,8 +56,13 @@ def _point(data: str) -> int:
 
 class HashRing:
     """Consistent-hash ring over replica names (vnodes on a 2^64
-    circle). Not thread-safe by itself — the policy serialises
-    membership changes; lookups on a frozen ring are pure."""
+    circle). Membership changes are copy-on-write: add/remove publish a
+    fresh points list wholesale, so an in-flight nodes_for iterator
+    (router handler threads) walks the ring it started on while fleet
+    discovery joins/forgets replicas concurrently. Vnode points are
+    deterministic per NAME, so a departed replica that rejoins lands on
+    exactly its old ring positions — the moved-key population of a
+    depart+rejoin cycle is the ~1/N of the depart alone, not 2x."""
 
     def __init__(self, nodes: Sequence[str] = (), vnodes: int = 64):
         if vnodes < 1:
@@ -74,10 +79,13 @@ class HashRing:
         return sorted({name for _, name in self._points})
 
     def add(self, node: str) -> None:
-        if any(name == node for _, name in self._points):
+        pts = self._points
+        if any(name == node for _, name in pts):
             return
+        pts = list(pts)
         for i in range(self.vnodes):
-            bisect.insort(self._points, (_point(f"{node}#{i}"), node))
+            bisect.insort(pts, (_point(f"{node}#{i}"), node))
+        self._points = pts
 
     def remove(self, node: str) -> None:
         self._points = [(p, n) for p, n in self._points if n != node]
@@ -87,13 +95,14 @@ class HashRing:
         the first is the affinity target, the rest the bounded-load
         spill order (deterministic per key, so a spilled tenant keeps
         landing on the SAME second-choice replica and can warm it)."""
-        if not self._points:
+        pts = self._points   # one snapshot: membership may change mid-walk
+        if not pts:
             return
-        start = bisect.bisect_left(self._points, (_point(key), ""))
+        start = bisect.bisect_left(pts, (_point(key), ""))
         seen = set()
-        n = len(self._points)
+        n = len(pts)
         for i in range(n):
-            _, name = self._points[(start + i) % n]
+            _, name = pts[(start + i) % n]
             if name not in seen:
                 seen.add(name)
                 yield name

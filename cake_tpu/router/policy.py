@@ -109,13 +109,20 @@ class RoutingPolicy:
         self._sticky: OrderedDict[str, str] = OrderedDict()
         self._sticky_cap = sticky_cap
         self._rr = 0   # round_robin cursor (the bench strawman)
-        # anomaly de-weighting (obs/actions.py RouterAnomalyActuator):
-        # replica -> weight in (0, 1]. Effective load = load / weight,
-        # so a de-weighted replica reads as saturated (affinity spills
-        # away, least-loaded stops picking it) but stays ELIGIBLE —
-        # never ejected on a stale anomaly window. Empty by default:
-        # report-only behavior is bit-identical to weightless routing.
+        # placement de-weighting: replica -> composed weight in
+        # (0, 1]. Effective load = load / weight, so a de-weighted
+        # replica reads as saturated (affinity spills away,
+        # least-loaded stops picking it) but stays ELIGIBLE — never
+        # ejected on a stale anomaly window. The composed weight is
+        # the PRODUCT of named factors (set_factor: "anomaly" from the
+        # obs/actions.py actuator, "headroom"/"attainment" from pushed
+        # fleet telemetry, router/discovery.py), floored at 0.05, with
+        # per-factor provenance kept for /api/v1/fleet audit. Empty by
+        # default: report-only behavior is bit-identical to weightless
+        # routing.
         self._weights: dict = {}
+        # replica -> {source: {"weight": w, "cause": str|None}}
+        self._factors: dict = {}
 
     # -- sticky map ------------------------------------------------------
 
@@ -153,28 +160,67 @@ class RoutingPolicy:
             entry = self._sticky.get(idem_key)
             return entry[1] if entry is not None else None
 
-    # -- anomaly de-weighting (obs/actions.py) ---------------------------
+    # -- composed placement weights --------------------------------------
 
     def set_weight(self, replica: str, weight: float) -> None:
-        """Set a replica's placement weight. 1.0 (or above) clears the
-        entry — the common case stays an empty dict and a single load
-        comparison. Floored at 0.05: a zero weight would be a de-facto
-        ejection, which the de-weighting contract forbids."""
+        """Back-compat seam for the closed-loop anomaly actuator
+        (obs/actions.py): sets the "anomaly" FACTOR, leaving factors
+        other sources own (headroom, attainment) intact — an anomaly
+        clearing must not also clear a memory-pressure de-weight."""
+        self.set_factor(replica, "anomaly", weight)
+
+    def set_factor(self, replica: str, source: str, weight: float,
+                   cause: Optional[str] = None) -> None:
+        """Set one source's weight factor for a replica. A factor at
+        (or above) 1.0 clears that source's entry — the common case
+        stays an empty dict and a single load comparison. The composed
+        weight is the product of the surviving factors, floored at
+        0.05: a zero weight would be a de-facto ejection, which the
+        de-weighting contract forbids. `cause` is the human-readable
+        provenance ("pool free 0.06 < 0.25") surfaced by
+        weight_provenance() and GET /api/v1/fleet."""
         with self._mu:
+            facs = self._factors.setdefault(replica, {})
             if weight >= 1.0:
+                facs.pop(source, None)
+            else:
+                facs[source] = {"weight": max(0.05, float(weight)),
+                                "cause": cause}
+            if not facs:
+                self._factors.pop(replica, None)
                 self._weights.pop(replica, None)
             else:
-                self._weights[replica] = max(0.05, float(weight))
+                w = 1.0
+                for f in facs.values():
+                    w *= f["weight"]
+                self._weights[replica] = max(0.05, w)
+
+    def clear_factors(self, replica: str) -> None:
+        """Drop every factor for a replica (it was forgotten by fleet
+        discovery — a future replica reusing the name starts clean)."""
+        with self._mu:
+            self._factors.pop(replica, None)
+            self._weights.pop(replica, None)
 
     def weight(self, replica: str) -> float:
         with self._mu:
             return self._weights.get(replica, 1.0)
 
     def weights(self) -> dict:
-        """Current non-1.0 weights (the /api/v1/anomalies and state
-        export)."""
+        """Current non-1.0 composed weights (the /api/v1/anomalies and
+        state export)."""
         with self._mu:
             return dict(self._weights)
+
+    def weight_provenance(self, replica: str) -> dict:
+        """The composed weight AND where it came from: per-factor
+        weight + cause. {"weight": 1.0, "factors": {}} for an
+        unweighted replica."""
+        with self._mu:
+            facs = self._factors.get(replica, {})
+            return {"weight": self._weights.get(replica, 1.0),
+                    "factors": {src: dict(f)
+                                for src, f in facs.items()}}
 
     def _load_of(self, st: ReplicaState) -> float:
         """Placement load: reported load divided by the replica's
@@ -187,8 +233,17 @@ class RoutingPolicy:
     # -- the pick --------------------------------------------------------
 
     def _eligible(self, exclude: Set[str]) -> List[ReplicaState]:
-        return [s for s in self.tracker.admitting()
-                if s.name not in exclude]
+        out = [s for s in self.tracker.admitting()
+               if s.name not in exclude]
+        # route AROUND a replica reporting a live config hot-switch
+        # (the compile wall behind a fold-everything switch would eat
+        # this request's TTFT; proxying into it just earns a 409 roam)
+        # — but ONLY while another eligible replica exists: a fleet
+        # that is all mid-switch still serves, it never strands
+        # traffic. Restore is automatic: the next doc without the flag
+        # (the epoch landed) puts the replica straight back.
+        steady = [s for s in out if not s.switch_in_flight]
+        return steady if steady else out
 
     def route(self, key: Optional[str] = None,
               idem_key: Optional[str] = None,

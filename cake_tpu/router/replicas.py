@@ -57,13 +57,23 @@ class ReplicaState:
     """One backend's last-known state. Reads are lock-free snapshots of
     immutable-once-assigned attributes; the tracker is the one writer."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, source: str = "static"):
         self.name = name
         self.doc: dict = {}
         self.last_ok: Optional[float] = None   # monotonic
         self.failures = 0
         self.ejected = False
         self.next_probe = 0.0                  # monotonic deadline
+        # fleet discovery (router/discovery.py): how this replica
+        # entered the fleet ("static" = --replicas seed, "announced" =
+        # registered by its own announce frame), when its last PUSHED
+        # telemetry frame arrived (monotonic; None = never — poll-only),
+        # and whether it sent the departure notice (drains-then-forgets:
+        # no new admissions, sticky attaches still land, forgotten once
+        # its load hits zero)
+        self.source = source
+        self.last_push: Optional[float] = None
+        self.departing = False
         # estimated wall-clock offset of THIS router vs the replica
         # (seconds): min over polls of receive-wall minus the
         # replica's health-reported wall ("now") — skew plus the
@@ -91,10 +101,19 @@ class ReplicaState:
     @property
     def admitting(self) -> bool:
         """New work may route here: polled, not ejected, not draining,
-        breaker not tripped, replica itself reports ok."""
+        not departing, breaker not tripped, replica itself reports
+        ok."""
         return (self.polled and not self.ejected and not self.draining
-                and not self.breaker_tripped
+                and not self.departing and not self.breaker_tripped
                 and self.doc.get("status") == "ok")
+
+    @property
+    def switch_in_flight(self) -> bool:
+        """The replica reports a live config hot-switch (the compile
+        wall): the policy routes AROUND it while another eligible
+        replica exists, and restores it automatically when a later
+        doc shows the epoch landed."""
+        return bool(self.doc.get("switch_in_flight"))
 
     @property
     def load(self) -> int:
@@ -121,12 +140,16 @@ class ReplicaState:
         return {
             "ejected": self.ejected,
             "draining": self.draining,
+            "departing": self.departing,
+            "source": self.source,
             "admitting": self.admitting,
             "failures": self.failures,
             "load": self.load,
             "config_epoch": self.config_epoch,
             "age_s": (round(time.monotonic() - self.last_ok, 3)
                       if self.last_ok is not None else None),
+            "push_age_s": (round(time.monotonic() - self.last_push, 3)
+                           if self.last_push is not None else None),
             "clock_offset_s": (round(self.clock_offset, 6)
                                if self.clock_offset is not None
                                else None),
@@ -154,8 +177,13 @@ class ReplicaTracker:
                  poll_interval_s: float = 0.25,
                  stale_after_s: float = 2.0,
                  fetch: Optional[Callable[[str], dict]] = None,
-                 timeout_s: float = 1.0):
-        if not replicas:
+                 timeout_s: float = 1.0,
+                 allow_empty: bool = False):
+        # allow_empty: fleet discovery (router/discovery.py) grows the
+        # fleet from announce frames, so the static seed MAY be empty
+        # there; without discovery an empty list is a fleet that can
+        # never serve — keep the loud error
+        if not replicas and not allow_empty:
             raise ValueError("router needs at least one replica")
         if len(set(replicas)) != len(list(replicas)):
             raise ValueError(f"duplicate replica names in {replicas}")
@@ -177,23 +205,54 @@ class ReplicaTracker:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    # -- views -----------------------------------------------------------
+    # -- views (membership reads take _mu: discovery mutates the dict) ---
 
     def names(self) -> List[str]:
-        return sorted(self._states)
+        with self._mu:
+            return sorted(self._states)
 
     def states(self) -> List[ReplicaState]:
-        return list(self._states.values())
+        with self._mu:
+            return list(self._states.values())
 
     def get(self, name: str) -> Optional[ReplicaState]:
-        return self._states.get(name)
+        with self._mu:
+            return self._states.get(name)
 
     def admitting(self) -> List[ReplicaState]:
-        return [s for s in self._states.values() if s.admitting]
+        return [s for s in self.states() if s.admitting]
 
     def snapshot(self) -> dict:
-        return {name: st.snapshot()
-                for name, st in sorted(self._states.items())}
+        with self._mu:
+            items = sorted(self._states.items())
+        return {name: st.snapshot() for name, st in items}
+
+    # -- dynamic membership (fleet discovery, router/discovery.py) -------
+
+    def add(self, name: str, source: str = "announced") -> bool:
+        """Register a replica discovered at runtime. Idempotent: False
+        when the name is already tracked (a re-announce refreshes state
+        through note_ok, it never double-registers)."""
+        with self._mu:
+            if name in self._states:
+                return False
+            self._states[name] = ReplicaState(name, source=source)
+            self._rng[name] = random.Random(f"cake-router:{name}")
+        log.info("router: replica %s registered (%s)", name, source)
+        return True
+
+    def remove(self, name: str) -> bool:
+        """Forget a replica (the drain-then-forget terminal step).
+        Its state gauge drops to DOWN — the series stays, bounded by
+        the names ever fronted."""
+        with self._mu:
+            st = self._states.pop(name, None)
+            self._rng.pop(name, None)
+        if st is None:
+            return False
+        _REPLICA_STATE.labels(replica=name).set(STATE_DOWN)
+        log.info("router: replica %s forgotten", name)
+        return True
 
     # -- state transitions (single-writer: poll thread or caller) --------
 
@@ -210,10 +269,21 @@ class ReplicaTracker:
     def _backoff_s(self, st: ReplicaState) -> float:
         base = min(self.BACKOFF_MAX_S,
                    self.BACKOFF_BASE_S * (2 ** min(st.failures, 6)))
-        return base * (0.5 + self._rng[st.name].random())
+        rng = self._rng.get(st.name) \
+            or random.Random(f"cake-router:{st.name}")
+        return base * (0.5 + rng.random())
 
-    def note_ok(self, name: str, doc: dict) -> None:
-        st = self._states[name]
+    def note_ok(self, name: str, doc: dict,
+                push: bool = False) -> None:
+        """A health document arrived for `name` — from the poll path
+        (default) or PUSHED in an announce frame (push=True, fleet
+        discovery). A fresh push also stamps last_push, which suppresses
+        the redundant poll for one staleness window; when the announce
+        stream goes quiet the stamp ages out and polling resumes — the
+        fallback-to-poll semantics, no mode switch anywhere."""
+        st = self.get(name)
+        if st is None:
+            return   # forgotten while the doc was in flight
         # clock sample: the health doc's build-time wall clock ("now",
         # api/server.py) against our receive wall. min over polls is
         # the tightest offset bound this channel can observe (the
@@ -227,6 +297,8 @@ class ReplicaTracker:
             reinstated = st.ejected
             st.doc = doc
             st.last_ok = time.monotonic()
+            if push:
+                st.last_push = st.last_ok
             st.failures = 0
             st.ejected = False
             st.next_probe = 0.0
@@ -240,14 +312,17 @@ class ReplicaTracker:
         if reinstated:
             log.info("router: replica %s reinstated", name)
         self._set_gauge(st)
-        _POLLS.labels(outcome="ok").inc()
+        if not push:
+            _POLLS.labels(outcome="ok").inc()
 
     def note_failure(self, name: str, hard: bool = False) -> None:
         """A poll (or, with hard=True, a data-path connect) failed.
         Ejection is staleness-based for soft failures — one dropped
         poll inside the window must not bounce a loaded replica — and
         immediate for hard ones."""
-        st = self._states[name]
+        st = self.get(name)
+        if st is None:
+            return   # forgotten while the failure was in flight
         now = time.monotonic()
         with self._mu:
             st.failures += 1
@@ -267,10 +342,19 @@ class ReplicaTracker:
     def poll_once(self, now: Optional[float] = None) -> None:
         """One pass over every replica: fetch lite health, update
         state. Ejected replicas are re-probed only past their jittered
-        backoff deadline."""
+        backoff deadline; replicas whose PUSHED announce frames are
+        fresh (within the staleness window) are skipped — the push
+        stream already carries liveness, so the poll would be a
+        redundant round trip. When frames stop, the stamp ages out and
+        this loop resumes polling automatically."""
         now = time.monotonic() if now is None else now
-        for name, st in self._states.items():
+        with self._mu:
+            items = list(self._states.items())
+        for name, st in items:
             if st.ejected and now < st.next_probe:
+                continue
+            if (st.last_push is not None
+                    and now - st.last_push <= self.stale_after_s):
                 continue
             try:
                 doc = self._fetch(name)

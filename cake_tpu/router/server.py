@@ -87,11 +87,11 @@ class RouterServer:
 
     # cakelint guards discipline: the tokenizer (page-aligned affinity
     # keys), the decision JSONL log, the hop tracer, the typed event
-    # ring and the regression sentinel are all optional planes — every
-    # dereference is `is not None`-guarded, machine-checked from day
-    # one (the PR 13/14 precedent)
+    # ring, the regression sentinel and the fleet-discovery plane are
+    # all optional planes — every dereference is `is not None`-guarded,
+    # machine-checked from day one (the PR 13/14 precedent)
     OPTIONAL_PLANES = ("tokenizer", "_log", "hops", "events",
-                       "sentinel", "actions")
+                       "sentinel", "actions", "discovery")
 
     def __init__(self, replicas, tokenizer=None,
                  poll_interval_s: float = 0.25,
@@ -108,11 +108,18 @@ class RouterServer:
                  sentinel_interval_s: float = 2.0,
                  anomaly_weighting: bool = False,
                  fetch_timeline=None,
-                 timeline_timeout_s: float = 5.0):
+                 timeline_timeout_s: float = 5.0,
+                 announce: Optional[str] = None,
+                 announce_interval_s: float = 2.0,
+                 announce_token: Optional[str] = None,
+                 forget_grace_s: float = 30.0):
         self.tokenizer = tokenizer
+        # with fleet discovery armed the static --replicas seed MAY be
+        # empty: the fleet forms from announce frames
         self.tracker = ReplicaTracker(
             replicas, poll_interval_s=poll_interval_s,
-            stale_after_s=stale_after_s, fetch=fetch)
+            stale_after_s=stale_after_s, fetch=fetch,
+            allow_empty=announce is not None)
         self.ring = HashRing(self.tracker.names(), vnodes=vnodes)
         self.policy = RoutingPolicy(
             self.tracker, ring=self.ring,
@@ -160,6 +167,19 @@ class RouterServer:
             self.actions = ActionPlane(events=self.events)
             RouterAnomalyActuator(self, self.actions).attach(
                 self.sentinel)
+        # fleet discovery (--router-announce, router/discovery.py):
+        # replicas self-register over the token-gated announce channel,
+        # pushed frames supersede polling while fresh, departures
+        # drain-then-forget, and pushed headroom/attainment become
+        # placement weight factors. None without the flag — the static
+        # polled fleet stays byte-identical.
+        self.discovery = None
+        if announce is not None:
+            from cake_tpu.router.discovery import FleetDiscovery
+            self.discovery = FleetDiscovery(
+                self, address=announce, token=announce_token,
+                announce_interval_s=announce_interval_s,
+                forget_grace_s=forget_grace_s)
         self._timeline_timeout_s = timeline_timeout_s
         # injectable replica-timeline fetch (tests / bench drive
         # in-process replicas); default is the HTTP GET
@@ -218,6 +238,9 @@ class RouterServer:
             "tracing": self.hops is not None,
             "sentinel": self.sentinel is not None,
             "anomaly_weighting": self.actions is not None,
+            "discovery": self.discovery is not None,
+            "announce_port": (self.discovery.port
+                              if self.discovery is not None else None),
             "weights": self.policy.weights(),
         }
 
@@ -233,7 +256,38 @@ class RouterServer:
             self._log.append(rec)
 
     def metrics(self) -> str:
-        return obs_metrics.REGISTRY.render()
+        text = obs_metrics.REGISTRY.render()
+        if self.discovery is not None:
+            # replica-labeled federated families from announce frames
+            # appended after the local render (the PR 11 pattern):
+            # families the router also owns reuse its HELP/TYPE block,
+            # replica-only families bring their own
+            try:
+                text += self.discovery.render_federated(
+                    {f.name for f in obs_metrics.REGISTRY.families()})
+            except Exception:  # noqa: BLE001 — a scrape must not fail
+                log.debug("federated render failed", exc_info=True)
+        return text
+
+    def fleet(self) -> dict:
+        """GET /api/v1/fleet: per-replica liveness, announce age,
+        clock offset, headroom, attainment, epoch and the composed
+        placement weight with provenance. Without discovery the router
+        still answers with the polled view (weights included) so the
+        endpoint is one stop regardless of how the fleet formed."""
+        if self.discovery is not None:
+            return self.discovery.fleet()
+        fleet = {}
+        for st in self.tracker.states():
+            snap = st.snapshot()
+            prov = self.policy.weight_provenance(st.name)
+            snap["live"] = st.polled and not st.ejected
+            snap["weight"] = prov["weight"]
+            snap["weight_provenance"] = prov["factors"]
+            fleet[st.name] = snap
+        return {"role": "router", "replicas": fleet,
+                "note": "fleet discovery disabled (start the router "
+                        "with --router-announce)"}
 
     # -- federated per-request explain ------------------------------------
 
@@ -338,6 +392,10 @@ class RouterServer:
         return out
 
     def close(self) -> None:
+        if self.discovery is not None:
+            # stop ingesting announce frames BEFORE the tracker goes
+            # down: a frame landing mid-teardown must not re-register
+            self.discovery.close()
         if self.sentinel is not None:
             self.sentinel.close()
         self.tracker.close()
@@ -411,6 +469,8 @@ def make_router_handler(router: RouterServer):
                     return self._json(400, {"error": str(e)})
             if route == "/api/v1/anomalies":
                 return self._json(200, router.anomalies())
+            if route == "/api/v1/fleet":
+                return self._json(200, router.fleet())
             if route in ("/metrics", "/api/v1/metrics"):
                 data = router.metrics().encode()
                 self.send_response(200)
@@ -518,6 +578,13 @@ def make_router_handler(router: RouterServer):
                     # saw — the router never invents its own
                     ra = (e.retry_after_s if e.retry_after_s is not None
                           else last_refusal_ra)
+                    if ra is None and router.discovery is not None:
+                        # the documented exception: during the
+                        # discovery WARM-UP window (no replica has ever
+                        # reported) the announce interval is an honest
+                        # bound on when one could — without it an empty
+                        # forming fleet reads as unretryable
+                        ra = router.discovery.warmup_retry_after()
                     if ra is not None:
                         hdrs["Retry-After"] = str(
                             max(1, int(-(-ra // 1))))
@@ -742,10 +809,14 @@ def start_router(replicas, address: str = "127.0.0.1:10127",
     router.tracker.start()
     if router.sentinel is not None:
         router.sentinel.start()
+    if router.discovery is not None:
+        router.discovery.start()
     httpd = ThreadingHTTPServer((host, int(port)),
                                 make_router_handler(router))
-    log.info("router listening on %s over replicas %s", address,
-             ",".join(router.tracker.names()))
+    log.info("router listening on %s over replicas %s%s", address,
+             ",".join(router.tracker.names()) or "(none yet)",
+             ("; announce channel on port %d" % router.discovery.port
+              if router.discovery is not None else ""))
 
     def serve():
         try:

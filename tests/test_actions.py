@@ -248,6 +248,10 @@ def test_engine_actuator_rate_limits_the_rollback():
 # -- RoutingPolicy weights ---------------------------------------------------
 
 class _St:
+    # mirrors the ReplicaState surface route() reads (ISSUE 18 added
+    # the hot-switch route-around flag)
+    switch_in_flight = False
+
     def __init__(self, name, load):
         self.name = name
         self.load = load

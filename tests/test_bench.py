@@ -389,3 +389,15 @@ def test_router_smoke_tier_affinity_beats_round_robin():
     assert sum(result["router_per_replica_round_robin"]) \
         == result["router_requests"]
     assert all(n > 0 for n in result["router_per_replica_round_robin"])
+    # the discovery/placement smoke (announce-only fleet): the
+    # hot-joined replica — in NO --replicas list — served real routed
+    # traffic, the flagged hot-switch admitted ZERO new work onto the
+    # switching box and restored it afterwards, and the explicit
+    # departure notice admitted ZERO new work before the forget
+    assert result["router_disc_joiner_completed"] > 0
+    assert result["router_disc_join_to_first_serve_ms"] > 0
+    assert 0 < result["router_disc_placement_shift"] < 1
+    assert result["router_disc_switch_admissions_routed_around"] == 0
+    assert result["router_disc_switch_restored"] is True
+    assert result["router_disc_post_departure_admissions"] == 0
+    assert result["router_disc_forgotten_after_depart"] is True

@@ -655,3 +655,51 @@ def test_issue16_optional_planes_declared():
     rep = _analyze([ROOT / "cake_tpu" / "obs" / "actions.py"])
     assert rep["findings"] == [], [f.message for f in rep["findings"]]
     assert rep["sites"]["guards"] > 0, rep["sites"]
+
+
+# -- ISSUE 18: the fleet-discovery planes gated from day one -----------------
+
+DISCOVERY_GUARDS_BAD = '''
+class ReplicaAnnouncer:
+    OPTIONAL_PLANES = ("_engine", "_sentinel")
+
+    def bad(self):
+        return self._sentinel.state(limit=0)
+
+    def ok(self):
+        if self._engine is not None:
+            return self._engine.stats
+'''
+
+
+def test_guards_checker_live_on_discovery_style_code(tmp_path):
+    """Seeded violation in announcer-shaped code: the unguarded
+    sentinel deref is a finding, the guarded engine deref is not — the
+    checker is live on exactly the declarations router/discovery.py
+    ships."""
+    p = _write(tmp_path, "discovery_bad.py", DISCOVERY_GUARDS_BAD)
+    rep = _analyze([p], rules=["guards"])
+    msgs = [f.message for f in rep["findings"]]
+    assert len(msgs) == 1, msgs
+    assert "_sentinel" in msgs[0]
+    assert rep["sites"]["guards"] == 2   # 1 unguarded + 1 guarded deref
+
+
+def test_issue18_optional_planes_declared():
+    """The ISSUE 18 satellite: the announcer's optional engine /
+    sentinel / health planes, the discovery maintenance thread, and
+    the router's discovery plane itself are declared OPTIONAL_PLANES,
+    so the `is not None` guard discipline around fleet discovery is
+    machine-checked by the tree gate from day one."""
+    from cake_tpu.router.discovery import (FleetDiscovery,
+                                           ReplicaAnnouncer)
+    from cake_tpu.router.server import RouterServer
+    for attr in ("_engine", "_sentinel", "_health"):
+        assert attr in ReplicaAnnouncer.OPTIONAL_PLANES, attr
+    assert "_thread" in FleetDiscovery.OPTIONAL_PLANES
+    assert "discovery" in RouterServer.OPTIONAL_PLANES
+    # the module that ships the plane is clean under the full rule set
+    # with guard sites provably exercised
+    rep = _analyze([ROOT / "cake_tpu" / "router" / "discovery.py"])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"]["guards"] > 0, rep["sites"]

@@ -103,13 +103,15 @@ def test_vocabulary_is_the_documented_set():
     # oversubscription) + the router tier's four (carried with trace=
     # instead of rid=) + the sentinel's anomaly transitions (ISSUE 15)
     # + the action plane's audit record for what an anomaly CHANGED
-    # (ISSUE 16)
+    # (ISSUE 16) + fleet membership transitions at the front door
+    # (ISSUE 18's announce-driven discovery)
     assert set(EVENT_TYPES) == {
         "preempted", "kv_spill", "kv_restore", "prefix_hit",
         "recovered", "poisoned", "reconfigured", "shed",
         "fault_injected", "recompile", "resident_spilled",
         "affinity_miss", "spill_to_secondary", "failover_resume",
-        "shed_by_router", "anomaly", "anomaly_action"}
+        "shed_by_router", "anomaly", "anomaly_action",
+        "replica_joined", "replica_departed", "replica_stale"}
 
 
 # -- publishers outside the engine -------------------------------------------

@@ -305,6 +305,42 @@ def test_host_cap_cli_flag(tmp_path):
     assert lm.main([str(p), "--host-cap", "abc"]) == 2
 
 
+def test_fleet_discovery_families_live_linted():
+    """ISSUE 18 tier-1 hook: importing the announcer module registers
+    the front-door discovery families (router/discovery.py) — announce
+    frames/departures per replica plus the fleet-size / composed-weight
+    / staleness gauges — with real help text and README rows, and the
+    router's `replica` relabel shares the federated topology cap."""
+    lm = _load()
+    import cake_tpu.router.discovery  # noqa: F401 — announcer + listener
+    from cake_tpu.obs import metrics as m
+    # the discovery surface is explicitly documented, not just riding
+    # the cake_router_ umbrella prefix
+    assert "cake_router_fleet_" in lm.DOCUMENTED_PREFIXES
+    assert "cake_router_announce_" in lm.DOCUMENTED_PREFIXES
+    text = m.REGISTRY.render()
+    for fam in ("cake_router_announce_frames_total",
+                "cake_router_announce_departures_total",
+                "cake_router_fleet_replicas",
+                "cake_router_fleet_weight",
+                "cake_router_fleet_stale_total"):
+        assert any(line.startswith(f"# TYPE {fam} ")
+                   for line in text.splitlines()), fam
+    readme = (TOOLS.parent / "README.md").read_text()
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs
+    # replica-labeled federated series (the announce listener rewrites
+    # host -> replica) count against the same topology-size cap
+    lines = ["# TYPE fed_total counter"]
+    lines += [f'fed_total{{replica="10.0.0.{i}:9000"}} 1'
+              for i in range(65)]
+    errs = lm.lint("\n".join(lines) + "\n", series_cap=0)
+    assert any("host label values" in e and "topology" in e
+               for e in errs)
+    assert lm.lint("\n".join(lines) + "\n", series_cap=0,
+                   host_cap=128) == []
+
+
 def test_goodput_event_families_live_linted():
     """The tier-1 hook covers the new families: cake_slo_* /
     cake_goodput_* / cake_events_* are registered (module import),

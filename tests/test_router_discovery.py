@@ -1,0 +1,550 @@
+"""Fleet discovery at the front door (cake_tpu/router/discovery.py,
+ISSUE 18): replica auto-registration over the token-gated announce
+channel, push-superseding-poll liveness, observability-fed placement
+factors, drain-then-forget departures, and the operator surfaces
+(/api/v1/fleet, tools/fleetctl.py, flag validation).
+
+Everything here is CPU-only and engine-free: frames are driven either
+directly through FleetDiscovery.on_frame (the deterministic seam) or
+over the REAL wire with a ReplicaAnnouncer pointed at the listener's
+ephemeral port. The engine-backed E2E lives in test_router_e2e.py.
+"""
+
+import importlib.util
+import io
+import json
+import pathlib
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _router(**kw):
+    """A RouterServer with discovery armed and an EMPTY static seed.
+    The maintenance thread is NOT started — tests drive maintain()
+    synchronously; the listener's accept threads run for real."""
+    from cake_tpu.router.server import RouterServer
+    kw.setdefault("announce", "127.0.0.1:0")
+    kw.setdefault("announce_interval_s", 0.2)
+    kw.setdefault("forget_grace_s", 2.0)
+    return RouterServer([], **kw)
+
+
+def _doc(load=0, **over):
+    d = {"status": "ok", "queue_depth": int(load),
+         "active_requests": 0, "now": time.time()}
+    d.update(over)
+    return d
+
+
+def _fleetctl():
+    spec = importlib.util.spec_from_file_location(
+        "fleetctl", ROOT / "tools" / "fleetctl.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- registration / churn -----------------------------------------------------
+
+def test_first_frame_registers_and_is_admitting():
+    router = _router()
+    name = "10.0.0.1:9000"
+    try:
+        router.discovery.on_frame(name, _doc(), None)
+        st = router.tracker.get(name)
+        assert st is not None and st.source == "announced"
+        assert st.admitting and st.last_push is not None
+        assert name in router.ring.nodes()
+        evs, _ = router.events.snapshot(type="replica_joined")
+        assert [e["replica"] for e in evs] == [name]
+    finally:
+        router.close()
+
+
+def test_unroutable_or_unknown_goodbye_frames_ignored():
+    router = _router()
+    try:
+        # a goodbye from a replica the fleet never knew: no-op
+        router.discovery.on_frame("10.0.0.1:9000",
+                                  _doc(departing=True), None)
+        # an announced identity without a port could never be proxied
+        # to — it must not poison the ring
+        router.discovery.on_frame("not-an-address", _doc(), None)
+        assert router.tracker.states() == []
+        assert router.ring.nodes() == []
+    finally:
+        router.close()
+
+
+def test_registration_churn_never_double_registers():
+    """Property: ANY interleaving of join / depart / maintain frames
+    leaves at most one tracker entry and one consistent ring per name,
+    and the per-replica joined/departed event stream alternates."""
+    router = _router()
+    disc = router.discovery
+    rng = random.Random(18)
+    names = [f"10.0.0.{i}:9000" for i in range(4)]
+    try:
+        for _ in range(300):
+            name = rng.choice(names)
+            op = rng.random()
+            if op < 0.45:
+                disc.on_frame(name, _doc(), None)
+            elif op < 0.75:
+                disc.on_frame(name, _doc(departing=True), None)
+            else:
+                disc.maintain()   # load 0: departing are forgotten
+            tracked = sorted(st.name for st in router.tracker.states())
+            assert len(tracked) == len(set(tracked))
+            assert sorted(router.ring.nodes()) == tracked
+        # drain the fleet completely
+        for name in names:
+            disc.on_frame(name, _doc(departing=True), None)
+        disc.maintain()
+        assert router.tracker.states() == []
+        assert router.ring.nodes() == []
+        # joined/departed alternate per replica: flapping never stacks
+        # two registrations (or two departures) for one name
+        evs, _ = router.events.snapshot()
+        per = {}
+        for e in evs:
+            if e["type"] in ("replica_joined", "replica_departed"):
+                per.setdefault(e["replica"], []).append(e["type"])
+        for name, seq in per.items():
+            assert seq[0] == "replica_joined", (name, seq)
+            for a, b in zip(seq, seq[1:]):
+                assert a != b, (name, seq)
+    finally:
+        router.close()
+
+
+def test_depart_rejoin_restores_exact_ring_position():
+    """Deterministic vnodes: a replica that departs and rejoins lands
+    on exactly its old ring points — one churn cycle moves only the
+    departed replica's keys (to survivors) and moves them BACK on
+    rejoin, never a fleet-wide reshuffle."""
+    router = _router()
+    disc = router.discovery
+    names = ["10.0.0.1:9000", "10.0.0.2:9000", "10.0.0.3:9000"]
+    try:
+        for n in names:
+            disc.on_frame(n, _doc(), None)
+        keys = [f"tenant-{i}" for i in range(300)]
+        before = {k: router.ring.node_for(k) for k in keys}
+        owned = {k for k, n in before.items() if n == names[1]}
+        assert owned   # ~1/3 of 300 keys; statistically certain
+        disc.on_frame(names[1], _doc(departing=True), None)
+        disc.maintain()
+        assert router.tracker.get(names[1]) is None
+        during = {k: router.ring.node_for(k) for k in keys}
+        moved = {k for k in keys if during[k] != before[k]}
+        assert moved == owned
+        disc.on_frame(names[1], _doc(), None)
+        after = {k: router.ring.node_for(k) for k in keys}
+        assert after == before
+    finally:
+        router.close()
+
+
+# -- drain-then-forget --------------------------------------------------------
+
+def test_departure_drains_then_forgets():
+    """The departure notice stops NEW admissions instantly, keeps the
+    replica tracked while loaded (sticky attaches still land), and
+    forgets it — tracker, ring, weight factors — once load reaches
+    zero."""
+    router = _router()
+    disc = router.discovery
+    a, b = "10.0.0.1:9000", "10.0.0.2:9000"
+    try:
+        disc.on_frame(a, _doc(), None)
+        disc.on_frame(b, _doc(), None)
+        disc.on_frame(b, _doc(load=3, departing=True), None)
+        st = router.tracker.get(b)
+        assert st is not None and st.departing and not st.admitting
+        disc.maintain()   # load 3, grace not expired: still tracked
+        assert router.tracker.get(b) is not None
+        for i in range(8):   # every new admission lands on a
+            assert router.policy.route(key=f"k{i}").replica == a
+        evs, _ = router.events.snapshot(type="replica_departed")
+        assert [e["replica"] for e in evs] == [b]
+        disc.on_frame(b, _doc(load=0, departing=True), None)
+        disc.maintain()   # drained: the terminal forget
+        assert router.tracker.get(b) is None
+        assert b not in router.ring.nodes()
+        assert router.policy.weight_provenance(b)["factors"] == {}
+    finally:
+        router.close()
+
+
+def test_departed_replica_forgotten_at_grace_deadline_even_loaded():
+    """A replica that dies MID-drain (load never reaches zero) is
+    still forgotten at the grace deadline — drain-then-forget cannot
+    wedge on a corpse's stale load figure."""
+    router = _router(forget_grace_s=1.0)
+    disc = router.discovery
+    name = "10.0.0.1:9000"
+    try:
+        disc.on_frame(name, _doc(), None)
+        disc.on_frame(name, _doc(load=5, departing=True), None)
+        disc.maintain()
+        assert router.tracker.get(name) is not None
+        disc.maintain(now=time.monotonic() + 1.5)   # past the deadline
+        assert router.tracker.get(name) is None
+    finally:
+        router.close()
+
+
+# -- staleness: push supersedes poll, then falls back -------------------------
+
+def test_push_supersedes_poll_until_the_stream_goes_quiet():
+    polled = []
+
+    def fetch(name):
+        polled.append(name)
+        return _doc()
+
+    router = _router(fetch=fetch)
+    name = "10.0.0.1:9000"
+    try:
+        router.discovery.on_frame(name, _doc(), None)
+        router.tracker.poll_once()
+        assert polled == []   # fresh push: the poll is redundant
+        st = router.tracker.get(name)
+        st.last_push -= router.tracker.stale_after_s + 0.1
+        router.tracker.poll_once()
+        assert polled == [name]   # stream quiet: polling resumed
+    finally:
+        router.close()
+
+
+def test_stale_transition_publishes_once_per_episode():
+    router = _router()
+    disc = router.discovery
+    name = "10.0.0.1:9000"
+    try:
+        disc.on_frame(name, _doc(), None)
+        quiet = time.monotonic() + disc.stale_after_s + 0.1
+        disc.maintain(now=quiet)
+        disc.maintain(now=quiet + 0.05)   # same episode: no repeat
+        evs, _ = router.events.snapshot(type="replica_stale")
+        assert [e["replica"] for e in evs] == [name]
+        disc.on_frame(name, _doc(), None)   # frames resume
+        disc.maintain()
+        disc.maintain(now=time.monotonic() + disc.stale_after_s + 0.1)
+        evs, _ = router.events.snapshot(type="replica_stale")
+        assert len(evs) == 2   # a NEW episode fires again
+    finally:
+        router.close()
+
+
+def test_announced_replica_that_died_without_goodbye_is_reaped():
+    """Ejected by the poll fallback AND quiet past grace: discovery
+    infers the departure (typed event, inferred=True) and forgets."""
+    router = _router(forget_grace_s=0.5)
+    disc = router.discovery
+    name = "10.0.0.1:9000"
+    try:
+        disc.on_frame(name, _doc(), None)
+        st = router.tracker.get(name)
+        st.ejected = True   # the poll fallback gave up on it
+        disc.maintain(now=time.monotonic() + disc.stale_after_s + 1.0)
+        assert router.tracker.get(name) is None
+        evs, _ = router.events.snapshot(type="replica_departed")
+        assert evs and evs[-1]["replica"] == name
+        assert evs[-1]["inferred"] is True
+    finally:
+        router.close()
+
+
+# -- observability-fed placement ----------------------------------------------
+
+def test_fleet_view_composes_weight_with_provenance():
+    router = _router()
+    disc = router.discovery
+    name = "10.0.0.1:9000"
+    try:
+        disc.on_frame(name, _doc(
+            pool={"pages_total": 100, "pages_free": 10},
+            slo={"attainment_1m": {"interactive": 0.5, "batch": 1.0}},
+        ), None)
+        fl = disc.fleet()["replicas"][name]
+        assert fl["live"] and fl["source"] == "announced"
+        prov = fl["weight_provenance"]
+        assert set(prov) == {"headroom", "attainment"}
+        assert fl["weight"] == pytest.approx(
+            (0.10 / 0.25) * (0.5 / 0.9), abs=1e-3)
+        assert "pool free fraction" in prov["headroom"]["cause"]
+        assert "attainment_1m" in prov["attainment"]["cause"]
+        # recovery clears both factors: weight back to exactly 1.0
+        disc.on_frame(name, _doc(
+            pool={"pages_total": 100, "pages_free": 80},
+            slo={"attainment_1m": {"interactive": 0.99}},
+        ), None)
+        fl = disc.fleet()["replicas"][name]
+        assert fl["weight"] == 1.0 and fl["weight_provenance"] == {}
+    finally:
+        router.close()
+
+
+def test_placement_weight_floor_never_ejects():
+    """A replica at zero headroom AND zero attainment keeps the 0.05
+    floor: de-weighting never becomes a de-facto ejection."""
+    router = _router()
+    name = "10.0.0.1:9000"
+    try:
+        router.discovery.on_frame(name, _doc(
+            pool={"pages_total": 100, "pages_free": 0},
+            slo={"attainment_1m": {"interactive": 0.0}},
+        ), None)
+        assert router.policy.weight(name) == pytest.approx(0.05)
+        assert router.policy.route(key="k").replica == name
+    finally:
+        router.close()
+
+
+def test_switch_in_flight_routed_around_and_restored():
+    router = _router()
+    disc = router.discovery
+    a, b = "10.0.0.1:9000", "10.0.0.2:9000"
+    try:
+        disc.on_frame(a, _doc(), None)
+        disc.on_frame(b, _doc(), None)
+        key = next(k for k in (f"k{i}" for i in range(200))
+                   if router.ring.node_for(k) == b)
+        assert router.policy.route(key=key).replica == b
+        # b reports a live hot-switch: routed around while a exists
+        disc.on_frame(b, _doc(switch_in_flight=True), None)
+        assert disc.fleet()["replicas"][b]["switch_in_flight"] is True
+        assert router.policy.route(key=key).replica == a
+        # a fleet that is ALL mid-switch still serves (never strands)
+        disc.on_frame(a, _doc(switch_in_flight=True), None)
+        assert router.policy.route(key=key).replica in (a, b)
+        disc.on_frame(a, _doc(), None)
+        # the epoch lands on b: restored instantly, no cooldown
+        disc.on_frame(b, _doc(config_epoch=3), None)
+        assert router.policy.route(key=key).replica == b
+    finally:
+        router.close()
+
+
+# -- the real wire: announcer -> listener -------------------------------------
+
+def _wait(pred, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def test_announcer_registers_and_departs_over_the_wire():
+    from cake_tpu.router.discovery import ReplicaAnnouncer
+    router = _router(announce_token="s3cret",
+                     announce_interval_s=0.1)
+    name = "127.0.0.1:19000"
+    ann = None
+    try:
+        ann = ReplicaAnnouncer(
+            f"127.0.0.1:{router.discovery.port}", name,
+            token="s3cret", interval_s=0.1,
+            health=lambda: _doc(), connect_timeout_s=5.0)
+        assert _wait(lambda: router.tracker.get(name) is not None)
+        st = router.tracker.get(name)
+        assert st.admitting and st.source == "announced"
+        assert st.clock_offset is not None   # frames carry "now"
+        # the ingest counter advanced and the fleet doc shows the push
+        from cake_tpu.obs import metrics as m
+        fam = m.REGISTRY.get("cake_router_announce_frames_total")
+        assert fam.samples()[(name,)] >= 1
+        fl = router.fleet()["replicas"][name]
+        assert fl["last_announce_age_s"] is not None
+        # explicit goodbye: synchronous, admission stops immediately
+        assert ann.depart(timeout_s=5.0) is True
+        assert _wait(lambda: router.tracker.get(name) is None
+                     or router.tracker.get(name).departing)
+        router.discovery.maintain()   # load 0: forgotten
+        assert router.tracker.get(name) is None
+    finally:
+        if ann is not None:
+            ann.close(depart=False)
+        router.close()
+
+
+def test_wrong_announce_token_never_registers():
+    from cake_tpu.router.discovery import ReplicaAnnouncer
+    router = _router(announce_token="s3cret",
+                     announce_interval_s=0.05)
+    ann = None
+    try:
+        ann = ReplicaAnnouncer(
+            f"127.0.0.1:{router.discovery.port}", "127.0.0.1:19001",
+            token="wrong", interval_s=0.05, health=lambda: _doc())
+        time.sleep(0.6)
+        assert router.tracker.states() == []
+    finally:
+        if ann is not None:
+            ann.close(depart=False)
+        router.close()
+
+
+def test_federated_metrics_carry_replica_label():
+    from cake_tpu.router.discovery import ReplicaAnnouncer
+    from cake_tpu.obs import metrics as m
+    router = _router(announce_interval_s=0.1)
+    reg = m.Registry()
+    g = m.Gauge("cake_engine_kv_pages_total", "pages", registry=reg)
+    g.set(48)
+    name = "127.0.0.1:19002"
+    ann = None
+    try:
+        ann = ReplicaAnnouncer(
+            f"127.0.0.1:{router.discovery.port}", name,
+            interval_s=0.1, health=lambda: _doc(), registry=reg)
+        assert _wait(lambda: router.tracker.get(name) is not None)
+        assert _wait(lambda: f'replica="{name}"' in router.metrics())
+        text = router.metrics()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("cake_engine_kv_pages_total")
+                    and name in ln)
+        assert f'replica="{name}"' in line and line.endswith(" 48")
+        # the federated dimension is replica=, never host= (the
+        # collector's own ingest bookkeeping may carry host labels;
+        # the replica's SHIPPED families must not)
+        assert 'host=' not in line
+    finally:
+        if ann is not None:
+            ann.close(depart=False)
+        router.close()
+
+
+# -- warm-up honesty over HTTP ------------------------------------------------
+
+def test_warmup_503_carries_announce_interval_retry_after():
+    """A fleet-wide NoReplicaError during the discovery WARM-UP window
+    (no replica has EVER reported) returns 503 with Retry-After =
+    max(1, announce interval) — the one documented exception to the
+    router's never-invent-a-Retry-After contract. The exception ends
+    the moment any replica reports."""
+    from cake_tpu.router import start_router
+    httpd, router = start_router(
+        [], address="127.0.0.1:0", block=False,
+        announce="127.0.0.1:0", announce_interval_s=3.0)
+    raddr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"http://{raddr}/api/v1/chat/completions",
+            data=json.dumps({"messages": [
+                {"role": "user", "content": "hi"}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "3"
+        assert router.discovery.warmup_retry_after() == 3.0
+        # any replica reporting ends the warm-up exception for good
+        router.discovery.on_frame("10.0.0.1:9000", _doc(), None)
+        assert router.discovery.warmup_retry_after() is None
+    finally:
+        httpd.shutdown()
+        router.close()
+
+
+# -- /api/v1/fleet ------------------------------------------------------------
+
+def test_fleet_endpoint_without_discovery_still_answers():
+    from cake_tpu.router.server import RouterServer
+    router = RouterServer(["h:1"])
+    try:
+        doc = router.fleet()
+        assert "h:1" in doc["replicas"]
+        assert "weight" in doc["replicas"]["h:1"]
+        assert "--router-announce" in doc["note"]
+        assert router.state()["discovery"] is False
+    finally:
+        router.close()
+
+
+def test_fleet_endpoint_over_http_and_fleetctl_rc_contract(tmp_path):
+    from cake_tpu.router import start_router
+    fc = _fleetctl()
+    httpd, router = start_router(
+        [], address="127.0.0.1:0", block=False,
+        announce="127.0.0.1:0", announce_interval_s=0.2)
+    raddr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # empty forming fleet: the table renders, rc 2 (cannot serve)
+        assert fc.main([f"http://{raddr}"]) == 2
+        router.discovery.on_frame(
+            "10.0.0.1:9000", _doc(
+                pool={"pages_total": 100, "pages_free": 10}), None)
+        doc = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/fleet", timeout=10).read())
+        entry = doc["replicas"]["10.0.0.1:9000"]
+        assert entry["live"] and entry["source"] == "announced"
+        assert entry["weight_provenance"]["headroom"]["cause"]
+        # one admitting replica: rc 0, in table and --json modes
+        assert fc.main([f"http://{raddr}"]) == 0
+        assert fc.main([f"http://{raddr}", "--json"]) == 0
+        # a departed fleet cannot serve: rc 2 again
+        router.discovery.on_frame(
+            "10.0.0.1:9000", _doc(departing=True), None)
+        assert fc.main([f"http://{raddr}"]) == 2
+    finally:
+        httpd.shutdown()
+        router.close()
+    # unreachable router: rc 2, never a traceback
+    assert fc.main([f"http://{raddr}", "--timeout", "0.5"]) == 2
+
+
+def test_fleetctl_render_offline_contract():
+    fc = _fleetctl()
+    out = io.StringIO()
+    healthy = {"replicas": {"10.0.0.1:9000": {
+        "live": True, "source": "announced", "admitting": True,
+        "load": 2, "weight": 0.4, "weight_provenance": {
+            "headroom": {"weight": 0.4, "cause": "pool"}},
+        "pool": {"pages_total": 100, "pages_free": 10},
+        "attainment_1m": {"interactive": 0.97},
+        "last_announce_age_s": 0.2}}}
+    assert fc.render(healthy, out=out) == 0
+    table = out.getvalue()
+    assert "REPLICA" in table and "headroom=0.40" in table
+    assert "10/100" in table and "0.970" in table
+    assert fc.render({"replicas": {}}, out=io.StringIO()) == 2
+    draining = {"replicas": {"a:1": {
+        "live": True, "source": "static", "admitting": False,
+        "draining": True, "load": 1}}}
+    assert fc.render(draining, out=io.StringIO()) == 2
+    assert fc.render({"note": "x"}, out=io.StringIO()) == 2
+
+
+# -- flag plumbing ------------------------------------------------------------
+
+def test_args_announce_flag_validation():
+    from cake_tpu.args import Args
+    # --router with NEITHER --replicas NOR --router-announce: loud
+    with pytest.raises(ValueError, match="requires --replicas"):
+        Args(router=True).validate()
+    # either one (or both) arms the front door
+    Args(router=True, router_announce="127.0.0.1:0").validate()
+    Args(router=True, replicas="h:1,g:2",
+         router_announce="0.0.0.0:7777").validate()
+    for bad in ("nohost", "host:", ":123", "h:notaport", "h:70000"):
+        with pytest.raises(ValueError, match="router-announce"):
+            Args(router_announce=bad).validate()
+    with pytest.raises(ValueError, match="announce-interval"):
+        Args(router=True, router_announce="127.0.0.1:0",
+             announce_interval=0.0).validate()
+
+
+def test_router_rejects_nonpositive_announce_interval():
+    with pytest.raises(ValueError, match="must be > 0"):
+        _router(announce_interval_s=0.0)

@@ -513,3 +513,172 @@ def test_killed_replica_keyed_sse_reconnects_token_identical(
                 pass
         for e in (engA, engB):
             e.stop(timeout=10)
+
+
+# -- fleet discovery: announce-only replicas end-to-end (ISSUE 18) ------------
+
+def test_discovered_replicas_serve_depart_and_failover(tiny_config,
+                                                       params):
+    """ISSUE 18 acceptance (real HTTP, CPU lane): a replica in NO
+    --replicas list self-registers over the announce channel and
+    receives routed traffic; a second hot-joins mid-fleet; the keyed
+    SSE client of a KILLED replica fails over to the survivor with no
+    duplicate events; the corpse is forgotten from /api/v1/fleet
+    (inferred departure); and the survivor's explicit departure notice
+    drains-then-forgets — ZERO new admissions while its in-flight
+    stream finishes."""
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.router import start_router
+    from cake_tpu.router.discovery import ReplicaAnnouncer
+    from cake_tpu.serve.errors import EngineResetError
+
+    rhttpd, router = start_router(
+        [], address="127.0.0.1:0", block=False,
+        tokenizer=ByteTokenizer(tiny_config.vocab_size),
+        poll_interval_s=0.05, stale_after_s=1.0,
+        announce="127.0.0.1:0", announce_interval_s=0.1,
+        forget_grace_s=0.5)
+    raddr = f"127.0.0.1:{rhttpd.server_address[1]}"
+    aport = router.discovery.port
+
+    def _announce(api, eng, addr):
+        return ReplicaAnnouncer(
+            f"127.0.0.1:{aport}", addr, interval_s=0.1,
+            health=lambda: api.health(lite=True), engine=eng)
+
+    def _until(pred, timeout_s=60):
+        deadline = time.monotonic() + timeout_s
+        while not pred() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pred()
+
+    engA, apiA, httpdA, addrA = _replica(tiny_config, params, "A")
+    engB, apiB, httpdB, addrB = _replica(tiny_config, params, "B")
+    annA = annB = conn = None
+    try:
+        # -- join: the router was started with an EMPTY replica list --
+        annA = _announce(apiA, engA, addrA)
+        _until(lambda: (st := router.tracker.get(addrA)) is not None
+               and st.admitting)
+        out = json.loads(_post(raddr, {
+            "messages": _messages("tenant-disc", "hello"),
+            "max_tokens": 2}).read())
+        assert out["choices"]
+        assert engA.stats.requests_completed == 1
+        fleet = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/fleet", timeout=10).read())
+        assert fleet["replicas"][addrA]["source"] == "announced"
+        assert fleet["replicas"][addrA]["live"] is True
+
+        # -- hot-join the second replica mid-fleet --
+        annB = _announce(apiB, engB, addrB)
+        _until(lambda: (st := router.tracker.get(addrB)) is not None
+               and st.admitting)
+
+        # -- keyed stream; kill its home; reconnect onto the survivor
+        body = {"messages": _messages("tenant-disc", "a story"),
+                "stream": True, "max_tokens": 24}
+        hdrs = {"Content-Type": "application/json",
+                "x-cake-idempotency-key": "disc-drill"}
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(), headers=hdrs)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        pre_events, cur_id = [], None
+        while len(pre_events) < 3:
+            line = resp.readline().decode()
+            if line.startswith("id: "):
+                cur_id = int(line[4:].strip())
+            elif line.startswith("data: ") and line.strip() != "data:":
+                doc = json.loads(line[6:])
+                if doc.get("choices", [{}])[0].get("delta", {}) \
+                        .get("content"):
+                    pre_events.append((cur_id, doc))
+        last_seen = max(i for i, _ in pre_events)
+        home = router.policy.sticky_home("disc-drill")
+        assert home in (addrA, addrB)
+        h_eng, h_httpd, h_ann = (engA, httpdA, annA) \
+            if home == addrA else (engB, httpdB, annB)
+        s_eng, s_api, s_addr, s_ann = (engB, apiB, addrB, annB) \
+            if home == addrA else (engA, apiA, addrA, annA)
+        # the crash: no departure notice — announce frames just STOP
+        h_ann.close(depart=False)
+        h_eng._fail_all(EngineResetError("replica killed"))
+        h_eng.stop(timeout=10)
+        h_httpd.shutdown()
+        h_httpd.server_close()
+        try:
+            resp.read()
+        except (OSError, http.client.HTTPException):
+            pass
+        conn.close()
+        conn = None
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps(body).encode(),
+                     headers={**hdrs, "Last-Event-ID": str(last_seen)})
+        resp2 = conn.getresponse()
+        assert resp2.status == 200
+        post_events = _read_sse(resp2)
+        assert _text_of(post_events)
+        assert all(i is None or i > last_seen
+                   for i, _ in post_events), post_events
+        conn.close()
+        conn = None
+        assert s_eng.stats.requests_completed >= 1
+
+        # -- the corpse is REAPED: quiet past staleness + grace, the
+        # poll fallback ejected it, discovery infers the departure --
+        _until(lambda: router.tracker.get(home) is None, timeout_s=60)
+        fleet = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/fleet", timeout=10).read())
+        assert home not in fleet["replicas"]
+        evs = json.loads(urllib.request.urlopen(
+            f"http://{raddr}/api/v1/events?type=replica_departed",
+            timeout=10).read())["events"]
+        assert any(e.get("replica") == home and e.get("inferred")
+                   for e in evs), evs
+
+        # -- explicit departure drains-then-forgets on the survivor --
+        conn = http.client.HTTPConnection(raddr, timeout=600)
+        conn.request("POST", "/api/v1/chat/completions",
+                     body=json.dumps({
+                         "messages": _messages("tenant-disc", "again"),
+                         "stream": True, "max_tokens": 24}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp3 = conn.getresponse()
+        assert resp3.status == 200
+        _until(lambda: s_eng.active >= 1)
+        base_done = s_eng.stats.requests_completed
+        assert s_ann.depart(timeout_s=5.0) is True
+        _until(lambda: (st := router.tracker.get(s_addr)) is None
+               or st.departing)
+        # ZERO new admissions after the notice: the fleet-wide refusal
+        # is a 503 with NO invented Retry-After (warm-up is over)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(raddr, {"messages": _messages("t", "x"),
+                          "max_tokens": 2})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is None
+        # ...while the in-flight stream FINISHES on the departing
+        # survivor, which is then forgotten (load drained to zero)
+        events = _read_sse(resp3)
+        assert _text_of(events)
+        assert s_eng.stats.requests_completed == base_done + 1
+        _until(lambda: router.tracker.get(s_addr) is None)
+    finally:
+        if conn is not None:
+            conn.close()
+        for a in (annA, annB):
+            if a is not None:
+                a.close(depart=True)
+        rhttpd.shutdown()
+        router.close()
+        for h in (httpdA, httpdB):
+            try:
+                h.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        for e in (engA, engB):
+            e.stop(timeout=10)

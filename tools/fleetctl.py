@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Operator view of the router's fleet (GET /api/v1/fleet).
+
+Renders the front door's per-replica discovery + placement state as a
+table: liveness, how the replica entered the fleet (static seed vs
+announce), announce age, load, the composed placement weight and WHY
+it is what it is (per-factor provenance — anomaly / headroom /
+attainment, router/discovery.py), KV-pool headroom and worst-class
+attainment.
+
+Exit status (the rc contract, mirroring tools/journal_check.py):
+    0  the fleet can serve: at least one replica is admitting
+    2  it cannot: router unreachable, malformed document, or no
+       admitting replica (empty fleet / all draining / all departed)
+
+Usage:
+    python tools/fleetctl.py http://HOST:PORT [--json] [--timeout S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _fmt_weight(entry: dict) -> str:
+    w = entry.get("weight")
+    return "-" if w is None else f"{float(w):.2f}"
+
+
+def _fmt_provenance(entry: dict) -> str:
+    facs = entry.get("weight_provenance") or {}
+    if not facs:
+        return "-"
+    return ",".join(f"{src}={facs[src].get('weight', 0):.2f}"
+                    for src in sorted(facs))
+
+
+def _fmt_headroom(entry: dict) -> str:
+    pool = entry.get("pool") or {}
+    total, free = pool.get("pages_total"), pool.get("pages_free")
+    if not total:
+        return "-"
+    return f"{free}/{total}"
+
+
+def _fmt_attainment(entry: dict) -> str:
+    att = entry.get("attainment_1m") or {}
+    vals = [v for v in att.values() if isinstance(v, (int, float))]
+    return "-" if not vals else f"{min(vals):.3f}"
+
+
+def _fmt_age(entry: dict) -> str:
+    age = entry.get("last_announce_age_s")
+    if age is None:
+        # poll-only replica (static seed that never announced)
+        age = entry.get("last_seen_age_s")
+        return "-" if age is None else f"{age:.1f}s(poll)"
+    return f"{age:.1f}s"
+
+
+def render(doc: dict, out=sys.stdout) -> int:
+    """The testable core: render one fleet document, return the rc."""
+    replicas = doc.get("replicas")
+    if not isinstance(replicas, dict):
+        print("fleetctl: malformed fleet document (no replicas map)",
+              file=sys.stderr)
+        return 2
+    cols = ("REPLICA", "LIVE", "SOURCE", "ADMIT", "LOAD", "WEIGHT",
+            "PROVENANCE", "POOL", "ATTAIN-1M", "ANNOUNCE-AGE")
+    rows = []
+    admitting = 0
+    for name in sorted(replicas):
+        e = replicas[name]
+        if not isinstance(e, dict):
+            continue
+        admit = bool(e.get("admitting"))
+        admitting += admit
+        state = ("departing" if e.get("departing")
+                 else "draining" if e.get("draining")
+                 else "yes" if admit else "no")
+        rows.append((name,
+                     "up" if e.get("live") else "DOWN",
+                     str(e.get("source") or "-"),
+                     state,
+                     str(e.get("load", "-")),
+                     _fmt_weight(e),
+                     _fmt_provenance(e),
+                     _fmt_headroom(e),
+                     _fmt_attainment(e),
+                     _fmt_age(e)))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)),
+          file=out)
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)),
+              file=out)
+    note = doc.get("note")
+    if note:
+        print(f"note: {note}", file=out)
+    if not rows:
+        print("fleetctl: fleet is empty (no replica has registered "
+              "or been seeded)", file=sys.stderr)
+        return 2
+    if not admitting:
+        print("fleetctl: no replica is admitting — the fleet cannot "
+              "serve new work", file=sys.stderr)
+        return 2
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetctl", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("router", help="router base URL (http://host:port)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump the raw fleet document instead of the "
+                         "table (same rc contract)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    url = args.router.rstrip("/") + "/api/v1/fleet"
+    if "://" not in url:
+        url = "http://" + url
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            doc = json.loads(resp.read())
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        print(f"fleetctl: cannot read {url}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(doc, dict):
+        print("fleetctl: malformed fleet document", file=sys.stderr)
+        return 2
+    if args.as_json:
+        rc = render(doc, out=io.StringIO())
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return rc
+    return render(doc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,9 @@ never ship):
     series per distinct label set, not per bucket) — an unbounded
     label (a rid, a raw URL, a user id) grows the scrape without limit
     and this catches it before production does;
-  * ``host``-labeled (federated, obs/federation.py) families may carry
+  * ``host``-labeled (federated, obs/federation.py) and
+    ``replica``-labeled (the router's announce listener,
+    router/discovery.py) families may carry
     at most ``--host-cap`` distinct host values (default 64, matching
     the collector's max_hosts default): the host dimension is bounded
     by TOPOLOGY size, not traffic — more values means something is
@@ -103,6 +105,14 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # failovers, replica-state gauge, proxy TTFT,
                        # traced hop latency
                        "cake_router_",
+                       # fleet discovery at the front door
+                       # (router/discovery.py): announce frames /
+                       # departures per replica plus the fleet-size /
+                       # composed-weight / staleness gauges. Already
+                       # inside cake_router_, listed explicitly so the
+                       # discovery surface stays documented even if
+                       # the umbrella prefix is ever narrowed.
+                       "cake_router_fleet_", "cake_router_announce_",
                        # online regression sentinel (obs/sentinel.py):
                        # per-kind anomaly firings + active gauge —
                        # cake_anomaly_ also covers the closed-loop
@@ -200,8 +210,10 @@ def lint(text: str,
     # family -> distinct label sets (minus le) — the live-series count
     # behind the cardinality cap
     live_series: Dict[str, set] = {}
-    # family -> distinct `host` label values (federated families must
-    # stay topology-sized)
+    # family -> distinct `host`/`replica` label values (federated
+    # families must stay topology-sized; the router's announce
+    # listener re-labels federated series `replica` —
+    # router/discovery.py — so both spellings share the cap)
     host_values: Dict[str, set] = {}
 
     for ln, line in enumerate(text.splitlines(), 1):
@@ -275,7 +287,7 @@ def lint(text: str,
         live_series.setdefault(fam, set()).add(
             tuple(sorted((k, v) for k, v in pairs if k != "le")))
         for k, v in pairs:
-            if k == "host":
+            if k in ("host", "replica"):
                 host_values.setdefault(fam, set()).add(v)
 
         if typ == "counter":
@@ -344,9 +356,9 @@ def lint(text: str,
             if len(vals) > host_cap:
                 errors.append(
                     f"{fam}: {len(vals)} distinct host label values "
-                    f"exceeds the topology-size cap {host_cap} — "
-                    "federated families carry one value per fleet "
-                    "host; something is inventing host names")
+                    f"(host/replica) exceeds the topology-size cap "
+                    f"{host_cap} — federated families carry one value "
+                    "per fleet host; something is inventing host names")
     return errors
 
 
