@@ -185,6 +185,21 @@ KV_TIER_TIERS = {
                       host_pages=8, wave=24),
 }
 
+# Disaggregated prefill/decode tiers (bench.py --disagg): the same
+# offered load served colocated and then split across a prefill engine
+# + decode engine pair wired over loopback (cake_tpu/kv/transfer.py),
+# at f32 and int8 KV. The contracts this tier exists for: the
+# disaggregated greedy streams are TOKEN-IDENTICAL to colocated at f32
+# KV, pages actually ship (pages_shipped > 0), and an int8 shipment
+# moves ~4x fewer bytes than f32 for the same prefix (the
+# serving-economics reason to quantize the transfer unit).
+DISAGG_TIERS = {
+    "disagg_8b_int8": dict(model="8b", quant="int8", max_seq=1024,
+                           slots=8, kv_pages=512, kv_page_size=128,
+                           paged_attn="pallas", prompt_len=512,
+                           gen_tokens=64, wave=12),
+}
+
 # SLO scheduling tiers (bench.py --slo): a mixed-priority saturation
 # run through a --priority-classes engine, measured TWICE — preemption
 # off then on, same offered load — reporting per-class TTFT p50/p99
@@ -326,6 +341,14 @@ SMOKE_TIERS = {
                         kv_page_size=16, paged_attn="fold",
                         prompt_len=24, gen_tokens=8, prefix_tokens=32,
                         host_pages=6, wave=18),
+    # f32-vs-int8 phases are built inside run_disagg_tier itself (the
+    # byte-ratio headline needs both pools over the same loopback
+    # channel); 4-slot engines + a 4-request wave keep the CPU smoke
+    # under a minute while still overlapping shipments in flight.
+    # 60-token streams on 16-token pages = 4 shipped pages/request
+    "disagg_tiny": dict(model="tiny", quant=False, max_seq=128, slots=4,
+                        kv_pages=48, kv_page_size=16, paged_attn="fold",
+                        prompt_len=48, gen_tokens=12, wave=4),
     "mixed_tiny": dict(model="tiny", quant=False, max_seq=128, slots=3,
                        kv_pages=24, kv_page_size=16, paged_attn="fold",
                        prompt_len=24, prefill_chunk=8, base_gen=64,
@@ -1079,6 +1102,156 @@ def run_kv_tier(name: str, model: str, quant, max_seq: int, slots: int,
         "kv_resident_spills_int8": q8["resident_spills"],
         "kv_resident_spills_f32": f32["resident_spills"],
         "kv_host_pages": host_pages,
+        "device_kind": dev.device_kind,
+    }
+
+
+def run_disagg_tier(name: str, model: str, quant, max_seq: int,
+                    slots: int, kv_pages: int, kv_page_size: int,
+                    paged_attn: str, prompt_len: int, gen_tokens: int,
+                    wave: int) -> dict:
+    """Disaggregated prefill/decode (cake_tpu/kv/transfer.py): the
+    same offered load served three ways — colocated at f32 KV, then
+    split across a prefill engine + decode engine pair over loopback
+    at f32, then the same split at int8. The decode host is the front
+    door in both split phases: submit defers scheduler entry, the
+    prefill peer runs the prompt and ships pool pages + the first
+    token, and the decode host adopts them token-identically (the f32
+    phase ASSERTS identity against colocated — the handoff contract,
+    not a throughput estimate). Reports decode tok/s and arrival TTFT
+    p50/p99 per phase (disagg TTFT includes the ship round trip),
+    pages/bytes shipped, and the headline: the int8/f32 ship-bytes
+    ratio for the same prefix — quantized pages cross the wire at the
+    pool's storage dtype, so ~4x fewer bytes buy the same decode."""
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    V = cfg.vocab_size - 4
+    prompt = partial(_synth_prompt, prompt_len=prompt_len, vocab=V)
+    token = "bench-disagg-loopback"
+
+    def build(kv_dtype: str, **disagg_kw) -> InferenceEngine:
+        return InferenceEngine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            max_slots=slots, max_seq_len=max_seq,
+            sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            kv_pages=kv_pages, kv_page_size=kv_page_size,
+            paged_attn=paged_attn, kv_dtype=kv_dtype, **disagg_kw)
+
+    def drive(engine: InferenceEngine, label: str) -> dict:
+        t0 = time.perf_counter()
+        warm = engine.submit(prompt(99), max_new_tokens=4)
+        assert warm.wait(timeout=900), f"{label} warmup timed out"
+        log(f"{label} warmup (compile): {time.perf_counter() - t0:.1f}s")
+        _settle_decode_stats(engine, 0.0)
+        base_tokens = engine.stats.tokens_generated
+        base_decode = engine.stats.decode_time_s
+        handles = [engine.submit(prompt(i), max_new_tokens=gen_tokens)
+                   for i in range(wave)]
+        assert all(h.wait(timeout=900) for h in handles), \
+            f"{label} wave timed out"
+        _settle_decode_stats(engine, base_decode)
+        ttfts = sorted(h.ttft * 1000.0 for h in handles)
+        tokens = engine.stats.tokens_generated - base_tokens
+        decode_s = engine.stats.decode_time_s - base_decode
+        return {
+            "tok_s": tokens / decode_s if decode_s > 0 else 0.0,
+            "ttft_p50_ms": _pct(ttfts, 0.50),
+            "ttft_p99_ms": _pct(ttfts, 0.99),
+            "streams": [h.token_ids for h in handles],
+        }
+
+    def colocated() -> dict:
+        engine = build("f32")
+        with engine:
+            out = drive(engine, "colocated[f32]")
+        log(f"colocated[f32]: {out['tok_s']:.1f} tok/s, TTFT p50 "
+            f"{out['ttft_p50_ms']:.0f}ms p99 {out['ttft_p99_ms']:.0f}ms")
+        return out
+
+    def disagg(kv_dtype: str) -> dict:
+        # prefill engine binds port 0; the decode engine dials the real
+        # port. The channel token rides the engine kwarg (no env var
+        # needed in-process), and the long adopt timeout absorbs the
+        # peer's first-prefill compile on cold CPU backends
+        pre = build(kv_dtype, disagg="prefill",
+                    disagg_peer="127.0.0.1:0", disagg_token=token)
+        pre.start()
+        try:
+            dec = build(kv_dtype, disagg="decode",
+                        disagg_peer=f"127.0.0.1:{pre._disagg.port}",
+                        disagg_token=token, disagg_timeout_s=600.0)
+            dec.start()
+            try:
+                assert dec._disagg._connected.wait(30), \
+                    f"disagg[{kv_dtype}] channel never connected"
+                out = drive(dec, f"disagg[{kv_dtype}]")
+                out.update(
+                    pages_shipped=pre._disagg.stats["pages"],
+                    ship_bytes=pre._disagg.stats["bytes"],
+                    shipments=pre._disagg.stats["shipments"],
+                    adopted=dec.stats.kv_adopts,
+                    degraded=dec._disagg.stats["degraded"],
+                )
+            finally:
+                dec.stop()
+        finally:
+            pre.stop()
+        log(f"disagg[{kv_dtype}]: {out['tok_s']:.1f} tok/s, TTFT p50 "
+            f"{out['ttft_p50_ms']:.0f}ms p99 {out['ttft_p99_ms']:.0f}ms, "
+            f"{out['pages_shipped']} pages / {out['ship_bytes']} B "
+            f"shipped in {out['shipments']} shipments, "
+            f"{out['adopted']} adopted, {out['degraded']} degraded")
+        return out
+
+    base = colocated()
+    d32 = disagg("f32")
+    # the handoff contract: greedy decode-host streams at f32 KV are
+    # token-identical to colocated — the shipped pages ARE the prefill
+    assert d32["streams"] == base["streams"], \
+        "disagg f32 streams diverged from colocated"
+    q8 = disagg("int8")
+    ratio = (q8["ship_bytes"] / d32["ship_bytes"]
+             if d32["ship_bytes"] else 0.0)
+    log(f"disagg shipping: int8 {q8['ship_bytes']} B vs f32 "
+        f"{d32['ship_bytes']} B for the same prefix -> {ratio:.3f}x")
+    return {
+        "metric": f"{name}_disagg_ship_bytes_ratio_int8",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "paged_attn": paged_attn,
+        "disagg_token_identical_f32": d32["streams"] == base["streams"],
+        "disagg_pages_shipped_f32": d32["pages_shipped"],
+        "disagg_pages_shipped_int8": q8["pages_shipped"],
+        "disagg_ship_bytes_f32": d32["ship_bytes"],
+        "disagg_ship_bytes_int8": q8["ship_bytes"],
+        "disagg_shipments_f32": d32["shipments"],
+        "disagg_shipments_int8": q8["shipments"],
+        "disagg_adopted_f32": d32["adopted"],
+        "disagg_adopted_int8": q8["adopted"],
+        "disagg_degraded_f32": d32["degraded"],
+        "disagg_degraded_int8": q8["degraded"],
+        "disagg_tok_s_colocated_f32": round(base["tok_s"], 2),
+        "disagg_tok_s_f32": round(d32["tok_s"], 2),
+        "disagg_tok_s_int8": round(q8["tok_s"], 2),
+        "disagg_ttft_p50_ms_colocated_f32": round(base["ttft_p50_ms"], 1),
+        "disagg_ttft_p50_ms_f32": round(d32["ttft_p50_ms"], 1),
+        "disagg_ttft_p50_ms_int8": round(q8["ttft_p50_ms"], 1),
+        "disagg_ttft_p99_ms_colocated_f32": round(base["ttft_p99_ms"], 1),
+        "disagg_ttft_p99_ms_f32": round(d32["ttft_p99_ms"], 1),
+        "disagg_ttft_p99_ms_int8": round(q8["ttft_p99_ms"], 1),
         "device_kind": dev.device_kind,
     }
 
@@ -2632,6 +2805,9 @@ def tier_main():
     elif name in KV_TIER_TIERS or name.startswith("kvtier"):
         kwargs = {**KV_TIER_TIERS, **SMOKE_TIERS}[name]
         result = run_kv_tier(name, **kwargs)
+    elif name in DISAGG_TIERS or name.startswith("disagg"):
+        kwargs = {**DISAGG_TIERS, **SMOKE_TIERS}[name]
+        result = run_disagg_tier(name, **kwargs)
     elif name in MIXED_TIERS or name.startswith("mixed_"):
         kwargs = {**MIXED_TIERS, **SMOKE_TIERS}[name]
         result = run_mixed_tier(name, **kwargs)
@@ -2844,6 +3020,18 @@ def _kv_tier_main() -> int:
         fail_error="kv tiering tier failed")
 
 
+def _disagg_main() -> int:
+    """`bench.py --disagg`: the disaggregated prefill/decode A/B — one
+    JSON line with colocated vs split-over-loopback decode tok/s and
+    arrival TTFT p50/p99, pages/bytes shipped per KV dtype, and an
+    f32 token-identity flag, headline value the int8/f32 ship-bytes
+    ratio. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "disagg_ship_bytes_ratio_int8", "x",
+        cpu_tier="disagg_tiny", tpu_tier="disagg_8b_int8",
+        fail_error="disaggregated prefill/decode tier failed")
+
+
 def _restart_main() -> int:
     """`bench.py --restart`: the durable-serving crash drill — one
     JSON line with RTO (recovery wall seconds after a staged kill -9),
@@ -3027,6 +3215,8 @@ if __name__ == "__main__":
         tier_main()
     elif "--kv-tier" in sys.argv:
         sys.exit(_kv_tier_main())
+    elif "--disagg" in sys.argv:
+        sys.exit(_disagg_main())
     elif "--mixed" in sys.argv:
         sys.exit(_mixed_main())
     elif "--autotune" in sys.argv:

@@ -387,6 +387,25 @@ class Args:
     # history, metrics snapshot, journal tail) here;
     # tools/postmortem.py renders a bundle into a wall-clock narrative
     postmortem_dir: Optional[str] = None
+    # --disagg {prefill,decode}: disaggregated prefill/decode serving
+    # (cake_tpu/kv/transfer.py) — this engine takes ONE phase of the
+    # pair. "decode" is the front door: it forwards each admission's
+    # prompt to the prefill peer, installs the shipped KV pages via
+    # the refcounted allocator and serves SSE from the first decoded
+    # token; "prefill" admits forwarded prompts, runs chunked prefill
+    # into pool pages and ships the pages + a handoff record. Requires
+    # --kv-pages (pages are the transfer unit) and the shared channel
+    # token in $CAKE_DISAGG_TOKEN on both engines. Any channel failure
+    # degrades the decode host to whole-prompt local prefill — never a
+    # wedged stream.
+    disagg: Optional[str] = None
+    # --disagg-peer host:port: the transfer channel address — the
+    # PREFILL engine binds it (port 0 = ephemeral), the DECODE engine
+    # connects to it (retrying with backoff, so start order is free)
+    disagg_peer: Optional[str] = None
+    # --disagg-timeout S: decode-host wait per forwarded prefill
+    # before degrading that request to local prefill
+    disagg_timeout: float = 30.0
 
     def validate(self) -> "Args":
         if self.dtype not in ("f16", "bf16", "f32"):
@@ -518,6 +537,38 @@ class Args:
                     "replicas self-register)")
             if self.replicas:
                 parse_replicas(self.replicas)
+        if self.disagg is not None:
+            if self.disagg not in ("prefill", "decode"):
+                raise ValueError(
+                    f"unsupported disagg '{self.disagg}' (choose "
+                    "prefill or decode)")
+            if not self.kv_pages:
+                raise ValueError(
+                    "--disagg requires --kv-pages: KV pool pages are "
+                    "the transfer unit (cake_tpu/kv/transfer.py)")
+            if not self.disagg_peer:
+                raise ValueError(
+                    "--disagg requires --disagg-peer host:port (the "
+                    "prefill engine binds it; the decode engine "
+                    "connects to it)")
+            host, sep, port = self.disagg_peer.rpartition(":")
+            if not sep or not host or not port.isdigit() \
+                    or not 0 <= int(port) <= 65535:
+                raise ValueError(
+                    f"--disagg-peer {self.disagg_peer!r} must be "
+                    "host:port (port 0 binds ephemeral on the prefill "
+                    "role)")
+            import os as _os
+            if not _os.environ.get("CAKE_DISAGG_TOKEN"):
+                # loud NOW, not a dead channel after the model loaded
+                # (the $CAKE_ANNOUNCE_TOKEN discipline)
+                raise ValueError(
+                    "--disagg needs the shared channel token in "
+                    "$CAKE_DISAGG_TOKEN on both engines")
+        if not self.disagg_timeout > 0:
+            raise ValueError(
+                f"--disagg-timeout {self.disagg_timeout} must be > 0 "
+                "seconds")
         if self.mode not in ("master", "worker"):
             raise ValueError(f"unsupported mode '{self.mode}'")
         for knob in ("tp", "dp", "sp", "microbatches", "batch_size",

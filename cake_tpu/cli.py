@@ -586,6 +586,16 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "--journal applies to engine serving (--api); one-shot "
             "generation journals nothing and replays nothing")
+    if getattr(args, "disagg", None):
+        # the prefill/decode split is a pair of SERVING engines wired
+        # by the transfer channel; a one-shot generation has neither —
+        # warn AND clear so Master.from_args does not bind/dial a
+        # channel no request will ever cross
+        logging.getLogger(__name__).warning(
+            "--disagg applies to engine serving (--api): a one-shot "
+            "generation has no peer to ship KV pages to "
+            "(cake_tpu/kv/transfer.py); ignoring it")
+        args.disagg = None
 
     if args.model_type.value == "image":
         count = [0]

@@ -56,6 +56,8 @@ SITES = frozenset({
     "journal.append",     # write-ahead journal record append
     "journal.fsync",      # journal durability barrier (fsync)
     "journal.replay",     # startup journal replay (serve/journal.py)
+    "kv.ship",            # disagg prefill host: page-shipment capture
+    "kv.adopt",           # disagg decode host: shipped-page adoption
 })
 
 TRIGGERS = ("nth", "step", "p", "always")

@@ -164,6 +164,11 @@ class Master:
                             "custom step fns own their cache contract; "
                             "only the built-in dense/paged engines can "
                             "hot-switch configs")
+            if getattr(self.args, "disagg", None):
+                log.warning("--disagg ignored: disaggregated "
+                            "prefill/decode ships paged pool pages "
+                            "(cake_tpu/kv/transfer.py), and the sp "
+                            "engine's ctx/tail cache is not paged")
             log.info("sp engine: %d slots, ctx window %d + decode tail "
                      "%d", slots, ctx_len, tail_len)
             return InferenceEngine(
@@ -248,6 +253,12 @@ class Master:
             # fold (ring/custom step fns)
             autotune=getattr(self.args, "autotune", "off"),
             autotune_policy=getattr(self.args, "autotune_policy", None),
+            # disaggregated prefill/decode (cake_tpu/kv/transfer.py):
+            # role + channel peer; the shared token rides
+            # $CAKE_DISAGG_TOKEN (validated loudly at startup)
+            disagg=getattr(self.args, "disagg", None),
+            disagg_peer=getattr(self.args, "disagg_peer", None),
+            disagg_timeout_s=getattr(self.args, "disagg_timeout", 30.0),
             **self._trace_kwargs(),
             **self._sched_kwargs(),
             **self._fault_kwargs(),

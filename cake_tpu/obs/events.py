@@ -88,6 +88,12 @@ EVENT_TYPES = (
     # announce stream went quiet and placement fell back to the poll
     # path (replica_stale)
     "replica_joined", "replica_departed", "replica_stale",
+    # disaggregated prefill/decode (cake_tpu/kv/transfer.py): a
+    # prefill host shipped a prefix's pool pages (kv_shipped), the
+    # decode host adopted them into its own pool (kv_adopted), or the
+    # shipment failed/expired and the request degraded to whole-prompt
+    # prefill on the decode host (kv_ship_degraded)
+    "kv_shipped", "kv_adopted", "kv_ship_degraded",
 )
 
 EVENTS_TOTAL = _m.counter(

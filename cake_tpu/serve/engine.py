@@ -26,6 +26,7 @@ tests/test_engine.py against the sequential generator).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -195,6 +196,13 @@ class _Request:
     # _alloc_slot_pages when the slot's KV was restored from host RAM
     # — the admission path then skips the recompute prefill entirely
     _kv_restored: bool = False
+    # disaggregated serving (cake_tpu/kv/transfer.py): on the PREFILL
+    # host, the callback handed the captured page shipment at
+    # retirement; on the DECODE host, True while the admission is
+    # parked awaiting the peer's shipment (disagg_complete enters it
+    # into the scheduler)
+    ship_sink: Optional[Callable] = None
+    _disagg_pending: bool = False
     submit_t: float = 0.0
     first_token_t: float = 0.0
     finish_t: float = 0.0
@@ -211,6 +219,12 @@ class RequestHandle:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._req.done.wait(timeout)
+
+    def finished(self) -> bool:
+        """True once the request retired (tokens final or error set) —
+        non-blocking; the disagg prefill plane's writer uses this to
+        spot admissions that died before capturing a shipment."""
+        return self._req.done.is_set()
 
     @property
     def token_ids(self) -> List[int]:
@@ -276,6 +290,11 @@ class EngineStats:
     kv_spills: int = 0
     kv_restores: int = 0
     kv_resident_spills: int = 0
+    # disaggregated serving (cake_tpu/kv/transfer.py): shipments
+    # captured on the prefill host / shipped prefills adopted on the
+    # decode host (the wire counters are cake_kv_ship_total et al.)
+    kv_ships: int = 0
+    kv_adopts: int = 0
     # crash recovery (cake_tpu/faults): successful reset+resubmit
     # cycles, requests carried across them, and requests quarantined
     # as poison so the rest of their batch could recover
@@ -336,6 +355,10 @@ class InferenceEngine:
         # handler<->engine mailboxes: strictly lock-guarded
         "_cancel_q": "_rid_lock",
         "_cmd_q": "_rid_lock",
+        # disaggregated serving: shipments staged by the decode plane's
+        # channel thread (disagg_complete) for the engine thread's
+        # adoption in _do_prefill/_mixed_admit
+        "_adopt_store": "_rid_lock",
     }
     HANDLER_THREAD_METHODS = (
         "submit", "chat", "cancel", "stop", "begin_drain",
@@ -345,6 +368,7 @@ class InferenceEngine:
         "reconfigure", "request_timeline", "recovery_state",
         "autotune_state", "current_config", "_set_queue_gauges",
         "shutdown_save", "_snapshot_before_fail", "_fail_all",
+        "disagg_complete",
     )
     # optional subsystems (None = disabled plane): every dotted use
     # must sit under an `is not None` guard so a disabled plane costs
@@ -353,7 +377,7 @@ class InferenceEngine:
     OPTIONAL_PLANES = ("_faults", "events", "_journal", "_shed",
                        "_control", "_host_tier", "_autotuner",
                        "telemetry", "sentinel", "_actions",
-                       "_postmortem")
+                       "_postmortem", "_disagg")
     # the only legal nesting order; _rid_lock sits on the submit/emit
     # hot path, so nothing may block under it
     LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
@@ -413,6 +437,10 @@ class InferenceEngine:
         sentinel_interval: float = 2.0,
         sentinel_act: bool = False,
         postmortem_dir: Optional[str] = None,
+        disagg: Optional[str] = None,
+        disagg_peer: Optional[str] = None,
+        disagg_token: Optional[str] = None,
+        disagg_timeout_s: float = 30.0,
     ):
         self.config = config
         self.params = params
@@ -959,6 +987,32 @@ class InferenceEngine:
             from cake_tpu.obs.actions import PostmortemSink
             self._postmortem = PostmortemSink(postmortem_dir)
 
+        # disaggregated prefill/decode (--disagg, kv/transfer.py): one
+        # engine runs prefill-only and ships pool pages; the other is
+        # the front door, adopting shipped prefills into its own pool.
+        # _adopt_store stages reassembled shipments (channel thread ->
+        # engine thread) keyed by rid; it exists even without the plane
+        # so the adoption peeks stay branch-free.
+        self._adopt_store = {}
+        self._disagg = None
+        if disagg is not None:
+            if not self.paged:
+                raise ValueError(
+                    "--disagg requires the paged KV pool (--kv-pages): "
+                    "pages are the transfer unit")
+            if not disagg_peer:
+                raise ValueError(
+                    "--disagg requires --disagg-peer host:port (the "
+                    "prefill engine binds it; the decode engine "
+                    "connects to it)")
+            from cake_tpu.kv.transfer import build_disagg_plane
+            token = disagg_token or os.environ.get(
+                "CAKE_DISAGG_TOKEN", "")
+            self._disagg = build_disagg_plane(
+                self, disagg, disagg_peer, token, events=self.events,
+                timeout_s=disagg_timeout_s)
+            log.info("disagg: %s role, peer %s", disagg, disagg_peer)
+
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "InferenceEngine":
@@ -970,11 +1024,17 @@ class InferenceEngine:
             self._thread.start()
             if self.sentinel is not None:
                 self.sentinel.start()
+            if self._disagg is not None:
+                self._disagg.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
         if self.sentinel is not None:
             self.sentinel.close()
+        if self._disagg is not None:
+            # first: a decode plane degrades its in-flight shipments to
+            # local prefill while the engine thread can still run them
+            self._disagg.stop()
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
@@ -1167,6 +1227,7 @@ class InferenceEngine:
         idempotency_key: Optional[str] = None,
         replay_tokens: Optional[Sequence[int]] = None,
         trace_id: Optional[str] = None,
+        ship_sink: Optional[Callable] = None,
     ) -> RequestHandle:
         """Queue one generation. stream(text_delta, is_final) is called from
         the engine thread as tokens finalize; a callback with attribute
@@ -1253,6 +1314,7 @@ class InferenceEngine:
             priority=cls,
             idempotency_key=idempotency_key,
             replayed_tokens=replayed,
+            ship_sink=ship_sink,
         )
         # admission critical section: a LIVE config switch
         # (_reconfigure_sync) replaces the pool/pager/scheduler on the
@@ -1334,28 +1396,83 @@ class InferenceEngine:
             self.tracer.admit(rid, len(ids), max_new, priority=cls,
                               config_epoch=self.config_epoch,
                               trace=trace_id)
-            ok = (self.scheduler.submit(rid, len(ids), max_new,
-                                        priority=cls)
-                  if self._slo else
-                  self.scheduler.submit(rid, len(ids), max_new))
-            if not ok:
-                self._requests.pop(rid, None)
-                self.tracer.drop(rid)
-                if self._journal is not None:
-                    # the admit was journaled write-ahead; the refused
-                    # admission must not replay after a restart
-                    self._journal.note_retire(rid, "cancelled")
-                retry = 1.0
-                if self._shed is not None:
-                    retry = self._shed.estimate_retry_after(
-                        cls, self.scheduler.queue_depth)
-                raise QueueFullError(retry_after=retry)
+            if (self._disagg is not None
+                    and self._disagg.role == "decode"
+                    and replay_tokens is None and not want_top_logprobs
+                    and self._disagg.request_prefill(req)):
+                # disaggregated front door: the admission is held OUT
+                # of the scheduler while the prefill peer computes its
+                # pages — disagg_complete enters it (with the shipment
+                # to adopt, or without one after any channel failure).
+                # Replays and top-logprob requests stay local: a replay
+                # suffix already holds generated tokens, and the
+                # shipped first token carries no top-N alternatives.
+                # request_prefill == False means the channel is down —
+                # fall through to the local path, same as colocated.
+                req._disagg_pending = True
+            else:
+                ok = (self.scheduler.submit(rid, len(ids), max_new,
+                                            priority=cls)
+                      if self._slo else
+                      self.scheduler.submit(rid, len(ids), max_new))
+                if not ok:
+                    self._requests.pop(rid, None)
+                    self.tracer.drop(rid)
+                    if self._journal is not None:
+                        # the admit was journaled write-ahead; the
+                        # refused admission must not replay after a
+                        # restart
+                        self._journal.note_retire(rid, "cancelled")
+                    retry = 1.0
+                    if self._shed is not None:
+                        retry = self._shed.estimate_retry_after(
+                            cls, self.scheduler.queue_depth)
+                    raise QueueFullError(retry_after=retry)
             if idempotency_key is not None:
                 with self._rid_lock:
                     self._idem_live[idempotency_key] = rid
         self._set_queue_gauges()
         self._wake.set()
         return RequestHandle(req, self.tokenizer, self.config.eos_token_ids)
+
+    # -- disaggregated serving (cake_tpu/kv/transfer.py) -------------------
+
+    def disagg_complete(self, rid: int, shipment) -> None:
+        """Decode-plane channel thread: the peer's answer for a
+        deferred admission arrived — a reassembled Shipment to adopt,
+        or None (peer down / timeout / refused / corrupt), which means
+        whole-prompt prefill locally. Either way the request NOW
+        enters the scheduler; adoption itself happens on the engine
+        thread when _do_prefill/_mixed_admit reach the rid."""
+        with self._switch_lock:
+            req = self._requests.get(rid)
+            if req is None or not req._disagg_pending:
+                return   # cancelled / failed while the shipment flew
+            req._disagg_pending = False
+            if shipment is not None:
+                with self._rid_lock:
+                    self._adopt_store[rid] = shipment
+            ids, max_new = req.prompt_ids, req.max_new_tokens
+            ok = (self.scheduler.submit(rid, len(ids), max_new,
+                                        priority=req.priority)
+                  if self._slo else
+                  self.scheduler.submit(rid, len(ids), max_new))
+            if not ok:
+                # mirror submit's refusal compensation — the deferred
+                # admission was already registered/journaled, so the
+                # late refusal must finish the handle with the same
+                # retryable error a synchronous refusal raises
+                self._requests.pop(rid, None)
+                with self._rid_lock:
+                    self._adopt_store.pop(rid, None)
+                self.tracer.drop(rid)
+                if self._journal is not None:
+                    self._journal.note_retire(rid, "cancelled")
+                req.error = QueueFullError(retry_after=1.0)
+                req.done.set()
+                return
+        self._set_queue_gauges()
+        self._wake.set()
 
     # -- durable serving: idempotency, drain, journal seams --------------
 
@@ -1899,6 +2016,10 @@ class InferenceEngine:
             if req is None:
                 continue
             self.scheduler.cancel(rid)
+            with self._rid_lock:
+                # a shipment staged for a cancelled admission must not
+                # outlive it in the adoption store
+                self._adopt_store.pop(rid, None)
             if self._host_tier is not None:
                 # a victim cancelled while parked leaves its spilled
                 # pages orphaned in the LRU — drop them now
@@ -3153,6 +3274,10 @@ class InferenceEngine:
                 self._prefixes.clear()
                 self._auto_pids.clear()
             self._prefix_last_hit = {}
+            with self._rid_lock:
+                # staged shipments referenced the failed requests'
+                # admissions; post-reset resubmits prefill locally
+                self._adopt_store.clear()
             if self._host_tier is not None:
                 # spilled victims/prefixes belonged to the failed
                 # requests / cleared registry — stale shortcuts only
@@ -3511,6 +3636,123 @@ class InferenceEngine:
         log.debug("restored rid=%d from the host tier (%d pages, "
                   "pos %d)", req.rid, ent.n_pages, ent.pos)
 
+    def _capture_shipment(self, req: _Request) -> None:
+        """Disaggregated PREFILL host (engine thread, inside _emit's
+        retirement, before _release_slot_pages frees the row): fetch
+        the pages holding the prompt's KV — raw pool slices, scale
+        sidecars included, dtype-blind — and hand a Shipment to the
+        request's ship_sink. Failure hands None: the decode peer
+        degrades to local prefill, so this must never raise."""
+        from cake_tpu.kv.host_tier import HostTier, pool_dtype_name
+        from cake_tpu.kv.transfer import Shipment
+        ship = None
+        try:
+            if self._faults is not None:
+                # inside the try: an injected ship fault degrades to
+                # the peer's local prefill, like a real fetch failure
+                self._faults.check("kv.ship", step=self.stats.steps)
+            if not self.paged or not req.out_tokens:
+                raise ValueError("nothing to ship (unpaged or no "
+                                 "first token)")
+            row = self._slot_pages.get(req.slot) or []
+            P = self._pager.page_size
+            n_tokens = len(req.prompt_ids)
+            n_written = -(-n_tokens // P)
+            if n_written > len(row):
+                raise ValueError(
+                    f"slot row holds {len(row)} pages; prompt needs "
+                    f"{n_written}")
+            pages = row[:n_written]
+            ship = Shipment(
+                epoch=0,   # stamped by the plane with the PEER's epoch
+                dtype=pool_dtype_name(self.cache),
+                page_size=P, n_tokens=n_tokens, n_written=n_written,
+                first_tok=int(req.out_tokens[0]), pages=list(pages),
+                arrays=HostTier.fetch_pages(self.cache, pages),
+                handoff={
+                    # the journal admit/emit schema's fields — what the
+                    # decode host needs to adopt the stream
+                    "rid": req.rid, "prompt_len": n_tokens,
+                    "max_new_tokens": req.max_new_tokens,
+                    "temperature": req.temperature,
+                    "top_p": req.top_p,
+                    "repeat_penalty": req.repeat_penalty,
+                    "priority": req.priority,
+                    "first_lp": float(req.out_logprobs[0])
+                    if req.out_logprobs else 0.0,
+                })
+            self.stats.kv_ships += 1
+            self.tracer.span(req.rid, "kv_shipped", pages=n_written)
+        except Exception:  # noqa: BLE001 — shipping is best-effort
+            log.exception("kv shipment capture failed rid=%d; peer "
+                          "will prefill locally", req.rid)
+            ship = None
+        try:
+            req.ship_sink(ship)
+        except Exception:  # noqa: BLE001 — never raise into _emit
+            log.exception("ship_sink failed rid=%d", req.rid)
+
+    def _adopt_install(self, req: _Request, slot: int, ent) -> bool:
+        """Disaggregated DECODE host (engine thread, from _do_prefill/
+        _mixed_admit after the row is allocated): install the shipped
+        pages into the slot's freshly-mapped row and resume the stream
+        at the shipped frontier — mirrors _restore_victim, with the
+        peer-sampled first token emitted verbatim. False = refused
+        (stale epoch, geometry drift, injected fault): the caller
+        falls through to whole-prompt local prefill, which rewrites
+        the row's pages and scales — the documented degradation."""
+        from cake_tpu.kv.host_tier import HostTier, pool_dtype_name
+        from cake_tpu.kv.transfer import note_adopt
+        outcome = "fault"
+        try:
+            if self._faults is not None:
+                self._faults.check("kv.adopt", step=self.stats.steps)
+            if ent.epoch != self.config_epoch:
+                outcome = "epoch"
+                raise ValueError(
+                    f"shipment config epoch {ent.epoch} != engine "
+                    f"epoch {self.config_epoch} (reconfigured while "
+                    "the shipment flew)")
+            pool_dt = pool_dtype_name(self.cache)
+            row = self._slot_pages.get(slot) or []
+            if (ent.page_size != self._pager.page_size
+                    or ent.dtype != pool_dt
+                    or ent.n_tokens != len(req.prompt_ids)
+                    or ent.n_written > len(row)):
+                outcome = "geometry"
+                raise ValueError(
+                    f"shipment geometry (page_size={ent.page_size}, "
+                    f"dtype={ent.dtype}, n_tokens={ent.n_tokens}, "
+                    f"n_written={ent.n_written}) does not fit this "
+                    f"pool (page_size={self._pager.page_size}, "
+                    f"dtype={pool_dt}, row={len(row)} pages)")
+            self.cache = HostTier.install_pages(
+                self.cache, row[:ent.n_written], ent.arrays)
+        except Exception:  # noqa: BLE001 — adoption is best-effort
+            note_adopt(outcome)
+            log.exception("kv adoption refused rid=%d; degrading to "
+                          "local prefill", req.rid)
+            return False
+        self._temp[slot] = req.temperature
+        self._top_p[slot] = req.top_p
+        self._penalty[slot] = req.repeat_penalty
+        self._prime_ring(slot, list(req.prime_tokens)
+                         + [ent.first_tok])
+        self._pos[slot] = ent.n_tokens
+        self._last_tok[slot] = ent.first_tok
+        self.stats.kv_adopts += 1
+        note_adopt("adopted")
+        self.tracer.span(req.rid, "kv_adopted", pages=ent.n_written)
+        if self.events is not None:
+            self.events.publish("kv_adopted", rid=req.rid,
+                                pages=ent.n_written, dtype=ent.dtype)
+        # the peer's first token emits verbatim — identity with the
+        # colocated engine is by construction, and the stream's SSE
+        # starts here, not after a local re-prefill
+        self._emit(req, ent.first_tok,
+                   logprob=float(ent.handoff.get("first_lp", 0.0)))
+        return True
+
     def _spill_cold_prefixes(self, n_pages_needed: int,
                              keep_pid=None) -> int:
         """Spill least-recently-hit COLD prefixes (every page at
@@ -3795,11 +4037,18 @@ class InferenceEngine:
         if self._faults is not None:
             self._faults.check("engine.prefill", step=self.stats.steps,
                                n_tokens=len(ids))
+        # shipped-prefill adoption (disaggregated decode host): a
+        # staged shipment replaces BOTH the prefix match and the local
+        # compute — the peer's pages hold the whole prompt, so the row
+        # allocates unshared. PEEK only here: the entry must survive a
+        # pool-exhausted requeue; it pops after the row exists.
+        with self._rid_lock:
+            adopt = self._adopt_store.get(rid)
         # match BEFORE page admission: a paged prefix hit changes the
         # allocation itself (suffix + budget pages only, prefix pages
         # mapped shared)
         hit = (self._match_and_validate_prefix(ids)
-               if self._prefix_capable else None)
+               if self._prefix_capable and adopt is None else None)
         if self.paged and not self._alloc_slot_pages(req, slot, hit):
             return None   # pool exhausted: requeued (or failed) inside
         if self.paged:
@@ -3811,6 +4060,15 @@ class InferenceEngine:
             # recompute-resume would re-derive was already emitted)
             req._kv_restored = False
             return None
+        if adopt is not None:
+            with self._rid_lock:
+                self._adopt_store.pop(rid, None)
+            if not req.out_tokens \
+                    and self._adopt_install(req, slot, adopt):
+                return None   # pages installed, first token emitted
+            # refused (stale epoch / geometry / injected fault): fall
+            # through — whole-prompt prefill rewrites the row's pages
+            # and scales, the documented degradation
         n_top = self._n_top_for([slot])
         if hit is not None:
             hit_pid, entry = hit
@@ -4006,8 +4264,13 @@ class InferenceEngine:
         if self._faults is not None:
             self._faults.check("engine.prefill", step=self.stats.steps,
                                n_tokens=len(ids))
+        # shipped-prefill adoption: PEEK before the prefix match (an
+        # adopted row allocates unshared), pop after the row exists —
+        # see _do_prefill for the full discipline
+        with self._rid_lock:
+            adopt = self._adopt_store.get(rid)
         hit = (self._match_and_validate_prefix(ids)
-               if self._prefix_capable else None)
+               if self._prefix_capable and adopt is None else None)
         if self.paged and not self._alloc_slot_pages(req, slot, hit):
             return   # pool exhausted: requeued (or failed) inside
         hit = req._effective_hit       # spilled-prefix restore failure
@@ -4017,6 +4280,15 @@ class InferenceEngine:
             # step as a chunk row
             req._kv_restored = False
             return
+        if adopt is not None:
+            with self._rid_lock:
+                self._adopt_store.pop(rid, None)
+            if not req.out_tokens \
+                    and self._adopt_install(req, slot, adopt):
+                # the slot resumes as a DECODE row from the shipped
+                # frontier — it must not also ride as a chunk row
+                return
+            # refused: fall through to local chunked prefill
         off = 0
         if hit is not None:
             # shared prefix pages already mapped at the row head
@@ -4935,6 +5207,11 @@ class InferenceEngine:
                     log.exception("stream callback failed rid=%d", req.rid)
         if finished:
             req.finish_t = now
+            if req.ship_sink is not None:
+                # disaggregated prefill host: fetch the slot's written
+                # pages BEFORE release frees them — the sink queues the
+                # shipment for the transfer channel's writer thread
+                self._capture_shipment(req)
             self._slot_req[req.slot] = None
             self._release_slot_pages(req.slot)
             self._requests.pop(req.rid, None)
@@ -4985,6 +5262,8 @@ class InferenceEngine:
         for rid, req in doomed:
             req.error = err
             self.scheduler.cancel(rid)
+            with self._rid_lock:
+                self._adopt_store.pop(rid, None)
             if self._host_tier is not None:
                 self._host_tier.drop(("victim", rid))
             if req.slot >= 0:

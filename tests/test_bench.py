@@ -258,6 +258,31 @@ def test_kv_tier_smoke_reports_capacity_win():
         assert result[f"kv_restores_{tag}"] > 0
 
 
+@pytest.mark.slow  # five engine builds over loopback -> slow lane
+def test_disagg_smoke_tier_ships_pages_and_stays_identical():
+    """The --disagg acceptance contract: pages actually crossed the
+    wire in both split phases (a run where every request silently
+    degraded to local prefill benches nothing), the f32 split streams
+    came back token-identical to colocated (the handoff contract), and
+    an int8 shipment moved well under 0.3x the f32 bytes for the same
+    prefix (int8 pages are 1/4 the value bytes + two small f32 scale
+    sidecars — the serving-economics reason to quantize the transfer
+    unit)."""
+    result = _run_tier("disagg_tiny")
+    assert result["unit"] == "x" and 0 < result["value"] < 0.3
+    assert result["disagg_token_identical_f32"] is True
+    for tag in ("f32", "int8"):
+        assert result[f"disagg_pages_shipped_{tag}"] > 0
+        assert result[f"disagg_shipments_{tag}"] > 0
+        assert result[f"disagg_adopted_{tag}"] > 0
+        assert result[f"disagg_degraded_{tag}"] == 0
+        assert result[f"disagg_tok_s_{tag}"] > 0
+        assert result[f"disagg_ttft_p99_ms_{tag}"] > 0
+    assert (result["disagg_ship_bytes_int8"]
+            < 0.3 * result["disagg_ship_bytes_f32"])
+    assert result["disagg_tok_s_colocated_f32"] > 0
+
+
 @pytest.mark.slow  # two engine phases + a live hot switch -> slow lane
 def test_autotune_smoke_tier_switches_without_losing_streams():
     """The --autotune tier's acceptance contract: the mid-run offered-

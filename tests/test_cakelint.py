@@ -703,3 +703,30 @@ def test_issue18_optional_planes_declared():
     rep = _analyze([ROOT / "cake_tpu" / "router" / "discovery.py"])
     assert rep["findings"] == [], [f.message for f in rep["findings"]]
     assert rep["sites"]["guards"] > 0, rep["sites"]
+
+
+def test_issue19_transfer_plane_declared():
+    """The ISSUE 19 satellite: the transfer channel's cross-thread
+    state is DECLARED single-writer — each plane's pending-shipment
+    map names its lock in ENGINE_THREAD_ATTRS, the handler-thread
+    entry points are listed, and the optional event bus sits in
+    OPTIONAL_PLANES — so cakelint's thread-affinity and guard checkers
+    police the disagg data plane (and the engine's own `_disagg` /
+    `_adopt_store` seams) from day one."""
+    from cake_tpu.kv.transfer import DisaggDecodePlane, DisaggPrefillPlane
+    from cake_tpu.serve.engine import InferenceEngine
+
+    assert DisaggPrefillPlane.ENGINE_THREAD_ATTRS == {
+        "_ship_pending": "_ship_lock"}
+    assert DisaggDecodePlane.ENGINE_THREAD_ATTRS == {
+        "_xfer_pending": "_xfer_lock"}
+    assert "request_prefill" in DisaggDecodePlane.HANDLER_THREAD_METHODS
+    for plane in (DisaggPrefillPlane, DisaggDecodePlane):
+        assert "_events" in plane.OPTIONAL_PLANES
+    assert "_disagg" in InferenceEngine.OPTIONAL_PLANES
+    assert InferenceEngine.ENGINE_THREAD_ATTRS["_adopt_store"] == "_rid_lock"
+    # the module that ships the channel is clean under the full rule
+    # set with its optional-plane guard sites provably exercised
+    rep = _analyze([ROOT / "cake_tpu" / "kv" / "transfer.py"])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"]["guards"] > 0, rep["sites"]

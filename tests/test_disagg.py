@@ -1,0 +1,239 @@
+"""Disaggregated prefill/decode acceptance (cake_tpu/kv/transfer.py).
+
+Two engines over loopback — the decode host is the front door, the
+prefill peer runs the prompt and ships its pool pages + the first
+token — and the handoff contract is TOKEN identity: every greedy
+stream served through the pair comes back identical to the same wave
+on one colocated engine at f32 KV (dense AND with a registered shared
+prefix), with both allocators conserving pages after retirement.
+Quantized pools ship their storage bytes: int8/int4 pairs stay
+token-identical to their colocated counterparts because the pages
+cross the wire bit-identical. Failure is first-class and NEVER wedges
+a stream: an injected kv.ship fault on the prefill host, an injected
+kv.adopt fault on the decode host, and a peer that is simply down all
+degrade to whole-prompt prefill on the decode host — still
+token-identical, pools still conserved.
+"""
+
+import contextlib
+import socket
+import time
+
+import pytest
+
+import jax.numpy as jnp
+
+T = 64
+PAGE = 16
+GEN = 10
+TOK = "test-disagg-token"
+
+P1 = [5] * 9
+P2 = [2, 9, 4, 7, 3]
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    import jax
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+def _mk(tiny_config, params, kv_dtype=None, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_pages", 16)
+    kw.setdefault("kv_page_size", PAGE)
+    if kv_dtype:
+        kw.setdefault("kv_dtype", kv_dtype)
+    else:
+        # f32 KV: greedy token equality must exercise the handoff,
+        # not bf16 tie-breaks (the test_faults idiom)
+        kw.setdefault("cache_dtype", jnp.float32)
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=T,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        **kw)
+
+
+@contextlib.contextmanager
+def _pair(tiny_config, params, kv_dtype=None, pre_kw=None, dec_kw=None):
+    """A started prefill+decode engine pair wired over loopback (the
+    prefill listener binds port 0; the channel token rides the engine
+    kwarg, no env var)."""
+    pre = _mk(tiny_config, params, kv_dtype, disagg="prefill",
+              disagg_peer="127.0.0.1:0", disagg_token=TOK,
+              **(pre_kw or {}))
+    pre.start()
+    try:
+        dec = _mk(tiny_config, params, kv_dtype, disagg="decode",
+                  disagg_peer=f"127.0.0.1:{pre._disagg.port}",
+                  disagg_token=TOK, disagg_timeout_s=300.0,
+                  **(dec_kw or {}))
+        dec.start()
+        try:
+            assert dec._disagg._connected.wait(15), \
+                "transfer channel never connected"
+            yield pre, dec
+        finally:
+            dec.stop()
+    finally:
+        pre.stop()
+
+
+def _wave(eng, prompts=(P1, P2), gen=GEN):
+    hs = [eng.submit(list(p), max_new_tokens=gen, temperature=0.0,
+                     repeat_penalty=1.0) for p in prompts]
+    assert all(h.wait(timeout=600) for h in hs), "wave timed out"
+    assert [h._req.error for h in hs] == [None] * len(hs)
+    return [list(h._req.out_tokens) for h in hs]
+
+
+def _conserved(eng, floor=0, timeout=5.0):
+    """Poll until the refcounted pool drains back to fully free (minus
+    ``floor`` pages pinned by e.g. a registered prefix)."""
+    want = eng.cache.n_pages - floor
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if eng._pager.free_pages == want:
+            return True
+        time.sleep(0.01)
+    return eng._pager.free_pages == want
+
+
+@pytest.fixture(scope="module")
+def colocated_f32(tiny_config, params):
+    eng = _mk(tiny_config, params)
+    with eng:
+        toks = _wave(eng)
+        assert _conserved(eng)
+    return toks
+
+
+# -- the handoff contract ----------------------------------------------------
+
+def test_dense_handoff_token_identical(tiny_config, params,
+                                       colocated_f32):
+    with _pair(tiny_config, params) as (pre, dec):
+        toks = _wave(dec)
+        assert toks == colocated_f32
+        # every request rode the wire: prefilled remotely, shipped,
+        # adopted at the shipped frontier — zero degradations
+        assert pre._disagg.stats["shipments"] == len(toks)
+        assert pre._disagg.stats["pages"] > 0
+        assert pre._disagg.stats["bytes"] > 0
+        assert dec.stats.kv_adopts == len(toks)
+        assert dec._disagg.stats["degraded"] == 0
+        assert pre.stats.kv_ships == len(toks)
+        # pages conserved on BOTH allocators after retirement
+        assert _conserved(pre)
+        assert _conserved(dec)
+
+
+def test_shared_prefix_handoff_token_identical(tiny_config, params):
+    prefix = [7] * PAGE
+    prompts = (prefix + [3, 1, 4], P1)
+
+    eng = _mk(tiny_config, params)
+    with eng:
+        eng.register_prefix(prefix)
+        clean = _wave(eng, prompts)
+        assert _conserved(eng, floor=1)
+
+    with _pair(tiny_config, params) as (pre, dec):
+        # the front door registers the prefix; an adopted shipment
+        # covers the WHOLE prompt, so adoption simply bypasses the
+        # prefix-hit path — identity must hold either way
+        dec.register_prefix(prefix)
+        toks = _wave(dec, prompts)
+        assert toks == clean
+        assert dec.stats.kv_adopts == 2
+        assert dec._disagg.stats["degraded"] == 0
+        assert _conserved(pre)
+        assert _conserved(dec, floor=1)
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+def test_quantized_pool_handoff(tiny_config, params, kv_dtype):
+    """Quantized pools ship their storage bytes (values + scale
+    sidecars) and stay token-identical to the same-dtype colocated
+    run — the pages crossed the wire bit-identical, so the decode
+    host's pool holds exactly what colocated prefill would have
+    written."""
+    eng = _mk(tiny_config, params, kv_dtype)
+    with eng:
+        clean = _wave(eng)
+
+    with _pair(tiny_config, params, kv_dtype) as (pre, dec):
+        toks = _wave(dec)
+        assert toks == clean
+        assert dec.stats.kv_adopts == 2
+        assert dec._disagg.stats["degraded"] == 0
+        assert pre._disagg.stats["bytes"] > 0
+        assert _conserved(pre)
+        assert _conserved(dec)
+
+
+# -- failure is first-class --------------------------------------------------
+
+def test_ship_fault_degrades_token_identical(tiny_config, params,
+                                             colocated_f32):
+    """An injected kv.ship fault on the prefill host drops the first
+    shipment: the decode host gets ship_fail, degrades that request to
+    whole-prompt LOCAL prefill, and the greedy stream still comes back
+    token-identical — the second request ships normally."""
+    with _pair(tiny_config, params,
+               pre_kw=dict(fault_plan="seed=5;kv.ship:nth=1:transient")
+               ) as (pre, dec):
+        toks = _wave(dec)
+        assert toks == colocated_f32
+        assert pre._faults.total == 1, "the planned ship fault never fired"
+        assert pre._disagg.stats["shipments"] == 1
+        assert pre._disagg.stats["failures"] == 1
+        assert dec._disagg.stats["degraded"] == 1
+        assert dec.stats.kv_adopts == 1
+        assert _conserved(pre)
+        assert _conserved(dec)
+
+
+def test_adopt_fault_degrades_token_identical(tiny_config, params,
+                                              colocated_f32):
+    """An injected kv.adopt fault on the decode host refuses the first
+    installed shipment at the adoption seam: the request falls through
+    to whole-prompt prefill (rewriting its freshly-allocated pages) —
+    token-identical, no wedge, no recovery storm."""
+    with _pair(tiny_config, params,
+               dec_kw=dict(fault_plan="seed=5;kv.adopt:nth=1:transient")
+               ) as (pre, dec):
+        toks = _wave(dec)
+        assert toks == colocated_f32
+        assert dec._faults.total == 1, "the planned adopt fault never fired"
+        assert pre._disagg.stats["shipments"] == 2
+        assert dec.stats.kv_adopts == 1
+        assert dec.stats.recoveries == 0, \
+            "an adoption refusal must degrade, not reset the engine"
+        assert _conserved(pre)
+        assert _conserved(dec)
+
+
+def test_peer_down_degrades_to_local_prefill(tiny_config, params,
+                                             colocated_f32):
+    """A decode host whose peer never answers serves every request
+    locally from the first submit — request_prefill refuses while the
+    channel is down, so nothing waits on the adopt timeout."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    dec = _mk(tiny_config, params, disagg="decode",
+              disagg_peer=f"127.0.0.1:{port}", disagg_token=TOK)
+    with dec:
+        toks = _wave(dec)
+        assert toks == colocated_f32
+        assert dec.stats.kv_adopts == 0
+        assert _conserved(dec)

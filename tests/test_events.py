@@ -104,14 +104,17 @@ def test_vocabulary_is_the_documented_set():
     # instead of rid=) + the sentinel's anomaly transitions (ISSUE 15)
     # + the action plane's audit record for what an anomaly CHANGED
     # (ISSUE 16) + fleet membership transitions at the front door
-    # (ISSUE 18's announce-driven discovery)
+    # (ISSUE 18's announce-driven discovery) + the disaggregated
+    # prefill/decode handoff's ship/adopt/degrade transitions
+    # (ISSUE 19's page transfer channel)
     assert set(EVENT_TYPES) == {
         "preempted", "kv_spill", "kv_restore", "prefix_hit",
         "recovered", "poisoned", "reconfigured", "shed",
         "fault_injected", "recompile", "resident_spilled",
         "affinity_miss", "spill_to_secondary", "failover_resume",
         "shed_by_router", "anomaly", "anomaly_action",
-        "replica_joined", "replica_departed", "replica_stale"}
+        "replica_joined", "replica_departed", "replica_stale",
+        "kv_shipped", "kv_adopted", "kv_ship_degraded"}
 
 
 # -- publishers outside the engine -------------------------------------------

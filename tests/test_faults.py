@@ -90,6 +90,9 @@ def test_parse_none_and_empty_mean_no_plan():
     ("engine.decode:nth=5:transient:match_len=96", "n_tokens"),
     ("pager.alloc:always:oom:match_len=4", "n_tokens"),
     ("journal.fsync:always:transient:match_len=4", "n_tokens"),
+    # the disagg seams fire outside prefill admission: no n_tokens
+    ("kv.ship:nth=1:transient:match_len=9", "n_tokens"),
+    ("kv.adopt:always:transient:match_len=9", "n_tokens"),
 ])
 def test_parse_rejects_malformed_rules(spec, frag):
     with pytest.raises(ValueError, match=frag):
@@ -253,6 +256,7 @@ def test_sites_frozen_and_documented():
     assert {"engine.step", "engine.prefill", "engine.decode",
             "engine.mixed", "control.publish", "control.recv",
             "host_tier.fetch", "host_tier.install", "pager.alloc",
+            "kv.ship", "kv.adopt",
             "journal.append", "journal.fsync",
             "journal.replay"} == set(SITES)
 

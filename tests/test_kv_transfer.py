@@ -1,0 +1,251 @@
+"""Page-channel units (cake_tpu/kv/transfer.py) — no engines, no JAX.
+
+The wire contracts the disaggregated handoff stands on: frames decode
+to exactly what was encoded (and refuse malformed payloads loudly),
+f32/int8/int4 pool slices round-trip BIT-identical through
+shipment_frames -> ShipmentAssembler (tobytes equality, not allclose —
+the decode host installs these bytes straight into its pool), a
+PageStream recv timeout keeps the partial frame buffered and the next
+call resumes the SAME frame, and every corruption the assembler can
+see — checksum mismatch, config-epoch drift between frames,
+out-of-order chunks, geometry that cannot describe a real pool slice
+(odd-page int4 nibble packing, n_written vs ceil(n_tokens/page_size))
+— refuses with ValueError so the caller degrades instead of adopting
+garbage.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+import cake_tpu.kv.transfer as transfer
+from cake_tpu.kv.transfer import (
+    MAX_FRAME_BYTES, PageStream, Shipment, ShipmentAssembler,
+    decode_frame, encode_frame, shipment_frames,
+    validate_shipment_header,
+)
+from cake_tpu.utils.wire import LEN
+
+
+# -- fixtures ----------------------------------------------------------------
+
+def _mk_ship(dtype: str = "float32", L: int = 2, n_pages: int = 3,
+             P: int = 4, KV: int = 2, hd: int = 8, epoch: int = 7):
+    """A shipment with the host_tier.fetch_pages array layout for each
+    pool flavor: (k, v) plain, (k_q, k_scale, v_q, v_scale) quantized
+    (scales per page per kv-head)."""
+    rng = np.random.default_rng(42)
+    if dtype == "int8":
+        arrays = tuple(
+            rng.integers(-128, 128, (L, n_pages, P, KV, hd)).astype(np.int8)
+            if i % 2 == 0 else
+            rng.standard_normal((L, n_pages, KV)).astype(np.float32)
+            for i in range(4))
+    elif dtype == "int4":
+        arrays = tuple(
+            rng.integers(0, 256,
+                         (L, n_pages, P // 2, KV, hd)).astype(np.uint8)
+            if i % 2 == 0 else
+            rng.standard_normal((L, n_pages, KV)).astype(np.float32)
+            for i in range(4))
+    else:
+        arrays = tuple(
+            rng.standard_normal((L, n_pages, P, KV, hd)).astype(dtype)
+            for _ in range(2))
+    n_tokens = (n_pages - 1) * P + 1   # ceil(n_tokens / P) == n_pages
+    return Shipment(
+        epoch=epoch, dtype=dtype, page_size=P, n_tokens=n_tokens,
+        n_written=n_pages, first_tok=5, pages=list(range(3, 3 + n_pages)),
+        arrays=arrays, handoff={"rid": 11, "first_lp": -0.25})
+
+
+def _reassemble(frames):
+    decoded = [decode_frame(f) for f in frames]
+    asm = ShipmentAssembler(decoded[0][0])
+    for header, blob in decoded[1:-1]:
+        asm.add_chunk(header, blob)
+    return asm.finish(decoded[-1][0])
+
+
+# -- frame encoding ----------------------------------------------------------
+
+def test_frame_roundtrip():
+    header = {"t": "ship_chunk", "tag": 3, "pages": [1, 2]}
+    blob = bytes(range(256))
+    h, b = decode_frame(encode_frame(header, blob))
+    assert h == header and b == blob
+    # control frames carry no blob
+    h, b = decode_frame(encode_frame({"t": "ship_end"}))
+    assert h == {"t": "ship_end"} and b == b""
+
+
+@pytest.mark.parametrize("payload", [
+    b"",                                     # shorter than header length
+    b"\x00\x00",
+    b"\xff\xff\xff\xff{}",                   # header length out of bounds
+    b"\x00\x00\x00\x05nope!",                # header not JSON
+    encode_frame({"x": 1})[:4] + b'{"x":1}',  # JSON but no type tag
+])
+def test_malformed_frames_refuse(payload):
+    with pytest.raises(ValueError):
+        decode_frame(payload)
+
+
+# -- shipment round trips ----------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "int8", "int4"])
+def test_shipment_bit_identical(dtype):
+    ship = _mk_ship(dtype)
+    out = _reassemble(list(shipment_frames(ship, tag=9)))
+    assert out.epoch == ship.epoch and out.dtype == ship.dtype
+    assert out.page_size == ship.page_size
+    assert out.n_tokens == ship.n_tokens
+    assert out.n_written == ship.n_written
+    assert out.first_tok == ship.first_tok
+    assert out.pages == ship.pages
+    assert out.handoff == ship.handoff
+    assert len(out.arrays) == len(ship.arrays)
+    for got, want in zip(out.arrays, ship.arrays):
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert got.tobytes() == want.tobytes()
+
+
+def test_multi_chunk_roundtrip(monkeypatch):
+    # shrink the chunk target so tiny arrays exercise the layer-range
+    # chunking + per-chunk crc path the real ~1 MiB frames use
+    monkeypatch.setattr(transfer, "CHUNK_BYTES", 64)
+    ship = _mk_ship("float32", L=4)
+    frames = list(shipment_frames(ship, tag=1))
+    begin, _ = decode_frame(frames[0])
+    assert begin["n_chunks"] == 4 and len(frames) == 6
+    out = _reassemble(frames)
+    for got, want in zip(out.arrays, ship.arrays):
+        assert got.tobytes() == want.tobytes()
+
+
+def test_payload_bytes_track_dtype():
+    f32, q8 = _mk_ship("float32"), _mk_ship("int8")
+    # int8 pages are 1/4 the value bytes + two small f32 scale sidecars
+    assert q8.payload_bytes < 0.3 * f32.payload_bytes
+
+
+# -- assembler refusals ------------------------------------------------------
+
+def test_checksum_mismatch_refused():
+    frames = [decode_frame(f) for f in shipment_frames(_mk_ship(), 2)]
+    asm = ShipmentAssembler(frames[0][0])
+    header, blob = frames[1]
+    corrupt = bytearray(blob)
+    corrupt[0] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        asm.add_chunk(header, bytes(corrupt))
+
+
+def test_config_epoch_mismatch_refused():
+    frames = [decode_frame(f) for f in shipment_frames(_mk_ship(), 2)]
+    asm = ShipmentAssembler(frames[0][0])
+    header, blob = frames[1]
+    stale = dict(header, epoch=header["epoch"] + 1)
+    with pytest.raises(ValueError, match="config-epoch mismatch"):
+        asm.add_chunk(stale, blob)
+
+
+def test_out_of_order_chunk_refused(monkeypatch):
+    monkeypatch.setattr(transfer, "CHUNK_BYTES", 64)
+    frames = [decode_frame(f) for f in shipment_frames(_mk_ship(L=4), 2)]
+    asm = ShipmentAssembler(frames[0][0])
+    with pytest.raises(ValueError, match="out of order"):
+        asm.add_chunk(*frames[2])   # seq 1 before seq 0
+
+
+def test_truncated_shipment_refused():
+    frames = [decode_frame(f) for f in shipment_frames(_mk_ship(), 2)]
+    asm = ShipmentAssembler(frames[0][0])
+    with pytest.raises(ValueError, match="ended after"):
+        asm.finish(frames[-1][0])   # finish with no chunks applied
+
+
+# -- geometry validation -----------------------------------------------------
+
+def _begin_header(ship):
+    return decode_frame(next(iter(shipment_frames(ship, 1))))[0]
+
+
+def test_int4_odd_page_size_refused():
+    h = dict(_begin_header(_mk_ship("int4")), page_size=5, n_tokens=9,
+             n_written=2)
+    with pytest.raises(ValueError, match="nibble-pack"):
+        validate_shipment_header(h)
+
+
+def test_written_page_count_must_cover_prompt():
+    h = dict(_begin_header(_mk_ship()), n_written=5)
+    with pytest.raises(ValueError, match="n_written"):
+        validate_shipment_header(h)
+
+
+def test_unknown_array_dtype_refused():
+    h = _begin_header(_mk_ship())
+    h = dict(h, arrays=[dict(h["arrays"][0], dtype="complex257")])
+    with pytest.raises(Exception):
+        validate_shipment_header(h)
+
+
+def test_page_id_list_must_match_geometry():
+    h = dict(_begin_header(_mk_ship()), pages=[1])
+    with pytest.raises(ValueError, match="page-id list"):
+        validate_shipment_header(h)
+
+
+# -- PageStream --------------------------------------------------------------
+
+def test_pagestream_partial_frame_timeout_resume():
+    a, b = socket.socketpair()
+    stream = PageStream(b)
+    try:
+        payload = encode_frame({"t": "x", "k": 1}, b"page-bytes")
+        framed = LEN.pack(len(payload)) + payload
+        # split mid-frame: the timeout keeps the partial buffer and the
+        # next recv resumes the SAME frame (the _rbuf discipline)
+        a.sendall(framed[:7])
+        assert stream.recv(timeout=0.05) is None
+        a.sendall(framed[7:])
+        assert stream.recv(timeout=1.0) == payload
+    finally:
+        stream.close()
+        a.close()
+
+
+def test_pagestream_burst_keeps_remainder_buffered():
+    a, b = socket.socketpair()
+    stream = PageStream(b)
+    try:
+        p1 = encode_frame({"t": "one"})
+        p2 = encode_frame({"t": "two"}, b"tail")
+        a.sendall(LEN.pack(len(p1)) + p1 + LEN.pack(len(p2)) + p2)
+        assert stream.recv(timeout=1.0) == p1
+        assert stream.recv(timeout=1.0) == p2
+    finally:
+        stream.close()
+        a.close()
+
+
+def test_pagestream_eof_and_oversize_are_fatal():
+    a, b = socket.socketpair()
+    stream = PageStream(b)
+    try:
+        a.sendall(LEN.pack(MAX_FRAME_BYTES + 1))
+        with pytest.raises(ValueError):
+            stream.recv(timeout=1.0)
+    finally:
+        stream.close()
+        a.close()
+    a, b = socket.socketpair()
+    stream = PageStream(b)
+    try:
+        a.close()
+        with pytest.raises(ConnectionError):
+            stream.recv(timeout=1.0)
+    finally:
+        stream.close()

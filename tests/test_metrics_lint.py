@@ -271,6 +271,24 @@ def test_fleet_wire_families_live_linted():
     assert errs == [], errs
 
 
+def test_disagg_transfer_families_live_linted():
+    """The ISSUE 19 tier-1 hook: the disaggregated page-channel
+    families (kv/transfer.py) are registered on import, carry real
+    help text and have README rows — `tools/lint_metrics.py --readme`
+    keeps gating them from here on."""
+    lm = _load()
+    import cake_tpu.kv.transfer  # noqa: F401 — cake_kv_ship_/_adopt_
+    from cake_tpu.obs import metrics as m
+    text = m.REGISTRY.render()
+    for fam in ("cake_kv_ship_total", "cake_kv_ship_bytes_total",
+                "cake_kv_ship_seconds", "cake_kv_adopt_total"):
+        assert any(line.startswith(f"# TYPE {fam} ")
+                   for line in text.splitlines()), fam
+    readme = (TOOLS.parent / "README.md").read_text()
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs
+
+
 def test_host_label_cardinality_capped_at_topology_size():
     """Federated families carry one host value per fleet host: more
     distinct values than --host-cap is a lint error (something is

@@ -75,10 +75,11 @@ HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 # metrics table row) — the obs/steps.py surface, the paged
 # prefix-sharing families (serve/engine.py cake_prefix_*), the SLO
 # scheduling families (cake_tpu/sched: preemption / shed / per-class
-# TTFT), the KV tiering families (cake_tpu/kv: quantized pool bytes +
-# host spill tier), and the fault-injection / crash-recovery families
-# (cake_tpu/faults + serve/engine recovery: injections, recovery
-# outcomes + latency, poison quarantines)
+# TTFT), the KV tiering + transfer families (cake_tpu/kv: quantized
+# pool bytes, host spill tier, disaggregated page shipments —
+# cake_kv_ship_* / cake_kv_adopt_*), and the fault-injection /
+# crash-recovery families (cake_tpu/faults + serve/engine recovery:
+# injections, recovery outcomes + latency, poison quarantines)
 DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        "cake_device_", "cake_prefix_", "cake_sched_",
                        "cake_shed_", "cake_preemptions_", "cake_mixed_",
