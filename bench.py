@@ -114,6 +114,20 @@ SPEC_TIERS = [
                              gamma=4, quant="int8")),
 ]
 
+# Paged speculative decoding tiers (bench.py --spec-paged): spec as a
+# row KIND of the paged engine (cake_tpu/spec) — the tier pins greedy
+# spec-paged output token-identical to plain greedy paged decode, with
+# acceptance > 0 and > 1 token emitted per round. draft_seed=0 shares
+# the target's init (a self-draft), making acceptance deterministically
+# full: the tier verifies the round/paging MECHANICS; the dense --spec
+# tier owns the random-weight acceptance-floor measurement.
+SPEC_PAGED_TIERS = {
+    "spec_paged_1b": dict(model="1b", quant=False, max_seq=512,
+                          slots=4, kv_pages=64, kv_page_size=64,
+                          prompt_len=64, gen_tokens=48, draft="1b",
+                          draft_seed=0, gamma=3),
+}
+
 # Paged-decode microbench tiers (bench.py --paged-attn fold|pallas):
 # aggregate decode tok/s through a --kv-pages engine, isolating the
 # paged-attention kernel choice — the fold-vs-pallas delta is the
@@ -400,6 +414,10 @@ SMOKE_TIERS = {
     "engine_spec_tiny": dict(model="tiny", quant=False, max_seq=256,
                              slots=2, prompt_len=16, gen_tokens=8,
                              draft="tiny", gamma=3),
+    "spec_paged_tiny": dict(model="tiny", quant=False, max_seq=256,
+                            slots=2, kv_pages=96, kv_page_size=8,
+                            prompt_len=16, gen_tokens=24,
+                            draft="tiny", draft_seed=0, gamma=3),
     # steps_b - steps_a must dwarf timing noise: with a tiny unet the
     # fixed CLIP/VAE/PNG overhead dominates a 2-step delta
     "sd_tiny": dict(version="tiny", steps_a=2, steps_b=12),
@@ -665,6 +683,108 @@ def run_engine_tier(name: str, model: str, quant, max_seq: int,
         log(f"spec: acceptance {engine.stats.spec_acceptance:.3f} "
             f"(gamma={gamma}, random-weight floor)")
     return out
+
+
+def run_spec_paged_tier(name: str, model: str, quant, max_seq: int,
+                        slots: int, kv_pages: int, kv_page_size: int,
+                        prompt_len: int = 16, gen_tokens: int = 24,
+                        draft: str = "tiny", draft_seed: int = 0,
+                        gamma: int = 3) -> dict:
+    """Paged speculative decoding smoke (cake_tpu/spec): the same
+    greedy prompts through a plain --kv-pages engine and a --spec-draft
+    engine must emit IDENTICAL tokens, with acceptance > 0, more than
+    one token per round, and the page pool fully conserved at the end
+    (free_pages == n_pages once every stream retired). Failures raise
+    — the orchestrator reports the tier failed rather than printing a
+    plausible-looking number for a broken mechanism."""
+    from functools import partial
+
+    import jax
+
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}/{dev.device_kind}")
+    cfg = make_config(model)
+    init, _ = _init_fn(quant)
+    params = jax.jit(partial(init, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    d_cfg = make_config(draft)
+    if draft == model and draft_seed == 0 and not quant:
+        d_params = params   # self-draft: share the tree, full acceptance
+    else:
+        d_init, _ = _init_fn(False)   # the draft stays unquantized
+        d_params = jax.jit(partial(d_init, d_cfg))(
+            jax.random.PRNGKey(draft_seed))
+        jax.block_until_ready(d_params)
+
+    prompts = [list(range(3 + i, 3 + i + prompt_len))
+               for i in range(slots)]
+    common = dict(max_slots=slots, max_seq_len=max_seq,
+                  sampling=SamplingConfig(temperature=0.0,
+                                          repeat_penalty=1.0),
+                  kv_pages=kv_pages, kv_page_size=kv_page_size)
+
+    def drive(spec: bool):
+        kw = (dict(spec_draft_params=d_params, spec_draft_config=d_cfg,
+                   spec_gamma=gamma) if spec else {})
+        eng = InferenceEngine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                              **common, **kw)
+        with eng:
+            t0 = time.perf_counter()
+            hs = [eng.submit(p, max_new_tokens=gen_tokens)
+                  for p in prompts]
+            assert all(h.wait(timeout=900) for h in hs), \
+                f"{'spec' if spec else 'plain'} request timed out"
+            wall = time.perf_counter() - t0
+            outs = [list(h._req.out_tokens) for h in hs]
+            stats = eng.stats
+            pool = (eng._pager.free_pages, eng._pager.live_pages,
+                    eng._pager.n_pages)
+        return outs, stats, wall, pool
+
+    plain_out, _stats, plain_wall, _pool = drive(False)
+    spec_out, stats, spec_wall, (free, live, n_pages) = drive(True)
+
+    rounds = stats.spec_proposed // max(gamma, 1)
+    acceptance = (stats.spec_accepted / stats.spec_proposed
+                  if stats.spec_proposed else 0.0)
+    tokens_per_round = ((stats.spec_accepted + rounds) / rounds
+                        if rounds else 0.0)
+    log(f"spec-paged: {rounds} rounds, acceptance {acceptance:.3f}, "
+        f"{tokens_per_round:.2f} tok/round; wall {spec_wall:.2f}s vs "
+        f"plain {plain_wall:.2f}s; pool free={free} live={live} "
+        f"n={n_pages}")
+    if plain_out != spec_out:
+        raise AssertionError(
+            f"greedy spec-paged output diverged from plain paged "
+            f"decode: {spec_out} != {plain_out}")
+    if not acceptance > 0:
+        raise AssertionError("spec-paged acceptance was 0 with a "
+                             "self-draft (verify/draft misalignment)")
+    if not tokens_per_round > 1:
+        raise AssertionError(
+            f"spec-paged emitted {tokens_per_round:.2f} <= 1 tokens "
+            "per round (speculation paid nothing)")
+    if free != n_pages or live != 0:
+        raise AssertionError(
+            f"page pool not conserved after retirement: free={free} "
+            f"live={live} n={n_pages}")
+    return {
+        "metric": f"{name}_spec_paged_tok_per_round",
+        "value": round(tokens_per_round, 3),
+        "unit": "tokens/round",
+        "vs_baseline": 0.0,
+        "spec_acceptance": round(acceptance, 4),
+        "spec_rounds": rounds,
+        "spec_gamma": gamma,
+        "identical_to_plain": True,
+        "spec_wall_s": round(spec_wall, 3),
+        "plain_wall_s": round(plain_wall, 3),
+        "pool_conserved": True,
+    }
 
 
 def run_paged_tier(name: str, model: str, quant, max_seq: int,
@@ -2828,6 +2948,9 @@ def tier_main():
     elif name in dict(SD_TIERS) or name == "sd_tiny":
         kwargs = {**dict(SD_TIERS), **SMOKE_TIERS}[name]
         result = run_sd_tier(name, **kwargs)
+    elif name in SPEC_PAGED_TIERS or name == "spec_paged_tiny":
+        kwargs = {**SPEC_PAGED_TIERS, **SMOKE_TIERS}[name]
+        result = run_spec_paged_tier(name, **kwargs)
     elif name in dict(SPEC_TIERS) or name == "spec_tiny":
         kwargs = {**dict(SPEC_TIERS), **SMOKE_TIERS}[name]
         result = run_spec_tier(name, **kwargs)
@@ -3104,6 +3227,17 @@ def _router_main() -> int:
         fail_error="router aggregate-goodput tier failed")
 
 
+def _spec_paged_main() -> int:
+    """`bench.py --spec-paged`: the paged speculative decoding smoke —
+    one JSON line pinning greedy spec-paged output token-identical to
+    plain greedy paged decode, acceptance > 0, tokens/round > 1, and
+    full page-pool conservation. CPU-fallback rules match main()."""
+    return _single_tier_main(
+        "spec_paged_tok_per_round", "tokens/round",
+        cpu_tier="spec_paged_tiny", tpu_tier="spec_paged_1b",
+        fail_error="paged speculative smoke tier failed")
+
+
 def _paged_prefix_main() -> int:
     """`bench.py --paged-prefix`: the paged prefix-sharing tier — one
     JSON line with suffix-only vs whole-prompt TTFT and pages_shared
@@ -3233,6 +3367,8 @@ if __name__ == "__main__":
         sys.exit(_router_main())
     elif "--paged-prefix" in sys.argv:
         sys.exit(_paged_prefix_main())
+    elif "--spec-paged" in sys.argv:
+        sys.exit(_spec_paged_main())
     elif "--paged-attn" in sys.argv:
         i = sys.argv.index("--paged-attn")
         arg = sys.argv[i + 1] if i + 1 < len(sys.argv) else ""

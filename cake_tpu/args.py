@@ -146,6 +146,13 @@ class Args:
     # verifies spec_gamma drafted tokens at once. Batch-1, single-device.
     draft_model: Optional[str] = None
     spec_gamma: int = 4
+    # PAGED speculative decoding (cake_tpu/spec): path to a small draft
+    # model whose KV rides a second paged pool behind the engine's one
+    # page allocator — spec becomes a row KIND of the mixed ragged step
+    # (many streams speculate concurrently) instead of the dense
+    # batch-engine above. Requires --kv-pages + f32/bf16 KV; shares
+    # --spec-gamma. Mutually exclusive with --draft-model.
+    spec_draft: Optional[str] = None
     # batch-1 CLI speculation: propose-verify rounds chained on device
     # per host fetch (spec_scan); the engine path batches across slots
     # instead and ignores this
@@ -442,6 +449,37 @@ class Args:
             # single source of truth for storage dtypes
             from cake_tpu.utils.devices import resolve_kv_dtype
             resolve_kv_dtype(self.kv_dtype)
+        if self.spec_draft is not None:
+            # paged speculative decoding (cake_tpu/spec): loud startup
+            # errors mirroring the engine's constructor checks, so a
+            # bad flag combination fails before the model loads
+            if self.draft_model is not None:
+                raise ValueError(
+                    "--spec-draft (paged spec rows) and --draft-model "
+                    "(the dense spec engine) are mutually exclusive")
+            if not self.kv_pages:
+                raise ValueError(
+                    "--spec-draft requires --kv-pages: paged "
+                    "speculative decoding shares the page allocator "
+                    "(use --draft-model for the dense spec engine)")
+            if self.kv_dtype in ("int8", "int4"):
+                raise ValueError(
+                    f"--spec-draft requires f32/bf16 KV pages, got "
+                    f"--kv-dtype {self.kv_dtype}: the draft pool has "
+                    "no quantized flavor yet (ROADMAP item 3)")
+            if self.spec_gamma < 1:
+                raise ValueError(
+                    f"--spec-gamma {self.spec_gamma} must be >= 1")
+            if self.disagg is not None:
+                raise ValueError(
+                    "--spec-draft is not supported with --disagg yet: "
+                    "a shipped prefill carries no draft-pool KV (the "
+                    "decode host would re-prefill every draft)")
+            if self.mixed_batch == "off":
+                raise ValueError(
+                    "--spec-draft requires the mixed ragged step "
+                    "(--mixed-batch auto/on): spec rows are a row "
+                    "kind of that step")
         if self.kv_host_pages is not None and self.kv_host_pages < 1:
             raise ValueError(
                 f"--kv-host-pages {self.kv_host_pages} must be >= 1")

@@ -34,3 +34,6 @@ from cake_tpu.autotune.search import (  # noqa: F401
 from cake_tpu.autotune.space import (  # noqa: F401
     EngineConfig, config_key, switch_guard, validate_config,
 )
+from cake_tpu.autotune.spec import (  # noqa: F401
+    SpecGammaTuner, SpecTunerConfig,
+)

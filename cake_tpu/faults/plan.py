@@ -58,6 +58,8 @@ SITES = frozenset({
     "journal.replay",     # startup journal replay (serve/journal.py)
     "kv.ship",            # disagg prefill host: page-shipment capture
     "kv.adopt",           # disagg decode host: shipped-page adoption
+    "spec.verify",        # paged speculative verify round (absorbed:
+                          # rows degrade to plain decode, never wedge)
 })
 
 TRIGGERS = ("nth", "step", "p", "always")

@@ -259,12 +259,52 @@ class Master:
             disagg=getattr(self.args, "disagg", None),
             disagg_peer=getattr(self.args, "disagg_peer", None),
             disagg_timeout_s=getattr(self.args, "disagg_timeout", 30.0),
+            **self._spec_kwargs(),
             **self._trace_kwargs(),
             **self._sched_kwargs(),
             **self._fault_kwargs(),
             **kwargs,
             **engine_kwargs,
         )
+
+    def _spec_kwargs(self) -> dict:
+        """Paged speculative decoding (cake_tpu/spec): load the draft
+        model behind --spec-draft and hand the engine its params +
+        config (the engine builds the paged draft pool itself, sized by
+        the target pool's page geometry). Config resolution mirrors
+        context._load_speculative; the draft stays unquantized
+        (--quant targets the big model — a paged draft is small by
+        construction)."""
+        d_dir = getattr(self.args, "spec_draft", None)
+        if not d_dir:
+            return {}
+        import dataclasses
+        import os
+
+        from cake_tpu.context import _resolve_flash
+        from cake_tpu.models import load_text_params
+        from cake_tpu.models.llama.config import LlamaConfig, load_config
+        from cake_tpu.utils.devices import resolve_dtype
+        g = self.llm
+        if os.path.exists(os.path.join(d_dir, "config.json")):
+            d_cfg = load_config(d_dir)
+        else:
+            d_cfg = LlamaConfig.tiny()
+        d_cfg = dataclasses.replace(
+            d_cfg, use_flash_attention=_resolve_flash(self.args))
+        if d_cfg.vocab_size != g.config.vocab_size:
+            raise ValueError(
+                f"spec draft vocab {d_cfg.vocab_size} != target vocab "
+                f"{g.config.vocab_size}: the verify pass scores draft "
+                "token ids directly, so the models must share a "
+                "tokenizer")
+        d_params = load_text_params(d_cfg, d_dir,
+                                    resolve_dtype(self.args.dtype))
+        log.info("paged speculative serving: gamma=%d draft=%s",
+                 self.args.spec_gamma, d_dir)
+        return dict(spec_draft_params=d_params,
+                    spec_draft_config=d_cfg,
+                    spec_gamma=self.args.spec_gamma)
 
     def _trace_kwargs(self) -> dict:
         """Request-lifecycle tracing + step-telemetry + event-bus +

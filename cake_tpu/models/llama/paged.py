@@ -913,6 +913,32 @@ def run_blocks_mixed_paged(blocks, x, cache: PagedKVCache, pos, q_len,
     return x, cache._replace(k=k_new, v=v_new)
 
 
+def _mixed_windows_trunk(params, tokens, pos, q_len, active,
+                         cache: PagedKVCache, rope,
+                         config: LlamaConfig, attn: str):
+    """Shared body of the mixed ragged step: embed, per-row per-column
+    rope, run_blocks_mixed_paged, final norm. mixed_step_paged reads
+    one position from the normed hidden states, the speculative verify
+    (verify_window_paged) reads all of them — the window math exists
+    once so the two callers cannot drift."""
+    from cake_tpu.ops.norms import rms_norm
+
+    C = tokens.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    # per-row per-column rope rows: query i of row b sits at absolute
+    # position pos[b] + i (clamped into the table for padding columns
+    # past the window — their values are garbage nothing reads)
+    T = rope.cos.shape[0]
+    pos_grid = jnp.minimum(pos[:, None] + jnp.arange(C)[None, :], T - 1)
+    rope_c = jnp.take(rope.cos, pos_grid, axis=0)     # [B, C, hd//2]
+    rope_s = jnp.take(rope.sin, pos_grid, axis=0)
+    x, cache = run_blocks_mixed_paged(params["blocks"], x, cache, pos,
+                                      q_len, active, rope_c, rope_s,
+                                      config, attn=attn)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    return x, cache
+
+
 @_partial(jax.jit, static_argnames=("config", "attn"),
           donate_argnames=("cache",))
 def mixed_step_paged(params, tokens, pos, q_len, active,
@@ -942,24 +968,33 @@ def mixed_step_paged(params, tokens, pos, q_len, active,
     the paged_attention_mixed impl ({fold,pallas}); fold is the
     bit-exact reference for the mixed step exactly as it is for decode.
     """
-    from cake_tpu.ops.norms import rms_norm
     from cake_tpu.ops.quant import qmatmul
 
-    B, C = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
-    # per-row per-column rope rows: query i of row b sits at absolute
-    # position pos[b] + i (clamped into the table for padding columns
-    # past the window — their values are garbage nothing reads)
-    T = rope.cos.shape[0]
-    pos_grid = jnp.minimum(pos[:, None] + jnp.arange(C)[None, :], T - 1)
-    rope_c = jnp.take(rope.cos, pos_grid, axis=0)     # [B, C, hd//2]
-    rope_s = jnp.take(rope.sin, pos_grid, axis=0)
-    x, cache = run_blocks_mixed_paged(params["blocks"], x, cache, pos,
-                                      q_len, active, rope_c, rope_s,
-                                      config, attn=attn)
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    B = tokens.shape[0]
+    x, cache = _mixed_windows_trunk(params, tokens, pos, q_len, active,
+                                    cache, rope, config, attn)
     last = jnp.take_along_axis(
         x, (jnp.maximum(q_len, 1) - 1).reshape(B, 1, 1).astype(jnp.int32),
         axis=1)[:, 0]
     logits = qmatmul(last, params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def verify_window_paged(params, tokens, pos, q_len, active,
+                        cache: PagedKVCache, rope,
+                        config: LlamaConfig, attn: str = "fold"):
+    """The speculative VERIFY pass over paged KV: the mixed ragged
+    step's exact window math (same trunk — write each row's window
+    into its pages, attend everything mapped through the table) but
+    with logits at EVERY window position [B, C, V], so the target
+    scores a row's whole [last_tok, d_0..d_{gamma-1}] burst in one
+    launch. A spec row carries (pos = round frontier, q_len = gamma+1);
+    an inactive row carries q_len = 0 and touches nothing. Un-jitted:
+    the paged spec round (cake_tpu/spec/round.py) calls it inside its
+    own jit."""
+    from cake_tpu.ops.quant import qmatmul
+
+    x, cache = _mixed_windows_trunk(params, tokens, pos, q_len, active,
+                                    cache, rope, config, attn)
+    logits = qmatmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, cache

@@ -77,57 +77,16 @@ def spec_step(t_params, d_params, t_cache: KVCache, d_cache: KVCache,
                        t_cfg, d_cfg, gamma, greedy)
 
 
-def _advance_row_keys(keys, advance_mask):
-    """Per-row PRNG split: returns (keys', subs [B, 2]) where keys'
-    advanced only for rows in advance_mask (idle slots and greedy rows
-    keep their stream untouched — concurrency must not change a
-    request's sampled tokens)."""
-    new_keys, subs = jax.vmap(jax.random.split, out_axes=1)(keys)
-    return jnp.where(advance_mask[:, None], new_keys, keys), subs
-
-
-def _greedy_accept(drafts, targets):
-    """Accepted-draft count per row under exact-match (greedy)
-    acceptance: the longest prefix where draft == target argmax."""
-    match = drafts == targets[:, : drafts.shape[1]]
-    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-
-
-def _rejection_accept(drafts, d_probs, t_probs, u, gamma: int):
-    """Leviathan accept/reject over a [B, gamma] draft burst, plus the
-    leftover-residual distribution at the first rejected position r —
-    norm(max(0, p_t - p_d)); at r == gamma (all accepted) the bonus
-    token samples from the target's own distribution.
-    Returns (n_acc [B], resid [B, V]). Shared verbatim by the batch-1
-    round (_spec_round) and the engine's batched round
-    (spec_round_batched) so the subtle acceptance arithmetic exists
-    exactly once."""
-    B = drafts.shape[0]
-    idx = drafts[..., None]                            # [B, gamma, 1]
-    p_t = jnp.take_along_axis(t_probs[:, :gamma], idx, axis=-1)[..., 0]
-    p_d = jnp.take_along_axis(d_probs, idx, axis=-1)[..., 0]
-    accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
-    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
-                    axis=1)
-    r = jnp.minimum(n_acc, gamma)
-    row = jnp.arange(B)
-    p_t_r = t_probs[row, r]                            # [B, V]
-    p_d_r = jnp.where((r < gamma)[:, None],
-                      d_probs[row, jnp.minimum(r, gamma - 1)], 0.0)
-    resid = jnp.maximum(p_t_r - p_d_r, 0.0)
-    resid = resid / jnp.maximum(jnp.sum(resid, -1, keepdims=True),
-                                1e-20)
-    return n_acc, resid
-
-
-def _assemble_sampled(drafts, correction, n_acc, gamma: int):
-    """Per-row output burst for the sampled path: accepted drafts, then
-    the correction/bonus token at position n_acc, tail padded with the
-    last draft (masked off by the caller's n_emit mask)."""
-    return jnp.where(jnp.arange(gamma + 1)[None] ==
-                     jnp.minimum(n_acc, gamma)[:, None],
-                     correction[:, None],
-                     jnp.concatenate([drafts, drafts[:, -1:]], axis=1))
+# The accept/resample arithmetic moved to cake_tpu/spec/accept.py so
+# the PAGED round (cake_tpu/spec/round.py) shares it verbatim with the
+# dense rounds below; the historical underscore names stay importable
+# here for the dense path's callers and tests.
+from cake_tpu.spec.accept import (  # noqa: E402
+    advance_row_keys as _advance_row_keys,
+    assemble_sampled as _assemble_sampled,
+    greedy_accept as _greedy_accept,
+    rejection_accept as _rejection_accept,
+)
 
 
 @partial(jax.jit,

@@ -94,6 +94,13 @@ EVENT_TYPES = (
     # shipment failed/expired and the request degraded to whole-prompt
     # prefill on the decode host (kv_ship_degraded)
     "kv_shipped", "kv_adopted", "kv_ship_degraded",
+    # paged speculative decoding (cake_tpu/spec): one batched
+    # draft+verify round's aggregate acceptance (spec_round, rid-less;
+    # fault=True marks an injected spec.verify round), and the degrade
+    # actions of the closed loop (spec_degraded: action="disabled"
+    # carries the stream's rid + reason, action="shrink_gamma" is the
+    # engine-wide tuner move)
+    "spec_round", "spec_degraded",
 )
 
 EVENTS_TOTAL = _m.counter(

@@ -25,8 +25,8 @@ from __future__ import annotations
 from typing import Optional
 
 from cake_tpu.sched.classes import (  # noqa: F401
-    CLASS_RANK, DEFAULT_PRIORITY, PRIORITY_CLASSES, ClassPolicy,
-    SchedConfig, validate_priority,
+    CLASS_RANK, DEFAULT_PRIORITY, PRIORITY_CLASSES, ROW_KINDS,
+    ClassPolicy, SchedConfig, partition_rows, validate_priority,
 )
 from cake_tpu.sched.shed import (  # noqa: F401
     ShedController, ShedDecision, ShedError,

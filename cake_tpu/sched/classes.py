@@ -25,6 +25,26 @@ PRIORITY_CLASSES: Tuple[str, ...] = ("interactive", "standard", "batch")
 CLASS_RANK: Dict[str, int] = {c: i for i, c in enumerate(PRIORITY_CLASSES)}
 DEFAULT_PRIORITY = "standard"
 
+# The row KINDS one engine iteration's plan can put on the device,
+# orthogonal to the priority classes above: every kind is admitted
+# under the same class policy (spec rows are ordinary decode rows to
+# the scheduler — only the engine's per-row partition decides whether
+# a decode row rides a speculative round this iteration). Step records
+# (cake_tpu/obs/steps.py) and the spec plane use this vocabulary.
+ROW_KINDS: Tuple[str, ...] = ("prefill", "decode", "spec")
+
+
+def partition_rows(plan, predicate):
+    """Split a plan's ``(rid, slot)`` rows by ``predicate(rid, slot)``
+    into (matching, rest), both order-preserving — the engine's row-
+    kind split (e.g. which decode rows ride this iteration's
+    speculative round) without re-ranking anything the scheduler
+    already ordered."""
+    hit, rest = [], []
+    for rid, slot in plan:
+        (hit if predicate(rid, slot) else rest).append((rid, slot))
+    return hit, rest
+
 
 def validate_priority(priority: Optional[str]) -> str:
     """Normalize a request priority: None -> the default class; an

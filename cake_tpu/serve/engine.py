@@ -377,7 +377,7 @@ class InferenceEngine:
     OPTIONAL_PLANES = ("_faults", "events", "_journal", "_shed",
                        "_control", "_host_tier", "_autotuner",
                        "telemetry", "sentinel", "_actions",
-                       "_postmortem", "_disagg")
+                       "_postmortem", "_disagg", "_specp")
     # the only legal nesting order; _rid_lock sits on the submit/emit
     # hot path, so nothing may block under it
     LOCK_ORDER = ("_switch_lock", "_rid_lock", "_ckpt_lock")
@@ -406,6 +406,8 @@ class InferenceEngine:
         draft_params=None,
         draft_config=None,
         spec_gamma: int = 4,
+        spec_draft_params=None,
+        spec_draft_config=None,
         kv_pages: Optional[int] = None,
         kv_page_size: int = 128,
         paged_attn: Optional[str] = None,
@@ -582,6 +584,41 @@ class InferenceEngine:
             # collapse; keep the caches aligned instead
             self._prefix_capable = False
             self.d_rope = RopeTables.create(draft_config, max_seq_len)
+        # PAGED speculative decoding (cake_tpu/spec): spec as a row
+        # KIND of the paged engine, not a separate engine — a draft
+        # model's KV lives in a second paged pool addressed by the SAME
+        # page allocator, streams opt in lazily per-row (incompatible
+        # sampling simply decodes plain), and acceptance truncates the
+        # speculative suffix pages back to the pool every round.
+        self._spec_paged = spec_draft_params is not None
+        self._specp = None
+        if self._spec_paged:
+            from cake_tpu.spec import SpecPlane
+            if self._spec:
+                raise ValueError(
+                    "--spec-draft (paged spec rows) and --draft-model "
+                    "(the dense spec engine) are mutually exclusive")
+            if kv_pages is None:
+                raise ValueError(
+                    "--spec-draft requires --kv-pages: paged "
+                    "speculative decoding shares the page allocator "
+                    "(use --draft-model for the dense spec engine)")
+            if kv_dtype in ("int8", "int4"):
+                raise ValueError(
+                    f"--spec-draft requires f32/bf16 KV pages, got "
+                    f"--kv-dtype {kv_dtype}: the draft pool has no "
+                    "quantized flavor yet (ROADMAP item 3)")
+            if spec_draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    "spec draft and target must share a vocabulary")
+            if spec_gamma < 1:
+                raise ValueError(
+                    f"spec_gamma must be >= 1, got {spec_gamma}")
+            from cake_tpu.autotune.spec import SpecGammaTuner
+            self._specp = SpecPlane(
+                spec_draft_params, spec_draft_config, spec_gamma,
+                rope=RopeTables.create(spec_draft_config, max_seq_len),
+                tuner=SpecGammaTuner(gamma=spec_gamma))
         # paged KV (round-5, the 32-slot HBM-thrash fix): KV lives in a
         # shared pool of kv_pages fixed-size pages; slots map position
         # ranges through a table and the page ALLOCATOR gates admission,
@@ -664,6 +701,11 @@ class InferenceEngine:
                 "--mixed-batch on requires --kv-pages: the mixed "
                 "ragged step dispatches over the paged pool")
         self._mixed = self.paged and mb != "off"
+        if self._spec_paged and not self._mixed:
+            raise ValueError(
+                "--spec-draft requires the mixed ragged step "
+                "(--mixed-batch auto/on): spec rows join the one-launch "
+                "mixed iteration, they have no phase-loop flavor")
         # slot -> in-flight prefill progress (req, remaining window
         # offsets); teardown paths clear entries via
         # _release_slot_pages so cancel/preempt/error cannot leave a
@@ -2650,6 +2692,22 @@ class InferenceEngine:
             log.info("kv host tier: %d pages (%.1f MiB capacity)",
                      kv_host_pages,
                      kv_host_pages * tier.page_bytes / 2**20)
+        # paged speculative decoding (cake_tpu/spec): the draft model's
+        # KV pages live in a SECOND pool with the target pool's page
+        # geometry, addressed by the SAME allocator — one page-id
+        # space, so draft pages debit the one budget the admission
+        # gate counts. The round fn rides the same static attn impl.
+        if self._specp is not None:
+            from cake_tpu.spec.round import spec_round_paged
+            self.d_cache = PagedKVCache.create(
+                self._specp.draft_config, self.max_slots, kv_pages,
+                kv_page_size, self.max_seq_len, dtype=pool_dtype)
+            self._spec_round_fn = partial(spec_round_paged, attn=impl)
+            log.info("paged spec: draft pool %d pages x %d tokens "
+                     "(%.2f GiB), gamma=%d",
+                     kv_pages, kv_page_size,
+                     self.d_cache.memory_bytes() / 2**30,
+                     self._specp.live_gamma)
 
     def _capture_cache_identity(self) -> None:
         """Record the cache's placement/dtype so post-error and
@@ -2673,12 +2731,17 @@ class InferenceEngine:
 
     def _reconfig_supported(self) -> bool:
         return (not self._custom_steps and not self.ring
-                and not self._spec and not self._multihost)
+                and not self._spec and not self._spec_paged
+                and not self._multihost)
 
     def _reconfig_refusal(self) -> str:
         if self._spec:
             return ("speculative serving has no hot-switch fold (the "
                     "draft cache cannot be rebuilt mid-round)")
+        if self._spec_paged:
+            return ("paged speculative serving has no hot-switch fold "
+                    "(the draft pool shares the page allocator a "
+                    "switch would swap wholesale)")
         if self.ring:
             return ("ring (sliding-window) caches own their layout; "
                     "a rebuilt ring cannot replay folded positions")
@@ -3282,6 +3345,16 @@ class InferenceEngine:
                 # spilled victims/prefixes belonged to the failed
                 # requests / cleared registry — stale shortcuts only
                 self._host_tier.clear()
+            if self._specp is not None:
+                # every stream's draft/suffix pages lived in the
+                # allocator just reset; drop the spec states and
+                # rebuild the draft pool (streams re-activate lazily
+                # after their recovery resubmit)
+                self._specp.spec_streams.clear()
+                self.d_cache = PagedKVCache.create(
+                    self._specp.draft_config, self.max_slots,
+                    self.cache.n_pages, self.cache.page_size,
+                    self.max_seq_len, dtype=self._pool_dtype)
             if self.kv_quant:
                 from cake_tpu.kv import (Int4PagedKVCache,
                                          QuantizedPagedKVCache)
@@ -3471,6 +3544,11 @@ class InferenceEngine:
         # a slot torn down mid-prefill (cancel / preempt / error) must
         # not ride the next mixed step as a ghost chunk row
         self._mixed_pending.pop(slot, None)
+        # spec teardown rides the SAME idempotent hook: the stream's
+        # draft pages and target suffix-extension pages go back with
+        # its base pages, whatever path tears the slot down (finish,
+        # cancel, preempt, error) — zero leaked suffix pages
+        self._release_spec_state(slot)
         pages = self._slot_pages.pop(slot, None)
         if pages:
             self._pager.release(pages)
@@ -3478,6 +3556,24 @@ class InferenceEngine:
         if n_shared:
             self._prefix_pages_shared -= n_shared
             _PREFIX_PAGES_SHARED.set(self._prefix_pages_shared)
+
+    def _release_spec_state(self, slot: int) -> None:
+        """Release a slot's speculative page bookkeeping (idempotent):
+        the draft row's pages and the target row's suffix-extension
+        pages return to the shared allocator. The device table rows
+        keep the stale ids until the next table_set_slot — the same
+        already-released-but-still-mapped window every slot teardown
+        has, harmless because inactive rows are neither written nor
+        read by callers."""
+        if self._specp is None:
+            return
+        st = self._specp.spec_streams.pop(slot, None)
+        if st is None:
+            return
+        if st.d_pages:
+            self._pager.release(st.d_pages)
+        if st.t_suffix_pages:
+            self._pager.release(st.t_suffix_pages)
 
     def _alloc_slot_pages(self, req: _Request, slot: int,
                           hit=None) -> bool:
@@ -3540,6 +3636,25 @@ class InferenceEngine:
         # never-written pages
         req._effective_hit = hit
         need = len(req.prompt_ids) - n_prefix + req.max_new_tokens
+        if self._specp is not None:
+            # spec admission gate: admit only when the pool can ALSO
+            # cover the stream's worst-case speculative pages — the
+            # draft row's whole-context pages (the draft pool shares
+            # no prefixes) plus the target row's gamma-token suffix
+            # overhang past the base allocation. Activation and
+            # per-round extension stay best-effort (a shortfall there
+            # degrades the row to plain decode), but admission counting
+            # the worst case keeps a pool of spec streams from
+            # admitting more residents than it can ever speculate for.
+            g = self._specp.live_gamma
+            base = len(req.prompt_ids) + req.max_new_tokens
+            cap = min(base + g, self.max_seq_len)
+            spec_extra = (self._pager.pages_for(cap)
+                          + max(self._pager.pages_for(cap)
+                                - self._pager.pages_for(base), 0))
+            if (self._pager.pages_for(need) + spec_extra
+                    > self._pager.free_pages):
+                return self._requeue_for_pages(req, slot, starved=True)
         pages = self._pager.alloc(need)
         if pages is None and self._host_tier is not None:
             # consult the host tier before refusing admission: COLD
@@ -4229,6 +4344,12 @@ class InferenceEngine:
                 # the device step (_mixed_dispatch re-validates per
                 # row; these phase-path programs do not)
                 decode_plan = self._live_decode_rows(decode_plan)
+            if decode_plan and self._specp is not None:
+                # spec rows ride one batched draft+verify round; rows
+                # the partition leaves behind (prefill frontier, page
+                # pressure, sampling options, window cap, degraded)
+                # fall through to the plain decode paths below
+                decode_plan = self._do_spec_paged(decode_plan)
             if decode_plan:
                 n = self._scan_steps_for(decode_plan)
                 if n > 1:
@@ -4799,6 +4920,346 @@ class InferenceEngine:
             except Exception:  # noqa: BLE001
                 log.exception("stream callback failed rid=%d", req.rid)
         req.done.set()
+
+    # -- paged speculative decoding (cake_tpu/spec) ---------------------------
+
+    @engine_thread_only
+    def _do_spec_paged(self, decode_plan):
+        """One batched draft+verify round over PAGED KV for this
+        iteration's spec-eligible decode rows; returns the rows the
+        round did NOT cover (the caller's plain decode paths take
+        them). Page discipline per row and round: extend BOTH table
+        rows to cover pos..pos+gamma before dispatch (spec_round_paged
+        writes gamma+1 positions in each pool; writes past the mapped
+        pages silently drop, which would zero an ACCEPTED position's
+        KV), then truncate back to the accepted frontier after the
+        fetch — `free_pages + live_pages == n_pages` holds again before
+        the method returns."""
+        if self._specp is None:
+            return decode_plan
+        from cake_tpu.sched import partition_rows
+        g = self._specp.live_gamma
+        spec_rows, plain = partition_rows(
+            decode_plan, lambda rid, slot: self._spec_row_ready(rid, slot, g))
+        if not spec_rows:
+            return plain
+        t0 = time.perf_counter()
+        plan = []
+        for rid, slot in spec_rows:
+            if self._spec_extend_rows(slot, g):
+                plan.append((self._slot_req[slot], slot))
+            else:
+                # pool pressure mid-flight: the row decodes plain this
+                # iteration and tries again when pages free up
+                plain.append((rid, slot))
+        if not plan:
+            self.stats.decode_time_s += time.perf_counter() - t0
+            return plain
+        # chaos site for the verify pass — the kv.ship failure
+        # discipline: an INJECTED verify fault is absorbed here
+        # (penalize the rows' acceptance signal, truncate their
+        # extensions, degrade repeat offenders, decode plain this
+        # iteration); organic dispatch errors below still propagate to
+        # the recovery path with the round's rows implicated
+        if self._faults is not None:
+            try:
+                self._faults.check("spec.verify", step=self.stats.steps)
+            except Exception as exc:  # noqa: BLE001 — injected faults
+                from cake_tpu.faults.plan import InjectedFault
+                if not isinstance(exc, InjectedFault):
+                    raise
+                self._spec_verify_failed(plan, g, exc)
+                self.stats.decode_time_s += time.perf_counter() - t0
+                return plain + [(req.rid, s) for req, s in plan]
+        self._implicated = tuple((req.rid, s) for req, s in plan)
+        sp = self._specp
+        active = np.zeros(self.max_slots, bool)
+        for _req, slot in plan:
+            active[slot] = True
+        last = jnp.asarray(self._last_tok[:, None], jnp.int32)
+        pos = jnp.asarray(np.minimum(self._pos, self.max_seq_len - 1),
+                          jnp.int32)
+        fargs = (self.params, sp.draft_params, self.cache, self.d_cache,
+                 last, pos, jnp.asarray(active), self._keys,
+                 jnp.asarray(self._temp), self.rope, sp.rope,
+                 self.config, sp.draft_config, g)
+        js = self._obs_jit("spec_round_paged", (g,),
+                           self._spec_round_fn, fargs)
+        t0d = time.perf_counter()
+        (out, n_emit, self.cache, self.d_cache,
+         self._keys) = self._spec_round_fn(*fargs)
+        disp = time.perf_counter() - t0d
+        js.finish(disp)
+        # ONE batched fetch for every row's round
+        t0f = time.perf_counter()
+        out_h, n_emit_h = jax.device_get((out, n_emit))
+        fetch = time.perf_counter() - t0f
+        round_tokens = proposed = accepted = 0
+        for req, slot in plan:
+            if req.done.is_set():
+                continue
+            n = int(n_emit_h[slot])
+            round_tokens += n
+            proposed += g
+            accepted += n - 1
+            toks = [int(t) for t in out_h[slot, :n]]
+            self.stats.spec_proposed += g
+            self.stats.spec_accepted += n - 1
+            pos0 = int(self._pos[slot])
+            self._last_tok[slot] = toks[-1]
+            self._steps[slot] += n
+            for j, tok in enumerate(toks):
+                # per-token position so _emit's cap check sees the
+                # value a single-step loop would have had
+                self._pos[slot] = pos0 + j + 1
+                self._emit(req, tok)
+                if req.done.is_set():
+                    break   # EOS / budget mid-round: drop the tail
+            # cache frontier for the next round: the round wrote n
+            # accepted positions regardless of the emission budget
+            self._pos[slot] = pos0 + n
+            # a finished row's _emit tail already tore its spec state
+            # down with the slot (zero leaked suffix pages); for live
+            # rows, fold the round into the stream's controller signal
+            # and give the unaccepted suffix pages back
+            st = sp.spec_streams.get(slot)
+            if st is not None and st.enabled:
+                st.verify_fails = 0
+                st.note_round(g, n - 1)
+                self._spec_truncate(slot)
+                from cake_tpu.spec.state import (
+                    STREAM_ACCEPT_FLOOR, STREAM_WARMUP_ROUNDS,
+                )
+                if (st.rounds >= STREAM_WARMUP_ROUNDS
+                        and (st.accept_ema or 0.0) < STREAM_ACCEPT_FLOOR):
+                    self._spec_disable(req, slot, "acceptance_collapse")
+        self.stats.steps += 1
+        sp.note_round(proposed, accepted, round_tokens, len(plan))
+        if self._specp.tuner is not None:
+            ng = self._specp.tuner.maybe_shrink()
+            if ng is not None and ng < self._specp.live_gamma:
+                from cake_tpu.spec.state import SPEC_DEGRADED
+                self._specp.live_gamma = ng
+                SPEC_DEGRADED.labels(action="shrink_gamma").inc()
+                log.warning("spec: acceptance EMA %.2f below tuner "
+                            "threshold — gamma shrunk to %d",
+                            self._specp.accept_ema or 0.0, ng)
+                if self.events is not None:
+                    self.events.publish(
+                        "spec_degraded", action="shrink_gamma",
+                        gamma=ng, accept_ema=self._specp.accept_ema)
+        if self.events is not None:
+            self.events.publish("spec_round", rows=len(plan),
+                                proposed=proposed, accepted=accepted,
+                                tokens=round_tokens, gamma=g)
+        self._record_step("spec", rows=len(plan), tokens=round_tokens,
+                          dispatch_s=disp, device_s=fetch,
+                          wall_s=disp + fetch, js=js,
+                          rids=[req.rid for req, _s in plan])
+        self.stats.decode_time_s += time.perf_counter() - t0
+        return plain
+
+    def _spec_row_ready(self, rid: int, slot: int, g: int) -> bool:
+        """Is this decode row riding THIS iteration's speculative
+        round? Temperature-only sampling (top-p / repetition-penalty /
+        top-logprobs rows replay exactly on the plain path — dense-spec
+        submit() rejects them, the paged engine just declines per row),
+        window room for a whole round, >= 1 emitted token (the round
+        contract wants last_tok's KV unwritten at the decode frontier),
+        and an enabled SpecState — activated lazily here, whatever path
+        brought the stream to its frontier (whole/chunked/prefix
+        prefill, preemption resume, recovery replay)."""
+        if self._specp is None:
+            return False
+        req = self._slot_req[slot]
+        if req is None or req.rid != rid or req.done.is_set():
+            return False
+        if not req.out_tokens:
+            return False
+        if req.top_p < 1.0 or req.repeat_penalty != 1.0 or req.want_top:
+            return False
+        if self._pos[slot] + g + 1 >= self.max_seq_len:
+            # too close to the window: the plain path finishes the
+            # stream at the cap (no dense-style _force_finish — the
+            # row loses speculation, not its tail tokens)
+            return False
+        st = self._specp.spec_streams.get(slot)
+        if st is not None and st.rid != req.rid:
+            # defensive: a slot reused without the teardown hook (not a
+            # known path) must not speculate against a stale draft row
+            self._release_spec_state(slot)
+            st = None
+        if st is None:
+            return self._spec_activate(req, slot)
+        return st.enabled
+
+    def _spec_activate(self, req: _Request, slot: int) -> bool:
+        """Opt a decoding stream into speculation: allocate the draft
+        row's context pages from the SHARED allocator and run one
+        whole-context draft prefill, leaving the draft pool with KV for
+        positions 0..pos-1 — exactly the round contract (the last
+        emitted token's KV unwritten in both pools). Best-effort: any
+        shortfall keeps the row on plain decode (False)."""
+        if self._specp is None:
+            return False
+        from cake_tpu.models.llama.paged import table_set_slot
+        pos = int(self._pos[slot])
+        ctx = (list(req.prompt_ids) + list(req.out_tokens))[:pos]
+        if len(ctx) != pos:
+            return False   # frontier/transcript mismatch: stay plain
+        d_pages = self._pager.alloc(len(ctx))
+        if d_pages is None:
+            return False   # pool pressure: retry on a later iteration
+        from cake_tpu.spec import SpecState
+        self._specp.spec_streams[slot] = SpecState(rid=req.rid,
+                                             d_pages=d_pages)
+        self.d_cache = self.d_cache._replace(
+            table=table_set_slot(self.d_cache.table, slot, d_pages))
+        bucket = bucket_length(len(ctx), self.max_seq_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(ctx)] = ctx
+        sp = self._specp
+        fargs = (sp.draft_params, jnp.asarray(toks),
+                 jnp.asarray([len(ctx)], jnp.int32), jnp.int32(slot),
+                 self.d_cache, sp.rope, sp.draft_config)
+        js = self._obs_jit("spec_draft_prefill", (bucket,),
+                           self._prefill_slot, fargs)
+        t0 = time.perf_counter()
+        _logits, self.d_cache = self._prefill_slot(*fargs)
+        js.finish(time.perf_counter() - t0)
+        self._last_jit = js
+        return True
+
+    def _spec_extend_rows(self, slot: int, g: int) -> bool:
+        """Pre-round page extension: both table rows must cover
+        positions pos..pos+gamma before dispatch. The draft row is one
+        list in its SpecState; the target row is the admission base
+        (the engine's `_slot_pages` + shared prefix, untouched here)
+        plus the state's suffix-extension pages. False = the pool
+        cannot cover the round; the row decodes plain this iteration
+        (whatever WAS extended stays until its post-round truncation
+        or teardown — conservation holds either way)."""
+        if self._specp is None:
+            return False
+        from cake_tpu.models.llama.paged import table_set_slot
+        st = self._specp.spec_streams[slot]
+        ps = self.cache.page_size
+        cover = int(self._pos[slot]) + g + 1
+        if cover > len(st.d_pages) * ps:
+            extra = self._pager.alloc(cover - len(st.d_pages) * ps)
+            if extra is None:
+                return False
+            st.d_pages = st.d_pages + extra
+            self.d_cache = self.d_cache._replace(
+                table=table_set_slot(self.d_cache.table, slot,
+                                     st.d_pages))
+        base = self._slot_row_pages(slot)
+        have = (len(base) + len(st.t_suffix_pages)) * ps
+        if cover > have:
+            extra = self._pager.alloc(cover - have)
+            if extra is None:
+                return False
+            st.t_suffix_pages = st.t_suffix_pages + extra
+            self.cache = self.cache._replace(
+                table=table_set_slot(self.cache.table, slot,
+                                     base + st.t_suffix_pages))
+        return True
+
+    def _slot_row_pages(self, slot: int) -> list:
+        """A slot's BASE target row (shared prefix pages + its own
+        admission pages, in the table order _alloc_slot_pages mapped) —
+        the part of the target row spec never owns."""
+        return list(self._slot_pages.get(slot, []))
+
+    def _spec_truncate(self, slot: int) -> None:
+        """Acceptance truncation: give back every speculative page past
+        the accepted frontier — the draft row shrinks to its context
+        coverage, the target row to whatever its base allocation does
+        not already cover — and remap the shrunk table rows. After this
+        the allocator invariant `free_pages + live_pages == n_pages`
+        holds with zero pages parked for rejected drafts."""
+        if self._specp is None:
+            return
+        st = self._specp.spec_streams.get(slot)
+        if st is None:
+            return
+        from cake_tpu.models.llama.paged import table_set_slot
+        need = self._pager.pages_for(int(self._pos[slot]))
+        keep = max(need, 1)     # a decoding row always keeps a page
+        if keep < len(st.d_pages):
+            self._pager.release(st.d_pages[keep:])
+            st.d_pages = st.d_pages[:keep]
+            self.d_cache = self.d_cache._replace(
+                table=table_set_slot(self.d_cache.table, slot,
+                                     st.d_pages))
+        base = self._slot_row_pages(slot)
+        keep_sfx = max(need - len(base), 0)
+        if keep_sfx < len(st.t_suffix_pages):
+            self._pager.release(st.t_suffix_pages[keep_sfx:])
+            st.t_suffix_pages = st.t_suffix_pages[:keep_sfx]
+            self.cache = self.cache._replace(
+                table=table_set_slot(self.cache.table, slot,
+                                     base + st.t_suffix_pages))
+
+    def _spec_disable(self, req: _Request, slot: int,
+                      reason: str) -> None:
+        """Per-stream degrade to plain decode — never wedge: release
+        every speculative page back to the pool, keep a disabled
+        tombstone so the stream is not re-activated, and publish the
+        degrade. The stream itself keeps decoding on the plain path
+        with its base pages untouched."""
+        if self._specp is None:
+            return
+        st = self._specp.spec_streams.get(slot)
+        if st is None or not st.enabled:
+            return
+        from cake_tpu.models.llama.paged import table_set_slot
+        from cake_tpu.spec.state import SPEC_DEGRADED
+        if st.d_pages:
+            self._pager.release(st.d_pages)
+            st.d_pages = []
+        if st.t_suffix_pages:
+            self._pager.release(st.t_suffix_pages)
+            st.t_suffix_pages = []
+            self.cache = self.cache._replace(
+                table=table_set_slot(self.cache.table, slot,
+                                     self._slot_row_pages(slot)))
+        st.enabled = False
+        SPEC_DEGRADED.labels(action="disabled").inc()
+        log.warning("spec: rid=%d degraded to plain decode (%s, "
+                    "accept_ema=%.2f after %d rounds)", req.rid, reason,
+                    st.accept_ema or 0.0, st.rounds)
+        if self.events is not None:
+            self.events.publish("spec_degraded", rid=req.rid,
+                                action="disabled", reason=reason,
+                                accept_ema=st.accept_ema,
+                                rounds=st.rounds)
+
+    def _spec_verify_failed(self, plan, g: int, exc) -> None:
+        """An injected spec.verify fault: charge a zero-acceptance
+        round to every planned row (the controller sees collapse, not
+        silence), truncate their pre-round extensions back, and disable
+        repeat offenders — the PR-19 kv.ship discipline: degrade, never
+        wedge, and the rows finish on the plain path either way."""
+        if self._specp is None:
+            return
+        from cake_tpu.spec.state import DISABLE_AFTER_FAILS
+        log.warning("spec.verify fault (%s): %d rows decode plain this "
+                    "iteration", exc, len(plan))
+        for req, slot in plan:
+            st = self._specp.spec_streams.get(slot)
+            if st is None or not st.enabled:
+                continue
+            st.verify_fails += 1
+            st.note_round(g, 0)
+            self._spec_truncate(slot)
+            if st.verify_fails >= DISABLE_AFTER_FAILS:
+                self._spec_disable(req, slot, "verify_faults")
+        self._specp.note_round(g * len(plan), 0, 0, len(plan))
+        if self.events is not None:
+            self.events.publish("spec_round", rows=len(plan),
+                                proposed=g * len(plan), accepted=0,
+                                tokens=0, gamma=g, fault=True)
 
     @engine_thread_only
     def _do_decode(self, decode_plan) -> None:

@@ -229,6 +229,22 @@ def test_spec_smoke_tier_reports_acceptance():
     assert result["spec_gamma"] == 4
 
 
+def test_spec_paged_smoke_tier_identical_and_conserved():
+    """FAST-LANE (ISSUE 20): the --spec-paged smoke pins the paged
+    speculative mechanics — greedy spec-paged serving token-identical
+    to plain greedy paged decode, self-draft acceptance > 0, more than
+    one emitted token per round, and a fully conserved page pool after
+    the wave (zero leaked draft/suffix pages)."""
+    result = _run_tier("spec_paged_tiny")
+    assert result["unit"] == "tokens/round"
+    assert result["value"] > 1
+    assert result["spec_acceptance"] > 0
+    assert result["spec_rounds"] > 0
+    assert result["spec_gamma"] == 3
+    assert result["identical_to_plain"] is True
+    assert result["pool_conserved"] is True
+
+
 @pytest.mark.slow  # three engine phases under load -> slow lane
 def test_kv_tier_smoke_reports_capacity_win():
     """The --kv-tier acceptance contract: at the SAME pool byte

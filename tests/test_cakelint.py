@@ -730,3 +730,29 @@ def test_issue19_transfer_plane_declared():
     rep = _analyze([ROOT / "cake_tpu" / "kv" / "transfer.py"])
     assert rep["findings"] == [], [f.message for f in rep["findings"]]
     assert rep["sites"]["guards"] > 0, rep["sites"]
+
+
+def test_issue20_spec_plane_declared():
+    """The ISSUE 20 satellite: the paged speculative plane is DECLARED
+    to cakelint — SpecState/EMA bookkeeping is engine-thread-only (no
+    handler entry points at all), the optional gamma tuner sits in
+    OPTIONAL_PLANES, and the engine registers `_specp` itself as an
+    optional plane so every spec deref outside __init__ must be
+    guard-dominated. The spec subtree + its tuner analyze clean under
+    the full rule set with guard sites provably exercised."""
+    from cake_tpu.serve.engine import InferenceEngine
+    from cake_tpu.spec import SpecPlane
+
+    assert set(SpecPlane.ENGINE_THREAD_ATTRS) == {
+        "spec_streams", "live_gamma", "accept_ema", "tokens_ema"}
+    assert all(lock is None
+               for lock in SpecPlane.ENGINE_THREAD_ATTRS.values())
+    assert SpecPlane.HANDLER_THREAD_METHODS == ()
+    assert "tuner" in SpecPlane.OPTIONAL_PLANES
+    assert "_specp" in InferenceEngine.OPTIONAL_PLANES
+    rep = _analyze([ROOT / "cake_tpu" / "spec" / "state.py",
+                    ROOT / "cake_tpu" / "spec" / "round.py",
+                    ROOT / "cake_tpu" / "spec" / "accept.py",
+                    ROOT / "cake_tpu" / "autotune" / "spec.py"])
+    assert rep["findings"] == [], [f.message for f in rep["findings"]]
+    assert rep["sites"]["guards"] > 0, rep["sites"]

@@ -106,7 +106,8 @@ def test_vocabulary_is_the_documented_set():
     # (ISSUE 16) + fleet membership transitions at the front door
     # (ISSUE 18's announce-driven discovery) + the disaggregated
     # prefill/decode handoff's ship/adopt/degrade transitions
-    # (ISSUE 19's page transfer channel)
+    # (ISSUE 19's page transfer channel) + paged speculative
+    # decoding's round/degrade records (ISSUE 20's cake_tpu/spec)
     assert set(EVENT_TYPES) == {
         "preempted", "kv_spill", "kv_restore", "prefix_hit",
         "recovered", "poisoned", "reconfigured", "shed",
@@ -114,7 +115,25 @@ def test_vocabulary_is_the_documented_set():
         "affinity_miss", "spill_to_secondary", "failover_resume",
         "shed_by_router", "anomaly", "anomaly_action",
         "replica_joined", "replica_departed", "replica_stale",
-        "kv_shipped", "kv_adopted", "kv_ship_degraded"}
+        "kv_shipped", "kv_adopted", "kv_ship_degraded",
+        "spec_round", "spec_degraded"}
+
+
+def test_spec_events_publish_with_typed_fields():
+    """ISSUE 20: the paged speculative vocabulary round-trips — a
+    rid-less aggregate spec_round and a per-stream spec_degraded
+    carrying its action/reason fields."""
+    bus = EventBus(capacity=8)
+    bus.publish("spec_round", rows=2, proposed=6, accepted=4,
+                tokens=6, gamma=3)
+    bus.publish("spec_degraded", rid=7, action="disabled",
+                reason="acceptance_collapse", accept_ema=0.05, rounds=9)
+    rounds = bus.dump(type="spec_round")
+    assert rounds and rounds[0]["proposed"] == 6
+    assert "rid" not in rounds[0]          # aggregate record, no rid
+    deg = bus.dump(type="spec_degraded")
+    assert deg and deg[0]["rid"] == 7
+    assert deg[0]["action"] == "disabled"
 
 
 # -- publishers outside the engine -------------------------------------------

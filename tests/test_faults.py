@@ -256,7 +256,7 @@ def test_sites_frozen_and_documented():
     assert {"engine.step", "engine.prefill", "engine.decode",
             "engine.mixed", "control.publish", "control.recv",
             "host_tier.fetch", "host_tier.install", "pager.alloc",
-            "kv.ship", "kv.adopt",
+            "kv.ship", "kv.adopt", "spec.verify",
             "journal.append", "journal.fsync",
             "journal.replay"} == set(SITES)
 

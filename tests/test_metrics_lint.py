@@ -289,6 +289,24 @@ def test_disagg_transfer_families_live_linted():
     assert errs == [], errs
 
 
+def test_spec_families_live_linted():
+    """The ISSUE 20 tier-1 hook: the paged speculative families
+    (cake_tpu/spec/state.py) are registered on import, carry real help
+    text and have README rows — `tools/lint_metrics.py --readme` keeps
+    gating them from here on."""
+    lm = _load()
+    import cake_tpu.spec.state  # noqa: F401 — cake_spec_*
+    from cake_tpu.obs import metrics as m
+    text = m.REGISTRY.render()
+    for fam in ("cake_spec_accept_ratio", "cake_spec_tokens_per_round",
+                "cake_spec_rounds_total", "cake_spec_degraded_total"):
+        assert any(line.startswith(f"# TYPE {fam} ")
+                   for line in text.splitlines()), fam
+    readme = (TOOLS.parent / "README.md").read_text()
+    errs = lm.lint_readme_coverage(text, readme)
+    assert errs == [], errs
+
+
 def test_host_label_cardinality_capped_at_topology_size():
     """Federated families carry one host value per fleet host: more
     distinct values than --host-cap is a lint error (something is

@@ -1,0 +1,272 @@
+"""Paged speculative decoding (cake_tpu/spec) as a ROW KIND of the
+paged engine.
+
+The acceptance bars from the issue, pinned:
+  * greedy spec-paged serving is token-identical to plain greedy paged
+    decode at f32 KV — dense prompts AND shared-prefix rows — for a
+    self-draft (near-full acceptance exercises the emit/truncate fast
+    path) and a mismatched draft (near-zero acceptance exercises the
+    resample + degrade path); verify is authoritative either way;
+  * the page allocator's `free + live == n_pages` invariant holds
+    after every wave, including waves where `spec.verify` faults force
+    whole rounds to reject — zero leaked draft or suffix pages;
+  * forced acceptance collapse (spec.verify:always) degrades each
+    stream to plain decode with a typed `spec_degraded` event — the
+    stream completes correct greedy tokens, never wedges;
+  * the gamma tuner narrows (never widens) with warmup/hold/cooldown
+    hysteresis, round-counted so this file stays deterministic.
+"""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.serve.errors import RecoveryConfig
+
+T = 64            # max_seq_len
+PAGE = 8
+PAGES = 32
+GAMMA = 3
+GEN = 16
+
+P1 = [5, 6, 7, 8, 9]
+P2 = [11, 12, 13]
+PREFIX = [7] * (2 * PAGE)           # page-granular shared head
+SUFFIXES = ([3, 9, 4], [8, 2, 6, 1])
+
+
+@pytest.fixture(scope="module")
+def params(tiny_config):
+    from cake_tpu.models.llama.params import init_params
+    return init_params(tiny_config, jax.random.PRNGKey(0),
+                       dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def mismatched_draft():
+    """A draft that shares nothing with the target but the vocabulary:
+    acceptance collapses organically (random agreement over 256 ids)."""
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.params import init_params
+    dcfg = LlamaConfig.tiny(num_hidden_layers=1)
+    return init_params(dcfg, jax.random.PRNGKey(42),
+                       dtype=jnp.float32), dcfg
+
+
+def _engine(tiny_config, params, **kw):
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_pages", PAGES)
+    kw.setdefault("kv_page_size", PAGE)
+    kw.setdefault("recovery_config",
+                  RecoveryConfig(backoff_base_s=0.01))
+    return InferenceEngine(
+        tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+        max_seq_len=T,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        # f32 KV: greedy equality must exercise accept/truncate, not
+        # bf16 tie-breaks (the PR 2 lesson)
+        cache_dtype=jnp.float32,
+        **kw)
+
+
+def _spec_kw(draft_params, draft_config, **kw):
+    kw.setdefault("spec_gamma", GAMMA)
+    return dict(spec_draft_params=draft_params,
+                spec_draft_config=draft_config, **kw)
+
+
+def _run_wave(eng, prompts=(P1, P2), gen=GEN, prefix=None):
+    with eng:
+        if prefix is not None:
+            eng.register_prefix(list(prefix))
+        hs = [eng.submit(list(p), max_new_tokens=gen, temperature=0.0,
+                         repeat_penalty=1.0) for p in prompts]
+        assert all(h.wait(timeout=600) for h in hs), "wave timed out"
+        assert all(h._req.error is None for h in hs)
+        return [list(h._req.out_tokens) for h in hs]
+
+
+def _pool_conserved(eng, registry_pages=0):
+    pg = eng._pager
+    assert pg.free_pages + pg.live_pages == pg.n_pages
+    assert pg.live_pages == registry_pages, (
+        f"leaked pages: live={pg.live_pages}, "
+        f"expected {registry_pages} (registry)")
+    # every SpecState retired with its slot — no draft/suffix residue
+    if eng._specp is not None:
+        assert not eng._specp.spec_streams
+
+
+@pytest.fixture(scope="module")
+def plain_dense(tiny_config, params):
+    return _run_wave(_engine(tiny_config, params))
+
+
+# -- greedy token identity -----------------------------------------------------
+
+
+def test_self_draft_token_identical_and_conserves_pool(
+        tiny_config, params, plain_dense):
+    """Self-draft (draft == target): near-full acceptance, so the
+    accepted-suffix emit + truncate path carries most tokens — and the
+    stream is still byte-identical to plain greedy decode."""
+    eng = _engine(tiny_config, params,
+                  **_spec_kw(params, tiny_config))
+    toks = _run_wave(eng)
+    assert toks == plain_dense
+    st = eng.stats
+    assert st.spec_proposed > 0, "spec rows never engaged"
+    assert st.spec_accepted > 0
+    # >1 token per round on average is the whole point
+    assert st.spec_accepted / max(st.spec_proposed, 1) > 0.5
+    _pool_conserved(eng)
+
+
+def test_mismatched_draft_token_identical_despite_collapse(
+        tiny_config, params, mismatched_draft, plain_dense):
+    """A useless draft costs throughput, never correctness: verify is
+    authoritative, rejected rounds emit the target's own resample, and
+    the collapsed streams degrade to plain decode rather than wedge."""
+    d_params, d_cfg = mismatched_draft
+    eng = _engine(tiny_config, params, **_spec_kw(d_params, d_cfg))
+    toks = _run_wave(eng)
+    assert toks == plain_dense
+    assert eng.stats.spec_proposed > 0
+    _pool_conserved(eng)
+
+
+def test_shared_prefix_token_identical(tiny_config, params):
+    """Spec rows compose with page-granular prefix sharing: the draft
+    pool prefills its own whole-context copy, the target row maps
+    registry pages + its suffix, and greedy output matches plain
+    shared-prefix serving token for token."""
+    prompts = [PREFIX + list(s) for s in SUFFIXES]
+    plain_eng = _engine(tiny_config, params)
+    want = _run_wave(plain_eng, prompts=prompts, prefix=PREFIX)
+    eng = _engine(tiny_config, params,
+                  **_spec_kw(params, tiny_config))
+    toks = _run_wave(eng, prompts=prompts, prefix=PREFIX)
+    assert toks == want
+    assert eng.stats.prefix_hits == len(prompts)
+    assert eng.stats.spec_proposed > 0, "prefix rows never engaged spec"
+    # only the registry's prefix pages stay live after the wave
+    _pool_conserved(eng, registry_pages=len(PREFIX) // PAGE)
+
+
+# -- page conservation under forced rejections --------------------------------
+
+
+def test_forced_rejections_leak_no_pages(tiny_config, params,
+                                         plain_dense):
+    """The regression bar from the issue: N rounds with spec.verify
+    faults forcing rejected rounds, then `free + live == n_pages` and
+    zero surviving SpecStates — the pre-round row extensions were all
+    truncated back."""
+    eng = _engine(tiny_config, params,
+                  fault_plan="seed=5;spec.verify:p=0.5:transient",
+                  **_spec_kw(params, tiny_config))
+    toks = _run_wave(eng)
+    assert eng._faults.total >= 1, "the planned faults never fired"
+    assert toks == plain_dense, "a faulted round corrupted the stream"
+    assert eng.stats.recoveries == 0, (
+        "injected spec.verify faults must be absorbed, not recovered")
+    _pool_conserved(eng)
+
+
+def test_verify_fault_storm_degrades_with_event(tiny_config, params,
+                                                plain_dense):
+    """spec.verify:always — every round faults, so each stream's
+    verify_fails budget trips and it degrades to plain decode with a
+    typed spec_degraded event; the wave still completes token-identical
+    and no stream is lost or wedged."""
+    from cake_tpu.spec.state import DISABLE_AFTER_FAILS
+    eng = _engine(tiny_config, params,
+                  fault_plan="seed=1;spec.verify:always:transient"
+                             ":times=12",
+                  **_spec_kw(params, tiny_config))
+    toks = _run_wave(eng)
+    assert toks == plain_dense
+    assert eng._faults.total >= DISABLE_AFTER_FAILS
+    deg = eng.events.dump(type="spec_degraded")
+    assert deg, "no spec_degraded event for the collapsed streams"
+    assert all(e["action"] == "disabled" for e in deg)
+    assert {e["reason"] for e in deg} == {"verify_faults"}
+    # every submitted stream degraded (both shared each faulted round)
+    assert {e["rid"] for e in deg} == {1, 2}
+    # the faulted rounds were still published (fault=True aggregates)
+    faulted = [e for e in eng.events.dump(type="spec_round")
+               if e.get("fault")]
+    assert len(faulted) >= DISABLE_AFTER_FAILS
+    assert all(e["accepted"] == 0 for e in faulted)
+    _pool_conserved(eng)
+
+
+# -- the closed loop: gamma tuner ---------------------------------------------
+
+
+def test_gamma_tuner_narrows_with_hysteresis():
+    from cake_tpu.autotune.spec import SpecGammaTuner, SpecTunerConfig
+    cfg = SpecTunerConfig(shrink_below=0.3, warmup_rounds=4, hold=2,
+                          cooldown_rounds=3)
+    t = SpecGammaTuner(8, cfg)
+    # warmup: even sustained collapse may not move gamma yet
+    for _ in range(3):
+        t.note_round(0.0)
+        assert t.maybe_shrink() is None
+    t.note_round(0.0)                      # round 4: warmup met, hold met
+    assert t.maybe_shrink() == 4
+    assert (t.gamma, t.shrinks) == (4, 1)
+    # cooldown: the next two rounds of collapse make no second move...
+    for _ in range(2):
+        t.note_round(0.0)
+        assert t.maybe_shrink() is None
+    # ...the streak keeps building through cooldown, so the round that
+    # retires it moves again
+    t.note_round(0.0)
+    assert t.maybe_shrink() == 2
+    # a healthy round resets the below-threshold streak
+    t.note_round(0.0)
+    t.note_round(0.0)
+    t.note_round(0.9)
+    t.note_round(0.0)
+    assert t.maybe_shrink() is None
+    # never below 1, and a gamma-1 tuner never moves
+    t2 = SpecGammaTuner(1, cfg)
+    for _ in range(10):
+        t2.note_round(0.0)
+    assert t2.maybe_shrink() is None
+    assert t2.gamma == 1
+
+
+def test_spec_paged_rejects_incompatible_flavors(tiny_config, params):
+    """Constructor refusals name their reason: quantized KV pools,
+    missing paging, and the dense spec engine are all incompatible."""
+    from cake_tpu.models.llama.generator import ByteTokenizer
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.serve.engine import InferenceEngine
+
+    def build(**kw):
+        base = dict(max_slots=2, max_seq_len=T,
+                    sampling=SamplingConfig(temperature=0.0,
+                                            repeat_penalty=1.0),
+                    spec_draft_params=params,
+                    spec_draft_config=tiny_config)
+        base.update(kw)
+        return InferenceEngine(
+            tiny_config, params, ByteTokenizer(tiny_config.vocab_size),
+            **base)
+
+    with pytest.raises(ValueError, match="paged"):
+        build()                                  # no kv_pages
+    with pytest.raises(ValueError, match="int8|quant"):
+        build(kv_pages=PAGES, kv_page_size=PAGE, kv_dtype="int8")
+    with pytest.raises(ValueError, match="gamma"):
+        build(kv_pages=PAGES, kv_page_size=PAGE, spec_gamma=0)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build(kv_pages=PAGES, kv_page_size=PAGE,
+              draft_params=params, draft_config=tiny_config)

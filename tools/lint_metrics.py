@@ -123,7 +123,11 @@ DOCUMENTED_PREFIXES = ("cake_step_", "cake_steps_", "cake_jit_",
                        # black-box postmortem bundles (obs/actions.py
                        # PostmortemSink): bundles written per trigger
                        # + best-effort write failures
-                       "cake_postmortem_")
+                       "cake_postmortem_",
+                       # paged speculative decoding (cake_tpu/spec):
+                       # acceptance / tokens-per-round EMAs, round
+                       # counter, degrade actions
+                       "cake_spec_")
 
 # label names that may NEVER appear on a metric series, whatever the
 # live count: per-request identity makes cardinality proportional to
